@@ -1,0 +1,48 @@
+"""matvec_mpi_multiplier_tpu — a TPU-native distributed matvec framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of the
+``yaroslav-i-am/MatVec_MPI_Multiplier`` reference (an MPI/C benchmark suite):
+three named sharding strategies for dense ``y = A @ x`` (rowwise, colwise,
+blockwise) over a TPU device mesh, a most-square mesh-factorization layer, the
+``matrix_<r>_<c>.txt`` data convention, the 100-repetition max-across-processes
+timing protocol with CSV metrics, and SpeedUp/Efficiency analysis.
+
+See SURVEY.md (repo root) for the reference blueprint and file:line citations.
+"""
+
+from __future__ import annotations
+
+from .models import (
+    BlockwiseStrategy,
+    ColwiseStrategy,
+    MatvecStrategy,
+    RowwiseStrategy,
+    STRATEGIES,
+    available_strategies,
+    get_strategy,
+)
+from .parallel.mesh import make_1d_mesh, make_mesh, mesh_grid_shape, most_square_factors
+from .utils import io
+from .utils.errors import ConfigError, DataFileError, MatvecError, ShardingError
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "MatvecStrategy",
+    "RowwiseStrategy",
+    "ColwiseStrategy",
+    "BlockwiseStrategy",
+    "STRATEGIES",
+    "get_strategy",
+    "available_strategies",
+    "make_mesh",
+    "make_1d_mesh",
+    "mesh_grid_shape",
+    "most_square_factors",
+    "io",
+    "MatvecError",
+    "ShardingError",
+    "DataFileError",
+    "ConfigError",
+    "__version__",
+]

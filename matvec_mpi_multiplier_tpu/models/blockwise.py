@@ -1,0 +1,79 @@
+"""Strategy P3 — blockwise: 2-D mesh sharding (SUMMA-style).
+
+Reference: ``src/multiplier_blockwise.c``. The process count is factored into
+the most-square grid ``(r, c)`` (``get_2_most_closest_multipliers``,
+``src/utils.c:26-37``); rank ``k`` at grid cell ``(k/c, k%c)`` owns the
+``(n_rows/r) × (n_cols/c)`` block ``(i, j)`` and x-segment ``j``
+(2-D blocks carved with ``MPI_Type_vector`` + ``MPI_Pack`` + tagged
+point-to-point sends, ``:17-141``). Compute is a plain local GEMV
+(``multiply_std_rowwise`` at ``:367`` — NOT the dead ``multiply_blockwise``
+at ``:214-255``, quirk Q1), yielding a length-``n_rows/r`` *partial* result
+per rank. The combine (``gather_local_results``, ``:144-210``) is a
+hand-rolled, root-serialized reduce-over-grid-columns +
+concatenate-over-grid-rows using ``MPI_ANY_SOURCE``.
+
+TPU-native formulation: a real 2-D mesh ``('rows', 'cols')``; A sharded over
+both axes, x over 'cols'; local GEMV; ``lax.psum`` over 'cols' replaces the
+root-serialized accumulation with a deterministic ICI collective, leaving y
+sharded over 'rows'. The optional all-gather over 'rows' completes the
+``MPI_Gather``-like concatenation. Constraints: ``n_rows % r == 0`` and
+``n_cols % c == 0`` — the *correct* guard (the reference only checked
+``(n_rows*n_cols) % p == 0`` and silently truncated, quirk Q3).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .base import MatvecStrategy
+from ..parallel.mesh import mesh_grid_shape
+from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
+from ..utils.errors import ShardingError, check_divisible
+
+
+class BlockwiseStrategy(MatvecStrategy):
+    name = "blockwise"
+
+    def __init__(
+        self,
+        row_axis: str = MESH_AXIS_ROWS,
+        col_axis: str = MESH_AXIS_COLS,
+    ):
+        self.row_axis = row_axis
+        self.col_axis = col_axis
+
+    def _check_mesh(self, mesh: Mesh) -> None:
+        if self.row_axis not in mesh.axis_names or self.col_axis not in mesh.axis_names:
+            raise ShardingError(
+                f"blockwise needs a 2-D mesh with axes "
+                f"({self.row_axis!r}, {self.col_axis!r}); got {mesh.axis_names}"
+            )
+
+    def specs(self, mesh: Mesh) -> tuple[P, P, P]:
+        self._check_mesh(mesh)
+        return (
+            P(self.row_axis, self.col_axis),
+            P(self.col_axis),
+            P(self.row_axis),
+        )
+
+    def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
+        col_axis = self.col_axis
+
+        def body(a_blk, x_seg):
+            # Partial y for this device's grid row (reference :367), then the
+            # reduce-over-grid-columns that gather_local_results hand-rolled
+            # through root (reference :144-210) as one psum over 'cols'.
+            partial = kernel(a_blk, x_seg)
+            return jax.lax.psum(partial, col_axis)
+
+        return body
+
+    def validate(self, n_rows: int, n_cols: int, mesh: Mesh) -> None:
+        self._check_mesh(mesh)
+        r, c = mesh_grid_shape(mesh)
+        check_divisible(n_rows, r, "n_rows", "mesh rows")
+        check_divisible(n_cols, c, "n_cols", "mesh cols")

@@ -1,0 +1,65 @@
+"""Strategy P2 — colwise: 1-D contraction-dimension sharding.
+
+Reference: ``src/multiplier_colwise.c``. Each rank owns ``n_cols/p`` columns
+and the matching x segment (strided column panels carved with
+``MPI_Type_vector`` + ``MPI_Pack`` + per-rank ``MPI_Send``, ``:15-84``; x via
+``MPI_Scatter``, ``:86-96``), scales columns by x in place and forms per-row
+partial sums (``multiply_colwise``, ``:105-129``), then sums full-length
+partial vectors to the root with ``MPI_Reduce(MPI_SUM)`` (``:124``) — the
+allreduce-bearing strategy, and the reference's only analog of
+sequence/context parallelism (sharding the reduced dimension, SURVEY.md §5.7).
+
+TPU-native formulation: shard A's column axis and x over the whole mesh;
+local partial GEMV; combine with ``lax.psum`` (replicated y, the
+``MPI_Reduce``-to-root analog) or ``lax.psum_scatter``
+(y row-sharded — the efficient form that never materializes p full-length
+partials). The reference's explicit strided-panel staging is free here: XLA
+layouts/resharding do it (SURVEY.md §5.8). Constraint preserved:
+``n_cols % p == 0`` (``src/multiplier_colwise.c:151-154``; error message fixed
+per quirk Q2 — the C code printed "n_rows" for a check on n_cols).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .base import MatvecStrategy, flat_axes, mesh_size
+from ..utils.errors import check_divisible
+
+
+class ColwiseStrategy(MatvecStrategy):
+    name = "colwise"
+
+    def __init__(self, scatter_output: bool = False):
+        # scatter_output=True uses psum_scatter: y comes out row-sharded over
+        # the mesh instead of replicated. Requires n_rows % p == 0 as well.
+        self.scatter_output = scatter_output
+
+    def specs(self, mesh: Mesh) -> tuple[P, P, P]:
+        axes = flat_axes(mesh)
+        spec_y = P(axes) if self.scatter_output else P()
+        return P(None, axes), P(axes), spec_y
+
+    def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
+        axes = flat_axes(mesh)
+        scatter = self.scatter_output
+
+        def body(a_panel, x_seg):
+            # Full-length partial y from this device's column panel — the
+            # moral equivalent of multiply_colwise's scale+row-sum
+            # (src/multiplier_colwise.c:107-122), fused by XLA into one dot.
+            partial = kernel(a_panel, x_seg)
+            if scatter:
+                return jax.lax.psum_scatter(partial, axes, tiled=True)
+            return jax.lax.psum(partial, axes)
+
+        return body
+
+    def validate(self, n_rows: int, n_cols: int, mesh: Mesh) -> None:
+        p = mesh_size(mesh)
+        check_divisible(n_cols, p, "n_cols", "number of devices")
+        if self.scatter_output:
+            check_divisible(n_rows, p, "n_rows", "number of devices")

@@ -1,0 +1,44 @@
+"""Strategy P1 — rowwise: 1-D output-dimension sharding.
+
+Reference: ``src/multiplier_rowwise.c``. Each of p ranks owns
+``n_rows/p`` contiguous matrix rows and the full vector
+(``distribute_data``, ``:12-51``: ``MPI_Scatter`` of row blocks +
+``MPI_Bcast`` of x), computes full local dot products
+(``multiply_std_rowwise``, ``src/matr_utils.c:86-96``), and the root
+concatenates exact y-slices (``MPI_Gather``, ``:141``). No inter-rank
+reduction exists — communication is pure data movement.
+
+TPU-native formulation: shard A's row axis over the whole mesh (both axes of
+a 2-D mesh flattened — the analog of the flat MPI_COMM_WORLD), replicate x,
+local ``dot``; y is born correctly sharded over rows. The optional final
+all-gather is the ``MPI_Gather`` analog. Constraint preserved:
+``n_rows % p == 0`` (``src/multiplier_rowwise.c:72-75``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .base import MatvecStrategy, flat_axes, mesh_size
+from ..utils.errors import check_divisible
+
+
+class RowwiseStrategy(MatvecStrategy):
+    name = "rowwise"
+
+    def specs(self, mesh: Mesh) -> tuple[P, P, P]:
+        axes = flat_axes(mesh)
+        return P(axes, None), P(), P(axes)
+
+    def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
+        def body(a_blk, x_full):
+            # Local GEMV over this device's contiguous row block; the result
+            # IS the device's exact slice of y (no collective needed).
+            return kernel(a_blk, x_full)
+
+        return body
+
+    def validate(self, n_rows: int, n_cols: int, mesh: Mesh) -> None:
+        check_divisible(n_rows, mesh_size(mesh), "n_rows", "number of devices")

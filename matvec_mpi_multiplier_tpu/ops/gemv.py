@@ -1,0 +1,75 @@
+"""Local GEMV kernels: the per-device compute tier.
+
+Reference analog: ``multiply_std_rowwise`` (``src/matr_utils.c:86-96``), the
+one serial dense row-major dot-product loop shared by the rowwise and
+blockwise executables (``src/multiplier_rowwise.c:140``,
+``src/multiplier_blockwise.c:367``), and the fused scale+partial-sum colwise
+kernel (``src/multiplier_colwise.c:105-129``).
+
+On TPU the idiomatic local kernel is a single XLA ``dot`` (it tiles onto the
+MXU/VPU and fuses with surrounding elementwise work). Additional kernel tiers
+(Pallas, C++ custom-call) register themselves here via
+:func:`register_kernel`. All kernels share the signature ``gemv(a, x) -> y``
+with ``a: (m, k)``, ``x: (k,)``, ``y: (m,)``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import jax.numpy as jnp
+from jax import Array
+
+
+class GemvKernel(Protocol):
+    def __call__(self, a: Array, x: Array) -> Array: ...
+
+
+def gemv_xla(a: Array, x: Array) -> Array:
+    """XLA-native GEMV: one dot, accumulated in at-least-fp32.
+
+    For bf16/fp16 inputs the MXU accumulates in fp32
+    (``preferred_element_type``), matching the numerics a careful hand kernel
+    would use; fp32/fp64 inputs accumulate at their own precision.
+    """
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    return jnp.dot(a, x, preferred_element_type=acc).astype(a.dtype)
+
+
+def gemv_colwise_xla(a: Array, x: Array) -> Array:
+    """Colwise-style local kernel: explicit scale-then-sum formulation.
+
+    Mirrors the two-pass structure of ``multiply_colwise``
+    (``src/multiplier_colwise.c:107-122``): scale column ``j`` by ``x_j``, then
+    sum each row — but without the reference's in-place destruction of the
+    local panel (quirk Q5/Q6: the C kernel could destroy ``local_matr`` only
+    because every repetition re-scattered it). XLA fuses the broadcast-multiply
+    into the reduction, so this stays one pass over memory.
+    """
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    return jnp.sum(a.astype(acc) * x.astype(acc)[None, :], axis=1).astype(a.dtype)
+
+
+_KERNELS: dict[str, GemvKernel] = {
+    "xla": gemv_xla,
+    "xla_colwise": gemv_colwise_xla,
+}
+
+
+def register_kernel(name: str, fn: GemvKernel) -> None:
+    _KERNELS[name] = fn
+
+
+def get_kernel(name: str | Callable) -> GemvKernel:
+    if callable(name):
+        return name
+    try:
+        return _KERNELS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown gemv kernel {name!r}; available: {sorted(_KERNELS)}"
+        ) from None
+
+
+def available_kernels() -> list[str]:
+    return sorted(_KERNELS)

@@ -1,0 +1,101 @@
+"""Device-mesh construction: the TPU-native process-grid layer.
+
+Reference analog: ``get_2_most_closest_multipliers`` (``src/utils.c:26-37``)
+factors the MPI process count into the most-square 2-D grid ``(r, c)`` with
+``r <= c`` by scanning down from ``floor(sqrt(n))``; the blockwise executable
+then places rank ``k`` at grid cell ``(k / c, k % c)``
+(``src/multiplier_blockwise.c:299-303``). Verified mapping: 1→1×1, 2→1×2,
+4→2×2, 6→2×3, 8→2×4, 12→3×4, 24→4×6.
+
+Here the same factorization builds a ``jax.sharding.Mesh`` whose axes carry the
+named shardings for the three strategies. Subset meshes (fewer devices than
+are physically present) support the reference's scaling sweeps
+(``test.sh:5`` runs p ∈ {1,2,6,12,24} on a fixed machine).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
+from ..utils.errors import ConfigError
+
+
+def most_square_factors(n: int) -> tuple[int, int]:
+    """Factor ``n`` into ``(r, c)`` with ``r <= c`` and ``r*c == n``, maximally square.
+
+    Exact semantics of ``get_2_most_closest_multipliers`` (``src/utils.c:26-37``):
+    scan ``r`` downward from ``floor(sqrt(n))`` until ``n % r == 0``.
+    """
+    if n <= 0:
+        raise ConfigError(f"device count must be positive, got {n}")
+    r = int(math.isqrt(n))
+    while n % r != 0:
+        r -= 1
+    return r, n // r
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    shape: tuple[int, int] | None = None,
+    axis_names: Sequence[str] = (MESH_AXIS_ROWS, MESH_AXIS_COLS),
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a 2-D device mesh over the first ``n_devices`` devices.
+
+    * ``shape=(r, c)`` pins the grid explicitly; otherwise the most-square
+      factorization of ``n_devices`` is used (reference ``src/utils.c:26-37``).
+    * 1-D strategies (rowwise/colwise) use the same 2-D mesh with one axis of
+      size 1 collapsed away by the strategy's PartitionSpec, so a single mesh
+      serves all three strategies.
+    * ``devices`` overrides the device list (used for subset meshes in scaling
+      sweeps, the analog of ``mpiexec -n p`` with varying p, ``test.sh:11``).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = math.prod(shape) if shape is not None else len(devices)
+    if n_devices > len(devices):
+        raise ConfigError(
+            f"requested {n_devices} devices but only {len(devices)} available"
+        )
+    if shape is None:
+        shape = most_square_factors(n_devices)
+    r, c = shape
+    if r * c != n_devices:
+        raise ConfigError(f"mesh shape {shape} does not cover {n_devices} devices")
+    device_grid = np.asarray(devices[:n_devices]).reshape(r, c)
+    return Mesh(device_grid, axis_names=tuple(axis_names))
+
+
+def make_1d_mesh(
+    n_devices: int | None = None,
+    *,
+    axis_name: str = MESH_AXIS_ROWS,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """A flat 1-D mesh, the analog of the reference's flat MPI_COMM_WORLD
+    used by rowwise/colwise (``src/multiplier_rowwise.c:68-69``)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ConfigError(
+            f"requested {n_devices} devices but only {len(devices)} available"
+        )
+    return Mesh(np.asarray(devices[:n_devices]), axis_names=(axis_name,))
+
+
+def mesh_grid_shape(mesh: Mesh) -> tuple[int, int]:
+    """Return the (rows, cols) grid shape of a 1-D or 2-D mesh."""
+    if len(mesh.axis_names) == 1:
+        return 1, mesh.devices.size
+    shape = mesh.devices.shape
+    return shape[0], shape[1]

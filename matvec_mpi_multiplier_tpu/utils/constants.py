@@ -1,0 +1,43 @@
+"""Framework-wide constants.
+
+TPU-native analog of the reference's ``src/constants.h`` (lines 4-7), which
+defined ``MAX_FILENAME_LENGTH 128``, ``MAIN_PROCESS 0``,
+``STR_DEFAULT_LENGTH 128``, ``SUBMATR_TAG 15`` (plus ``SUBVEC_TAG 25`` /
+``N_DIVIDERS 2`` at ``src/multiplier_blockwise.c:12-14``).
+
+On TPU there are no MPI message tags or fixed-length C strings; what remains
+meaningful is the coordinator-process convention, the data-directory layout,
+and the benchmark protocol parameters (``src/multiplier_rowwise.c:135`` runs
+100 repetitions; CSV schema at ``src/multiplier_rowwise.c:86``).
+"""
+
+from __future__ import annotations
+
+# The coordinator process (reference: MAIN_PROCESS, src/constants.h:5).
+# With jax.distributed, process 0 plays the same role (it loads data and
+# writes metrics); on a single host it is the only process.
+MAIN_PROCESS: int = 0
+
+# Data-file conventions (reference: src/matr_utils.c:9-18, "./data/" prefix at
+# src/matr_utils.c:45-46). The directory itself is resolved at call time in
+# utils/io.py (env var MATVEC_DATA_DIR) so it can be overridden after import.
+OUT_SUBDIR: str = "out"
+MATRIX_FILENAME_FMT: str = "matrix_{n_rows}_{n_cols}.txt"
+VECTOR_FILENAME_FMT: str = "vector_{n}.txt"
+
+# Benchmark protocol (reference: 100-rep loop, src/multiplier_rowwise.c:135;
+# mean over reps at :168; max across ranks at :147).
+DEFAULT_N_REPS: int = 100
+
+# CSV metric schema — byte-identical header to the reference
+# (src/multiplier_rowwise.c:86): "n_rows, n_cols, n_processes, time".
+CSV_HEADER: str = "n_rows, n_cols, n_processes, time"
+# Extended schema for the TPU build's richer metrics (new capability).
+CSV_HEADER_EXTENDED: str = (
+    "n_rows, n_cols, n_devices, time, strategy, dtype, mode, gflops, gbps"
+)
+
+# Default mesh axis names for the 2-D device grid (reference's process grid
+# from get_2_most_closest_multipliers, src/utils.c:26-37).
+MESH_AXIS_ROWS: str = "rows"
+MESH_AXIS_COLS: str = "cols"

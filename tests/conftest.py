@@ -1,0 +1,63 @@
+"""Test configuration: 8 virtual CPU devices + fp64.
+
+The reference's multi-rank story is ``mpiexec -n p`` on one machine
+(``test.sh:11``); the TPU-native analog for tests is
+``--xla_force_host_platform_device_count=8`` on the CPU backend (SURVEY.md §4).
+fp64 is enabled because the reference computes in C ``double``
+(``src/matr_utils.c:86-96``) and the correctness tier must match it.
+
+These env vars must be set before jax initializes, hence this conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# Some environments register an accelerator plugin at interpreter startup and
+# pin jax_platforms via jax.config (which outranks the env var) — force CPU at
+# the same config level so the 8-device virtual mesh is what tests see.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
+
+
+# The committed correctness fixture (reference data/matrix_4_8.txt and
+# data/vector_8.txt; expected product derived in SURVEY.md §3.5).
+FIXTURE_MATRIX = np.array(
+    [
+        [2.4, 2.1, 8.4, 4.1, 5.0, 6.0, 7.0, 8.0],
+        [9.4, 1.2, 3.45, 0.1, 5.0, 6.0, 7.0, 8.0],
+        [1.4, 4.6, 0.99, 1.0, 5.0, 6.0, 7.0, 8.0],
+        [0.1, 2.5, 4.6, 10.0, 5.0, 6.0, 7.0, 8.0],
+    ]
+)
+FIXTURE_VECTOR = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0])
+FIXTURE_PRODUCT = np.array([222.2, 196.55, 191.57, 232.9])
+
+
+@pytest.fixture(scope="session")
+def fixture_4x8():
+    a, x, y = FIXTURE_MATRIX, FIXTURE_VECTOR, FIXTURE_PRODUCT
+    np.testing.assert_allclose(a @ x, y, rtol=1e-12)  # sanity on the fixture itself
+    return a, x

@@ -1,0 +1,76 @@
+"""Data/IO layer tests: filename convention, round-trip, error paths.
+
+Contract under test is ``src/matr_utils.c`` (see utils/io.py docstring).
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu.utils import io
+from matvec_mpi_multiplier_tpu.utils.errors import DataFileError
+
+from conftest import FIXTURE_MATRIX, FIXTURE_VECTOR
+
+
+def test_filename_convention(tmp_path):
+    assert io.matrix_path(600, 1200, tmp_path).name == "matrix_600_1200.txt"
+    assert io.vector_path(600, tmp_path).name == "vector_600.txt"
+
+
+def test_roundtrip_matrix(tmp_path, rng):
+    a = np.round(rng.uniform(0, 10, size=(6, 4)), 4)
+    io.save_matrix(a, tmp_path)
+    loaded = io.load_matrix(6, 4, tmp_path)
+    np.testing.assert_array_equal(loaded, a)
+
+
+def test_roundtrip_vector(tmp_path, rng):
+    v = np.round(rng.uniform(0, 10, size=(16,)), 4)
+    io.save_vector(v, tmp_path)
+    np.testing.assert_array_equal(io.load_vector(16, tmp_path), v)
+
+
+def test_reference_fixture_format(tmp_path):
+    """Our writer emits files the reference loader contract accepts, and our
+    loader reads the exact committed 4×8 fixture layout."""
+    io.save_matrix(FIXTURE_MATRIX, tmp_path)
+    io.save_vector(FIXTURE_VECTOR, tmp_path)
+    a = io.load_matrix(4, 8, tmp_path)
+    x = io.load_vector(8, tmp_path)
+    np.testing.assert_allclose(a @ x, [222.2, 196.55, 191.57, 232.9], rtol=1e-12)
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises(DataFileError, match="Unable to locate"):
+        io.load_matrix(3, 3, tmp_path)
+    with pytest.raises(DataFileError, match="Unable to locate"):
+        io.load_vector(3, tmp_path)
+
+
+def test_size_mismatch_raises(tmp_path):
+    io.save_matrix(np.ones((2, 3)), tmp_path)
+    (io.matrix_path(5, 5, tmp_path)).write_text(
+        io.matrix_path(2, 3, tmp_path).read_text()
+    )
+    with pytest.raises(DataFileError, match="expected"):
+        io.load_matrix(5, 5, tmp_path)
+
+
+def test_ensure_data_generates(tmp_path):
+    a, x = io.ensure_data(8, 16, tmp_path)
+    assert a.shape == (8, 16) and x.shape == (16,)
+    assert io.matrix_path(8, 16, tmp_path).exists()
+    assert io.vector_path(16, tmp_path).exists()
+    # idempotent: second call loads the same values
+    a2, x2 = io.ensure_data(8, 16, tmp_path)
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(x, x2)
+
+
+def test_generator_determinism():
+    np.testing.assert_array_equal(
+        io.generate_matrix(4, 4, seed=7), io.generate_matrix(4, 4, seed=7)
+    )
+    assert not np.array_equal(
+        io.generate_matrix(4, 4, seed=7), io.generate_matrix(4, 4, seed=8)
+    )

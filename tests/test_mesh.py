@@ -1,0 +1,82 @@
+"""Mesh-construction tests.
+
+The factorization table is verified against the reference's
+``get_2_most_closest_multipliers`` semantics (``src/utils.c:26-37``), whose
+behavior SURVEY.md §1/L1 records as 1→1×1, 2→1×2, 4→2×2, 6→2×3, 8→2×4,
+12→3×4, 24→4×6.
+"""
+
+import jax
+import pytest
+
+from matvec_mpi_multiplier_tpu.parallel.mesh import (
+    make_1d_mesh,
+    make_mesh,
+    mesh_grid_shape,
+    most_square_factors,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+
+@pytest.mark.parametrize(
+    "n,expected",
+    [
+        (1, (1, 1)),
+        (2, (1, 2)),
+        (3, (1, 3)),
+        (4, (2, 2)),
+        (6, (2, 3)),
+        (8, (2, 4)),
+        (12, (3, 4)),
+        (16, (4, 4)),
+        (24, (4, 6)),
+        (7, (1, 7)),
+        (36, (6, 6)),
+    ],
+)
+def test_most_square_factors(n, expected):
+    r, c = most_square_factors(n)
+    assert (r, c) == expected
+    assert r * c == n
+    assert r <= c
+
+
+def test_most_square_factors_invalid():
+    with pytest.raises(ConfigError):
+        most_square_factors(0)
+
+
+def test_make_mesh_default(devices):
+    mesh = make_mesh()
+    assert mesh.devices.shape == (2, 4)
+    assert mesh.axis_names == ("rows", "cols")
+    assert mesh_grid_shape(mesh) == (2, 4)
+
+
+@pytest.mark.parametrize("n,grid", [(1, (1, 1)), (2, (1, 2)), (4, (2, 2)), (8, (2, 4))])
+def test_make_mesh_subset(devices, n, grid):
+    mesh = make_mesh(n)
+    assert mesh.devices.shape == grid
+    assert mesh.devices.size == n
+
+
+def test_make_mesh_explicit_shape(devices):
+    mesh = make_mesh(shape=(4, 2))
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_make_mesh_too_many(devices):
+    with pytest.raises(ConfigError):
+        make_mesh(len(jax.devices()) + 1)
+
+
+def test_make_mesh_bad_shape(devices):
+    with pytest.raises(ConfigError):
+        make_mesh(8, shape=(3, 2))
+
+
+def test_make_1d_mesh(devices):
+    mesh = make_1d_mesh(8)
+    assert mesh.axis_names == ("rows",)
+    assert mesh.devices.shape == (8,)
+    assert mesh_grid_shape(mesh) == (1, 8)
