@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Headline benchmark: blockwise distributed matvec on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The flagship configuration is the blockwise strategy (the reference's best
+performer, BASELINE.md) in amortized mode (operands HBM-resident; the honest
+TPU number — the reference's in-loop redistribution measures PCIe on TPU, see
+SURVEY.md §7 hard part (i)) at bf16, on whatever devices are available. The
+baseline is the reference's best aggregate effective bandwidth anywhere in its
+committed data: 4.13 GB/s (blockwise 10200² p=12, BASELINE.md), since the
+reference is bandwidth-bound and GB/s is the dtype-fair comparison.
+
+Timing uses the chain-slope method (bench/timing.py): per-matvec time is the
+slope between back-to-back execution chains of two lengths, fenced by scalar
+fetches — robust on tunneled PJRT backends where block_until_ready returns
+early and a single fetch costs a ~30-70 ms round-trip.
+
+Environment overrides: MATVEC_BENCH_SIZE (default 32768), MATVEC_BENCH_REPS
+(default 50), MATVEC_BENCH_DTYPE (default bfloat16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.bench.timing import time_fn_chained
+
+# Reference best: blockwise 10200^2 p=12, 0.201654 s -> 4.13 GB/s aggregate
+# (data/out/blockwise.csv:37; derivation in BASELINE.md).
+REFERENCE_BEST_GBPS = 4.13
+
+
+def main() -> int:
+    size = int(os.environ.get("MATVEC_BENCH_SIZE", 32768))
+    n_reps = int(os.environ.get("MATVEC_BENCH_REPS", 50))
+    dtype = os.environ.get("MATVEC_BENCH_DTYPE", "bfloat16")
+
+    import jax
+    import jax.numpy as jnp
+
+    mesh = make_mesh()
+    strategy = get_strategy("blockwise")
+    strategy.validate(size, size, mesh)
+    sh_a, sh_x = strategy.shardings(mesh)
+
+    # Operands filled on device with the strategy sharding — multi-GB arrays
+    # never cross the host link. An iota-derived fill (values cycling in
+    # [0, 10), matching the reference generator's range, README.md:32) keeps
+    # the fill kernel trivial to compile; a bandwidth benchmark is
+    # value-independent.
+    @jax.jit
+    def gen():
+        ia = jax.lax.iota(jnp.int32, size * size).reshape(size, size)
+        a = (ia % 1024).astype(dtype) * (10.0 / 1024.0)
+        ix = jax.lax.iota(jnp.int32, size)
+        x = (ix % 1024).astype(dtype) * (10.0 / 1024.0)
+        return (
+            jax.lax.with_sharding_constraint(a, sh_a),
+            jax.lax.with_sharding_constraint(x, sh_x),
+        )
+
+    a, x = gen()
+    fn = strategy.build(mesh)
+    times = time_fn_chained(fn, (a, x), n_reps=n_reps)
+    mean_t = float(np.mean(times))
+    itemsize = jnp.dtype(dtype).itemsize
+    gbps = itemsize * (size * size + 2 * size) / mean_t / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": f"blockwise_{size}x{size}_{dtype}_matvec_bandwidth",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / REFERENCE_BEST_GBPS, 2),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""Time / SpeedUp / Efficiency figures.
+
+Reinstates the reference's missing ``stats_visualization.ipynb`` (C13): per
+strategy, three curves over process/device count for each matrix size, plus a
+cross-strategy comparison at a fixed size — the figures the reference README
+embeds as (dead) image links (``README.md:59-68``).
+
+Matplotlib is imported lazily so the core framework has no hard plotting
+dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from pathlib import Path
+
+from .stats import ScalingPoint
+
+
+def _mpl():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+def _series(points: list[ScalingPoint]):
+    """Group points into {(n_rows, n_cols): sorted [(p, point)]}."""
+    by_size = defaultdict(list)
+    for p in points:
+        by_size[(p.n_rows, p.n_cols)].append(p)
+    return {
+        size: sorted(ps, key=lambda q: q.n_processes)
+        for size, ps in sorted(by_size.items())
+    }
+
+
+def plot_strategy(
+    points: list[ScalingPoint], out_path: str | os.PathLike, title: str = ""
+) -> Path:
+    """One figure per strategy: Time, SpeedUp, Efficiency vs device count,
+    one line per matrix size (the README's per-algorithm figure set)."""
+    plt = _mpl()
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    panels = [
+        ("time_s", "Time (s)", lambda q: q.time_s),
+        ("speedup", "SpeedUp  S = T1/Tp", lambda q: q.speedup),
+        ("efficiency", "Efficiency  E = S/p", lambda q: q.efficiency),
+    ]
+    for ax, (_, ylabel, get) in zip(axes, panels):
+        for (m, n), ps in _series(points).items():
+            xs = [q.n_processes for q in ps if get(q) is not None]
+            ys = [get(q) for q in ps if get(q) is not None]
+            if xs:
+                ax.plot(xs, ys, marker="o", label=f"{m}×{n}")
+        ax.set_xlabel("devices")
+        ax.set_ylabel(ylabel)
+        ax.grid(True, alpha=0.3)
+    axes[0].set_yscale("log")
+    axes[1].legend(fontsize=7, ncol=2)
+    fig.suptitle(title or (points[0].strategy if points else ""))
+    fig.tight_layout()
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+def plot_comparison(
+    by_strategy: dict[str, list[ScalingPoint]],
+    n_rows: int,
+    n_cols: int,
+    out_path: str | os.PathLike,
+) -> Path:
+    """Cross-strategy Time/SpeedUp/Efficiency at one size (the README's
+    comparison figures at the largest sweep size)."""
+    plt = _mpl()
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    panels = [
+        ("Time (s)", lambda q: q.time_s),
+        ("SpeedUp", lambda q: q.speedup),
+        ("Efficiency", lambda q: q.efficiency),
+    ]
+    for name, points in by_strategy.items():
+        ps = sorted(
+            (q for q in points if (q.n_rows, q.n_cols) == (n_rows, n_cols)),
+            key=lambda q: q.n_processes,
+        )
+        for ax, (ylabel, get) in zip(axes, panels):
+            xs = [q.n_processes for q in ps if get(q) is not None]
+            ys = [get(q) for q in ps if get(q) is not None]
+            if xs:
+                ax.plot(xs, ys, marker="o", label=name)
+            ax.set_xlabel("devices")
+            ax.set_ylabel(ylabel)
+            ax.grid(True, alpha=0.3)
+    axes[0].set_yscale("log")
+    axes[0].legend()
+    fig.suptitle(f"{n_rows}×{n_cols}")
+    fig.tight_layout()
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path

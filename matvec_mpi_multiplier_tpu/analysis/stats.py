@@ -1,0 +1,106 @@
+"""SpeedUp / Efficiency analysis.
+
+Reference analog: components C13/C14 — the *missing* plotting notebook
+``stats_visualization.ipynb`` (listed in ``.MISSING_LARGE_BLOBS:1``) that
+consumed ``data/out/*.csv`` and produced the README's Time / SpeedUp /
+Efficiency figures (``README.md:59-68``). Formulas (``README.md:47-50``):
+
+* SpeedUp   ``S_p = T_1 / T_p``  (baseline = same strategy, same size, p=1)
+* Efficiency ``E_p = S_p / p``
+
+plus the derived throughput columns BASELINE.md defines:
+``GFLOP/s = 2·m·n / T / 1e9`` and ``GB/s = itemsize·(m·n + m + n) / T / 1e9``.
+
+Works on both this framework's CSVs and the reference's committed ones (the
+parser in bench.metrics tolerates both header variants, quirk Q10), so
+TPU-device-count curves can be overlaid directly on the reference's
+MPI-process-count curves — the BASELINE.json north star.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import defaultdict
+from pathlib import Path
+from typing import Iterable
+
+from ..bench.metrics import read_csv
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalingPoint:
+    n_rows: int
+    n_cols: int
+    n_processes: int
+    time_s: float
+    speedup: float | None  # None when no p=1 baseline exists for this size
+    efficiency: float | None
+    strategy: str = ""
+
+    def gflops(self) -> float:
+        return 2.0 * self.n_rows * self.n_cols / self.time_s / 1e9
+
+    def gbps(self, itemsize: int = 8) -> float:
+        elems = self.n_rows * self.n_cols + self.n_rows + self.n_cols
+        return itemsize * elems / self.time_s / 1e9
+
+
+def _mean_times(rows: Iterable[dict]) -> dict[tuple[int, int, int], float]:
+    """Average duplicate rows (append-only CSVs accumulate re-runs)."""
+    acc: dict[tuple[int, int, int], list[float]] = defaultdict(list)
+    for r in rows:
+        key = (int(r["n_rows"]), int(r["n_cols"]), int(r["n_processes"]))
+        acc[key].append(float(r["time"]))
+    return {k: sum(v) / len(v) for k, v in acc.items()}
+
+
+def scaling_table(rows: Iterable[dict], strategy: str = "") -> list[ScalingPoint]:
+    """Compute S and E for every (size, p) against the p=1 row of the same
+    size (README.md:47-50)."""
+    means = _mean_times(rows)
+    points = []
+    for (m, n, p), t in sorted(means.items()):
+        t1 = means.get((m, n, 1))
+        s = t1 / t if t1 is not None else None
+        points.append(
+            ScalingPoint(
+                n_rows=m, n_cols=n, n_processes=p, time_s=t,
+                speedup=s, efficiency=(s / p if s is not None else None),
+                strategy=strategy,
+            )
+        )
+    return points
+
+
+def load_strategy_csv(path: str | os.PathLike, strategy: str = "") -> list[ScalingPoint]:
+    path = Path(path)
+    if not strategy:
+        strategy = path.stem.replace("asymmetric_", "")
+    return scaling_table(read_csv(path), strategy=strategy)
+
+
+def best_point(points: list[ScalingPoint], n_rows: int, n_cols: int) -> ScalingPoint:
+    """Fastest configuration for a given size (the README's 'best wall time'
+    comparison, README.md:71-75)."""
+    cands = [p for p in points if p.n_rows == n_rows and p.n_cols == n_cols]
+    if not cands:
+        raise ValueError(f"no rows for size {n_rows}x{n_cols}")
+    return min(cands, key=lambda p: p.time_s)
+
+
+def format_table(points: list[ScalingPoint], itemsize: int = 8) -> str:
+    """Markdown table in the BASELINE.md column layout."""
+    lines = [
+        "| Strategy | Matrix | p | Time (s) | SpeedUp | Efficiency | GFLOP/s | GB/s |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for p in points:
+        s = f"{p.speedup:.2f}" if p.speedup is not None else "—"
+        e = f"{p.efficiency:.3f}" if p.efficiency is not None else "—"
+        lines.append(
+            f"| {p.strategy} | {p.n_rows}×{p.n_cols} | {p.n_processes} "
+            f"| {p.time_s:.6f} | {s} | {e} | {p.gflops():.2f} "
+            f"| {p.gbps(itemsize):.2f} |"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,15 @@
+"""Benchmark harness: timing protocol (C9), CSV metrics (C8), sweep CLI (C10)."""
+
+from .metrics import append_result, csv_path, extended_csv_path, read_csv
+from .timing import TIMING_MODES, TimingResult, benchmark_strategy, time_matvec
+
+__all__ = [
+    "TimingResult",
+    "TIMING_MODES",
+    "benchmark_strategy",
+    "time_matvec",
+    "append_result",
+    "csv_path",
+    "extended_csv_path",
+    "read_csv",
+]

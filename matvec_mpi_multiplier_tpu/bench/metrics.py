@@ -1,0 +1,95 @@
+"""CSV metric emission.
+
+Reference analog: component C8, the inline CSV bootstrap+append in each
+``main`` (``src/multiplier_rowwise.c:77-88,159-170`` and colwise/blockwise
+equivalents): per-strategy file ``./data/out/<strategy>.csv``, header row
+``"n_rows, n_cols, n_processes, time"`` written once if the file is absent
+(``:86``), then one appended row per run (``:168``) — append-only so re-runs
+extend the sweep incrementally (the reference's only "resume" mechanism,
+SURVEY.md §5.4).
+
+Preserved exactly: the schema, the spaced header, the per-strategy filename,
+append-only semantics. Fixed: the reference's fd leak in the existence probe
+(quirk Q7 — ``fopen(..., "r")`` never closed, ``src/multiplier_rowwise.c:80``).
+Added: an extended CSV with strategy/dtype/mode/throughput columns for the
+TPU build's richer analysis.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+from ..utils.constants import CSV_HEADER, CSV_HEADER_EXTENDED, OUT_SUBDIR
+from ..utils.io import data_dir
+from .timing import TimingResult
+
+
+def out_dir(root: str | os.PathLike | None = None) -> Path:
+    return data_dir(root) / OUT_SUBDIR
+
+
+def csv_path(strategy: str, root: str | os.PathLike | None = None) -> Path:
+    """Per-strategy CSV, the reference's ``./data/out/<strategy>.csv``."""
+    return out_dir(root) / f"{strategy}.csv"
+
+
+def extended_csv_path(root: str | os.PathLike | None = None) -> Path:
+    return out_dir(root) / "results_extended.csv"
+
+
+def _append_row(path: Path, header: str, row: str) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    is_new = not path.exists()
+    with open(path, "a") as f:
+        if is_new:
+            f.write(header + "\n")
+        f.write(row + "\n")
+
+
+def append_result(result: TimingResult, root: str | os.PathLike | None = None) -> Path:
+    """Append one result in the reference schema (+ the extended CSV).
+
+    Row format mirrors ``fprintf(..., "%ld, %ld, %d, %f\\n", ...)`` at
+    ``src/multiplier_rowwise.c:168``: comma+space separated, time with 6
+    decimal places.
+    """
+    path = csv_path(result.strategy, root)
+    row = (
+        f"{result.n_rows}, {result.n_cols}, {result.n_devices}, "
+        f"{result.mean_time_s:.6f}"
+    )
+    _append_row(path, CSV_HEADER, row)
+
+    ext_row = (
+        f"{result.n_rows}, {result.n_cols}, {result.n_devices}, "
+        f"{result.mean_time_s:.6f}, {result.strategy}, {result.dtype}, "
+        f"{result.mode}, {result.gflops:.4f}, {result.gbps:.4f}"
+    )
+    _append_row(extended_csv_path(root), CSV_HEADER_EXTENDED, ext_row)
+    return path
+
+
+def read_csv(path: str | os.PathLike) -> list[dict]:
+    """Parse a reference-schema or extended CSV into row dicts (numbers
+    converted). Tolerates both the spaced reference header and the no-space
+    header of the reference's asymmetric CSVs (quirk Q10)."""
+    path = Path(path)
+    lines = [ln.strip() for ln in path.read_text().splitlines() if ln.strip()]
+    if not lines:
+        return []
+    keys = [k.strip() for k in lines[0].split(",")]
+    rows = []
+    for ln in lines[1:]:
+        vals = [v.strip() for v in ln.split(",")]
+        row: dict = {}
+        for k, v in zip(keys, vals):
+            try:
+                row[k] = int(v)
+            except ValueError:
+                try:
+                    row[k] = float(v)
+                except ValueError:
+                    row[k] = v
+        rows.append(row)
+    return rows

@@ -1,0 +1,203 @@
+"""Benchmark sweep driver CLI.
+
+Reference analog: component C10, ``test.sh`` — for each strategy it runs the
+matrix of ``n_proc ∈ {1,2,6,12,24}`` × ``n ∈ {600,1800,...,10200}`` square
+sizes (``test.sh:5,8``), invoking ``mpiexec -n $n_proc out/multiplier
+$n_rows $n_rows`` (``:11``), appending to the per-strategy CSV. The
+asymmetric CSVs (120–1200 × 60000, quirk Q10) came from a modified driver the
+reference never committed; here both sweeps are first-class.
+
+TPU-native mapping: the process count axis becomes subset device meshes
+(1,2,4,8,... of the available devices); strategy selection is a runtime flag,
+not a compile-time binary choice (``test.sh:3,10``).
+
+Usage (replaces ``./test.sh <type>``)::
+
+    python -m matvec_mpi_multiplier_tpu.bench.sweep --strategy rowwise
+    python -m matvec_mpi_multiplier_tpu.bench.sweep \
+        --strategy all --devices 1 2 4 8 --sweep square --dtype float32
+    python -m matvec_mpi_multiplier_tpu.bench.sweep --sweep asymmetric
+
+By default operand data is generated in memory (seeded, identical
+distribution to the file generator): the reference's whitespace-text format at
+its own 10200² top size is an ~800 MB file, and at the TPU-scale sizes in
+BASELINE.json it would be tens of GB. ``--use-files`` restores the
+reference-faithful path through ``./data/matrix_*.txt``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import numpy as np
+
+from ..models import available_strategies, get_strategy
+from ..parallel.mesh import make_mesh
+from ..utils import io
+from ..utils.errors import MatvecError
+from .metrics import append_result, csv_path
+from .timing import TIMING_MODES, benchmark_strategy
+
+# The reference's sweeps (test.sh:5,8 and the asymmetric CSVs' sizes).
+SQUARE_SIZES = list(range(600, 10201, 1200))
+ASYMMETRIC_SIZES = [(r, 60000) for r in range(120, 1201, 120)]
+
+
+def device_counts_available(max_devices: int | None = None) -> list[int]:
+    """Power-of-two subset mesh sizes up to the device count — the analog of
+    test.sh's {1,2,6,12,24} process list on a fixed machine."""
+    n = len(jax.devices())
+    if max_devices is not None:
+        n = min(n, max_devices)
+    counts = []
+    c = 1
+    while c <= n:
+        counts.append(c)
+        c *= 2
+    if counts[-1] != n and n not in counts:
+        counts.append(n)
+    return counts
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="matvec-sweep",
+        description="Benchmark sweep over strategies x device counts x sizes "
+        "(TPU-native replacement for the reference's test.sh).",
+    )
+    p.add_argument(
+        "--strategy",
+        nargs="+",
+        default=["all"],
+        help=f"strategies to run: {available_strategies()} or 'all'",
+    )
+    p.add_argument(
+        "--devices",
+        nargs="+",
+        type=int,
+        default=None,
+        help="device counts to sweep (default: powers of two up to available)",
+    )
+    p.add_argument(
+        "--sweep",
+        choices=["square", "asymmetric", "both"],
+        default="square",
+        help="size sweep: square 600..10200 step 1200 (test.sh:8) or "
+        "asymmetric 120..1200 x 60000 (the reference's long-contraction regime)",
+    )
+    p.add_argument(
+        "--sizes",
+        nargs="+",
+        type=int,
+        default=None,
+        help="explicit square sizes, overriding --sweep",
+    )
+    p.add_argument("--dtype", default="float32", help="operand dtype")
+    p.add_argument(
+        "--n-reps",
+        type=int,
+        default=100,
+        help="repetitions per config (reference: 100, src/multiplier_rowwise.c:135)",
+    )
+    p.add_argument(
+        "--mode",
+        choices=list(TIMING_MODES) + ["both"],
+        default="amortized",
+        help="'amortized': operands HBM-resident (honest TPU number); "
+        "'reference': host->device transfer timed every rep (quirk Q5 parity)",
+    )
+    p.add_argument("--kernel", default="xla", help="local GEMV kernel name")
+    p.add_argument(
+        "--use-files",
+        action="store_true",
+        help="load operands via the ./data/matrix_*.txt convention "
+        "(reference-faithful; slow/huge for large sizes)",
+    )
+    p.add_argument("--data-root", default=None, help="data directory override")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--no-csv", action="store_true", help="print results without writing CSVs"
+    )
+    return p
+
+
+def resolve_strategies(names: list[str]) -> list[str]:
+    if "all" in names:
+        return available_strategies()
+    for n in names:
+        if n not in available_strategies():
+            raise SystemExit(
+                f"unknown strategy {n!r}; available: {available_strategies()}"
+            )
+    return names
+
+
+def operands(n_rows: int, n_cols: int, args) -> tuple[np.ndarray, np.ndarray]:
+    if args.use_files:
+        return io.ensure_data(n_rows, n_cols, args.data_root, seed=args.seed)
+    return (
+        io.generate_matrix(n_rows, n_cols, seed=args.seed),
+        io.generate_vector(n_cols, seed=args.seed + 1),
+    )
+
+
+def run_sweep(args: argparse.Namespace) -> int:
+    strategies = resolve_strategies(args.strategy)
+    counts = args.devices or device_counts_available()
+    if args.sizes:
+        sizes = [(s, s) for s in args.sizes]
+    elif args.sweep == "square":
+        sizes = [(s, s) for s in SQUARE_SIZES]
+    elif args.sweep == "asymmetric":
+        sizes = list(ASYMMETRIC_SIZES)
+    else:
+        sizes = [(s, s) for s in SQUARE_SIZES] + list(ASYMMETRIC_SIZES)
+    modes = list(TIMING_MODES) if args.mode == "both" else [args.mode]
+
+    n_ok = n_skip = 0
+    for name in strategies:
+        for n_dev in counts:
+            mesh = make_mesh(n_dev)
+            strat = get_strategy(name)
+            for n_rows, n_cols in sizes:
+                try:
+                    strat.validate(n_rows, n_cols, mesh)
+                except MatvecError as e:
+                    print(f"skip {name} {n_rows}x{n_cols} p={n_dev}: {e}")
+                    n_skip += 1
+                    continue
+                a, x = operands(n_rows, n_cols, args)
+                for mode in modes:
+                    result = benchmark_strategy(
+                        strat,
+                        mesh,
+                        a,
+                        x,
+                        dtype=args.dtype,
+                        n_reps=args.n_reps,
+                        mode=mode,
+                        kernel=args.kernel,
+                    )
+                    if not args.no_csv:
+                        append_result(result, args.data_root)
+                    print(
+                        f"{name} {n_rows}x{n_cols} p={n_dev} [{mode}] "
+                        f"mean={result.mean_time_s:.6f}s "
+                        f"{result.gflops:.2f} GFLOP/s {result.gbps:.2f} GB/s"
+                    )
+                    n_ok += 1
+    if not args.no_csv:
+        for name in strategies:
+            print(f"CSV: {csv_path(name, args.data_root)}")
+    print(f"{n_ok} configs timed, {n_skip} skipped")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_sweep(build_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

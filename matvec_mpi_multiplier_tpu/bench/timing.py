@@ -1,0 +1,261 @@
+"""Benchmark timing protocol.
+
+Reference analog: component C9, the inline loop in each ``main``
+(``src/multiplier_rowwise.c:135-151``, ``src/multiplier_colwise.c:218-233``,
+``src/multiplier_blockwise.c:361-378``) and the protocol definition at
+``README.md:41-52``:
+
+* 100 repetitions (``:135``);
+* per-rep fences: ``MPI_Barrier`` → ``MPI_Wtime`` → work → ``MPI_Barrier`` →
+  ``MPI_Wtime`` (``:136-144``);
+* per-run time = **max across ranks** (``MPI_Reduce(MPI_MAX)``, ``:147``);
+* reported time = mean over repetitions (``:168``).
+
+TPU-native mapping: the barrier+Wtime pair becomes ``block_until_ready`` +
+``time.perf_counter``; max-across-ranks becomes a max over JAX processes (on a
+single host there is one process, and within it XLA already synchronizes all
+devices at ``block_until_ready``).
+
+Two timing modes (SURVEY.md §7 hard part (i)):
+
+* ``amortized`` — operands resident in HBM with their strategy sharding before
+  the loop; measures the distributed matvec itself. The honest TPU number.
+* ``reference`` — host→device placement of A and x is INSIDE the timed region
+  every repetition, reproducing the reference's in-loop ``distribute_data``
+  (quirk Q5: ``README.md:42-44`` requires timing to start with data preloaded
+  on the main process only). On TPU this measures PCIe, and is reported so
+  curves are comparable with the reference's.
+
+Compilation is warmed up before the loop in both modes — the C reference has
+no JIT, so including XLA compile time in rep 0 would measure nothing the
+reference measures.
+
+Two measurement methods:
+
+* ``chain`` (amortized default) — enqueue N executions back-to-back and time
+  the whole chain between two device fetches, for two different N; the
+  per-matvec time is the slope ``(T(N2) - T(N1)) / (N2 - N1)``. Device
+  execution is stream-ordered, so one small fetch at the end fences the whole
+  chain, and dispatch/transport latency cancels in the difference. This is
+  robust on remote-tunneled backends where ``block_until_ready`` returns
+  before execution completes and a fetch costs a large fixed round-trip
+  (measured here: ~30-70 ms), and on local hardware it simply converges to
+  the sync number.
+* ``sync`` (reference-mode default) — the literal per-rep protocol: fence,
+  start clock, run once, fence, stop clock. Matches the reference
+  rep-by-rep; on tunneled backends each rep pays the round-trip, which is
+  reported as-is (for mode="reference" that round-trip IS the host↔device
+  distribution cost being measured).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.constants import DEFAULT_N_REPS
+from ..utils.errors import ConfigError
+
+TIMING_MODES = ("amortized", "reference")
+MEASURE_METHODS = ("auto", "chain", "sync")
+
+
+@dataclasses.dataclass(frozen=True)
+class TimingResult:
+    """One benchmark measurement (one CSV row)."""
+
+    n_rows: int
+    n_cols: int
+    n_devices: int
+    strategy: str
+    dtype: str
+    mode: str
+    mean_time_s: float
+    # 'sync': per-rep max-across-processes times (n_reps entries);
+    # 'chain': independent slope estimates of the per-matvec time.
+    times_s: tuple[float, ...]
+    n_reps: int = DEFAULT_N_REPS
+
+    @property
+    def gflops(self) -> float:
+        """Aggregate GFLOP/s: 2·m·n FLOPs per matvec (BASELINE.md formula)."""
+        return 2.0 * self.n_rows * self.n_cols / self.mean_time_s / 1e9
+
+    @property
+    def gbps(self) -> float:
+        """Effective GB/s: one read of A and x, one write of y."""
+        itemsize = np.dtype(self.dtype).itemsize if self.dtype != "bfloat16" else 2
+        elems = self.n_rows * self.n_cols + self.n_rows + self.n_cols
+        return itemsize * elems / self.mean_time_s / 1e9
+
+    @property
+    def min_time_s(self) -> float:
+        return min(self.times_s)
+
+
+def _max_across_processes(value: float) -> float:
+    """The MPI_Reduce(MPI_MAX) analog (src/multiplier_rowwise.c:147).
+
+    With jax.distributed initialized (multi-host), take the max over
+    processes; single-process runs return the local value unchanged.
+    """
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    arr = multihost_utils.process_allgather(np.asarray(value))
+    return float(np.max(arr))
+
+
+def _fence(y) -> None:
+    """Force completion of everything enqueued before ``y`` was produced.
+
+    ``block_until_ready`` alone is not trusted (remote-tunneled PJRT backends
+    have been observed returning early); fetching a scalar reduction of the
+    result is an unambiguous completion fence because device programs execute
+    in submission order.
+    """
+    np.asarray(jnp.sum(y))
+
+
+def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int) -> list[float]:
+    """Per-execution time as the slope between chains of n1 and n2 runs."""
+
+    def chain(n: int) -> float:
+        start = time.perf_counter()
+        y = None
+        for _ in range(n):
+            y = run_once()
+        _fence(y)
+        return time.perf_counter() - start
+
+    estimates = []
+    for _ in range(samples):
+        t1 = chain(n1)
+        t2 = chain(n2)
+        # Clamp: host-timer noise can make t2 < t1 for sub-microsecond
+        # kernels; keep estimates positive so derived GB/s stays finite.
+        estimates.append(max((t2 - t1) / (n2 - n1), 1e-9))
+    return estimates
+
+
+def time_fn_chained(
+    fn: Callable, args: tuple, *, n_reps: int = DEFAULT_N_REPS,
+    samples: int = 3,
+) -> list[float]:
+    """Chain-slope timing of an arbitrary device function on device-resident
+    args (no host placement). Used by bench.py with device-side operand
+    generation so multi-GB operands never cross the host link."""
+    _fence(fn(*args))  # warm-up
+    n1 = max(1, n_reps // 10)
+    return [
+        _max_across_processes(t)
+        for t in _chain_slope(lambda: fn(*args), n1, n1 + n_reps, samples)
+    ]
+
+
+def time_matvec(
+    fn: Callable,
+    a,
+    x,
+    *,
+    shardings=None,
+    n_reps: int = DEFAULT_N_REPS,
+    mode: str = "amortized",
+    measure: str = "auto",
+    chain_samples: int = 3,
+) -> list[float]:
+    """Run the reference timing protocol around ``fn(a, x)``.
+
+    ``a``/``x`` are host (numpy) arrays; ``shardings`` is the (A, x) pair of
+    NamedShardings from ``strategy.shardings(mesh)`` (None → default
+    placement). Returns per-measurement max-across-processes times in seconds
+    (see module docstring for the two measurement methods).
+    """
+    if mode not in TIMING_MODES:
+        raise ConfigError(f"mode must be one of {TIMING_MODES}, got {mode!r}")
+    if measure not in MEASURE_METHODS:
+        raise ConfigError(
+            f"measure must be one of {MEASURE_METHODS}, got {measure!r}"
+        )
+    if measure == "auto":
+        # Chain for amortized (robust everywhere); literal per-rep protocol
+        # for reference mode, whose point is to include the transfer.
+        measure = "chain" if mode == "amortized" else "sync"
+    sh_a, sh_x = shardings if shardings is not None else (None, None)
+
+    def place(arr, sh):
+        return jax.device_put(arr, sh)
+
+    # Warm-up: compile + one run, outside the timed region (the C reference
+    # pays no compile cost; see module docstring).
+    a_dev, x_dev = place(a, sh_a), place(x, sh_x)
+    _fence(fn(a_dev, x_dev))
+
+    if mode == "amortized" and measure == "chain":
+        n1 = max(1, n_reps // 10)
+        n2 = n1 + n_reps
+        per = _chain_slope(lambda: fn(a_dev, x_dev), n1, n2, chain_samples)
+        return [_max_across_processes(t) for t in per]
+
+    times: list[float] = []
+    for _ in range(n_reps):
+        if mode == "reference":
+            # Host→device distribution inside the timed region (quirk Q5).
+            # Delete device copies first so device_put really transfers.
+            a_dev.delete()
+            x_dev.delete()
+            start = time.perf_counter()
+            a_dev = place(a, sh_a)
+            x_dev = place(x, sh_x)
+            _fence(fn(a_dev, x_dev))
+            elapsed = time.perf_counter() - start
+        else:
+            start = time.perf_counter()
+            _fence(fn(a_dev, x_dev))
+            elapsed = time.perf_counter() - start
+        times.append(_max_across_processes(elapsed))
+    return times
+
+
+def benchmark_strategy(
+    strategy,
+    mesh,
+    a: np.ndarray,
+    x: np.ndarray,
+    *,
+    dtype: str | None = None,
+    n_reps: int = DEFAULT_N_REPS,
+    mode: str = "amortized",
+    measure: str = "auto",
+    kernel: str | Callable = "xla",
+    gather_output: bool = True,
+) -> TimingResult:
+    """Benchmark one (strategy, mesh, size) configuration — the body of the
+    reference's per-config run (``src/multiplier_rowwise.c:54-176``) minus the
+    CSV write (see bench.metrics)."""
+    if dtype is not None:
+        a = a.astype(dtype)
+        x = x.astype(dtype)
+    strategy.validate(a.shape[0], a.shape[1], mesh)
+    fn = strategy.build(mesh, kernel=kernel, gather_output=gather_output)
+    times = time_matvec(
+        fn, a, x, shardings=strategy.shardings(mesh), n_reps=n_reps,
+        mode=mode, measure=measure,
+    )
+    return TimingResult(
+        n_rows=a.shape[0],
+        n_cols=a.shape[1],
+        n_devices=int(mesh.devices.size),
+        strategy=strategy.name,
+        dtype=str(a.dtype),
+        mode=mode,
+        mean_time_s=float(np.mean(times)),
+        times_s=tuple(times),
+        n_reps=n_reps,
+    )

@@ -66,6 +66,7 @@ class MatvecStrategy(abc.ABC):
         *,
         kernel: str | Callable = "xla",
         gather_output: bool = True,
+        check_vma: bool | None = None,
     ) -> Callable[[Array, Array], Array]:
         """Return jitted ``matvec(a, x) -> y`` for this strategy on ``mesh``.
 
@@ -78,10 +79,18 @@ class MatvecStrategy(abc.ABC):
         """
         kern = get_kernel(kernel)
         spec_a, spec_x, spec_y = self.specs(mesh)
+        if check_vma is None:
+            # Pallas interpret mode (the CPU test path) mixes constants into
+            # the kernel body in ways the vma checker can't track; the psum/
+            # out_specs contracts are independently validated by the XLA-
+            # kernel test matrix, so relax the check for pallas-backed
+            # kernels only (keyed on the resolved kernel, not its name).
+            check_vma = not getattr(kern, "uses_pallas", False)
 
         body = self.local_body(mesh, kern)
         mapped = jax.shard_map(
-            body, mesh=mesh, in_specs=(spec_a, spec_x), out_specs=spec_y
+            body, mesh=mesh, in_specs=(spec_a, spec_x), out_specs=spec_y,
+            check_vma=check_vma,
         )
 
         @jax.jit

@@ -66,9 +66,10 @@ class BlockwiseStrategy(MatvecStrategy):
         def body(a_blk, x_seg):
             # Partial y for this device's grid row (reference :367), then the
             # reduce-over-grid-columns that gather_local_results hand-rolled
-            # through root (reference :144-210) as one psum over 'cols'.
+            # through root (reference :144-210) as one psum over 'cols' — run
+            # on the kernel's accumulator dtype, cast back after.
             partial = kernel(a_blk, x_seg)
-            return jax.lax.psum(partial, col_axis)
+            return jax.lax.psum(partial, col_axis).astype(a_blk.dtype)
 
         return body
 

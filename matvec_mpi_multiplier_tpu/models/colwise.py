@@ -51,10 +51,14 @@ class ColwiseStrategy(MatvecStrategy):
             # Full-length partial y from this device's column panel — the
             # moral equivalent of multiply_colwise's scale+row-sum
             # (src/multiplier_colwise.c:107-122), fused by XLA into one dot.
+            # The cross-device sum runs on the kernel's accumulator dtype
+            # (fp32 for bf16 storage) and casts back only afterwards.
             partial = kernel(a_panel, x_seg)
             if scatter:
-                return jax.lax.psum_scatter(partial, axes, tiled=True)
-            return jax.lax.psum(partial, axes)
+                y = jax.lax.psum_scatter(partial, axes, tiled=True)
+            else:
+                y = jax.lax.psum(partial, axes)
+            return y.astype(a_panel.dtype)
 
         return body
 

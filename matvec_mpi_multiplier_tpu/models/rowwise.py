@@ -35,8 +35,9 @@ class RowwiseStrategy(MatvecStrategy):
     def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
         def body(a_blk, x_full):
             # Local GEMV over this device's contiguous row block; the result
-            # IS the device's exact slice of y (no collective needed).
-            return kernel(a_blk, x_full)
+            # IS the device's exact slice of y (no collective needed). The
+            # kernel returns its accumulator dtype; cast back to storage.
+            return kernel(a_blk, x_full).astype(a_blk.dtype)
 
         return body
 

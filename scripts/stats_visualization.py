@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Analysis CLI: compute SpeedUp/Efficiency tables and emit figures.
+
+Reinstates the reference's missing ``stats_visualization.ipynb`` (C13,
+``.MISSING_LARGE_BLOBS:1``) as a script. Reads reference-schema CSVs from a
+``data/out`` directory (this framework's output or the reference's own
+committed CSVs) and writes:
+
+* a markdown scaling table per strategy (stdout),
+* per-strategy Time/SpeedUp/Efficiency figures,
+* a cross-strategy comparison figure at the largest common size.
+
+Example::
+
+    python scripts/stats_visualization.py --data-out /root/reference/data/out \
+        --fig-dir figures/reference
+    python scripts/stats_visualization.py --data-out data/out --itemsize 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from matvec_mpi_multiplier_tpu.analysis.plots import plot_comparison, plot_strategy
+from matvec_mpi_multiplier_tpu.analysis.stats import format_table, load_strategy_csv
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-out", default="data/out", help="directory of CSVs")
+    p.add_argument("--fig-dir", default="figures", help="output directory")
+    p.add_argument(
+        "--itemsize", type=int, default=8,
+        help="bytes per element for GB/s (8=fp64, 4=fp32, 2=bf16)",
+    )
+    args = p.parse_args(argv)
+
+    data_out = Path(args.data_out)
+    csvs = sorted(data_out.glob("*.csv"))
+    if not csvs:
+        print(f"no CSVs in {data_out}", file=sys.stderr)
+        return 1
+
+    by_strategy: dict[str, list] = {}
+    for path in csvs:
+        if path.stem == "results_extended":
+            continue
+        points = load_strategy_csv(path)
+        by_strategy.setdefault(path.stem, []).extend(points)
+        print(f"\n## {path.stem}\n")
+        print(format_table(points, itemsize=args.itemsize))
+        fig = plot_strategy(points, Path(args.fig_dir) / f"{path.stem}.png",
+                            title=path.stem)
+        print(f"\nfigure: {fig}")
+
+    # Comparison at the largest size shared by >1 strategy.
+    sizes: dict[tuple[int, int], int] = {}
+    for points in by_strategy.values():
+        for size in {(q.n_rows, q.n_cols) for q in points}:
+            sizes[size] = sizes.get(size, 0) + 1
+    shared = [s for s, c in sizes.items() if c > 1]
+    if shared:
+        m, n = max(shared, key=lambda s: s[0] * s[1])
+        fig = plot_comparison(
+            by_strategy, m, n, Path(args.fig_dir) / f"comparison_{m}x{n}.png"
+        )
+        print(f"\ncomparison figure: {fig}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

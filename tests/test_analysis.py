@@ -1,0 +1,100 @@
+"""Analysis-layer tests: SpeedUp/Efficiency math against the reference's own
+committed CSVs (the numbers BASELINE.md derives must fall out of our code)."""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu.analysis.stats import (
+    best_point,
+    format_table,
+    load_strategy_csv,
+    scaling_table,
+)
+
+REF_OUT = "/root/reference/data/out"
+
+
+def test_reference_rowwise_speedup():
+    """BASELINE.md: rowwise 10200², p=6 → S=1.45, E=0.242."""
+    points = load_strategy_csv(f"{REF_OUT}/rowwise.csv")
+    p6 = next(
+        p for p in points
+        if (p.n_rows, p.n_cols, p.n_processes) == (10200, 10200, 6)
+    )
+    assert p6.speedup == pytest.approx(1.45, abs=0.01)
+    assert p6.efficiency == pytest.approx(0.242, abs=0.005)
+    assert p6.time_s == pytest.approx(0.207392, abs=1e-5)
+    assert p6.gflops() == pytest.approx(1.00, abs=0.02)
+
+
+def test_reference_colwise_best_speedup():
+    """BASELINE.md: colwise has the best curves — S=2.13 at 10200² p=6."""
+    points = load_strategy_csv(f"{REF_OUT}/colwise.csv")
+    p6 = next(
+        p for p in points
+        if (p.n_rows, p.n_cols, p.n_processes) == (10200, 10200, 6)
+    )
+    assert p6.speedup == pytest.approx(2.13, abs=0.01)
+
+
+def test_reference_blockwise_best_time():
+    """BASELINE.md headline: best absolute time at 10200² is blockwise p=12
+    (0.2017 s), and p=24 collapses."""
+    points = load_strategy_csv(f"{REF_OUT}/blockwise.csv")
+    best = best_point(points, 10200, 10200)
+    assert best.n_processes == 12
+    assert best.time_s == pytest.approx(0.201654, abs=1e-5)
+    p24 = next(p for p in points if p.n_processes == 24 and p.n_rows == 10200)
+    assert p24.speedup < 0.2  # oversubscription collapse (README.md:74)
+
+
+def test_reference_asymmetric_parses():
+    """Quirk Q10: asymmetric CSVs have a no-space header; must still parse."""
+    points = load_strategy_csv(f"{REF_OUT}/asymmetric_rowwise.csv")
+    assert {p.n_cols for p in points} == {60000}
+    p6 = next(p for p in points if p.n_rows == 1200 and p.n_processes == 6)
+    assert p6.speedup == pytest.approx(1.44, abs=0.01)
+
+
+def test_scaling_table_no_baseline():
+    rows = [
+        {"n_rows": 8, "n_cols": 8, "n_processes": 2, "time": 0.5},
+    ]
+    (pt,) = scaling_table(rows)
+    assert pt.speedup is None and pt.efficiency is None
+
+
+def test_scaling_table_averages_duplicates():
+    rows = [
+        {"n_rows": 8, "n_cols": 8, "n_processes": 1, "time": 1.0},
+        {"n_rows": 8, "n_cols": 8, "n_processes": 1, "time": 3.0},
+        {"n_rows": 8, "n_cols": 8, "n_processes": 4, "time": 1.0},
+    ]
+    pts = scaling_table(rows)
+    p4 = next(p for p in pts if p.n_processes == 4)
+    assert p4.speedup == pytest.approx(2.0)
+    assert p4.efficiency == pytest.approx(0.5)
+
+
+def test_format_table():
+    points = load_strategy_csv(f"{REF_OUT}/rowwise.csv")
+    md = format_table(points[:3])
+    assert md.splitlines()[0].startswith("| Strategy | Matrix | p |")
+    assert "rowwise" in md
+
+
+def test_plots_render(tmp_path):
+    from matvec_mpi_multiplier_tpu.analysis.plots import (
+        plot_comparison,
+        plot_strategy,
+    )
+
+    points = load_strategy_csv(f"{REF_OUT}/rowwise.csv")
+    f1 = plot_strategy(points, tmp_path / "rowwise.png")
+    assert f1.exists() and f1.stat().st_size > 1000
+    by = {
+        "rowwise": points,
+        "colwise": load_strategy_csv(f"{REF_OUT}/colwise.csv"),
+    }
+    f2 = plot_comparison(by, 10200, 10200, tmp_path / "cmp.png")
+    assert f2.exists() and f2.stat().st_size > 1000

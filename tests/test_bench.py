@@ -1,0 +1,159 @@
+"""Benchmark-harness tests: timing protocol, CSV schema, sweep CLI.
+
+The CSV schema assertions pin the reference contract
+(``src/multiplier_rowwise.c:86,168``): header
+``n_rows, n_cols, n_processes, time``, append-only with write-once header.
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.bench import (
+    TimingResult,
+    append_result,
+    benchmark_strategy,
+    csv_path,
+    extended_csv_path,
+    read_csv,
+)
+from matvec_mpi_multiplier_tpu.bench.sweep import (
+    ASYMMETRIC_SIZES,
+    SQUARE_SIZES,
+    build_parser,
+    device_counts_available,
+    main as sweep_main,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+
+def _bench(mesh, name="rowwise", shape=(16, 16), **kw):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal(shape)
+    x = rng.standard_normal(shape[1])
+    return benchmark_strategy(get_strategy(name), mesh, a, x, n_reps=3, **kw)
+
+
+def test_benchmark_strategy_basic(devices):
+    res = _bench(make_mesh(4))
+    assert res.n_rows == 16 and res.n_cols == 16
+    assert res.n_devices == 4
+    assert res.strategy == "rowwise"
+    assert res.n_reps == 3
+    assert len(res.times_s) == 3  # chain measure: chain_samples estimates
+    assert res.mean_time_s == pytest.approx(np.mean(res.times_s))
+    assert res.gflops > 0 and res.gbps > 0
+
+
+def test_benchmark_sync_measure(devices):
+    res = _bench(make_mesh(2), measure="sync")
+    assert len(res.times_s) == 3  # per-rep times
+    assert all(t > 0 for t in res.times_s)
+
+
+def test_benchmark_bad_measure(devices):
+    with pytest.raises(ConfigError, match="measure"):
+        _bench(make_mesh(2), measure="guess")
+
+
+def test_benchmark_reference_mode(devices):
+    res = _bench(make_mesh(2), mode="reference")
+    assert res.mode == "reference"
+    assert all(t > 0 for t in res.times_s)
+
+
+def test_benchmark_bad_mode(devices):
+    with pytest.raises(ConfigError, match="mode"):
+        _bench(make_mesh(2), mode="warp")
+
+
+def test_timing_result_derived_metrics():
+    res = TimingResult(
+        n_rows=1000, n_cols=1000, n_devices=1, strategy="rowwise",
+        dtype="float64", mode="amortized", mean_time_s=0.001,
+        times_s=(0.001,),
+    )
+    assert res.gflops == pytest.approx(2.0)  # 2e6 flops / 1e-3 s / 1e9
+    # 8 bytes * (1e6 + 2e3) elements / 1e-3 s / 1e9
+    assert res.gbps == pytest.approx(8 * (1_002_000) / 1e6, rel=1e-6)
+    assert res.min_time_s == 0.001
+
+
+def test_csv_reference_schema(devices, tmp_path):
+    res = _bench(make_mesh(2))
+    path = append_result(res, tmp_path)
+    assert path == csv_path("rowwise", tmp_path)
+    lines = path.read_text().splitlines()
+    # Byte-identical header to src/multiplier_rowwise.c:86.
+    assert lines[0] == "n_rows, n_cols, n_processes, time"
+    assert lines[1].startswith("16, 16, 2, ")
+    # Append-only, header written once (reference :77-88).
+    append_result(res, tmp_path)
+    lines = path.read_text().splitlines()
+    assert len(lines) == 3
+    assert lines[0] == "n_rows, n_cols, n_processes, time"
+
+
+def test_csv_extended(devices, tmp_path):
+    res = _bench(make_mesh(2))
+    append_result(res, tmp_path)
+    rows = read_csv(extended_csv_path(tmp_path))
+    assert rows[0]["strategy"] == "rowwise"
+    assert rows[0]["n_devices"] == 2
+    assert rows[0]["gflops"] > 0
+
+
+def test_read_csv_reference_files():
+    """Our parser must read the reference's own committed CSVs, including the
+    no-space asymmetric header (quirk Q10)."""
+    rows = read_csv("/root/reference/data/out/rowwise.csv")
+    assert rows[0] == {"n_rows": 600, "n_cols": 600, "n_processes": 1,
+                       "time": pytest.approx(0.00101, abs=1e-4)}
+    arows = read_csv("/root/reference/data/out/asymmetric_rowwise.csv")
+    assert arows[0]["n_cols"] == 60000
+
+
+def test_sweep_sizes_match_reference():
+    # test.sh:8 — 600..10200 step 1200; asymmetric CSVs: 120..1200 x 60000.
+    assert SQUARE_SIZES == [600, 1800, 3000, 4200, 5400, 6600, 7800, 9000, 10200]
+    assert ASYMMETRIC_SIZES[0] == (120, 60000)
+    assert ASYMMETRIC_SIZES[-1] == (1200, 60000)
+    assert len(ASYMMETRIC_SIZES) == 10
+
+
+def test_device_counts(devices):
+    assert device_counts_available() == [1, 2, 4, 8]
+    assert device_counts_available(max_devices=3) == [1, 2, 3]
+
+
+def test_sweep_cli_end_to_end(devices, tmp_path, monkeypatch):
+    monkeypatch.setenv("MATVEC_DATA_DIR", str(tmp_path))
+    rc = sweep_main([
+        "--strategy", "rowwise", "--devices", "2", "--sizes", "16",
+        "--n-reps", "2", "--dtype", "float64",
+    ])
+    assert rc == 0
+    rows = read_csv(csv_path("rowwise", tmp_path))
+    assert rows[0]["n_rows"] == 16 and rows[0]["n_processes"] == 2
+
+
+def test_sweep_cli_skips_indivisible(devices, tmp_path, capsys):
+    rc = sweep_main([
+        "--strategy", "rowwise", "--devices", "8", "--sizes", "12",
+        "--n-reps", "1", "--no-csv",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "skip rowwise 12x12" in out
+
+
+def test_sweep_cli_unknown_strategy():
+    with pytest.raises(SystemExit, match="unknown strategy"):
+        sweep_main(["--strategy", "nope", "--no-csv"])
+
+
+def test_parser_defaults():
+    args = build_parser().parse_args([])
+    assert args.mode == "amortized"
+    assert args.n_reps == 100
+    assert args.sweep == "square"

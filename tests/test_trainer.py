@@ -1,0 +1,53 @@
+"""Distributed least-squares trainer tests (models/trainer.py).
+
+Verifies the training step runs fully sharded on the 2-D virtual mesh, the
+loss decreases, the recovered solution matches the normal-equations solution,
+and the parameter sharding survives the update.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.models import trainer
+
+
+def test_fit_converges(devices, rng):
+    mesh = make_mesh(8)  # 2x4
+    x_true = rng.standard_normal(16)
+    a = rng.standard_normal((32, 16))
+    b = a @ x_true
+    state, losses = trainer.fit(
+        mesh, a, b, learning_rate=0.02, n_steps=300, dtype=jnp.float64
+    )
+    assert losses[-1] < 1e-3 * losses[0]
+    np.testing.assert_allclose(np.asarray(state.x), x_true, atol=0.2)
+
+
+def test_param_stays_sharded(devices, rng):
+    mesh = make_mesh(8)
+    a = rng.standard_normal((16, 16))
+    b = rng.standard_normal(16)
+    opt = optax.sgd(1e-3)
+    sh = trainer.shardings(mesh)
+    state = trainer.init_state(mesh, 16, opt, dtype=jnp.float64)
+    step = trainer.build_train_step(mesh, opt)
+    a_dev = jax.device_put(jnp.asarray(a), sh["a"])
+    b_dev = jax.device_put(jnp.asarray(b), sh["b"])
+    state, loss = step(state, a_dev, b_dev)
+    assert state.x.sharding.spec == P("cols")
+    assert state.step == 1
+    assert np.isfinite(float(loss))
+
+
+def test_single_device_matches_multi(devices, rng):
+    """Same problem, 1-device vs 8-device mesh: identical trajectories (up to
+    fp64 reduction-order noise)."""
+    a = rng.standard_normal((16, 8))
+    b = rng.standard_normal(16)
+    _, l1 = trainer.fit(make_mesh(1), a, b, n_steps=20, dtype=jnp.float64)
+    _, l8 = trainer.fit(make_mesh(8), a, b, n_steps=20, dtype=jnp.float64)
+    np.testing.assert_allclose(l1, l8, rtol=1e-9)
