@@ -29,9 +29,20 @@ def out_dir(root: str | os.PathLike | None = None) -> Path:
     return data_dir(root) / OUT_SUBDIR
 
 
-def csv_path(strategy: str, root: str | os.PathLike | None = None) -> Path:
-    """Per-strategy CSV, the reference's ``./data/out/<strategy>.csv``."""
-    return out_dir(root) / f"{strategy}.csv"
+def csv_path(
+    strategy: str, root: str | os.PathLike | None = None, mode: str = "amortized"
+) -> Path:
+    """Per-strategy CSV, the reference's ``./data/out/<strategy>.csv``.
+
+    Reference-mode timings (host transfer in the timed region) land in a
+    separate ``<strategy>_reference.csv``: the two modes differ by orders of
+    magnitude and the reference schema has no column to tell them apart, so
+    sharing a file would corrupt the SpeedUp/Efficiency averaging in
+    analysis/stats.py. (The schema also cannot carry dtype — use the extended
+    CSV for dtype-aware analysis.)
+    """
+    suffix = "" if mode == "amortized" else f"_{mode}"
+    return out_dir(root) / f"{strategy}{suffix}.csv"
 
 
 def extended_csv_path(root: str | os.PathLike | None = None) -> Path:
@@ -54,7 +65,7 @@ def append_result(result: TimingResult, root: str | os.PathLike | None = None) -
     ``src/multiplier_rowwise.c:168``: comma+space separated, time with 6
     decimal places.
     """
-    path = csv_path(result.strategy, root)
+    path = csv_path(result.strategy, root, mode=result.mode)
     row = (
         f"{result.n_rows}, {result.n_cols}, {result.n_devices}, "
         f"{result.mean_time_s:.6f}"

@@ -1,0 +1,39 @@
+"""Profiling: JAX/XLA trace capture around benchmark regions.
+
+Reference analog: §5.1 — the reference has no tracer; its only profiling is
+the manual barrier/Wtime protocol (C9). The timing module reproduces that
+protocol; this module adds the capability the reference lacked: on-device
+traces (TensorBoard/Perfetto format) of the benchmark region, showing the
+XLA fusion boundaries, collective schedule, and HBM traffic that the
+wall-clock numbers summarize.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from pathlib import Path
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | os.PathLike, *, enabled: bool = True):
+    """Capture a device trace of the enclosed region into ``log_dir``.
+
+    View with TensorBoard (profile plugin) or Perfetto. ``enabled=False``
+    turns this into a no-op so call sites can thread a --profile flag
+    through unconditionally.
+    """
+    if not enabled:
+        yield None
+        return
+    log_dir = Path(log_dir)
+    log_dir.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(log_dir)):
+        yield log_dir
+
+
+def annotate(name: str):
+    """Named sub-region inside a trace (shows as a span in the viewer)."""
+    return jax.profiler.TraceAnnotation(name)

@@ -157,18 +157,24 @@ def run_sweep(args: argparse.Namespace) -> int:
     modes = list(TIMING_MODES) if args.mode == "both" else [args.mode]
 
     n_ok = n_skip = 0
-    for name in strategies:
-        for n_dev in counts:
-            mesh = make_mesh(n_dev)
+    meshes = {n_dev: make_mesh(n_dev) for n_dev in counts}
+    # Sizes on the outer loop: operands depend only on the size (and seed),
+    # so each (n_rows, n_cols) pair is generated/loaded exactly once and
+    # shared across every strategy x device-count combination.
+    for n_rows, n_cols in sizes:
+        a = x = None
+        for name in strategies:
             strat = get_strategy(name)
-            for n_rows, n_cols in sizes:
+            for n_dev in counts:
+                mesh = meshes[n_dev]
                 try:
                     strat.validate(n_rows, n_cols, mesh)
                 except MatvecError as e:
                     print(f"skip {name} {n_rows}x{n_cols} p={n_dev}: {e}")
                     n_skip += 1
                     continue
-                a, x = operands(n_rows, n_cols, args)
+                if a is None:
+                    a, x = operands(n_rows, n_cols, args)
                 for mode in modes:
                     result = benchmark_strategy(
                         strat,
@@ -190,7 +196,8 @@ def run_sweep(args: argparse.Namespace) -> int:
                     n_ok += 1
     if not args.no_csv:
         for name in strategies:
-            print(f"CSV: {csv_path(name, args.data_root)}")
+            for mode in modes:
+                print(f"CSV: {csv_path(name, args.data_root, mode=mode)}")
     print(f"{n_ok} configs timed, {n_skip} skipped")
     return 0
 

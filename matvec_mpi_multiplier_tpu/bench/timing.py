@@ -187,6 +187,12 @@ def time_matvec(
         # Chain for amortized (robust everywhere); literal per-rep protocol
         # for reference mode, whose point is to include the transfer.
         measure = "chain" if mode == "amortized" else "sync"
+    if mode == "reference" and measure == "chain":
+        raise ConfigError(
+            "measure='chain' cannot time mode='reference': the per-rep "
+            "host->device transfer is the thing being measured and cannot "
+            "ride a fenced execution chain; use measure='sync'"
+        )
     sh_a, sh_x = shardings if shardings is not None else (None, None)
 
     def place(arr, sh):

@@ -9,12 +9,13 @@ from __future__ import annotations
 
 from .base import MatvecStrategy
 from .blockwise import BlockwiseStrategy
-from .colwise import ColwiseStrategy
+from .colwise import ColwiseRingStrategy, ColwiseStrategy
 from .rowwise import RowwiseStrategy
 
 STRATEGIES: dict[str, type[MatvecStrategy]] = {
     RowwiseStrategy.name: RowwiseStrategy,
     ColwiseStrategy.name: ColwiseStrategy,
+    ColwiseRingStrategy.name: ColwiseRingStrategy,
     BlockwiseStrategy.name: BlockwiseStrategy,
 }
 
@@ -37,6 +38,7 @@ __all__ = [
     "MatvecStrategy",
     "RowwiseStrategy",
     "ColwiseStrategy",
+    "ColwiseRingStrategy",
     "BlockwiseStrategy",
     "STRATEGIES",
     "get_strategy",

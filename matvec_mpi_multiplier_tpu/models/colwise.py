@@ -67,3 +67,27 @@ class ColwiseStrategy(MatvecStrategy):
         check_divisible(n_cols, p, "n_cols", "number of devices")
         if self.scatter_output:
             check_divisible(n_rows, p, "n_rows", "number of devices")
+
+
+class ColwiseRingStrategy(ColwiseStrategy):
+    """Colwise with the combine expressed as an explicit neighbor-ring
+    reduce-scatter (parallel/ring.py) instead of one ``lax.psum_scatter`` —
+    the long-context / sequence-parallel schedule (each hop rides a single
+    ICI neighbor link, adds overlap hops). Output is always row-sharded.
+    """
+
+    name = "colwise_ring"
+
+    def __init__(self):
+        super().__init__(scatter_output=True)
+
+    def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
+        from ..parallel.ring import ring_psum_scatter
+
+        axes = flat_axes(mesh)
+
+        def body(a_panel, x_seg):
+            partial = kernel(a_panel, x_seg)
+            return ring_psum_scatter(partial, axes).astype(a_panel.dtype)
+
+        return body

@@ -59,9 +59,11 @@ def init_state(
 ) -> TrainState:
     sh = shardings(mesh)
     x0 = jax.device_put(jnp.zeros((n_cols,), dtype=dtype), sh["x"])
-    return TrainState(
-        x=x0, opt_state=optimizer.init(x0), step=jnp.zeros((), jnp.int32)
-    )
+    # step lives replicated on the mesh so the whole state shares one device
+    # set (a single-device scalar would poison jit/checkpoint-restore with
+    # mixed device placements).
+    step0 = jax.device_put(jnp.zeros((), jnp.int32), sh["replicated"])
+    return TrainState(x=x0, opt_state=optimizer.init(x0), step=step0)
 
 
 def loss_fn(x: Array, a: Array, b: Array, mesh: Mesh) -> Array:

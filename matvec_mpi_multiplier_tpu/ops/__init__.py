@@ -4,8 +4,9 @@ from . import gemv
 from .gemv import available_kernels, get_kernel, gemv_xla, register_kernel
 
 # Kernel tiers self-register on import; pallas is always available (it falls
-# back to interpret mode off-TPU).
+# back to interpret mode off-TPU), native only when its .so has been built.
 from . import pallas_gemv  # noqa: F401
+from . import native_gemv  # noqa: F401
 
 __all__ = [
     "gemv",

@@ -1,0 +1,139 @@
+"""Native C++ GEMV tier: ctypes oracle + XLA CPU custom call.
+
+Reference analog: the reference's entire compute path is native C compiled by
+mpicc (``multiply_std_rowwise``, ``src/matr_utils.c:86-96``). This module
+keeps a true native-code execution path in the TPU-native framework:
+
+* :func:`gemv_ctypes` — direct ctypes call into ``libmatvec_gemv.so``
+  (numpy in/out), used as a JAX-free oracle in tests;
+* ``kernel name "native"`` — the same C++ kernel as an XLA FFI custom call on
+  the CPU backend, usable inside jit/shard_map (the off-TPU native tier; TPU
+  executes the XLA/Pallas tiers — a host custom call has no place on an
+  accelerator hot path).
+
+The library is built by ``make -C native`` (repo root); if it is absent this
+module degrades gracefully: :func:`native_available` returns False and the
+kernel is not registered.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+import jax
+import numpy as np
+from jax import Array
+
+from .gemv import register_kernel
+
+_LIB_ENV = "MATVEC_NATIVE_LIB"
+_FFI_TARGETS_REGISTERED = False
+_lib: ctypes.CDLL | None = None
+
+
+def _lib_path() -> Path:
+    if _LIB_ENV in os.environ:
+        return Path(os.environ[_LIB_ENV])
+    # repo layout: <root>/native/libmatvec_gemv.so, package at <root>/matvec_…
+    return Path(__file__).resolve().parents[2] / "native" / "libmatvec_gemv.so"
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _lib_path()
+    if not path.exists():
+        return None
+    lib = ctypes.CDLL(str(path))
+    for sym, ctype in (("matvec_gemv_f32", ctypes.c_float),
+                       ("matvec_gemv_f64", ctypes.c_double)):
+        fn = getattr(lib, sym)
+        fn.restype = None
+        fn.argtypes = [
+            ctypes.POINTER(ctype),
+            ctypes.POINTER(ctype),
+            ctypes.POINTER(ctype),
+            ctypes.c_int64,
+            ctypes.c_int64,
+        ]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def gemv_ctypes(a: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Host-side native GEMV (numpy in/out) — the JAX-free oracle path."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            f"native library not found at {_lib_path()}; run `make -C native`"
+        )
+    a = np.ascontiguousarray(a)
+    x = np.ascontiguousarray(x, dtype=a.dtype)
+    if a.dtype == np.float32:
+        fn, ctype = lib.matvec_gemv_f32, ctypes.c_float
+    elif a.dtype == np.float64:
+        fn, ctype = lib.matvec_gemv_f64, ctypes.c_double
+    else:
+        raise TypeError(f"native gemv supports float32/float64, got {a.dtype}")
+    m, k = a.shape
+    y = np.empty((m,), dtype=a.dtype)
+    ptr = lambda arr: arr.ctypes.data_as(ctypes.POINTER(ctype))
+    fn(ptr(a), ptr(x), ptr(y), m, k)
+    return y
+
+
+def _register_ffi_targets() -> bool:
+    """Register the .so's XLA FFI handlers as CPU custom-call targets."""
+    global _FFI_TARGETS_REGISTERED
+    if _FFI_TARGETS_REGISTERED:
+        return True
+    lib = _load()
+    if lib is None:
+        return False
+    for target, symbol in (("matvec_gemv_f32_ffi", "GemvF32"),
+                           ("matvec_gemv_f64_ffi", "GemvF64")):
+        handler = getattr(lib, symbol)
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(handler), platform="cpu"
+        )
+    _FFI_TARGETS_REGISTERED = True
+    return True
+
+
+def gemv_native(a: Array, x: Array) -> Array:
+    """The C++ kernel as an XLA custom call (CPU backend only).
+
+    Matches the kernel registry contract (ops/gemv.py) except that the native
+    kernel accumulates in its storage dtype (like the reference's C kernel,
+    which is all-fp64) — it supports f32/f64 only, where storage == preferred
+    accumulator anyway.
+    """
+    if not _register_ffi_targets():
+        raise RuntimeError(
+            f"native library not found at {_lib_path()}; run `make -C native`"
+        )
+    if a.dtype == np.float32:
+        target = "matvec_gemv_f32_ffi"
+    elif a.dtype == np.float64:
+        target = "matvec_gemv_f64_ffi"
+    else:
+        raise TypeError(f"native gemv supports float32/float64, got {a.dtype}")
+    call = jax.ffi.ffi_call(
+        target, jax.ShapeDtypeStruct((a.shape[0],), a.dtype)
+    )
+    return call(a, x)
+
+
+# The FFI result's varying-axes set can't be tracked by the shard_map vma
+# checker (same situation as pallas interpret mode — see models/base.py).
+gemv_native.relax_vma_check = True  # type: ignore[attr-defined]
+
+if native_available():
+    register_kernel("native", gemv_native)

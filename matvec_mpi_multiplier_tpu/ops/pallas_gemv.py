@@ -48,9 +48,13 @@ def _largest_divisor_leq(n: int, cap: int, multiple: int) -> int | None:
 
 
 def _gemv_kernel(a_ref, x_ref, o_ref):
-    """One (bm, bk) tile: o[bm, 1] (+)= sum(a * x, axis=1)."""
-    a_tile = a_ref[...].astype(jnp.float32)
-    x_tile = x_ref[...].astype(jnp.float32)  # (1, bk)
+    """One (bm, bk) tile: o[bm, 1] (+)= sum(a * x, axis=1).
+
+    Accumulates in the output ref's dtype — the kernel-contract accumulator
+    (fp32 for bf16/fp32 storage, fp64 for fp64 storage; ops/gemv.py).
+    """
+    a_tile = a_ref[...].astype(o_ref.dtype)
+    x_tile = x_ref[...].astype(o_ref.dtype)  # (1, bk)
     partial = jnp.sum(a_tile * x_tile, axis=1, keepdims=True)  # (bm, 1)
 
     @pl.when(pl.program_id(1) == 0)
@@ -73,6 +77,10 @@ def _pallas_gemv(
     vma = frozenset(jax.typeof(a).vma) | frozenset(jax.typeof(x).vma)
     a = jax.lax.pcast(a, tuple(vma - frozenset(jax.typeof(a).vma)), to="varying")
     x = jax.lax.pcast(x, tuple(vma - frozenset(jax.typeof(x).vma)), to="varying")
+    # Kernel contract (ops/gemv.py): accumulate and return the accumulator
+    # dtype (fp32 for bf16/fp32, fp64 for fp64); the strategy casts back to
+    # storage dtype after its cross-device reduce.
+    acc = jnp.promote_types(a.dtype, jnp.float32)
     out = pl.pallas_call(
         _gemv_kernel,
         grid=grid,
@@ -81,17 +89,24 @@ def _pallas_gemv(
             pl.BlockSpec((1, bk), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((m, 1), jnp.float32, vma=vma),
+        out_shape=jax.ShapeDtypeStruct((m, 1), acc, vma=vma),
         interpret=interpret,
     )(a, x[None, :])
-    # Kernel contract (ops/gemv.py): return the accumulator dtype; the
-    # strategy casts back to storage dtype after its cross-device reduce.
-    acc = jnp.promote_types(a.dtype, jnp.float32)
-    return out[:, 0].astype(acc)
+    return out[:, 0]
 
 
 def _on_tpu() -> bool:
-    return jax.default_backend() not in ("cpu",)
+    """True only on a real TPU backend — interpret mode everywhere else
+    (CPU, GPU, ...); the TPU BlockSpecs here don't lower on other backends.
+    Checked via the device rather than the backend name so TPU-plugin
+    platforms with custom names are still recognized."""
+    devs = jax.devices()
+    if not devs:
+        return False
+    d = devs[0]
+    return "tpu" in (getattr(d, "platform", "") or "").lower() or "tpu" in (
+        getattr(d, "device_kind", "") or ""
+    ).lower()
 
 
 def gemv_pallas(a: Array, x: Array) -> Array:
@@ -113,6 +128,6 @@ def gemv_pallas(a: Array, x: Array) -> Array:
 # Marks this kernel for the shard_map vma-check relaxation (models/base.py):
 # interpret-mode pallas mixes constants into the body in ways the vma checker
 # cannot track.
-gemv_pallas.uses_pallas = True  # type: ignore[attr-defined]
+gemv_pallas.relax_vma_check = True  # type: ignore[attr-defined]
 
 register_kernel("pallas", gemv_pallas)

@@ -1,0 +1,84 @@
+"""Multi-host runtime: the MPI_Init/MPI_Finalize analog.
+
+Reference analog: L0 runtime bring-up — ``MPI_Init``/``MPI_Finalize``
+(``src/multiplier_rowwise.c:66,157``) and the SPMD identity calls
+``MPI_Comm_size``/``MPI_Comm_rank`` (``:68-69``). The reference launches p
+single-threaded ranks with ``mpiexec -n p`` on one machine (``test.sh:11``);
+the TPU equivalent is one JAX process per host, each owning its local
+devices, joined by ``jax.distributed.initialize`` — after which
+``jax.devices()`` spans every chip in the slice/pod and the mesh layer
+(parallel/mesh.py) shards over ICI within a slice and DCN across slices.
+
+On a single host nothing needs initializing — every helper degrades to the
+trivial one-process answers, so the same benchmark scripts run unmodified on
+a laptop CPU, one TPU VM, or a multi-host pod (driven by e.g.
+``gcloud ... tpu-vm ssh --worker=all --command="python bench.py"``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..utils.constants import MAIN_PROCESS
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the multi-host runtime (no-op if already initialized or if all
+    arguments are None on a TPU pod, where JAX autodetects from metadata).
+
+    Mirrors ``MPI_Init`` (``src/multiplier_rowwise.c:66``): call once at
+    program start, before any device computation.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if kwargs or _on_multihost_platform():
+        jax.distributed.initialize(**kwargs)
+
+
+def _on_multihost_platform() -> bool:
+    """True when running under a launcher that provides coordination env
+    (TPU pod metadata / SLURM / OMPI) — the cases jax.distributed.initialize
+    can autodetect."""
+    import os
+
+    return any(
+        v in os.environ
+        for v in ("COORDINATOR_ADDRESS", "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE")
+    )
+
+
+def process_index() -> int:
+    """This process's rank (``MPI_Comm_rank``, ``src/multiplier_rowwise.c:69``)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """World size in processes (``MPI_Comm_size``, ``src/multiplier_rowwise.c:68``)."""
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """The coordinator-role check (``rank == MAIN_PROCESS``,
+    ``src/constants.h:5``): the process that loads data files and writes CSV
+    metrics, exactly as the reference's root rank does."""
+    return jax.process_index() == MAIN_PROCESS
+
+
+def device_count() -> int:
+    """Global device count across all processes (the 'p' in speedup curves)."""
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,71 @@
+"""Checkpoint / resume.
+
+Reference analog: §5.4 — the reference's only resumable state is its
+append-only CSV with a write-once header (``src/multiplier_rowwise.c:77-88``),
+which lets an interrupted sweep be re-run incrementally. That behavior is
+preserved verbatim in bench/metrics.py. This module adds real compute-state
+checkpointing (a capability the reference lacks) for the trainer: Orbax
+save/restore of the sharded TrainState, restoring arrays directly to their
+mesh shardings so resume never materializes the full state on one host.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_state(state: Any, path: str | os.PathLike) -> Path:
+    """Save a pytree (e.g. models.trainer.TrainState) to ``path``."""
+    path = Path(path).resolve()
+    _checkpointer().save(path, state, force=True)
+    return path
+
+
+def restore_state(path: str | os.PathLike, like: Any) -> Any:
+    """Restore a pytree saved by :func:`save_state`.
+
+    ``like`` is a template pytree (same structure; arrays may be abstract or
+    concrete) — each restored array adopts the corresponding template
+    array's sharding, so state comes back distributed across the mesh.
+    """
+    import orbax.checkpoint as ocp
+
+    def to_restore_args(x):
+        if isinstance(x, jax.Array):
+            return ocp.ArrayRestoreArgs(
+                sharding=x.sharding, global_shape=x.shape, dtype=x.dtype
+            )
+        return ocp.RestoreArgs()
+
+    restore_args = jax.tree.map(to_restore_args, like)
+    return _checkpointer().restore(
+        Path(path).resolve(), item=like, restore_args=restore_args
+    )
+
+
+def latest_step_dir(root: str | os.PathLike) -> Path | None:
+    """Find the highest-numbered ``step_<n>`` checkpoint under ``root``."""
+    root = Path(root)
+    if not root.exists():
+        return None
+    steps = []
+    for p in root.iterdir():
+        if p.is_dir() and p.name.startswith("step_"):
+            try:
+                steps.append((int(p.name.split("_", 1)[1]), p))
+            except ValueError:
+                continue
+    if not steps:
+        return None
+    return max(steps)[1]

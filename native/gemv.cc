@@ -1,0 +1,87 @@
+// Native C++ GEMV kernel: the framework's host-side native compute tier.
+//
+// Reference analog: multiply_std_rowwise (src/matr_utils.c:86-96), the serial
+// dense row-major dot-product kernel the reference compiles with mpicc. Here
+// the same kernel is exposed two ways:
+//   * plain extern "C" entry points (matvec_gemv_f32/f64) for ctypes use as a
+//     host-side oracle;
+//   * typed XLA FFI handlers (GemvF32/GemvF64) registered as CPU custom
+//     calls, so the native kernel participates in jitted/shard_mapped JAX
+//     programs off-TPU (the true native-code execution path).
+//
+// Build: `make` in this directory (links against nothing; XLA FFI headers
+// ship with jaxlib, see Makefile).
+
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+template <typename T>
+void GemvKernel(const T* a, const T* x, T* y, int64_t m, int64_t k) {
+  for (int64_t i = 0; i < m; ++i) {
+    const T* row = a + i * k;
+    // Four partial accumulators break the sequential-add dependence chain so
+    // the compiler can keep the FMA pipes full after vectorizing.
+    T acc0 = 0, acc1 = 0, acc2 = 0, acc3 = 0;
+    int64_t j = 0;
+    for (; j + 4 <= k; j += 4) {
+      acc0 += row[j] * x[j];
+      acc1 += row[j + 1] * x[j + 1];
+      acc2 += row[j + 2] * x[j + 2];
+      acc3 += row[j + 3] * x[j + 3];
+    }
+    for (; j < k; ++j) acc0 += row[j] * x[j];
+    y[i] = (acc0 + acc1) + (acc2 + acc3);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void matvec_gemv_f32(const float* a, const float* x, float* y, int64_t m,
+                     int64_t k) {
+  GemvKernel(a, x, y, m, k);
+}
+
+void matvec_gemv_f64(const double* a, const double* x, double* y, int64_t m,
+                     int64_t k) {
+  GemvKernel(a, x, y, m, k);
+}
+
+}  // extern "C"
+
+template <ffi::DataType DT>
+static ffi::Error GemvImpl(ffi::Buffer<DT> a, ffi::Buffer<DT> x,
+                           ffi::ResultBuffer<DT> y) {
+  auto dims = a.dimensions();
+  if (dims.size() != 2) {
+    return ffi::Error::InvalidArgument("gemv: a must be rank 2");
+  }
+  int64_t m = dims[0];
+  int64_t k = dims[1];
+  if (x.element_count() != k) {
+    return ffi::Error::InvalidArgument("gemv: x length must equal a cols");
+  }
+  if (y->element_count() != m) {
+    return ffi::Error::InvalidArgument("gemv: y length must equal a rows");
+  }
+  GemvKernel(a.typed_data(), x.typed_data(), y->typed_data(), m, k);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GemvF32, GemvImpl<ffi::F32>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GemvF64, GemvImpl<ffi::F64>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F64>>()
+                                  .Arg<ffi::Buffer<ffi::F64>>()
+                                  .Ret<ffi::Buffer<ffi::F64>>());

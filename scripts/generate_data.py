@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Data-file generator CLI.
+
+The reference generates its input matrices externally with numpy and saves
+them as %.4f text (README.md:32) but never commits the generator; its
+``.gitignore`` excludes the resulting ``*.txt``. This script IS that missing
+generator, emitting files in the exact ``data/matrix_<r>_<c>.txt`` /
+``data/vector_<n>.txt`` convention (src/matr_utils.c:9-18).
+
+Examples::
+
+    python scripts/generate_data.py 600 600            # one square pair
+    python scripts/generate_data.py --sweep square     # the full test.sh:8 set
+    python scripts/generate_data.py --sweep asymmetric # 120..1200 x 60000
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from matvec_mpi_multiplier_tpu.bench.sweep import ASYMMETRIC_SIZES, SQUARE_SIZES
+from matvec_mpi_multiplier_tpu.utils import io
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("n_rows", nargs="?", type=int)
+    p.add_argument("n_cols", nargs="?", type=int)
+    p.add_argument("--sweep", choices=["square", "asymmetric"], default=None)
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    if args.sweep == "square":
+        sizes = [(s, s) for s in SQUARE_SIZES]
+    elif args.sweep == "asymmetric":
+        sizes = list(ASYMMETRIC_SIZES)
+    elif args.n_rows and args.n_cols:
+        sizes = [(args.n_rows, args.n_cols)]
+    else:
+        p.error("give n_rows n_cols, or --sweep square|asymmetric")
+
+    for n_rows, n_cols in sizes:
+        mp = io.save_matrix(
+            io.generate_matrix(n_rows, n_cols, seed=args.seed), args.data_root
+        )
+        vp = io.save_vector(
+            io.generate_vector(n_cols, seed=args.seed + 1), args.data_root
+        )
+        print(f"{mp}  {vp}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+"""Aux-subsystem tests: distributed helpers, profiling, checkpoint/resume,
+data-generator CLI."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.bench.profiling import annotate, trace
+from matvec_mpi_multiplier_tpu.models import trainer
+from matvec_mpi_multiplier_tpu.parallel import distributed
+from matvec_mpi_multiplier_tpu.utils import checkpoint
+
+
+def test_distributed_single_process(devices):
+    # Single-host: trivial identities, no initialization needed.
+    assert distributed.process_count() == 1
+    assert distributed.process_index() == 0
+    assert distributed.is_main_process()
+    assert distributed.device_count() == 8
+    assert distributed.local_device_count() == 8
+    distributed.initialize()  # must be a no-op, not raise
+    assert distributed.process_count() == 1
+
+
+def test_profiling_trace(devices, tmp_path):
+    with trace(tmp_path / "prof") as d:
+        with annotate("matvec-region"):
+            jnp.dot(jnp.ones((64, 64)), jnp.ones(64)).block_until_ready()
+    files = list((tmp_path / "prof").rglob("*"))
+    assert files, "trace produced no files"
+
+
+def test_profiling_disabled(tmp_path):
+    with trace(tmp_path / "prof2", enabled=False) as d:
+        assert d is None
+    assert not (tmp_path / "prof2").exists()
+
+
+def test_checkpoint_roundtrip_sharded(devices, rng, tmp_path):
+    """Save a sharded TrainState, restore into the same shardings, resume."""
+    mesh = make_mesh(8)
+    opt = optax.sgd(1e-2)
+    a = rng.standard_normal((16, 16)).astype(np.float32)
+    b = rng.standard_normal(16).astype(np.float32)
+    sh = trainer.shardings(mesh)
+    a_dev = jax.device_put(jnp.asarray(a), sh["a"])
+    b_dev = jax.device_put(jnp.asarray(b), sh["b"])
+    step = trainer.build_train_step(mesh, opt)
+    state = trainer.init_state(mesh, 16, opt)
+    for _ in range(3):
+        state, _ = step(state, a_dev, b_dev)
+
+    path = checkpoint.save_state(state, tmp_path / "ckpt" / "step_3")
+    template = trainer.init_state(mesh, 16, opt)
+    restored = checkpoint.restore_state(path, template)
+
+    assert int(restored.step) == 3
+    assert restored.x.sharding == state.x.sharding
+    np.testing.assert_allclose(np.asarray(restored.x), np.asarray(state.x))
+
+    # Resumed trajectory == uninterrupted trajectory.
+    cont_a, _ = step(state, a_dev, b_dev)
+    cont_b, _ = step(restored, a_dev, b_dev)
+    np.testing.assert_allclose(np.asarray(cont_a.x), np.asarray(cont_b.x))
+
+
+def test_latest_step_dir(tmp_path):
+    assert checkpoint.latest_step_dir(tmp_path / "none") is None
+    for s in (1, 5, 10):
+        (tmp_path / f"step_{s}").mkdir()
+    (tmp_path / "step_bogus").mkdir()
+    assert checkpoint.latest_step_dir(tmp_path).name == "step_10"
+
+
+def test_generate_data_cli(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, "/root/repo/scripts")
+    import generate_data
+
+    rc = generate_data.main(["24", "16", "--data-root", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "matrix_24_16.txt").exists()
+    assert (tmp_path / "vector_16.txt").exists()
+    from matvec_mpi_multiplier_tpu.utils import io
+    a = io.load_matrix(24, 16, tmp_path)
+    x = io.load_vector(16, tmp_path)
+    assert a.shape == (24, 16) and x.shape == (16,)
+
+
+def test_generate_data_cli_requires_args():
+    import generate_data
+    with pytest.raises(SystemExit):
+        generate_data.main([])

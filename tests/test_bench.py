@@ -67,6 +67,18 @@ def test_benchmark_bad_mode(devices):
         _bench(make_mesh(2), mode="warp")
 
 
+def test_reference_mode_rejects_chain(devices):
+    with pytest.raises(ConfigError, match="chain"):
+        _bench(make_mesh(2), mode="reference", measure="chain")
+
+
+def test_reference_mode_separate_csv(devices, tmp_path):
+    res = _bench(make_mesh(2), mode="reference")
+    path = append_result(res, tmp_path)
+    assert path.name == "rowwise_reference.csv"
+    assert not csv_path("rowwise", tmp_path).exists()
+
+
 def test_timing_result_derived_metrics():
     res = TimingResult(
         n_rows=1000, n_cols=1000, n_devices=1, strategy="rowwise",

@@ -176,6 +176,8 @@ def test_kernel_accumulator_contract():
 def test_registry():
     from matvec_mpi_multiplier_tpu import available_strategies
 
-    assert available_strategies() == ["blockwise", "colwise", "rowwise"]
+    assert available_strategies() == [
+        "blockwise", "colwise", "colwise_ring", "rowwise",
+    ]
     with pytest.raises(KeyError, match="unknown strategy"):
         get_strategy("diagonal")
