@@ -248,6 +248,10 @@ def benchmark_strategy(
     if dtype is not None:
         a = a.astype(dtype)
         x = x.astype(dtype)
+    if a.dtype == np.float64 and not jax.config.jax_enable_x64:
+        # Without x64, JAX silently downcasts fp64 operands to fp32 while
+        # TimingResult would still record 'float64' — mislabeled results.
+        jax.config.update("jax_enable_x64", True)
     strategy.validate(a.shape[0], a.shape[1], mesh)
     fn = strategy.build(mesh, kernel=kernel, gather_output=gather_output)
     times = time_matvec(

@@ -100,6 +100,30 @@ def save_vector(v: np.ndarray, root: str | os.PathLike | None = None) -> Path:
     return path
 
 
+def format_matrix(a: np.ndarray, precision: int = 2) -> str:
+    """Debug-print formatting, the ``print_matr`` analog
+    (``src/matr_utils.c:21-31``): one row per line, fixed precision."""
+    a = np.atleast_2d(np.asarray(a))
+    if a.ndim != 2:
+        raise DataFileError(f"matrix must be 1-D or 2-D, got shape {a.shape}")
+    return "\n".join(
+        " ".join(f"{v:.{precision}f}" for v in row) for row in a
+    )
+
+
+def format_vector(v: np.ndarray, precision: int = 2) -> str:
+    """``print_vec`` analog (``src/matr_utils.c:33-39``): one value per line."""
+    return "\n".join(f"{x:.{precision}f}" for x in np.asarray(v).reshape(-1))
+
+
+def print_matrix(a: np.ndarray, precision: int = 2) -> None:
+    print(format_matrix(a, precision))
+
+
+def print_vector(v: np.ndarray, precision: int = 2) -> None:
+    print(format_vector(v, precision))
+
+
 def generate_matrix(
     n_rows: int, n_cols: int, seed: int = 0, high: float = 10.0
 ) -> np.ndarray:

@@ -40,6 +40,27 @@ def test_reference_fixture_format(tmp_path):
     np.testing.assert_allclose(a @ x, [222.2, 196.55, 191.57, 232.9], rtol=1e-12)
 
 
+def test_committed_fixture_files():
+    """The fixture committed in this repo's data/ (reference parity, C11)
+    must load through the convention loaders and give the known product."""
+    root = "/root/repo/data"
+    a = io.load_matrix(4, 8, root)
+    x = io.load_vector(8, root)
+    np.testing.assert_array_equal(a, FIXTURE_MATRIX)
+    np.testing.assert_array_equal(x, FIXTURE_VECTOR)
+    np.testing.assert_allclose(a @ x, [222.2, 196.55, 191.57, 232.9], rtol=1e-12)
+
+
+def test_debug_printers():
+    """print_matr/print_vec analogs (src/matr_utils.c:21-39)."""
+    assert io.format_matrix(np.array([[1.234, 5.0]])) == "1.23 5.00"
+    assert io.format_matrix(np.array([1.0, 2.0])) == "1.00 2.00"  # 1-D promotes
+    assert io.format_vector(np.array([1.5, 2.25]), precision=1) == "1.5\n2.2"
+    import pytest as _pytest
+    with _pytest.raises(DataFileError, match="1-D or 2-D"):
+        io.format_matrix(np.zeros((2, 2, 2)))
+
+
 def test_missing_file_raises(tmp_path):
     with pytest.raises(DataFileError, match="Unable to locate"):
         io.load_matrix(3, 3, tmp_path)
