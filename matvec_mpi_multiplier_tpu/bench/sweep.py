@@ -28,6 +28,8 @@ reference-faithful path through ``./data/matrix_*.txt``.
 from __future__ import annotations
 
 import argparse
+import os
+import re
 import sys
 
 import jax
@@ -38,7 +40,7 @@ from ..parallel.mesh import make_mesh
 from ..utils import io
 from ..utils.errors import MatvecError
 from .metrics import append_result, csv_path
-from .timing import TIMING_MODES, benchmark_strategy
+from .timing import MEASURE_METHODS, TIMING_MODES, benchmark_strategy
 
 # The reference's sweeps (test.sh:5,8 and the asymmetric CSVs' sizes).
 SQUARE_SIZES = list(range(600, 10201, 1200))
@@ -110,12 +112,36 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--kernel", default="xla", help="local GEMV kernel name")
     p.add_argument(
+        "--measure",
+        choices=list(MEASURE_METHODS),
+        default="auto",
+        help="'chain': slope between fenced execution chains (robust on "
+        "tunneled backends); 'sync': literal per-rep fence protocol — use on "
+        "oversubscribed virtual-device CPU meshes, where long queued chains "
+        "can starve a device thread past XLA's collective-rendezvous timeout",
+    )
+    p.add_argument(
         "--use-files",
         action="store_true",
         help="load operands via the ./data/matrix_*.txt convention "
         "(reference-faithful; slow/huge for large sizes)",
     )
     p.add_argument("--data-root", default=None, help="data directory override")
+    p.add_argument(
+        "--platform",
+        default=None,
+        help="force a jax platform (e.g. 'cpu'); set at jax.config level "
+        "because accelerator plugins may pin jax_platforms at startup, where "
+        "the JAX_PLATFORMS env var alone is outranked",
+    )
+    p.add_argument(
+        "--host-devices",
+        type=int,
+        default=None,
+        help="with --platform cpu: number of virtual host devices "
+        "(--xla_force_host_platform_device_count), the reference's "
+        "'mpiexec -n p on one machine' analog",
+    )
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
         "--no-csv", action="store_true", help="print results without writing CSVs"
@@ -143,7 +169,35 @@ def operands(n_rows: int, n_cols: int, args) -> tuple[np.ndarray, np.ndarray]:
     )
 
 
+def configure_platform(platform: str | None, host_devices: int | None) -> None:
+    """Apply platform/virtual-device overrides before any backend exists."""
+    if host_devices is not None:
+        flag = f"--xla_force_host_platform_device_count={host_devices}"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            # Replace the inherited value — silently keeping it would hand the
+            # user a different device count than the one they asked for.
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
+            )
+        else:
+            flags = f"{flags} {flag}".strip()
+        os.environ["XLA_FLAGS"] = flags
+    if platform is not None:
+        os.environ["JAX_PLATFORMS"] = platform
+        jax.config.update("jax_platforms", platform)
+
+
 def run_sweep(args: argparse.Namespace) -> int:
+    if args.measure == "chain" and args.mode in ("reference", "both"):
+        # Reject up front: time_matvec raises the same ConfigError, but only
+        # deep inside the loop, after earlier configs already burned minutes.
+        raise SystemExit(
+            "--measure chain cannot time --mode reference (the per-rep "
+            "host->device transfer cannot ride a fenced execution chain); "
+            "use --measure sync or auto"
+        )
+    configure_platform(args.platform, args.host_devices)
     strategies = resolve_strategies(args.strategy)
     counts = args.devices or device_counts_available()
     if args.sizes:
@@ -184,6 +238,7 @@ def run_sweep(args: argparse.Namespace) -> int:
                         dtype=args.dtype,
                         n_reps=args.n_reps,
                         mode=mode,
+                        measure=args.measure,
                         kernel=args.kernel,
                     )
                     if not args.no_csv:

@@ -75,6 +75,7 @@ class TimingResult:
     strategy: str
     dtype: str
     mode: str
+    measure: str
     mean_time_s: float
     # 'sync': per-rep max-across-processes times (n_reps entries);
     # 'chain': independent slope estimates of the per-matvec time.
@@ -159,6 +160,27 @@ def time_fn_chained(
     ]
 
 
+def resolve_measure(mode: str, measure: str) -> str:
+    """Validate (mode, measure) and resolve 'auto' to a concrete method."""
+    if mode not in TIMING_MODES:
+        raise ConfigError(f"mode must be one of {TIMING_MODES}, got {mode!r}")
+    if measure not in MEASURE_METHODS:
+        raise ConfigError(
+            f"measure must be one of {MEASURE_METHODS}, got {measure!r}"
+        )
+    if measure == "auto":
+        # Chain for amortized (robust everywhere); literal per-rep protocol
+        # for reference mode, whose point is to include the transfer.
+        measure = "chain" if mode == "amortized" else "sync"
+    if mode == "reference" and measure == "chain":
+        raise ConfigError(
+            "measure='chain' cannot time mode='reference': the per-rep "
+            "host->device transfer is the thing being measured and cannot "
+            "ride a fenced execution chain; use measure='sync'"
+        )
+    return measure
+
+
 def time_matvec(
     fn: Callable,
     a,
@@ -177,22 +199,7 @@ def time_matvec(
     placement). Returns per-measurement max-across-processes times in seconds
     (see module docstring for the two measurement methods).
     """
-    if mode not in TIMING_MODES:
-        raise ConfigError(f"mode must be one of {TIMING_MODES}, got {mode!r}")
-    if measure not in MEASURE_METHODS:
-        raise ConfigError(
-            f"measure must be one of {MEASURE_METHODS}, got {measure!r}"
-        )
-    if measure == "auto":
-        # Chain for amortized (robust everywhere); literal per-rep protocol
-        # for reference mode, whose point is to include the transfer.
-        measure = "chain" if mode == "amortized" else "sync"
-    if mode == "reference" and measure == "chain":
-        raise ConfigError(
-            "measure='chain' cannot time mode='reference': the per-rep "
-            "host->device transfer is the thing being measured and cannot "
-            "ride a fenced execution chain; use measure='sync'"
-        )
+    measure = resolve_measure(mode, measure)
     sh_a, sh_x = shardings if shardings is not None else (None, None)
 
     def place(arr, sh):
@@ -245,6 +252,7 @@ def benchmark_strategy(
     """Benchmark one (strategy, mesh, size) configuration — the body of the
     reference's per-config run (``src/multiplier_rowwise.c:54-176``) minus the
     CSV write (see bench.metrics)."""
+    measure = resolve_measure(mode, measure)
     if dtype is not None:
         a = a.astype(dtype)
         x = x.astype(dtype)
@@ -265,6 +273,7 @@ def benchmark_strategy(
         strategy=strategy.name,
         dtype=str(a.dtype),
         mode=mode,
+        measure=measure,
         mean_time_s=float(np.mean(times)),
         times_s=tuple(times),
         n_reps=n_reps,

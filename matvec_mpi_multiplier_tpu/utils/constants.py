@@ -34,7 +34,8 @@ DEFAULT_N_REPS: int = 100
 CSV_HEADER: str = "n_rows, n_cols, n_processes, time"
 # Extended schema for the TPU build's richer metrics (new capability).
 CSV_HEADER_EXTENDED: str = (
-    "n_rows, n_cols, n_devices, time, strategy, dtype, mode, gflops, gbps"
+    "n_rows, n_cols, n_devices, time, strategy, dtype, mode, measure, "
+    "gflops, gbps"
 )
 
 # Default mesh axis names for the 2-D device grid (reference's process grid

@@ -82,7 +82,7 @@ def test_reference_mode_separate_csv(devices, tmp_path):
 def test_timing_result_derived_metrics():
     res = TimingResult(
         n_rows=1000, n_cols=1000, n_devices=1, strategy="rowwise",
-        dtype="float64", mode="amortized", mean_time_s=0.001,
+        dtype="float64", mode="amortized", measure="sync", mean_time_s=0.001,
         times_s=(0.001,),
     )
     assert res.gflops == pytest.approx(2.0)  # 2e6 flops / 1e-3 s / 1e9
@@ -169,3 +169,36 @@ def test_parser_defaults():
     assert args.mode == "amortized"
     assert args.n_reps == 100
     assert args.sweep == "square"
+
+
+def test_sweep_rejects_chain_measure_for_reference_mode():
+    # The ConfigError from time_matvec would otherwise only surface deep in
+    # the sweep loop, after earlier configs already ran.
+    with pytest.raises(SystemExit, match="cannot time"):
+        sweep_main(["--mode", "both", "--measure", "chain", "--no-csv"])
+
+
+def test_configure_platform_replaces_inherited_device_count(monkeypatch):
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=4 --other"
+    )
+    configure_platform(None, 8)
+    import os
+
+    assert os.environ["XLA_FLAGS"] == (
+        "--xla_force_host_platform_device_count=8 --other"
+    )
+
+
+def test_configure_platform_appends_when_absent(monkeypatch):
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    configure_platform(None, 8)
+    import os
+
+    assert (
+        os.environ["XLA_FLAGS"] == "--xla_force_host_platform_device_count=8"
+    )
