@@ -147,12 +147,20 @@ def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int)
 
 def time_fn_chained(
     fn: Callable, args: tuple, *, n_reps: int = DEFAULT_N_REPS,
-    samples: int = 3,
+    samples: int = 3, warmup: int = 1,
 ) -> list[float]:
     """Chain-slope timing of an arbitrary device function on device-resident
     args (no host placement). Used by bench.py with device-side operand
-    generation so multi-GB operands never cross the host link."""
-    _fence(fn(*args))  # warm-up
+    generation so multi-GB operands never cross the host link.
+
+    ``warmup`` extra fenced executions run after the compile: a cold process
+    measurably under-reports bandwidth on its first chains (clock ramp /
+    cold caches), so headline callers should warm for a few runs.
+    """
+    y = fn(*args)
+    for _ in range(max(0, warmup)):
+        y = fn(*args)
+    _fence(y)
     n1 = max(1, n_reps // 10)
     return [
         _max_across_processes(t)
