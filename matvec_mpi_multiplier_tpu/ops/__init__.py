@@ -4,9 +4,11 @@ from . import gemv
 from .gemv import available_kernels, get_kernel, gemv_xla, register_kernel
 
 # Kernel tiers self-register on import; pallas is always available (it falls
-# back to interpret mode off-TPU), native only when its .so has been built.
+# back to interpret mode off-TPU), native only when its .so has been built,
+# compensated (double-float fp64-grade accumulation) everywhere.
 from . import pallas_gemv  # noqa: F401
 from . import native_gemv  # noqa: F401
+from . import compensated  # noqa: F401
 
 __all__ = [
     "gemv",

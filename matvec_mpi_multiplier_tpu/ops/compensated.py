@@ -1,0 +1,141 @@
+"""Compensated (double-float) GEMV: fp64-grade accumulation without fp64.
+
+Reference parity problem (SURVEY.md §7 hard part (ii)): the reference
+computes in C ``double`` end-to-end (``multiply_std_rowwise``,
+``src/matr_utils.c:86-96``), but the TPU MXU has no fp64 — plain fp32
+accumulation drifts by ~sqrt(k)·eps_f32 over a length-``k`` contraction and
+collapses entirely under cancellation. This kernel closes that gap on TPU:
+every product and every addition is tracked as an unevaluated double-float
+pair ``(hi, lo)`` via error-free transformations, giving ~2·24-bit effective
+mantissa — the practical equivalent of fp64 accumulation for fp32 data —
+using only IEEE fp32 VPU ops (no MXU, whose fp32 matmul is itself a bf16-pass
+decomposition on TPU and not error-free).
+
+Building blocks (classic EFT literature — Dekker 1971, Knuth TAOCP §4.2.2,
+Ogita-Rump-Oishi 2005):
+
+* ``two_sum(a, b)``   — branch-free exact sum: ``a + b = s + err`` exactly;
+* ``split(a)``        — Dekker split of one fp32 into two 12-bit halves;
+* ``two_prod(a, b)``  — exact product ``a*b = p + err`` via four half
+  products (no FMA primitive is exposed by jnp, so Dekker's splitting is
+  used rather than ``fma(a, b, -p)``);
+* ``df_add``          — double-float addition with renormalization;
+* a pairwise **tree reduction** over the contraction axis in double-float
+  arithmetic — O(log k) elementwise levels, so the whole kernel is VPU
+  (elementwise) work that XLA fuses; padding with exact zeros is harmless.
+
+The kernel registers as ``"compensated"``:
+``strategy.build(mesh, kernel="compensated")`` runs every local partial in
+double-float and returns the ``hi`` component in the standard accumulator
+dtype (fp32), so the cross-device ``psum`` operates on values that are each
+correctly rounded to fp32 — the remaining cross-device error is one rounding
+per mesh-axis hop, exactly the error profile of the reference's
+``MPI_Reduce(MPI_SUM)`` on doubles scaled to fp32.
+
+Works for any input dtype: bf16/fp16 are upcast to fp32 storage first (their
+values embed exactly), fp64 inputs run the same algorithm in fp64 pairs
+(quad-ish accumulation) on backends that support it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from .gemv import register_kernel
+
+# Dekker split constant for radix-2 precision p: 2^ceil(p/2) + 1.
+# fp32: p=24 -> 2^12 + 1; fp64: p=53 -> 2^27 + 1.
+_SPLITTERS = {jnp.dtype(jnp.float32): 4097.0, jnp.dtype(jnp.float64): 134217729.0}
+
+
+def two_sum(a: Array, b: Array) -> tuple[Array, Array]:
+    """Knuth's branch-free TwoSum: returns (s, err) with a + b == s + err."""
+    s = a + b
+    bp = s - a
+    err = (a - (s - bp)) + (b - bp)
+    return s, err
+
+
+def fast_two_sum(a: Array, b: Array) -> tuple[Array, Array]:
+    """Dekker's FastTwoSum, valid when |a| >= |b| (used after df renorm)."""
+    s = a + b
+    err = b - (s - a)
+    return s, err
+
+
+def split(a: Array) -> tuple[Array, Array]:
+    """Dekker split: a == hi + lo with hi, lo each fitting in half a mantissa."""
+    c = a * _SPLITTERS[jnp.dtype(a.dtype)]
+    hi = c - (c - a)
+    return hi, a - hi
+
+
+def two_prod(a: Array, b: Array) -> tuple[Array, Array]:
+    """Exact product: returns (p, err) with a * b == p + err.
+
+    Dekker's split overflows for |a| above ~2^emax/splitter (fp32: ~8.3e34 —
+    inside the fp32 range), which would poison ``err`` with NaN while ``p``
+    itself is still finite. Those lanes degrade to (p, 0) — plain-product
+    accuracy — instead of NaN; genuine overflow/NaN in ``p`` still propagates
+    naturally.
+    """
+    p = a * b
+    ah, al = split(a)
+    bh, bl = split(b)
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    err = jnp.where(jnp.isfinite(err), err, jnp.zeros_like(err))
+    return p, err
+
+
+def df_add(
+    hi1: Array, lo1: Array, hi2: Array, lo2: Array
+) -> tuple[Array, Array]:
+    """Double-float addition (Joldes/Muller 'accurate' variant): adds two
+    (hi, lo) pairs, renormalizing so |lo| <= ulp(hi)/2."""
+    s, e = two_sum(hi1, hi2)
+    t, f = two_sum(lo1, lo2)
+    e = e + t
+    s, e = fast_two_sum(s, e)
+    e = e + f
+    return fast_two_sum(s, e)
+
+
+def _df_reduce_lastaxis(hi: Array, lo: Array) -> tuple[Array, Array]:
+    """Pairwise tree-sum of (hi, lo) pairs along the last axis.
+
+    log2(k) levels of elementwise df_add; odd lengths are padded with exact
+    zeros (identity for double-float addition).
+    """
+    while hi.shape[-1] > 1:
+        n = hi.shape[-1]
+        if n % 2:
+            pad = [(0, 0)] * (hi.ndim - 1) + [(0, 1)]
+            hi = jnp.pad(hi, pad)
+            lo = jnp.pad(lo, pad)
+        hi, lo = df_add(
+            hi[..., 0::2], lo[..., 0::2], hi[..., 1::2], lo[..., 1::2]
+        )
+    return hi[..., 0], lo[..., 0]
+
+
+def gemv_compensated(a: Array, x: Array) -> Array:
+    """Double-float GEMV: y_i = sum_j a_ij * x_j with EFT products and a
+    double-float tree reduction. Returns the accumulator dtype (fp32 for
+    bf16/fp16/fp32 storage, fp64 for fp64), per the kernel contract
+    (ops/gemv.py)."""
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    a = a.astype(acc)
+    x = x.astype(acc)
+    if a.shape[-1] == 0:
+        # Empty contraction: match the other kernels (jnp.matmul -> zeros).
+        return jnp.zeros(a.shape[:-1], acc)
+    p, e = two_prod(a, x[None, :])
+    hi, lo = _df_reduce_lastaxis(p, e)
+    # hi is the double-float sum correctly rounded to `acc`; adding lo cannot
+    # change it (|lo| <= ulp(hi)/2) but keeps the dependence explicit against
+    # an overly clever dead-code pass.
+    return hi + lo
+
+
+register_kernel("compensated", gemv_compensated)

@@ -1,0 +1,119 @@
+"""Compensated (double-float) GEMV kernel: fp64-grade accumulation in fp32.
+
+The reference computes in C double (src/matr_utils.c:86-96); TPU has no fp64.
+These tests pin the SURVEY.md §7 hard-part-(ii) answer: the "compensated"
+kernel must track fp64 ground truth to ~1 ulp of fp32 where plain fp32
+accumulation drifts or collapses.
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.ops.compensated import (
+    gemv_compensated,
+    two_prod,
+    two_sum,
+)
+from matvec_mpi_multiplier_tpu.ops.gemv import available_kernels, gemv_xla
+
+import jax.numpy as jnp
+
+
+def _ulps(y, truth):
+    """Error in units of fp32 ulp of the true value."""
+    t32 = truth.astype(np.float32)
+    return np.abs(y.astype(np.float64) - truth) / np.spacing(np.abs(t32))
+
+
+def test_registered():
+    assert "compensated" in available_kernels()
+
+
+def test_two_sum_exact():
+    a = jnp.float32(1e8)
+    b = jnp.float32(1.0)
+    s, e = two_sum(a, b)
+    # 1e8 + 1 is not representable in fp32; the error term recovers it.
+    assert float(s) + float(e) == 100000001.0
+
+
+def test_two_prod_exact():
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.uniform(-10, 10, 1024), jnp.float32)
+    b = jnp.asarray(rng.uniform(-10, 10, 1024), jnp.float32)
+    p, e = two_prod(a, b)
+    exact = np.asarray(a, np.float64) * np.asarray(b, np.float64)
+    np.testing.assert_array_equal(
+        np.asarray(p, np.float64) + np.asarray(e, np.float64), exact
+    )
+
+
+def test_long_contraction_beats_plain_fp32(devices):
+    # Uniform positive data, long k: plain fp32 random-walks away from the
+    # fp64 truth; compensated stays within ~1 ulp.
+    rng = np.random.default_rng(1)
+    m, k = 8, 1 << 16
+    a64 = rng.uniform(0.0, 10.0, (m, k))
+    x64 = rng.uniform(0.0, 10.0, k)
+    truth = a64 @ x64
+    a32 = jnp.asarray(a64, jnp.float32)
+    x32 = jnp.asarray(x64, jnp.float32)
+
+    plain = np.asarray(gemv_xla(a32, x32))
+    comp = np.asarray(gemv_compensated(a32, x32))
+    assert _ulps(comp, truth).max() <= 2.0
+    # ... and is at least 10x closer than the plain kernel on this regime.
+    assert _ulps(comp, truth).max() * 10 < _ulps(plain, truth).max()
+
+
+def test_catastrophic_cancellation(devices):
+    # Rows of (big, 1, -big) triples: the true result is the count of small
+    # terms; plain fp32 accumulation returns garbage scaled by `big`.
+    m, triples = 4, 256
+    k = 3 * triples
+    a = np.zeros((m, k), np.float32)
+    a[:, 0::3] = 3e7
+    a[:, 1::3] = 1.0
+    a[:, 2::3] = -3e7
+    x = np.ones(k, np.float32)
+    truth = np.full(m, float(triples))
+    comp = np.asarray(gemv_compensated(jnp.asarray(a), jnp.asarray(x)))
+    np.testing.assert_array_equal(comp, truth.astype(np.float32))
+
+
+@pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise"])
+def test_strategies_with_compensated_kernel(devices, name):
+    # The kernel plugs into every strategy; distributed result tracks the
+    # fp64 oracle to fp32 ulp despite fp32 storage.
+    rng = np.random.default_rng(2)
+    m, k = 64, 512
+    a64 = rng.uniform(0.0, 10.0, (m, k))
+    x64 = rng.uniform(0.0, 10.0, k)
+    mesh = make_mesh(8)
+    fn = get_strategy(name).build(mesh, kernel="compensated")
+    y = np.asarray(fn(jnp.asarray(a64, jnp.float32), jnp.asarray(x64, jnp.float32)))
+    assert _ulps(y, a64 @ x64).max() <= 4.0
+
+
+def test_fp64_inputs_run_quad(devices):
+    # fp64 inputs run the same EFT algorithm in fp64 pairs on CPU.
+    rng = np.random.default_rng(3)
+    a = rng.uniform(0.0, 10.0, (8, 128))
+    x = rng.uniform(0.0, 10.0, 128)
+    y = np.asarray(gemv_compensated(jnp.asarray(a), jnp.asarray(x)))
+    np.testing.assert_allclose(y, a @ x, rtol=1e-15)
+
+
+def test_huge_magnitudes_degrade_not_nan(devices):
+    # |a| beyond the Dekker-split overflow threshold (~8.3e34 in fp32) must
+    # degrade to plain-product accuracy, never NaN.
+    a = jnp.asarray([[1e35, 2.0]], jnp.float32)
+    x = jnp.asarray([1e-30, 3.0], jnp.float32)
+    y = np.asarray(gemv_compensated(a, x))
+    np.testing.assert_allclose(y, [1e5 + 6.0], rtol=1e-6)
+
+
+def test_empty_contraction(devices):
+    y = np.asarray(gemv_compensated(jnp.zeros((4, 0)), jnp.zeros((0,))))
+    np.testing.assert_array_equal(y, np.zeros(4))
