@@ -89,18 +89,33 @@ def best_point(points: list[ScalingPoint], n_rows: int, n_cols: int) -> ScalingP
     return min(cands, key=lambda p: p.time_s)
 
 
-def format_table(points: list[ScalingPoint], itemsize: int = 8) -> str:
-    """Markdown table in the BASELINE.md column layout."""
+def format_table(
+    points: list[ScalingPoint],
+    itemsize: int = 8,
+    hbm_peak_gbps: float | None = None,
+) -> str:
+    """Markdown table in the BASELINE.md column layout.
+
+    ``hbm_peak_gbps`` adds the roofline column (%-of-HBM-peak, the
+    BASELINE.json north-star metric): aggregate peak = per-chip peak × p,
+    e.g. 819 for TPU v5e, 1229 for v4.
+    """
+    roofline = hbm_peak_gbps is not None
     lines = [
-        "| Strategy | Matrix | p | Time (s) | SpeedUp | Efficiency | GFLOP/s | GB/s |",
-        "|---|---|---|---|---|---|---|---|",
+        "| Strategy | Matrix | p | Time (s) | SpeedUp | Efficiency | GFLOP/s | GB/s |"
+        + (" % HBM peak |" if roofline else ""),
+        "|---|---|---|---|---|---|---|---|" + ("---|" if roofline else ""),
     ]
     for p in points:
         s = f"{p.speedup:.2f}" if p.speedup is not None else "—"
         e = f"{p.efficiency:.3f}" if p.efficiency is not None else "—"
-        lines.append(
+        row = (
             f"| {p.strategy} | {p.n_rows}×{p.n_cols} | {p.n_processes} "
             f"| {p.time_s:.6f} | {s} | {e} | {p.gflops():.2f} "
             f"| {p.gbps(itemsize):.2f} |"
         )
+        if roofline:
+            pct = 100.0 * p.gbps(itemsize) / (hbm_peak_gbps * p.n_processes)
+            row += f" {pct:.1f} |"
+        lines.append(row)
     return "\n".join(lines)

@@ -37,7 +37,14 @@ def main(argv=None) -> int:
         "--itemsize", type=int, default=8,
         help="bytes per element for GB/s (8=fp64, 4=fp32, 2=bf16)",
     )
+    p.add_argument(
+        "--hbm-peak", type=float, default=None, metavar="GBPS",
+        help="per-chip HBM peak GB/s; adds the roofline %%-of-peak column "
+        "(BASELINE.json north star), e.g. 819 for TPU v5e",
+    )
     args = p.parse_args(argv)
+    if args.hbm_peak is not None and args.hbm_peak <= 0:
+        p.error("--hbm-peak must be positive")
 
     data_out = Path(args.data_out)
     csvs = sorted(data_out.glob("*.csv"))
@@ -52,7 +59,11 @@ def main(argv=None) -> int:
         points = load_strategy_csv(path)
         by_strategy.setdefault(path.stem, []).extend(points)
         print(f"\n## {path.stem}\n")
-        print(format_table(points, itemsize=args.itemsize))
+        print(
+            format_table(
+                points, itemsize=args.itemsize, hbm_peak_gbps=args.hbm_peak
+            )
+        )
         fig = plot_strategy(points, Path(args.fig_dir) / f"{path.stem}.png",
                             title=path.stem)
         print(f"\nfigure: {fig}")

@@ -98,3 +98,18 @@ def test_plots_render(tmp_path):
     }
     f2 = plot_comparison(by, 10200, 10200, tmp_path / "cmp.png")
     assert f2.exists() and f2.stat().st_size > 1000
+
+
+def test_format_table_roofline_column():
+    from matvec_mpi_multiplier_tpu.analysis.stats import ScalingPoint, format_table
+
+    pt = ScalingPoint(
+        n_rows=1000, n_cols=1000, n_processes=2, time_s=0.001,
+        speedup=1.5, efficiency=0.75, strategy="rowwise",
+    )
+    out = format_table([pt], itemsize=4, hbm_peak_gbps=819.0)
+    assert "% HBM peak" in out
+    # gbps = 4*(1e6+2e3)/1e-3/1e9 ~ 4.008; pct = 100*4.008/(819*2) ~ 0.245
+    assert "| 0.2 |" in out
+    # Without the argument the column is absent (backward compatible).
+    assert "% HBM peak" not in format_table([pt], itemsize=4)
