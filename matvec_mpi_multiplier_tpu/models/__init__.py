@@ -9,13 +9,18 @@ from __future__ import annotations
 
 from .base import MatvecStrategy
 from .blockwise import BlockwiseStrategy
-from .colwise import ColwiseRingStrategy, ColwiseStrategy
+from .colwise import (
+    ColwiseRingOverlapStrategy,
+    ColwiseRingStrategy,
+    ColwiseStrategy,
+)
 from .rowwise import RowwiseStrategy
 
 STRATEGIES: dict[str, type[MatvecStrategy]] = {
     RowwiseStrategy.name: RowwiseStrategy,
     ColwiseStrategy.name: ColwiseStrategy,
     ColwiseRingStrategy.name: ColwiseRingStrategy,
+    ColwiseRingOverlapStrategy.name: ColwiseRingOverlapStrategy,
     BlockwiseStrategy.name: BlockwiseStrategy,
 }
 
@@ -39,6 +44,7 @@ __all__ = [
     "RowwiseStrategy",
     "ColwiseStrategy",
     "ColwiseRingStrategy",
+    "ColwiseRingOverlapStrategy",
     "BlockwiseStrategy",
     "STRATEGIES",
     "get_strategy",

@@ -74,20 +74,39 @@ class ColwiseRingStrategy(ColwiseStrategy):
     reduce-scatter (parallel/ring.py) instead of one ``lax.psum_scatter`` —
     the long-context / sequence-parallel schedule (each hop rides a single
     ICI neighbor link, adds overlap hops). Output is always row-sharded.
+
+    ``overlap=True`` moves the GEMV itself into the ring (ring_matvec): each
+    step computes only the (m/p, k/p) tile feeding the chunk in flight, so
+    per-step compute overlaps the previous hop's ppermute — the
+    ring-attention schedule shape, vs. compute-then-reduce.
     """
 
     name = "colwise_ring"
 
-    def __init__(self):
+    def __init__(self, overlap: bool = False):
         super().__init__(scatter_output=True)
+        self.overlap = overlap
 
     def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
-        from ..parallel.ring import ring_psum_scatter
+        from ..parallel.ring import ring_matvec, ring_psum_scatter
 
         axes = flat_axes(mesh)
+        overlap = self.overlap
 
         def body(a_panel, x_seg):
-            partial = kernel(a_panel, x_seg)
-            return ring_psum_scatter(partial, axes).astype(a_panel.dtype)
+            if overlap:
+                y = ring_matvec(a_panel, x_seg, axes, kernel)
+            else:
+                y = ring_psum_scatter(kernel(a_panel, x_seg), axes)
+            return y.astype(a_panel.dtype)
 
         return body
+
+
+class ColwiseRingOverlapStrategy(ColwiseRingStrategy):
+    """The overlapped ring schedule as a named registry entry."""
+
+    name = "colwise_ring_overlap"
+
+    def __init__(self):
+        super().__init__(overlap=True)

@@ -27,6 +27,25 @@ def _ring_perm(p: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % p) for i in range(p)]
 
 
+def _ring_reduce(chunk_fn, axis_name: str):
+    """The shared ring-reduce walk: after step ``s`` the accumulator holds
+    the partial sum for chunk ``idx - 1 - s``, so after ``p - 1`` hops device
+    ``idx`` ends holding chunk ``idx`` summed across the whole ring.
+
+    ``chunk_fn(i)`` produces this device's contribution to logical chunk
+    ``i`` (``i`` is a traced, possibly negative index — implementations
+    wrap with ``jnp.mod``). Callers handle ``p == 1`` themselves.
+    """
+    p = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    perm = _ring_perm(p)
+    acc = chunk_fn(idx - 1)
+    for s in range(1, p):
+        acc = jax.lax.ppermute(acc, axis_name, perm)
+        acc = acc + chunk_fn(idx - 1 - s)
+    return acc
+
+
 def ring_psum_scatter(x: Array, axis_name: str) -> Array:
     """Ring reduce-scatter of a length-n array over ``axis_name``.
 
@@ -44,20 +63,46 @@ def ring_psum_scatter(x: Array, axis_name: str) -> Array:
     if n % p != 0:
         raise ValueError(f"ring_psum_scatter: length {n} not divisible by {p}")
     chunks = x.reshape(p, n // p)
-    idx = jax.lax.axis_index(axis_name)
-    perm = _ring_perm(p)
+    return _ring_reduce(
+        lambda i: jnp.take(chunks, jnp.mod(i, p), axis=0), axis_name
+    )
 
-    def chunk(i):
-        return jnp.take(chunks, jnp.mod(i, p), axis=0)
 
-    # Start with own chunk (idx-1); after step s the accumulator holds the
-    # partial sum for chunk (idx-1-s), so after p-1 hops device idx ends with
-    # chunk idx summed across all devices.
-    acc = chunk(idx - 1)
-    for s in range(1, p):
-        acc = jax.lax.ppermute(acc, axis_name, perm)
-        acc = acc + chunk(idx - 1 - s)
-    return acc
+def ring_matvec(a_panel: Array, x_seg: Array, axis_name: str, kernel) -> Array:
+    """Overlapped ring matvec: compute rides the ring with the accumulator.
+
+    The ring-attention-style schedule (SURVEY.md §5.7): where
+    :func:`ring_psum_scatter` first materializes the full-length local partial
+    and then reduces it around the ring, this version never forms it — at
+    each of the p steps the device computes only the ``(m/p, k/p)`` tile of
+    its column panel that contributes to the chunk currently held by the
+    accumulator, so each step's GEMV tile overlaps the previous step's
+    single-neighbor ``ppermute`` hop under XLA's async collective scheduling.
+    Per-step working set drops from O(m) to O(m/p).
+
+    Must be called inside shard_map. ``a_panel`` is the device's ``(m, k/p)``
+    column panel, ``x_seg`` its ``(k/p,)`` x segment; returns chunk ``i`` of
+    ``y`` (length ``m/p``, the kernel's accumulator dtype) on device ``i`` —
+    the same contract as
+    ``ring_psum_scatter(kernel(a_panel, x_seg), axis_name)``.
+
+    Requires ``m % p == 0``.
+    """
+    p = jax.lax.axis_size(axis_name)
+    if p == 1:
+        return kernel(a_panel, x_seg)
+    m = a_panel.shape[0]
+    if m % p != 0:
+        raise ValueError(f"ring_matvec: {m} rows not divisible by {p}")
+    chunk_rows = m // p
+
+    def tile_gemv(i):
+        # Rows of this panel contributing to output chunk i (traced index).
+        start = jnp.mod(i, p) * chunk_rows
+        tile = jax.lax.dynamic_slice_in_dim(a_panel, start, chunk_rows, axis=0)
+        return kernel(tile, x_seg)
+
+    return _ring_reduce(tile_gemv, axis_name)
 
 
 def ring_all_gather(x: Array, axis_name: str) -> Array:
