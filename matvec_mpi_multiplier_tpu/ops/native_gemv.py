@@ -19,7 +19,6 @@ kernel is not registered.
 from __future__ import annotations
 
 import ctypes
-import os
 from pathlib import Path
 
 import jax
@@ -28,38 +27,37 @@ from jax import Array
 
 from .gemv import register_kernel
 
-_LIB_ENV = "MATVEC_NATIVE_LIB"
 _FFI_TARGETS_REGISTERED = False
-_lib: ctypes.CDLL | None = None
+_GEMV_ARGTYPES_SET = False
 
 
 def _lib_path() -> Path:
-    if _LIB_ENV in os.environ:
-        return Path(os.environ[_LIB_ENV])
-    # repo layout: <root>/native/libmatvec_gemv.so, package at <root>/matvec_…
-    return Path(__file__).resolve().parents[2] / "native" / "libmatvec_gemv.so"
+    from ..utils.native_lib import lib_path
+
+    return lib_path()
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib
-    if _lib is not None:
-        return _lib
-    path = _lib_path()
-    if not path.exists():
+    """The shared library handle with the GEMV argtypes declared."""
+    global _GEMV_ARGTYPES_SET
+    from ..utils.native_lib import load_library
+
+    lib = load_library()
+    if lib is None:
         return None
-    lib = ctypes.CDLL(str(path))
-    for sym, ctype in (("matvec_gemv_f32", ctypes.c_float),
-                       ("matvec_gemv_f64", ctypes.c_double)):
-        fn = getattr(lib, sym)
-        fn.restype = None
-        fn.argtypes = [
-            ctypes.POINTER(ctype),
-            ctypes.POINTER(ctype),
-            ctypes.POINTER(ctype),
-            ctypes.c_int64,
-            ctypes.c_int64,
-        ]
-    _lib = lib
+    if not _GEMV_ARGTYPES_SET:
+        for sym, ctype in (("matvec_gemv_f32", ctypes.c_float),
+                           ("matvec_gemv_f64", ctypes.c_double)):
+            fn = getattr(lib, sym)
+            fn.restype = None
+            fn.argtypes = [
+                ctypes.POINTER(ctype),
+                ctypes.POINTER(ctype),
+                ctypes.POINTER(ctype),
+                ctypes.c_int64,
+                ctypes.c_int64,
+            ]
+        _GEMV_ARGTYPES_SET = True
     return lib
 
 

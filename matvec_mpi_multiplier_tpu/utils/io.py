@@ -23,6 +23,7 @@ the committed 4×8 fixture.
 
 from __future__ import annotations
 
+import ctypes
 import os
 from pathlib import Path
 
@@ -30,6 +31,59 @@ import numpy as np
 
 from .constants import MATRIX_FILENAME_FMT, VECTOR_FILENAME_FMT
 from .errors import DataFileError
+
+_NATIVE_IO_ENV = "MATVEC_NATIVE_IO"  # set to "0" to force the numpy parser
+
+
+def _native_lib():
+    if os.environ.get(_NATIVE_IO_ENV, "1") == "0":
+        return None
+    from .native_lib import load_library
+
+    lib = load_library()
+    if lib is None or not hasattr(lib, "matvec_load_text"):
+        return None  # not built, or an older .so without the text loader
+    if lib.matvec_load_text.restype != ctypes.c_int64:
+        lib.matvec_load_text.restype = ctypes.c_int64
+        lib.matvec_load_text.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64,
+        ]
+    return lib
+
+
+def _load_values(path: Path, count: int) -> np.ndarray:
+    """Parse exactly ``count`` whitespace-separated doubles from ``path``.
+
+    Uses the native C++ loader (native/textio.cc — the reference's IO layer
+    is native C, and numpy's Python-level parser takes minutes at the
+    reference's own top sweep size) when the library is built, falling back
+    to ``np.loadtxt`` otherwise. A token-count mismatch raises
+    :class:`DataFileError` either way.
+    """
+    lib = _native_lib()
+    if lib is not None:
+        out = np.empty(count, np.float64)
+        n = lib.matvec_load_text(
+            str(path).encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            count,
+        )
+        if n == count:
+            return out
+        if n >= 0:
+            held = f"more than {count}" if n > count else str(n)
+            raise DataFileError(
+                f"{path} holds {held} values, expected {count}"
+            )
+        # n < 0: unreadable through the native path; let numpy report.
+    flat = np.loadtxt(path, dtype=np.float64).reshape(-1)
+    if flat.size != count:
+        raise DataFileError(
+            f"{path} holds {flat.size} values, expected {count}"
+        )
+    return flat
 
 
 def data_dir(root: str | os.PathLike | None = None) -> Path:
@@ -58,11 +112,7 @@ def load_matrix(
     path = matrix_path(n_rows, n_cols, root)
     if not path.exists():
         raise DataFileError(f"Unable to locate matrix file {path}")
-    flat = np.loadtxt(path, dtype=np.float64).reshape(-1)
-    if flat.size != n_rows * n_cols:
-        raise DataFileError(
-            f"{path} holds {flat.size} values, expected {n_rows}x{n_cols}"
-        )
+    flat = _load_values(path, n_rows * n_cols)
     return flat.reshape(n_rows, n_cols).astype(dtype)
 
 
@@ -74,10 +124,7 @@ def load_vector(
     path = vector_path(n, root)
     if not path.exists():
         raise DataFileError(f"Unable to locate vector file {path}")
-    vec = np.loadtxt(path, dtype=np.float64).reshape(-1)
-    if vec.size != n:
-        raise DataFileError(f"{path} holds {vec.size} values, expected {n}")
-    return vec.astype(dtype)
+    return _load_values(path, n).astype(dtype)
 
 
 def save_matrix(a: np.ndarray, root: str | os.PathLike | None = None) -> Path:

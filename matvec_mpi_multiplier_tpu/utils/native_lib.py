@@ -1,0 +1,36 @@
+"""Locating and loading the native C++ library (jax-free).
+
+Shared by the two native-tier consumers — ``ops/native_gemv.py`` (GEMV
+kernels; adds jax FFI registration on top) and ``utils/io.py`` (text loader)
+— so the utils layer never imports jax just to open a ``ctypes.CDLL``.
+
+``MATVEC_NATIVE_LIB`` overrides the default path
+(``<repo>/native/libmatvec_gemv.so``, built by ``make -C native``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+_LIB_ENV = "MATVEC_NATIVE_LIB"
+_lib: ctypes.CDLL | None = None
+
+
+def lib_path() -> Path:
+    if _LIB_ENV in os.environ:
+        return Path(os.environ[_LIB_ENV])
+    # repo layout: <root>/native/libmatvec_gemv.so, package at <root>/matvec_…
+    return Path(__file__).resolve().parents[2] / "native" / "libmatvec_gemv.so"
+
+
+def load_library() -> ctypes.CDLL | None:
+    """The native library, loaded once per process (None when not built)."""
+    global _lib
+    if _lib is None:
+        path = lib_path()
+        if not path.exists():
+            return None
+        _lib = ctypes.CDLL(str(path))
+    return _lib

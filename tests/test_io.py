@@ -95,3 +95,79 @@ def test_generator_determinism():
     assert not np.array_equal(
         io.generate_matrix(4, 4, seed=7), io.generate_matrix(4, 4, seed=8)
     )
+
+
+# ---------- native text loader (native/textio.cc) ----------
+
+def _native_io_available():
+    from matvec_mpi_multiplier_tpu.utils.io import _native_lib
+
+    return _native_lib() is not None
+
+
+@pytest.mark.skipif(
+    not _native_io_available(), reason="native lib not built (make -C native)"
+)
+def test_native_loader_matches_numpy(tmp_path, monkeypatch):
+    from matvec_mpi_multiplier_tpu.utils import io
+
+    a = io.generate_matrix(37, 53, seed=9)
+    io.save_matrix(a, tmp_path)
+    native = io.load_matrix(37, 53, tmp_path)
+    monkeypatch.setenv("MATVEC_NATIVE_IO", "0")
+    via_numpy = io.load_matrix(37, 53, tmp_path)
+    np.testing.assert_array_equal(native, via_numpy)
+
+
+@pytest.mark.skipif(
+    not _native_io_available(), reason="native lib not built (make -C native)"
+)
+def test_native_loader_count_mismatch(tmp_path):
+    from matvec_mpi_multiplier_tpu.utils import io
+
+    (tmp_path / "vector_9.txt").write_text("1\n2\n3\n4\n5\n6\n7\n8\n")
+    with pytest.raises(DataFileError, match="expected"):
+        io.load_vector(9, tmp_path)  # too few values in the file
+    # Too many values must also be rejected (the has-more probe).
+    (tmp_path / "vector_4.txt").write_text("1\n2\n3\n4\n5\n")
+    with pytest.raises(DataFileError, match="expected"):
+        io.load_vector(4, tmp_path)
+
+
+def test_numpy_fallback_env(tmp_path, monkeypatch):
+    from matvec_mpi_multiplier_tpu.utils import io
+
+    monkeypatch.setenv("MATVEC_NATIVE_IO", "0")
+    io.save_vector(np.arange(5.0), tmp_path)
+    np.testing.assert_array_equal(io.load_vector(5, tmp_path), np.arange(5.0))
+
+
+@pytest.mark.skipif(
+    not _native_io_available(), reason="native lib not built (make -C native)"
+)
+def test_native_loader_strtod_fallback_tokens(tmp_path, monkeypatch):
+    # e-notation / >15-digit tokens route through the strtod fallback and
+    # must stay bitwise identical to the numpy parser.
+    (tmp_path / "vector_6.txt").write_text(
+        "1.5e3 -2.25E-2 0.123456789012345678 42 -0 7.0001\n"
+    )
+    from matvec_mpi_multiplier_tpu.utils import io
+
+    native = io.load_vector(6, tmp_path)
+    monkeypatch.setenv("MATVEC_NATIVE_IO", "0")
+    via_numpy = io.load_vector(6, tmp_path)
+    np.testing.assert_array_equal(native, via_numpy)
+
+
+@pytest.mark.skipif(
+    not _native_io_available(), reason="native lib not built (make -C native)"
+)
+def test_native_loader_rejects_malformed(tmp_path):
+    # Both parser paths must reject the same files: trailing garbage and
+    # fused tokens fall back to numpy, which raises.
+    (tmp_path / "vector_4.txt").write_text("1 2 3 abc\n")
+    with pytest.raises(Exception):
+        io.load_vector(4, tmp_path)
+    (tmp_path / "vector_2.txt").write_text("1.5-2.5\n")
+    with pytest.raises(Exception):
+        io.load_vector(2, tmp_path)
