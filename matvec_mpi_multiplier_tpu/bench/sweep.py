@@ -40,6 +40,7 @@ from ..parallel.mesh import make_mesh
 from ..utils import io
 from ..utils.errors import MatvecError
 from .metrics import append_result, csv_path
+from .profiling import annotate, trace
 from .timing import MEASURE_METHODS, TIMING_MODES, benchmark_strategy
 
 # The reference's sweeps (test.sh:5,8 and the asymmetric CSVs' sizes).
@@ -146,6 +147,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--no-csv", action="store_true", help="print results without writing CSVs"
     )
+    p.add_argument(
+        "--profile-dir",
+        default=None,
+        metavar="DIR",
+        help="capture a JAX device trace of the whole sweep into DIR "
+        "(TensorBoard/Perfetto format; bench/profiling.py — the capability "
+        "the reference lacked, SURVEY.md §5.1)",
+    )
     return p
 
 
@@ -212,6 +221,23 @@ def run_sweep(args: argparse.Namespace) -> int:
 
     n_ok = n_skip = 0
     meshes = {n_dev: make_mesh(n_dev) for n_dev in counts}
+    counters = [0, 0]  # [timed, skipped]
+    # The trace must stop (and flush its file) on ANY exit — an exception
+    # mid-sweep or Ctrl+C hours in must not lose the whole capture.
+    with trace(args.profile_dir or "", enabled=args.profile_dir is not None):
+        _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters)
+    n_ok, n_skip = counters
+    if not args.no_csv:
+        for name in strategies:
+            for mode in modes:
+                print(f"CSV: {csv_path(name, args.data_root, mode=mode)}")
+    if args.profile_dir is not None:
+        print(f"trace: {args.profile_dir}")
+    print(f"{n_ok} configs timed, {n_skip} skipped")
+    return 0
+
+
+def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
     # Sizes on the outer loop: operands depend only on the size (and seed),
     # so each (n_rows, n_cols) pair is generated/loaded exactly once and
     # shared across every strategy x device-count combination.
@@ -225,22 +251,23 @@ def run_sweep(args: argparse.Namespace) -> int:
                     strat.validate(n_rows, n_cols, mesh)
                 except MatvecError as e:
                     print(f"skip {name} {n_rows}x{n_cols} p={n_dev}: {e}")
-                    n_skip += 1
+                    counters[1] += 1
                     continue
                 if a is None:
                     a, x = operands(n_rows, n_cols, args)
                 for mode in modes:
-                    result = benchmark_strategy(
-                        strat,
-                        mesh,
-                        a,
-                        x,
-                        dtype=args.dtype,
-                        n_reps=args.n_reps,
-                        mode=mode,
-                        measure=args.measure,
-                        kernel=args.kernel,
-                    )
+                    with annotate(f"{name}_{n_rows}x{n_cols}_p{n_dev}_{mode}"):
+                        result = benchmark_strategy(
+                            strat,
+                            mesh,
+                            a,
+                            x,
+                            dtype=args.dtype,
+                            n_reps=args.n_reps,
+                            mode=mode,
+                            measure=args.measure,
+                            kernel=args.kernel,
+                        )
                     if not args.no_csv:
                         append_result(result, args.data_root)
                     print(
@@ -248,13 +275,7 @@ def run_sweep(args: argparse.Namespace) -> int:
                         f"mean={result.mean_time_s:.6f}s "
                         f"{result.gflops:.2f} GFLOP/s {result.gbps:.2f} GB/s"
                     )
-                    n_ok += 1
-    if not args.no_csv:
-        for name in strategies:
-            for mode in modes:
-                print(f"CSV: {csv_path(name, args.data_root, mode=mode)}")
-    print(f"{n_ok} configs timed, {n_skip} skipped")
-    return 0
+                    counters[0] += 1
 
 
 def main(argv: list[str] | None = None) -> int:

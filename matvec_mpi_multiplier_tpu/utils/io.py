@@ -66,7 +66,7 @@ def _load_values(path: Path, count: int) -> np.ndarray:
     if lib is not None:
         out = np.empty(count, np.float64)
         n = lib.matvec_load_text(
-            str(path).encode(),
+            os.fsencode(path),  # not str.encode: paths may hold non-UTF-8
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
             count,
         )

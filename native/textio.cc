@@ -62,8 +62,15 @@ inline double ParseDouble(const char* p, const char** end) {
       ++frac;
     }
   }
+  if (*p == 'x' || *p == 'X') {
+    // C99 hex-float ('0x1p3'): strtod accepts it but numpy rejects it — the
+    // two paths must reject identical files, so fail the token here (the
+    // caller's trailing-content check then reports the file malformed).
+    *end = orig;
+    return 0.0;
+  }
   if (digits == 0 || digits > 15 || *p == 'e' || *p == 'E' || *p == 'n' ||
-      *p == 'N' || *p == 'i' || *p == 'I' || *p == 'x' || *p == 'X') {
+      *p == 'N' || *p == 'i' || *p == 'I') {
     // strtod_l with a cached C locale: plain strtod honors LC_NUMERIC, so an
     // embedding app under e.g. de_DE (comma decimal separator) would silently
     // misparse '1.5e3' — the numpy path is locale-independent and this one

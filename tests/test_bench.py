@@ -202,3 +202,13 @@ def test_configure_platform_appends_when_absent(monkeypatch):
     assert (
         os.environ["XLA_FLAGS"] == "--xla_force_host_platform_device_count=8"
     )
+
+
+def test_sweep_cli_profile_dir(devices, tmp_path):
+    rc = sweep_main([
+        "--strategy", "rowwise", "--devices", "2", "--sizes", "16",
+        "--n-reps", "1", "--no-csv", "--profile-dir", str(tmp_path / "trace"),
+    ])
+    assert rc == 0
+    # jax.profiler writes a plugins/profile/<ts>/ tree with trace artifacts.
+    assert any((tmp_path / "trace").rglob("*"))

@@ -171,3 +171,14 @@ def test_native_loader_rejects_malformed(tmp_path):
     (tmp_path / "vector_2.txt").write_text("1.5-2.5\n")
     with pytest.raises(Exception):
         io.load_vector(2, tmp_path)
+
+
+@pytest.mark.skipif(
+    not _native_io_available(), reason="native lib not built (make -C native)"
+)
+def test_native_loader_rejects_hex_floats(tmp_path):
+    # strtod accepts C99 hex-floats; numpy does not — the native path must
+    # agree with numpy and reject the file.
+    (tmp_path / "vector_2.txt").write_text("0x1p3 2.0\n")
+    with pytest.raises(Exception):
+        io.load_vector(2, tmp_path)
