@@ -39,31 +39,37 @@ from matvec_mpi_multiplier_tpu.bench.timing import time_fn_chained
 REFERENCE_BEST_GBPS = 4.13
 
 
-def _backend_reachable(timeout_s: float = 120.0, attempts: int = 3) -> bool:
-    """Probe jax.devices() in a subprocess with a hard timeout.
+def _backend_reachable(timeout_s: float = 120.0, attempts: int = 3) -> str | None:
+    """Probe jax.devices() in a subprocess; return an error string or None.
 
     The tunneled TPU backend has been observed wedging so hard that
     jax.devices() blocks forever in C++ (uninterruptible by signals). Probing
     in a killable subprocess keeps bench.py from hanging the whole driver;
     after `attempts` failed probes the caller emits an explicit failure line
-    instead of silence.
+    — carrying the child's actual stderr, so a crash (plugin error, import
+    failure) isn't misreported as a timeout.
     """
     import subprocess
     import time
 
+    last_error = "unknown"
     for i in range(attempts):
         try:
             r = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout_s, capture_output=True,
+                timeout=timeout_s, capture_output=True, text=True,
             )
             if r.returncode == 0:
-                return True
+                return None
+            tail = (r.stderr or "").strip().splitlines()
+            last_error = f"probe exited {r.returncode}: " + (
+                tail[-1] if tail else "no stderr"
+            )
         except subprocess.TimeoutExpired:
-            pass
+            last_error = f"probe timed out after {timeout_s:.0f}s"
         if i + 1 < attempts:
             time.sleep(30)
-    return False
+    return f"{last_error} ({attempts} attempts)"
 
 
 def main() -> int:
@@ -71,7 +77,8 @@ def main() -> int:
     n_reps = int(os.environ.get("MATVEC_BENCH_REPS", 50))
     dtype = os.environ.get("MATVEC_BENCH_DTYPE", "bfloat16")
 
-    if not _backend_reachable():
+    probe_error = _backend_reachable()
+    if probe_error is not None:
         print(
             json.dumps(
                 {
@@ -79,8 +86,7 @@ def main() -> int:
                     "value": 0.0,
                     "unit": "GB/s",
                     "vs_baseline": 0.0,
-                    "error": "accelerator backend unreachable (device probe "
-                    "timed out 3x); rerun when the tunnel recovers",
+                    "error": f"accelerator backend unreachable: {probe_error}",
                 }
             )
         )

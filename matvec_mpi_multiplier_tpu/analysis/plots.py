@@ -70,14 +70,21 @@ def plot_strategy(
     return out_path
 
 
-def plot_comparison(
-    by_strategy: dict[str, list[ScalingPoint]],
+def plot_overlay(
+    runs: dict[str, dict[str, list[ScalingPoint]]],
     n_rows: int,
     n_cols: int,
     out_path: str | os.PathLike,
 ) -> Path:
-    """Cross-strategy Time/SpeedUp/Efficiency at one size (the README's
-    comparison figures at the largest sweep size)."""
+    """Overlay Time/SpeedUp/Efficiency curves from multiple result sets.
+
+    ``runs`` maps a run label (e.g. "reference (MPI)", "this work (CPU
+    mesh)") to its per-strategy points. This is the BASELINE.json north-star
+    figure: TPU/virtual-device curves drawn directly over the reference's
+    MPI process-count curves at one matrix size, one linestyle per run, one
+    color per strategy. With a single run under an empty label this renders
+    the plain single-run comparison (see :func:`plot_comparison`).
+    """
     plt = _mpl()
     fig, axes = plt.subplots(1, 3, figsize=(15, 4))
     panels = [
@@ -85,25 +92,53 @@ def plot_comparison(
         ("SpeedUp", lambda q: q.speedup),
         ("Efficiency", lambda q: q.efficiency),
     ]
-    for name, points in by_strategy.items():
-        ps = sorted(
-            (q for q in points if (q.n_rows, q.n_cols) == (n_rows, n_cols)),
-            key=lambda q: q.n_processes,
-        )
-        for ax, (ylabel, get) in zip(axes, panels):
-            xs = [q.n_processes for q in ps if get(q) is not None]
-            ys = [get(q) for q in ps if get(q) is not None]
-            if xs:
-                ax.plot(xs, ys, marker="o", label=name)
-            ax.set_xlabel("devices")
-            ax.set_ylabel(ylabel)
-            ax.grid(True, alpha=0.3)
+    linestyles = ["-", "--", ":", "-."]
+    colors: dict[str, object] = {}
+    for run_i, (run_label, by_strategy) in enumerate(runs.items()):
+        ls = linestyles[run_i % len(linestyles)]
+        for name, points in sorted(by_strategy.items()):
+            ps = sorted(
+                (q for q in points if (q.n_rows, q.n_cols) == (n_rows, n_cols)),
+                key=lambda q: q.n_processes,
+            )
+            if not ps:
+                continue
+            if name not in colors:
+                colors[name] = f"C{len(colors)}"
+            curve_label = f"{name} [{run_label}]" if run_label else name
+            for ax, (ylabel, get) in zip(axes, panels):
+                xs = [q.n_processes for q in ps if get(q) is not None]
+                ys = [get(q) for q in ps if get(q) is not None]
+                if xs:
+                    ax.plot(
+                        xs, ys, marker="o", linestyle=ls, color=colors[name],
+                        label=curve_label,
+                    )
+    for ax, (ylabel, _) in zip(axes, panels):
+        ax.set_xlabel("processes / devices")
+        ax.set_ylabel(ylabel)
+        ax.grid(True, alpha=0.3)
     axes[0].set_yscale("log")
-    axes[0].legend()
-    fig.suptitle(f"{n_rows}×{n_cols}")
+    axes[0].legend(fontsize=6 if len(runs) > 1 else 8)
+    title = f"{n_rows}×{n_cols}"
+    if len(runs) > 1:
+        title += ": overlaid runs"
+    fig.suptitle(title)
     fig.tight_layout()
     out_path = Path(out_path)
     out_path.parent.mkdir(parents=True, exist_ok=True)
     fig.savefig(out_path, dpi=120)
     plt.close(fig)
     return out_path
+
+
+def plot_comparison(
+    by_strategy: dict[str, list[ScalingPoint]],
+    n_rows: int,
+    n_cols: int,
+    out_path: str | os.PathLike,
+) -> Path:
+    """Cross-strategy Time/SpeedUp/Efficiency at one size (the README's
+    comparison figures at the largest sweep size) — the single-run special
+    case of :func:`plot_overlay`."""
+    return plot_overlay({"": by_strategy}, n_rows, n_cols, out_path)

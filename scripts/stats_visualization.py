@@ -25,8 +25,23 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from matvec_mpi_multiplier_tpu.analysis.plots import plot_comparison, plot_strategy
+from matvec_mpi_multiplier_tpu.analysis.plots import (
+    plot_comparison,
+    plot_overlay,
+    plot_strategy,
+)
 from matvec_mpi_multiplier_tpu.analysis.stats import format_table, load_strategy_csv
+
+
+def load_run(data_out: Path) -> dict[str, list]:
+    """Load every per-strategy CSV in a data/out directory, keyed by stem
+    (the one place the stem convention / results_extended exclusion lives)."""
+    run: dict[str, list] = {}
+    for path in sorted(data_out.glob("*.csv")):
+        if path.stem == "results_extended":
+            continue
+        run.setdefault(path.stem, []).extend(load_strategy_csv(path))
+    return run
 
 
 def main(argv=None) -> int:
@@ -42,31 +57,58 @@ def main(argv=None) -> int:
         help="per-chip HBM peak GB/s; adds the roofline %%-of-peak column "
         "(BASELINE.json north star), e.g. 819 for TPU v5e",
     )
+    p.add_argument(
+        "--overlay", nargs="+", default=None, metavar="LABEL=DIR",
+        help="overlay runs from multiple data/out dirs in one figure at the "
+        "largest shared size, e.g. --overlay 'reference=/root/reference/"
+        "data/out' 'this work=data/out/cpu_mesh' (BASELINE.json: TPU curves "
+        "directly over the reference's MPI curves)",
+    )
     args = p.parse_args(argv)
     if args.hbm_peak is not None and args.hbm_peak <= 0:
         p.error("--hbm-peak must be positive")
 
     data_out = Path(args.data_out)
-    csvs = sorted(data_out.glob("*.csv"))
-    if not csvs:
+    by_strategy = load_run(data_out)
+    if not by_strategy and not args.overlay:
         print(f"no CSVs in {data_out}", file=sys.stderr)
         return 1
 
-    by_strategy: dict[str, list] = {}
-    for path in csvs:
-        if path.stem == "results_extended":
-            continue
-        points = load_strategy_csv(path)
-        by_strategy.setdefault(path.stem, []).extend(points)
-        print(f"\n## {path.stem}\n")
+    for name, points in by_strategy.items():
+        print(f"\n## {name}\n")
         print(
             format_table(
                 points, itemsize=args.itemsize, hbm_peak_gbps=args.hbm_peak
             )
         )
-        fig = plot_strategy(points, Path(args.fig_dir) / f"{path.stem}.png",
-                            title=path.stem)
+        fig = plot_strategy(points, Path(args.fig_dir) / f"{name}.png",
+                            title=name)
         print(f"\nfigure: {fig}")
+
+    if args.overlay:
+        runs: dict[str, dict[str, list]] = {}
+        for spec in args.overlay:
+            label, _, d = spec.partition("=")
+            if not d:
+                p.error(f"--overlay expects LABEL=DIR, got {spec!r}")
+            run = load_run(Path(d))
+            if not run:
+                p.error(f"--overlay: no strategy CSVs in {d!r}")
+            runs[label] = run
+        # Largest size present in every run.
+        size_sets = [
+            {(q.n_rows, q.n_cols) for pts in run.values() for q in pts}
+            for run in runs.values()
+        ]
+        shared_sizes = set.intersection(*size_sets) if size_sets else set()
+        if shared_sizes:
+            m, n = max(shared_sizes, key=lambda s: s[0] * s[1])
+            fig = plot_overlay(
+                runs, m, n, Path(args.fig_dir) / f"overlay_{m}x{n}.png"
+            )
+            print(f"\noverlay figure: {fig}")
+        else:
+            print("\nno size shared by all overlay runs", file=sys.stderr)
 
     # Comparison at the largest size shared by >1 strategy.
     sizes: dict[tuple[int, int], int] = {}

@@ -113,3 +113,22 @@ def test_format_table_roofline_column():
     assert "| 0.2 |" in out
     # Without the argument the column is absent (backward compatible).
     assert "% HBM peak" not in format_table([pt], itemsize=4)
+
+
+def test_plot_overlay(tmp_path):
+    pytest.importorskip("matplotlib")
+    from matvec_mpi_multiplier_tpu.analysis.plots import plot_overlay
+    from matvec_mpi_multiplier_tpu.analysis.stats import ScalingPoint
+
+    def pts(scale):
+        return [
+            ScalingPoint(n_rows=8, n_cols=8, n_processes=p, time_s=scale / p,
+                         speedup=float(p), efficiency=1.0, strategy="rowwise")
+            for p in (1, 2, 4)
+        ]
+
+    out = plot_overlay(
+        {"ref": {"rowwise": pts(1.0)}, "ours": {"rowwise": pts(0.1)}},
+        8, 8, tmp_path / "overlay.png",
+    )
+    assert out.exists() and out.stat().st_size > 0
