@@ -1,0 +1,144 @@
+"""Distributed dense matrix–matrix multiply: the same sharding ladder, MXU-bound.
+
+The reference suite is matvec-only (`y = A·x`, `src/matr_utils.c:86-96`) —
+a memory-bandwidth-bound kernel on any hardware. This module extends the
+framework's three partitioning strategies to GEMM (``C = A @ B``), where the
+TPU MXU actually earns its keep: the same `PartitionSpec` ladder the matvec
+strategies define (SURVEY.md §2.1), applied to a rank-2 right-hand side,
+yields the canonical distributed matmul decompositions:
+
+* ``rowwise``   — A row-sharded, B replicated, C row-sharded: pure data
+  parallelism over output rows; no inter-device reduction (the GEMM face of
+  `src/multiplier_rowwise.c`'s scatter/gather scheme).
+* ``colwise``   — A and B contraction-sharded, partial C's summed with
+  ``psum`` (the `MPI_Reduce(SUM)` analog, `src/multiplier_colwise.c:124`) —
+  the k-parallel / SUMMA-reduction decomposition.
+* ``blockwise`` — 2-D ``('rows','cols')`` mesh: A block-sharded, B sharded
+  over 'cols' on its contraction axis, local matmul, psum over 'cols', C
+  sharded over 'rows' — the one-shot SUMMA step matching
+  `src/multiplier_blockwise.c`'s grid decomposition.
+
+All three share the matvec numerics contract: local compute accumulates in
+fp32 for sub-fp32 storage (``preferred_element_type``), the cross-device
+reduction runs on the accumulator, and the cast back to storage dtype happens
+once at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import mesh_grid_shape
+from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
+from ..utils.errors import ShardingError, check_divisible
+from .base import flat_axes, mesh_size
+
+
+def _local_matmul(a_blk: Array, b_blk: Array) -> Array:
+    """Local MXU matmul in the kernel accumulator dtype (ops/gemv.py rule)."""
+    acc = jnp.promote_types(a_blk.dtype, jnp.float32)
+    return jnp.matmul(a_blk, b_blk, preferred_element_type=acc)
+
+
+_GEMM_SPECS: dict[str, Callable[[Mesh], tuple[P, P, P, str | None]]] = {}
+
+
+def _specs_rowwise(mesh: Mesh):
+    axes = flat_axes(mesh)
+    return P(axes, None), P(None, None), P(axes, None), None
+
+
+def _specs_colwise(mesh: Mesh):
+    axes = flat_axes(mesh)
+    return P(None, axes), P(axes, None), P(None, None), axes
+
+
+def _specs_blockwise(mesh: Mesh):
+    return (
+        P(MESH_AXIS_ROWS, MESH_AXIS_COLS),
+        P(MESH_AXIS_COLS, None),
+        P(MESH_AXIS_ROWS, None),
+        MESH_AXIS_COLS,
+    )
+
+
+_GEMM_SPECS.update(
+    rowwise=_specs_rowwise, colwise=_specs_colwise, blockwise=_specs_blockwise
+)
+
+
+def available_gemm_strategies() -> list[str]:
+    return sorted(_GEMM_SPECS)
+
+
+def validate_gemm(
+    name: str, m: int, k: int, n: int, mesh: Mesh
+) -> None:
+    """Divisibility guards, mirroring the matvec strategies' validate()."""
+    if name not in _GEMM_SPECS:
+        raise KeyError(
+            f"unknown gemm strategy {name!r}; available: "
+            f"{available_gemm_strategies()}"
+        )
+    p = mesh_size(mesh)
+    if name == "rowwise":
+        check_divisible(m, p, "m (rows of A)", "number of devices")
+    elif name == "colwise":
+        check_divisible(k, p, "k (contraction dim)", "number of devices")
+    else:  # blockwise
+        if (
+            MESH_AXIS_ROWS not in mesh.axis_names
+            or MESH_AXIS_COLS not in mesh.axis_names
+        ):
+            raise ShardingError(
+                f"blockwise gemm needs a 2-D mesh with axes "
+                f"({MESH_AXIS_ROWS!r}, {MESH_AXIS_COLS!r}); got {mesh.axis_names}"
+            )
+        r, c = mesh_grid_shape(mesh)
+        check_divisible(m, r, "m (rows of A)", "mesh rows")
+        check_divisible(k, c, "k (contraction dim)", "mesh cols")
+
+
+def gemm_shardings(
+    name: str, mesh: Mesh
+) -> tuple[NamedSharding, NamedSharding]:
+    """Device placements for (A, B) — the distribute_data analog for GEMM."""
+    spec_a, spec_b, _, _ = _GEMM_SPECS[name](mesh)
+    return NamedSharding(mesh, spec_a), NamedSharding(mesh, spec_b)
+
+
+def build_gemm(
+    name: str, mesh: Mesh, *, gather_output: bool = True
+) -> Callable[[Array, Array], Array]:
+    """Return jitted ``matmul(a, b) -> c`` for one strategy on ``mesh``."""
+    if name not in _GEMM_SPECS:
+        raise KeyError(
+            f"unknown gemm strategy {name!r}; available: "
+            f"{available_gemm_strategies()}"
+        )
+    spec_a, spec_b, spec_c, reduce_axis = _GEMM_SPECS[name](mesh)
+
+    def body(a_blk: Array, b_blk: Array) -> Array:
+        partial = _local_matmul(a_blk, b_blk)
+        if reduce_axis is not None:
+            partial = jax.lax.psum(partial, reduce_axis)
+        return partial.astype(a_blk.dtype)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec_a, spec_b), out_specs=spec_c
+    )
+
+    @jax.jit
+    def matmul(a: Array, b: Array) -> Array:
+        validate_gemm(name, a.shape[0], a.shape[1], b.shape[1], mesh)
+        c = mapped(a, b)
+        if gather_output:
+            c = jax.lax.with_sharding_constraint(c, NamedSharding(mesh, P()))
+        return c
+
+    return matmul
