@@ -50,8 +50,27 @@ def extended_csv_path(root: str | os.PathLike | None = None) -> Path:
 
 
 def _append_row(path: Path, header: str, row: str) -> None:
+    """Append-only write with header-schema validation.
+
+    A pre-existing file written under an older schema (e.g. the extended CSV
+    before the ``measure`` column) must not silently receive rows misaligned
+    with its header — it is rotated to ``<name>.bak`` (``.bak2`` … if taken)
+    and a fresh file started under the current header.
+    """
     path.parent.mkdir(parents=True, exist_ok=True)
-    is_new = not path.exists()
+    is_new = True
+    if path.exists():
+        with open(path) as f:
+            existing = f.readline().rstrip("\n")
+        if existing == header:
+            is_new = False
+        elif existing:  # non-empty stale header: rotate; empty file: reuse
+            bak = path.with_suffix(path.suffix + ".bak")
+            n = 2
+            while bak.exists():
+                bak = path.with_suffix(f"{path.suffix}.bak{n}")
+                n += 1
+            path.rename(bak)
     with open(path, "a") as f:
         if is_new:
             f.write(header + "\n")

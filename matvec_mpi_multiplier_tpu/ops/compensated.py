@@ -74,17 +74,30 @@ def split(a: Array) -> tuple[Array, Array]:
 def two_prod(a: Array, b: Array) -> tuple[Array, Array]:
     """Exact product: returns (p, err) with a * b == p + err.
 
-    Dekker's split overflows for |a| above ~2^emax/splitter (fp32: ~8.3e34 —
-    inside the fp32 range), which would poison ``err`` with NaN while ``p``
-    itself is still finite. Those lanes degrade to (p, 0) — plain-product
-    accuracy — instead of NaN; genuine overflow/NaN in ``p`` still propagates
-    naturally.
+    Dekker's split is exact only in the interior of the exponent range; at
+    both ends the computed ``err`` is garbage rather than the true rounding
+    error, and those lanes must degrade to (p, 0) — plain-product accuracy:
+
+    * **Overflow:** for |a| above ~2^emax/splitter (fp32: ~8.3e34, inside the
+      fp32 range) the split itself overflows and ``err`` is NaN/inf while
+      ``p`` is still finite.
+    * **Underflow:** when the split low parts or the half-products land in
+      subnormal territory (flushed to zero on TPU and by XLA CPU), the
+      residual ``ah*bh - p`` no longer cancels and ``err`` comes out ~2^12×
+      too large — *worse* than the plain product if kept.
+
+    Both are caught by one validity test: a genuine rounding error satisfies
+    |err| <= 0.5·ulp(p) <= eps·|p|, so any ``err`` larger than a few eps·|p|
+    (or non-finite) is spurious and is zeroed. Genuine overflow/NaN in ``p``
+    itself still propagates naturally.
     """
     p = a * b
     ah, al = split(a)
     bh, bl = split(b)
     err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
-    err = jnp.where(jnp.isfinite(err), err, jnp.zeros_like(err))
+    tol = jnp.asarray(16.0 * jnp.finfo(p.dtype).eps, p.dtype)
+    valid = jnp.isfinite(err) & (jnp.abs(err) <= jnp.abs(p) * tol)
+    err = jnp.where(valid, err, jnp.zeros_like(err))
     return p, err
 
 

@@ -39,6 +39,12 @@ from .gemv import gemv_xla, register_kernel
 DEFAULT_BM = 512
 DEFAULT_BK = 4096
 
+# The (512, 4096) tuning was done at bf16: a 4 MiB A-tile, 8 MiB
+# double-buffered. Wider dtypes must shrink bk to stay inside the same VMEM
+# budget (fp32 would otherwise double the tile, fp64 quadruple it — enough to
+# fail pallas_call compilation on smaller-VMEM TPU generations).
+TILE_BYTE_BUDGET = DEFAULT_BM * DEFAULT_BK * 2  # 4 MiB
+
 
 def _largest_divisor_leq(n: int, cap: int, multiple: int) -> int | None:
     """Largest d ≤ cap with n % d == 0 and d % multiple == 0 (None if none)."""
@@ -123,8 +129,13 @@ def gemv_pallas(a: Array, x: Array) -> Array:
     m, k = a.shape
     # fp32 min sublane is 8; bf16 is 16. Use 16 to cover both.
     bm = _largest_divisor_leq(m, DEFAULT_BM, 16)
-    bk = _largest_divisor_leq(k, DEFAULT_BK, 128)
-    if bm is None or bk is None:
+    if bm is None:
+        return gemv_xla(a, x)
+    # Fixed tile *byte* budget: bk shrinks for wider dtypes (bf16 keeps the
+    # tuned 4096; fp32 caps at 2048, fp64 at 1024 for the full-size bm).
+    bk_cap = min(DEFAULT_BK, TILE_BYTE_BUDGET // (bm * jnp.dtype(a.dtype).itemsize))
+    bk = _largest_divisor_leq(k, bk_cap, 128)
+    if bk is None:
         return gemv_xla(a, x)
     return _pallas_gemv(a, x, bm=bm, bk=bk, interpret=not _on_tpu())
 

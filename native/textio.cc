@@ -111,20 +111,48 @@ extern "C" int64_t matvec_load_text(const char* path, double* out,
 
   const char* p = buf.data();
   int64_t n = 0;
+  // Line-structure tracking: np.loadtxt skips blank lines but rejects ragged
+  // ones ("Wrong number of columns at line N"), even when the total element
+  // count matches — both parser paths must reject identical files, so the
+  // first non-blank line fixes the expected token count and every later
+  // non-blank line must match it.
+  int64_t tokens_in_line = 0;
+  int64_t tokens_per_line = -1;
+  auto end_line = [&]() -> bool {  // false => ragged line structure
+    if (tokens_in_line == 0) return true;  // blank line: skipped, like numpy
+    if (tokens_per_line < 0) {
+      tokens_per_line = tokens_in_line;
+    } else if (tokens_in_line != tokens_per_line) {
+      return false;
+    }
+    tokens_in_line = 0;
+    return true;
+  };
   while (n < capacity) {
+    while (IsSpace(*p)) {
+      if (*p == '\n' && !end_line()) return -3;
+      ++p;
+    }
     const char* end = nullptr;
     double v = ParseDouble(p, &end);
     if (end == p) break;  // no more parseable tokens
     // Tokens must be whitespace-separated: a fused token like '1.5-2.5'
     // (which numpy rejects) must not silently split into two values.
     if (!IsSpace(*end) && *end != '\0') return -3;
+    ++tokens_in_line;
     out[n++] = v;
     p = end;
   }
   // Whatever remains must be pure whitespace (EOF) or, at capacity, more
   // well-formed values (count mismatch). Anything else is malformed.
-  while (IsSpace(*p)) ++p;
-  if (*p == '\0') return n;
+  while (IsSpace(*p)) {
+    if (*p == '\n' && !end_line()) return -3;
+    ++p;
+  }
+  if (*p == '\0') {
+    if (!end_line()) return -3;  // final line, no trailing newline
+    return n;
+  }
   if (n == capacity) {
     const char* end = nullptr;
     (void)ParseDouble(p, &end);

@@ -115,6 +115,19 @@ def test_csv_extended(devices, tmp_path):
     assert rows[0]["gflops"] > 0
 
 
+def test_csv_stale_header_rotated(devices, tmp_path):
+    # A pre-existing file written under an older schema must not silently
+    # receive misaligned rows: it is rotated to .bak and a fresh file started.
+    ext = extended_csv_path(tmp_path)
+    ext.parent.mkdir(parents=True, exist_ok=True)
+    ext.write_text("old, header\n1, 2\n")
+    res = _bench(make_mesh(2))
+    append_result(res, tmp_path)
+    assert ext.with_suffix(".csv.bak").read_text() == "old, header\n1, 2\n"
+    rows = read_csv(ext)
+    assert rows[0]["strategy"] == "rowwise"  # fresh file, current schema
+
+
 def test_read_csv_reference_files():
     """Our parser must read the reference's own committed CSVs, including the
     no-space asymmetric header (quirk Q10)."""

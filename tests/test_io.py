@@ -176,6 +176,23 @@ def test_native_loader_rejects_malformed(tmp_path):
 @pytest.mark.skipif(
     not _native_io_available(), reason="native lib not built (make -C native)"
 )
+def test_native_loader_rejects_ragged_lines(tmp_path):
+    # np.loadtxt rejects ragged rows even when the total element count
+    # matches ("Wrong number of columns at line N"); the native path must
+    # agree. 3 + 5 tokens = 8 = 2*4, so only line structure distinguishes it.
+    (tmp_path / "matrix_2_4.txt").write_text("1 2 3\n4 5 6 7 8\n")
+    with pytest.raises(Exception):
+        io.load_matrix(2, 4, tmp_path)
+    # Blank lines are not ragged — numpy skips them; so must the native path.
+    (tmp_path / "matrix_2_2.txt").write_text("1 2\n\n3 4\n")
+    np.testing.assert_array_equal(
+        io.load_matrix(2, 2, tmp_path), np.array([[1.0, 2.0], [3.0, 4.0]])
+    )
+
+
+@pytest.mark.skipif(
+    not _native_io_available(), reason="native lib not built (make -C native)"
+)
 def test_native_loader_rejects_hex_floats(tmp_path):
     # strtod accepts C99 hex-floats; numpy does not — the native path must
     # agree with numpy and reject the file.

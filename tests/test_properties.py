@@ -71,6 +71,20 @@ def test_kernels_agree(devices, case):
         )
 
 
+def test_compensated_subnormal_regression(devices):
+    # Round-1 falsifying example (hypothesis, committed in
+    # .hypothesis/patches/2026-07-29--c64797ae.patch): the Dekker-split low
+    # parts of a near-subnormal operand flush to zero, and two_prod's err
+    # used to come out ~140 ulp WORSE than the plain fp32 product. The
+    # underflow degrade in ops/compensated.py:two_prod zeroes the bogus err.
+    a = jnp.asarray([[1183.0]], jnp.float32)
+    x = jnp.asarray([1.7713329e-36], jnp.float32)
+    truth = np.asarray(a, np.float64) @ np.asarray(x, np.float64)
+    err_comp = np.abs(np.asarray(gemv_compensated(a, x), np.float64) - truth)
+    err_plain = np.abs(np.asarray(gemv_xla(a, x), np.float64) - truth)
+    assert (err_comp <= err_plain).all()
+
+
 @given(case=matvec_case(multiple_of=1))
 @settings(**COMMON)
 def test_compensated_no_worse_than_plain(devices, case):
