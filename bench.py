@@ -39,15 +39,19 @@ from matvec_mpi_multiplier_tpu.bench.timing import time_fn_chained
 REFERENCE_BEST_GBPS = 4.13
 
 
-def _backend_reachable(timeout_s: float = 120.0, attempts: int = 3) -> str | None:
+def _backend_reachable(timeout_s: float = 90.0, attempts: int = 2) -> str | None:
     """Probe jax.devices() in a subprocess; return an error string or None.
 
     The tunneled TPU backend has been observed wedging so hard that
     jax.devices() blocks forever in C++ (uninterruptible by signals). Probing
-    in a killable subprocess keeps bench.py from hanging the whole driver;
-    after `attempts` failed probes the caller emits an explicit failure line
-    — carrying the child's actual stderr, so a crash (plugin error, import
-    failure) isn't misreported as a timeout.
+    in a killable subprocess keeps bench.py from hanging the whole driver.
+
+    Cost discipline: a wedge is permanent for the life of the tunnel, so a
+    probe *timeout* reports immediately — retrying would burn minutes of
+    driver wall-clock re-measuring a known state. Only a probe that *crashes*
+    (nonzero exit: transient plugin/import error) earns a short-delay retry;
+    its stderr tail is carried into the failure line so a crash isn't
+    misreported as a timeout.
     """
     import subprocess
     import time
@@ -66,9 +70,12 @@ def _backend_reachable(timeout_s: float = 120.0, attempts: int = 3) -> str | Non
                 tail[-1] if tail else "no stderr"
             )
         except subprocess.TimeoutExpired:
-            last_error = f"probe timed out after {timeout_s:.0f}s"
+            return (
+                f"probe timed out after {timeout_s:.0f}s "
+                "(wedged tunnel — permanent, not retried)"
+            )
         if i + 1 < attempts:
-            time.sleep(30)
+            time.sleep(15)
     return f"{last_error} ({attempts} attempts)"
 
 

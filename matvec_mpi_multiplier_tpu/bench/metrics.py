@@ -95,7 +95,7 @@ def append_result(result: TimingResult, root: str | os.PathLike | None = None) -
         f"{result.n_rows}, {result.n_cols}, {result.n_devices}, "
         f"{result.mean_time_s:.6f}, {result.strategy}, {result.dtype}, "
         f"{result.mode}, {result.measure}, {result.gflops:.4f}, "
-        f"{result.gbps:.4f}"
+        f"{result.gbps:.4f}, {result.n_rhs}"
     )
     _append_row(extended_csv_path(root), CSV_HEADER_EXTENDED, ext_row)
     return path

@@ -36,12 +36,18 @@ import jax
 import numpy as np
 
 from ..models import available_strategies, get_strategy
+from ..models.gemm import available_gemm_strategies, validate_gemm
 from ..parallel.mesh import make_mesh
 from ..utils import io
 from ..utils.errors import MatvecError
 from .metrics import append_result, csv_path
 from .profiling import annotate, trace
-from .timing import MEASURE_METHODS, TIMING_MODES, benchmark_strategy
+from .timing import (
+    MEASURE_METHODS,
+    TIMING_MODES,
+    benchmark_gemm,
+    benchmark_strategy,
+)
 
 # The reference's sweeps (test.sh:5,8 and the asymmetric CSVs' sizes).
 SQUARE_SIZES = list(range(600, 10201, 1200))
@@ -74,7 +80,22 @@ def build_parser() -> argparse.ArgumentParser:
         "--strategy",
         nargs="+",
         default=["all"],
-        help=f"strategies to run: {available_strategies()} or 'all'",
+        help=f"strategies to run: {available_strategies()} or 'all' "
+        f"(with --op gemm: {available_gemm_strategies()})",
+    )
+    p.add_argument(
+        "--op",
+        choices=["matvec", "gemm"],
+        default="matvec",
+        help="operation to sweep: matvec (y = A·x, the reference's scope) or "
+        "gemm (C = A @ B, the MXU-bound extension; rows land in "
+        "gemm_<strategy>.csv)",
+    )
+    p.add_argument(
+        "--n-rhs",
+        type=int,
+        default=None,
+        help="with --op gemm: columns of B (default: square, n_rhs = n_cols)",
     )
     p.add_argument(
         "--devices",
@@ -158,13 +179,16 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def resolve_strategies(names: list[str]) -> list[str]:
+def resolve_strategies(names: list[str], op: str = "matvec") -> list[str]:
+    available = (
+        available_gemm_strategies() if op == "gemm" else available_strategies()
+    )
     if "all" in names:
-        return available_strategies()
+        return available
     for n in names:
-        if n not in available_strategies():
+        if n not in available:
             raise SystemExit(
-                f"unknown strategy {n!r}; available: {available_strategies()}"
+                f"unknown {op} strategy {n!r}; available: {available}"
             )
     return names
 
@@ -206,8 +230,25 @@ def run_sweep(args: argparse.Namespace) -> int:
             "host->device transfer cannot ride a fenced execution chain); "
             "use --measure sync or auto"
         )
+    if args.op == "gemm" and args.use_files:
+        raise SystemExit(
+            "--use-files is matvec-only (the reference's vector-file "
+            "convention has no rank-2 right-hand side); gemm operands are "
+            "generated in memory"
+        )
+    # Fail fast on an unknown kernel: get_*_kernel raises the same KeyError,
+    # but only deep inside the loop, after earlier configs already ran.
+    from ..ops import available_gemm_kernels, available_kernels
+
+    kernels = (
+        available_gemm_kernels() if args.op == "gemm" else available_kernels()
+    )
+    if args.kernel not in kernels:
+        raise SystemExit(
+            f"unknown {args.op} kernel {args.kernel!r}; available: {kernels}"
+        )
     configure_platform(args.platform, args.host_devices)
-    strategies = resolve_strategies(args.strategy)
+    strategies = resolve_strategies(args.strategy, args.op)
     counts = args.devices or device_counts_available()
     if args.sizes:
         sizes = [(s, s) for s in args.sizes]
@@ -229,8 +270,9 @@ def run_sweep(args: argparse.Namespace) -> int:
     n_ok, n_skip = counters
     if not args.no_csv:
         for name in strategies:
+            csv_name = f"gemm_{name}" if args.op == "gemm" else name
             for mode in modes:
-                print(f"CSV: {csv_path(name, args.data_root, mode=mode)}")
+                print(f"CSV: {csv_path(csv_name, args.data_root, mode=mode)}")
     if args.profile_dir is not None:
         print(f"trace: {args.profile_dir}")
     print(f"{n_ok} configs timed, {n_skip} skipped")
@@ -241,37 +283,51 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
     # Sizes on the outer loop: operands depend only on the size (and seed),
     # so each (n_rows, n_cols) pair is generated/loaded exactly once and
     # shared across every strategy x device-count combination.
+    gemm = args.op == "gemm"
     for n_rows, n_cols in sizes:
+        n_rhs = (args.n_rhs or n_cols) if gemm else 1
         a = x = None
         for name in strategies:
-            strat = get_strategy(name)
+            strat = None if gemm else get_strategy(name)
             for n_dev in counts:
                 mesh = meshes[n_dev]
                 try:
-                    strat.validate(n_rows, n_cols, mesh)
+                    if gemm:
+                        validate_gemm(name, n_rows, n_cols, n_rhs, mesh)
+                    else:
+                        strat.validate(n_rows, n_cols, mesh)
                 except MatvecError as e:
                     print(f"skip {name} {n_rows}x{n_cols} p={n_dev}: {e}")
                     counters[1] += 1
                     continue
                 if a is None:
-                    a, x = operands(n_rows, n_cols, args)
+                    if gemm:
+                        a = io.generate_matrix(n_rows, n_cols, seed=args.seed)
+                        x = io.generate_matrix(n_cols, n_rhs, seed=args.seed + 1)
+                    else:
+                        a, x = operands(n_rows, n_cols, args)
                 for mode in modes:
-                    with annotate(f"{name}_{n_rows}x{n_cols}_p{n_dev}_{mode}"):
-                        result = benchmark_strategy(
-                            strat,
-                            mesh,
-                            a,
-                            x,
+                    label = f"{args.op}_{name}_{n_rows}x{n_cols}_p{n_dev}_{mode}"
+                    with annotate(label):
+                        bench_kwargs = dict(
                             dtype=args.dtype,
                             n_reps=args.n_reps,
                             mode=mode,
                             measure=args.measure,
                             kernel=args.kernel,
                         )
+                        if gemm:
+                            result = benchmark_gemm(
+                                name, mesh, a, x, **bench_kwargs
+                            )
+                        else:
+                            result = benchmark_strategy(
+                                strat, mesh, a, x, **bench_kwargs
+                            )
                     if not args.no_csv:
                         append_result(result, args.data_root)
                     print(
-                        f"{name} {n_rows}x{n_cols} p={n_dev} [{mode}] "
+                        f"{result.strategy} {n_rows}x{n_cols} p={n_dev} [{mode}] "
                         f"mean={result.mean_time_s:.6f}s "
                         f"{result.gflops:.2f} GFLOP/s {result.gbps:.2f} GB/s"
                     )

@@ -81,17 +81,23 @@ class TimingResult:
     # 'chain': independent slope estimates of the per-matvec time.
     times_s: tuple[float, ...]
     n_reps: int = DEFAULT_N_REPS
+    # Columns of the right-hand side: 1 = matvec (y = A·x, the reference's
+    # whole scope); >1 = GEMM (C = A @ B with B (n_cols, n_rhs)).
+    n_rhs: int = 1
 
     @property
     def gflops(self) -> float:
-        """Aggregate GFLOP/s: 2·m·n FLOPs per matvec (BASELINE.md formula)."""
-        return 2.0 * self.n_rows * self.n_cols / self.mean_time_s / 1e9
+        """Aggregate GFLOP/s: 2·m·k·n_rhs FLOPs (BASELINE.md formula at
+        n_rhs=1)."""
+        return (
+            2.0 * self.n_rows * self.n_cols * self.n_rhs / self.mean_time_s / 1e9
+        )
 
     @property
     def gbps(self) -> float:
-        """Effective GB/s: one read of A and x, one write of y."""
+        """Effective GB/s: one read of A and B(/x), one write of C(/y)."""
         itemsize = np.dtype(self.dtype).itemsize if self.dtype != "bfloat16" else 2
-        elems = self.n_rows * self.n_cols + self.n_rows + self.n_cols
+        elems = self.n_rows * self.n_cols + (self.n_rows + self.n_cols) * self.n_rhs
         return itemsize * elems / self.mean_time_s / 1e9
 
     @property
@@ -244,6 +250,55 @@ def time_matvec(
     return times
 
 
+def _run_benchmark(
+    *,
+    fn: Callable,
+    a: np.ndarray,
+    rhs: np.ndarray,
+    shardings,
+    mesh,
+    strategy_name: str,
+    n_rhs: int,
+    n_reps: int,
+    mode: str,
+    measure: str,
+) -> TimingResult:
+    """The shared protocol body behind :func:`benchmark_strategy` and
+    :func:`benchmark_gemm`: time the built fn and assemble the result —
+    one place, so matvec and GEMM rows in the shared extended CSV are always
+    measured under the identical protocol."""
+    times = time_matvec(
+        fn, a, rhs, shardings=shardings, n_reps=n_reps, mode=mode,
+        measure=measure,
+    )
+    return TimingResult(
+        n_rows=a.shape[0],
+        n_cols=a.shape[1],
+        n_devices=int(mesh.devices.size),
+        strategy=strategy_name,
+        dtype=str(a.dtype),
+        mode=mode,
+        measure=measure,
+        mean_time_s=float(np.mean(times)),
+        times_s=tuple(times),
+        n_reps=n_reps,
+        n_rhs=n_rhs,
+    )
+
+
+def _prepare_operands(
+    a: np.ndarray, rhs: np.ndarray, dtype: str | None
+) -> tuple[np.ndarray, np.ndarray]:
+    if dtype is not None:
+        a = a.astype(dtype)
+        rhs = rhs.astype(dtype)
+    if a.dtype == np.float64 and not jax.config.jax_enable_x64:
+        # Without x64, JAX silently downcasts fp64 operands to fp32 while
+        # TimingResult would still record 'float64' — mislabeled results.
+        jax.config.update("jax_enable_x64", True)
+    return a, rhs
+
+
 def benchmark_strategy(
     strategy,
     mesh,
@@ -261,28 +316,44 @@ def benchmark_strategy(
     reference's per-config run (``src/multiplier_rowwise.c:54-176``) minus the
     CSV write (see bench.metrics)."""
     measure = resolve_measure(mode, measure)
-    if dtype is not None:
-        a = a.astype(dtype)
-        x = x.astype(dtype)
-    if a.dtype == np.float64 and not jax.config.jax_enable_x64:
-        # Without x64, JAX silently downcasts fp64 operands to fp32 while
-        # TimingResult would still record 'float64' — mislabeled results.
-        jax.config.update("jax_enable_x64", True)
+    a, x = _prepare_operands(a, x, dtype)
     strategy.validate(a.shape[0], a.shape[1], mesh)
     fn = strategy.build(mesh, kernel=kernel, gather_output=gather_output)
-    times = time_matvec(
-        fn, a, x, shardings=strategy.shardings(mesh), n_reps=n_reps,
-        mode=mode, measure=measure,
-    )
-    return TimingResult(
-        n_rows=a.shape[0],
-        n_cols=a.shape[1],
-        n_devices=int(mesh.devices.size),
-        strategy=strategy.name,
-        dtype=str(a.dtype),
-        mode=mode,
+    return _run_benchmark(
+        fn=fn, a=a, rhs=x, shardings=strategy.shardings(mesh), mesh=mesh,
+        strategy_name=strategy.name, n_rhs=1, n_reps=n_reps, mode=mode,
         measure=measure,
-        mean_time_s=float(np.mean(times)),
-        times_s=tuple(times),
-        n_reps=n_reps,
+    )
+
+
+def benchmark_gemm(
+    name: str,
+    mesh,
+    a: np.ndarray,
+    b: np.ndarray,
+    *,
+    dtype: str | None = None,
+    n_reps: int = DEFAULT_N_REPS,
+    mode: str = "amortized",
+    measure: str = "auto",
+    kernel: str | Callable = "xla",
+    gather_output: bool = True,
+) -> TimingResult:
+    """Benchmark one GEMM (strategy, mesh, size) configuration.
+
+    Same protocol as :func:`benchmark_strategy` with a rank-2 right-hand
+    side; the result's strategy is recorded as ``gemm_<name>`` so GEMM rows
+    land in their own per-strategy CSVs (the reference schema has no op
+    column to tell matvec and GEMM apart).
+    """
+    from ..models.gemm import build_gemm, gemm_shardings, validate_gemm
+
+    measure = resolve_measure(mode, measure)
+    a, b = _prepare_operands(a, b, dtype)
+    validate_gemm(name, a.shape[0], a.shape[1], b.shape[1], mesh)
+    fn = build_gemm(name, mesh, kernel=kernel, gather_output=gather_output)
+    return _run_benchmark(
+        fn=fn, a=a, rhs=b, shardings=gemm_shardings(name, mesh), mesh=mesh,
+        strategy_name=f"gemm_{name}", n_rhs=b.shape[1], n_reps=n_reps,
+        mode=mode, measure=measure,
     )

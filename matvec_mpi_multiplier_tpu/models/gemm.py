@@ -29,21 +29,14 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
-import jax.numpy as jnp
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.gemm_kernels import get_gemm_kernel
 from ..parallel.mesh import mesh_grid_shape
 from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
 from ..utils.errors import ShardingError, check_divisible
 from .base import flat_axes, mesh_size
-
-
-def _local_matmul(a_blk: Array, b_blk: Array) -> Array:
-    """Local MXU matmul in the kernel accumulator dtype (ops/gemv.py rule)."""
-    acc = jnp.promote_types(a_blk.dtype, jnp.float32)
-    return jnp.matmul(a_blk, b_blk, preferred_element_type=acc)
-
 
 _GEMM_SPECS: dict[str, Callable[[Mesh], tuple[P, P, P, str | None]]] = {}
 
@@ -113,24 +106,40 @@ def gemm_shardings(
 
 
 def build_gemm(
-    name: str, mesh: Mesh, *, gather_output: bool = True
+    name: str,
+    mesh: Mesh,
+    *,
+    kernel: str | Callable = "xla",
+    gather_output: bool = True,
+    check_vma: bool | None = None,
 ) -> Callable[[Array, Array], Array]:
-    """Return jitted ``matmul(a, b) -> c`` for one strategy on ``mesh``."""
+    """Return jitted ``matmul(a, b) -> c`` for one strategy on ``mesh``.
+
+    ``kernel`` names a local-matmul tier from the GEMM kernel registry
+    (ops/gemm_kernels.py): ``"xla"`` (default) or ``"pallas"`` (the explicit
+    MXU tile, ops/pallas_gemm.py).
+    """
     if name not in _GEMM_SPECS:
         raise KeyError(
             f"unknown gemm strategy {name!r}; available: "
             f"{available_gemm_strategies()}"
         )
+    kern = get_gemm_kernel(kernel)
     spec_a, spec_b, spec_c, reduce_axis = _GEMM_SPECS[name](mesh)
+    if check_vma is None:
+        # Same relaxation rule as MatvecStrategy.build (models/base.py):
+        # pallas interpret mode defeats the vma checker.
+        check_vma = not getattr(kern, "relax_vma_check", False)
 
     def body(a_blk: Array, b_blk: Array) -> Array:
-        partial = _local_matmul(a_blk, b_blk)
+        partial = kern(a_blk, b_blk)
         if reduce_axis is not None:
             partial = jax.lax.psum(partial, reduce_axis)
         return partial.astype(a_blk.dtype)
 
     mapped = jax.shard_map(
-        body, mesh=mesh, in_specs=(spec_a, spec_b), out_specs=spec_c
+        body, mesh=mesh, in_specs=(spec_a, spec_b), out_specs=spec_c,
+        check_vma=check_vma,
     )
 
     @jax.jit

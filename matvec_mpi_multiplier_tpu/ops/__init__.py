@@ -10,10 +10,23 @@ from . import pallas_gemv  # noqa: F401
 from . import native_gemv  # noqa: F401
 from . import compensated  # noqa: F401
 
+# The GEMM kernel tier (same registry pattern, rank-2 right-hand side).
+from .gemm_kernels import (
+    available_gemm_kernels,
+    get_gemm_kernel,
+    matmul_xla,
+    register_gemm_kernel,
+)
+from . import pallas_gemm  # noqa: F401
+
 __all__ = [
     "gemv",
     "gemv_xla",
     "get_kernel",
     "register_kernel",
     "available_kernels",
+    "matmul_xla",
+    "get_gemm_kernel",
+    "register_gemm_kernel",
+    "available_gemm_kernels",
 ]

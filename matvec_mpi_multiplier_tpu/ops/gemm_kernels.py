@@ -1,0 +1,53 @@
+"""Local GEMM kernels: the per-device matmul tier.
+
+The reference's compute layer is matvec-only (``multiply_std_rowwise``,
+``src/matr_utils.c:86-96``); GEMM (``C = A @ B``) is this framework's
+extension of the same kernel-registry pattern (ops/gemv.py) to the rank-2
+right-hand side, where the TPU MXU is actually compute-bound instead of
+HBM-bound.
+
+All kernels share the signature ``matmul(a, b) -> c`` with ``a: (m, k)``,
+``b: (k, n)``, ``c: (m, n)`` and the same accumulator-dtype contract as the
+GEMV tier: kernels return the *accumulator* dtype (fp32 for bf16/fp16
+inputs; the input dtype for fp32/fp64), the strategies psum on the
+accumulator and cast back to storage dtype at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import jax.numpy as jnp
+from jax import Array
+
+
+class GemmKernel(Protocol):
+    def __call__(self, a: Array, b: Array) -> Array: ...
+
+
+def matmul_xla(a: Array, b: Array) -> Array:
+    """XLA-native matmul — tiles straight onto the MXU; the default tier."""
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    return jnp.matmul(a, b, preferred_element_type=acc)
+
+
+_GEMM_KERNELS: dict[str, GemmKernel] = {"xla": matmul_xla}
+
+
+def register_gemm_kernel(name: str, fn: GemmKernel) -> None:
+    _GEMM_KERNELS[name] = fn
+
+
+def get_gemm_kernel(name: str | Callable) -> GemmKernel:
+    if callable(name):
+        return name
+    try:
+        return _GEMM_KERNELS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown gemm kernel {name!r}; available: {sorted(_GEMM_KERNELS)}"
+        ) from None
+
+
+def available_gemm_kernels() -> list[str]:
+    return sorted(_GEMM_KERNELS)

@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for the local GEMM tile.
+
+The MXU-bound counterpart of ops/pallas_gemv.py (which is HBM-bound). The
+grid walks (row-block i, col-block j, contraction-block kk) tiles with the
+contraction innermost: each (i, j) output block stays resident in VMEM as an
+fp32 accumulator while the kk loop streams (bm, bk) tiles of A and (bk, bn)
+tiles of B through the MXU via ``jnp.dot``. This is the canonical Pallas
+matmul schedule — the compiler double-buffers the A/B streams, and the MXU
+sees large static-shaped matmuls, exactly what SURVEY.md §7's design stance
+asks of the compute layer.
+
+The reference has no GEMM (its kernel layer is the serial GEMV at
+``src/matr_utils.c:86-96``); this tier exists so the framework's strategy
+ladder (models/gemm.py) has an explicit-kernel path at the sizes where the
+MXU, not HBM, is the roofline.
+
+Falls back to interpret mode off-TPU (testable on the CPU mesh) and to the
+XLA kernel for shapes that don't admit aligned tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+
+from .gemm_kernels import matmul_xla, register_gemm_kernel
+from .pallas_gemv import _largest_divisor_leq, _on_tpu
+
+# (512, 512) output block with a 1024-deep contraction slice: bf16 A/B tiles
+# are 1 MiB each (2 MiB double-buffered), the fp32 accumulator block is
+# 1 MiB — comfortably inside VMEM on every TPU generation. The MXU processes
+# (128, 128)x(128, 128) per pass, so all three dims are MXU-aligned.
+DEFAULT_BM = 512
+DEFAULT_BN = 512
+DEFAULT_BK = 1024
+
+# Per-operand tile byte budget (same discipline as pallas_gemv's
+# TILE_BYTE_BUDGET): wider dtypes shrink bk so fp32/fp64 operands don't
+# overflow VMEM on smaller-VMEM generations.
+TILE_BYTE_BUDGET = DEFAULT_BM * DEFAULT_BK * 2  # 1 MiB
+
+
+def _mm_kernel(a_ref, b_ref, o_ref):
+    """One (bm, bn) output block: o (+)= a_tile @ b_tile over the kk grid."""
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += jnp.dot(
+        a_ref[...], b_ref[...], preferred_element_type=o_ref.dtype
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret"))
+def _pallas_matmul(
+    a: Array, b: Array, *, bm: int, bn: int, bk: int, interpret: bool
+) -> Array:
+    m, k = a.shape
+    _, n = b.shape
+    grid = (m // bm, n // bn, k // bk)
+    # Align varying-mesh-axis sets across inputs (see pallas_gemv.py): under
+    # shard_map one operand may be device-varying while the other is
+    # replicated, and the kernel-level ops need matching vma sets.
+    vma = frozenset(jax.typeof(a).vma) | frozenset(jax.typeof(b).vma)
+    a = jax.lax.pcast(a, tuple(vma - frozenset(jax.typeof(a).vma)), to="varying")
+    b = jax.lax.pcast(b, tuple(vma - frozenset(jax.typeof(b).vma)), to="varying")
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    return pl.pallas_call(
+        _mm_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), acc, vma=vma),
+        interpret=interpret,
+    )(a, b)
+
+
+def matmul_pallas(a: Array, b: Array) -> Array:
+    """Pallas tiled matmul with automatic tile-size selection.
+
+    Shapes without aligned tiles fall back to the XLA kernel — the contract
+    is the registry's ``matmul(a, b) -> c``, not a shape restriction.
+    """
+    m, k = a.shape
+    _, n = b.shape
+    bm = _largest_divisor_leq(m, DEFAULT_BM, 16)
+    bn = _largest_divisor_leq(n, DEFAULT_BN, 128)
+    if bm is None or bn is None:
+        return matmul_xla(a, b)
+    itemsize = jnp.dtype(a.dtype).itemsize
+    bk_cap = min(DEFAULT_BK, TILE_BYTE_BUDGET // (max(bm, bn) * itemsize))
+    bk = _largest_divisor_leq(k, bk_cap, 128)
+    if bk is None:
+        return matmul_xla(a, b)
+    return _pallas_matmul(a, b, bm=bm, bn=bn, bk=bk, interpret=not _on_tpu())
+
+
+# Same shard_map vma-check relaxation as the pallas GEMV (models/base.py).
+matmul_pallas.relax_vma_check = True  # type: ignore[attr-defined]
+
+register_gemm_kernel("pallas", matmul_pallas)

@@ -21,13 +21,20 @@ import jax
 def _checkpointer():
     import orbax.checkpoint as ocp
 
-    return ocp.PyTreeCheckpointer()
+    # StandardCheckpointer is the current supported API (the legacy
+    # PyTreeCheckpointer item/restore_args family is deprecated). It is an
+    # AsyncCheckpointer: save_state blocks on wait_until_finished so callers
+    # (and the reference-style resume flow) see a complete checkpoint on
+    # return.
+    return ocp.StandardCheckpointer()
 
 
 def save_state(state: Any, path: str | os.PathLike) -> Path:
     """Save a pytree (e.g. models.trainer.TrainState) to ``path``."""
     path = Path(path).resolve()
-    _checkpointer().save(path, state, force=True)
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
     return path
 
 
@@ -38,19 +45,16 @@ def restore_state(path: str | os.PathLike, like: Any) -> Any:
     concrete) — each restored array adopts the corresponding template
     array's sharding, so state comes back distributed across the mesh.
     """
-    import orbax.checkpoint as ocp
 
-    def to_restore_args(x):
+    def to_abstract(x):
         if isinstance(x, jax.Array):
-            return ocp.ArrayRestoreArgs(
-                sharding=x.sharding, global_shape=x.shape, dtype=x.dtype
-            )
-        return ocp.RestoreArgs()
+            # Abstract template: shape/dtype/sharding without materializing
+            # data — restore places each array directly on its mesh shards.
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
 
-    restore_args = jax.tree.map(to_restore_args, like)
-    return _checkpointer().restore(
-        Path(path).resolve(), item=like, restore_args=restore_args
-    )
+    template = jax.tree.map(to_abstract, like)
+    return _checkpointer().restore(Path(path).resolve(), template)
 
 
 def latest_step_dir(root: str | os.PathLike) -> Path | None:

@@ -33,9 +33,10 @@ DEFAULT_N_REPS: int = 100
 # (src/multiplier_rowwise.c:86): "n_rows, n_cols, n_processes, time".
 CSV_HEADER: str = "n_rows, n_cols, n_processes, time"
 # Extended schema for the TPU build's richer metrics (new capability).
+# n_rhs: columns of the right-hand side (1 = matvec, >1 = GEMM).
 CSV_HEADER_EXTENDED: str = (
     "n_rows, n_cols, n_devices, time, strategy, dtype, mode, measure, "
-    "gflops, gbps"
+    "gflops, gbps, n_rhs"
 )
 
 # Default mesh axis names for the 2-D device grid (reference's process grid

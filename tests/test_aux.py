@@ -25,6 +25,70 @@ def test_distributed_single_process(devices):
     assert distributed.process_count() == 1
 
 
+def test_max_across_processes_multiprocess_fake(devices, monkeypatch):
+    # The multi-host max-reduce (MPI_Reduce(MPI_MAX) analog,
+    # src/multiplier_rowwise.c:147) cannot run for real on a single host;
+    # pin its semantics behind fakes: with process_count>1 it must return the
+    # max over the allgathered per-process values, not the local one.
+    from matvec_mpi_multiplier_tpu.bench import timing
+
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    from jax.experimental import multihost_utils
+
+    gathered = []
+
+    def fake_allgather(value):
+        gathered.append(float(value))
+        return np.array([0.25, 0.75, float(value), 0.5])
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", fake_allgather)
+    assert timing._max_across_processes(0.1) == 0.75  # remote rank is slowest
+    assert timing._max_across_processes(0.9) == 0.9   # local rank is slowest
+    assert gathered == [0.1, 0.9]  # the local value entered the allgather
+
+
+def test_initialize_multiprocess_fakes(devices, monkeypatch):
+    # initialize() semantics behind fakes (jax.distributed.initialize must
+    # not actually run in tests):
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: calls.append(kw)
+    )
+
+    # 1. Already initialized (process_count > 1): no second init — the
+    #    reference's MPI_Init is likewise once-only (src/multiplier_rowwise.c:66).
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    distributed.initialize(coordinator_address="h:1", num_processes=2)
+    assert calls == []
+
+    # 2. Explicit coordinates: passed through verbatim.
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    distributed.initialize(
+        coordinator_address="host:1234", num_processes=4, process_id=3
+    )
+    assert calls == [
+        {
+            "coordinator_address": "host:1234",
+            "num_processes": 4,
+            "process_id": 3,
+        }
+    ]
+
+    # 3. No coordinates, launcher env present (SLURM): autodetect path.
+    calls.clear()
+    monkeypatch.setenv("SLURM_JOB_ID", "42")
+    distributed.initialize()
+    assert calls == [{}]
+
+
+def test_is_main_process_multiprocess_fake(devices, monkeypatch):
+    # Rank-role check on a faked non-zero rank (rank == MAIN_PROCESS is the
+    # reference's coordinator convention, src/constants.h:5).
+    monkeypatch.setattr(jax, "process_index", lambda: 3)
+    assert not distributed.is_main_process()
+    assert distributed.process_index() == 3
+
+
 def test_profiling_trace(devices, tmp_path):
     with trace(tmp_path / "prof") as d:
         with annotate("matvec-region"):

@@ -173,7 +173,7 @@ def test_sweep_cli_skips_indivisible(devices, tmp_path, capsys):
 
 
 def test_sweep_cli_unknown_strategy():
-    with pytest.raises(SystemExit, match="unknown strategy"):
+    with pytest.raises(SystemExit, match="unknown matvec strategy"):
         sweep_main(["--strategy", "nope", "--no-csv"])
 
 

@@ -81,3 +81,107 @@ def test_gemm_shardings_placement(devices, rng):
     np.testing.assert_allclose(
         np.asarray(c), np.asarray(a) @ np.asarray(b), rtol=1e-10
     )
+
+
+def test_gemm_kernel_registry():
+    from matvec_mpi_multiplier_tpu.ops import available_gemm_kernels, get_gemm_kernel
+
+    assert "xla" in available_gemm_kernels()
+    assert "pallas" in available_gemm_kernels()
+    with pytest.raises(KeyError, match="unknown gemm kernel"):
+        get_gemm_kernel("nope")
+
+
+def test_pallas_gemm_matches_xla(rng):
+    # Tile-aligned shape: exercises the pallas path (interpret mode on CPU)
+    # against the XLA kernel.
+    from matvec_mpi_multiplier_tpu.ops.pallas_gemm import matmul_pallas
+
+    a = rng.standard_normal((32, 256)).astype(np.float32)
+    b = rng.standard_normal((256, 128)).astype(np.float32)
+    c = np.asarray(matmul_pallas(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(c, a @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_gemm_fallback_unaligned(rng):
+    # Shapes without aligned tiles route through the XLA kernel.
+    from matvec_mpi_multiplier_tpu.ops.pallas_gemm import matmul_pallas
+
+    a = rng.standard_normal((7, 13)).astype(np.float32)
+    b = rng.standard_normal((13, 5)).astype(np.float32)
+    c = np.asarray(matmul_pallas(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(c, a @ b, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise"])
+def test_gemm_pallas_kernel_distributed(devices, rng, name):
+    # The pallas tier under shard_map on the 8-device mesh. 32-row/128-col
+    # tiles divide the local blocks, so the pallas path (not the fallback)
+    # runs on every device.
+    m, k, n = 64, 512, 128
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    mesh = make_mesh(8)
+    c = build_gemm(name, mesh, kernel="pallas")(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_benchmark_gemm_result(devices, rng, tmp_path):
+    from matvec_mpi_multiplier_tpu.bench.metrics import append_result, csv_path, read_csv
+    from matvec_mpi_multiplier_tpu.bench.timing import benchmark_gemm
+
+    a = rng.standard_normal((16, 16))
+    b = rng.standard_normal((16, 8))
+    res = benchmark_gemm(
+        "blockwise", make_mesh(8), a, b, n_reps=2, measure="sync"
+    )
+    assert res.strategy == "gemm_blockwise"
+    assert res.n_rhs == 8
+    # FLOPs/bytes account for the rank-2 rhs.
+    assert res.gflops == pytest.approx(2 * 16 * 16 * 8 / res.mean_time_s / 1e9)
+    path = append_result(res, tmp_path)
+    assert path == csv_path("gemm_blockwise", tmp_path)
+    rows = read_csv(path)
+    assert rows[0]["n_rows"] == 16
+
+
+def test_sweep_cli_gemm(devices, tmp_path, monkeypatch):
+    from matvec_mpi_multiplier_tpu.bench import sweep
+
+    monkeypatch.chdir(tmp_path)
+    rc = sweep.main(
+        [
+            "--op", "gemm", "--strategy", "blockwise", "--sizes", "16",
+            "--devices", "8", "--n-rhs", "8", "--n-reps", "2",
+            "--measure", "sync",
+        ]
+    )
+    assert rc == 0
+    from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
+
+    rows = read_csv(tmp_path / "data" / "out" / "gemm_blockwise.csv")
+    assert rows[0]["n_rows"] == 16
+    assert rows[0]["n_cols"] == 16
+    assert rows[0]["n_processes"] == 8
+    assert rows[0]["time"] > 0
+    ext = read_csv(tmp_path / "data" / "out" / "results_extended.csv")
+    assert ext[0]["strategy"] == "gemm_blockwise"
+    assert ext[0]["n_rhs"] == 8
+
+
+def test_sweep_cli_gemm_rejects_use_files(devices):
+    from matvec_mpi_multiplier_tpu.bench import sweep
+
+    with pytest.raises(SystemExit, match="matvec-only"):
+        sweep.main(["--op", "gemm", "--use-files", "--sizes", "16"])
+
+
+def test_sweep_cli_rejects_wrong_registry_kernel(devices):
+    # 'compensated' exists in the matvec registry but not the GEMM one (and
+    # vice versa for typos): the sweep must fail fast, before any config runs.
+    from matvec_mpi_multiplier_tpu.bench import sweep
+
+    with pytest.raises(SystemExit, match="unknown gemm kernel"):
+        sweep.main(["--op", "gemm", "--kernel", "compensated", "--sizes", "16"])
+    with pytest.raises(SystemExit, match="unknown matvec kernel"):
+        sweep.main(["--kernel", "nope", "--sizes", "16"])
