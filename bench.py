@@ -132,10 +132,10 @@ def main() -> int:
 
     a, x = gen()
     fn = strategy.build(mesh, kernel=kernel)
-    # Median of 5 independent slope samples after a multi-run warm-up: a cold
-    # process under-reports on its first chains, and the median rejects the
-    # stray slow sample the mean would absorb.
-    times = time_fn_chained(fn, (a, x), n_reps=n_reps, samples=5, warmup=8)
+    # Median of DEFAULT_CHAIN_SAMPLES independent slope samples after a
+    # multi-run warm-up: a cold process under-reports on its first chains,
+    # and the median rejects the stray slow sample the mean would absorb.
+    times = time_fn_chained(fn, (a, x), n_reps=n_reps, warmup=8)
     mean_t = float(np.median(times))
     itemsize = jnp.dtype(dtype).itemsize
     gbps = itemsize * (size * size + 2 * size) / mean_t / 1e9

@@ -143,6 +143,13 @@ def build_parser() -> argparse.ArgumentParser:
         "can starve a device thread past XLA's collective-rendezvous timeout",
     )
     p.add_argument(
+        "--chain-samples",
+        type=int,
+        default=None,
+        help="independent chain-slope estimates per config (median reported; "
+        "default 5 — single slopes stall on tunneled backends)",
+    )
+    p.add_argument(
         "--use-files",
         action="store_true",
         help="load operands via the ./data/matrix_*.txt convention "
@@ -316,6 +323,8 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                             measure=args.measure,
                             kernel=args.kernel,
                         )
+                        if args.chain_samples is not None:
+                            bench_kwargs["chain_samples"] = args.chain_samples
                         if gemm:
                             result = benchmark_gemm(
                                 name, mesh, a, x, **bench_kwargs

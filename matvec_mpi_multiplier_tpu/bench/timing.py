@@ -64,6 +64,11 @@ from ..utils.errors import ConfigError
 TIMING_MODES = ("amortized", "reference")
 MEASURE_METHODS = ("auto", "chain", "sync")
 
+# Independent chain-slope estimates per config; the reported time is their
+# MEDIAN. 5 (not 3): on tunneled backends single slopes occasionally stall
+# by orders of magnitude, and a median-of-5 still rejects two outliers.
+DEFAULT_CHAIN_SAMPLES = 5
+
 
 @dataclasses.dataclass(frozen=True)
 class TimingResult:
@@ -132,6 +137,8 @@ def _fence(y) -> None:
 
 def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int) -> list[float]:
     """Per-execution time as the slope between chains of n1 and n2 runs."""
+    if samples < 1:
+        raise ConfigError(f"chain_samples must be >= 1, got {samples}")
 
     def chain(n: int) -> float:
         start = time.perf_counter()
@@ -153,7 +160,7 @@ def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int)
 
 def time_fn_chained(
     fn: Callable, args: tuple, *, n_reps: int = DEFAULT_N_REPS,
-    samples: int = 3, warmup: int = 1,
+    samples: int = DEFAULT_CHAIN_SAMPLES, warmup: int = 1,
 ) -> list[float]:
     """Chain-slope timing of an arbitrary device function on device-resident
     args (no host placement). Used by bench.py with device-side operand
@@ -204,7 +211,7 @@ def time_matvec(
     n_reps: int = DEFAULT_N_REPS,
     mode: str = "amortized",
     measure: str = "auto",
-    chain_samples: int = 3,
+    chain_samples: int = DEFAULT_CHAIN_SAMPLES,
 ) -> list[float]:
     """Run the reference timing protocol around ``fn(a, x)``.
 
@@ -262,15 +269,26 @@ def _run_benchmark(
     n_reps: int,
     mode: str,
     measure: str,
+    chain_samples: int = DEFAULT_CHAIN_SAMPLES,
 ) -> TimingResult:
     """The shared protocol body behind :func:`benchmark_strategy` and
     :func:`benchmark_gemm`: time the built fn and assemble the result —
     one place, so matvec and GEMM rows in the shared extended CSV are always
-    measured under the identical protocol."""
+    measured under the identical protocol.
+
+    Reported time: **mean** over the per-rep times for ``sync`` (the
+    reference's own protocol, ``src/multiplier_rowwise.c:168``) but
+    **median** over slope estimates for ``chain`` — each chain sample is an
+    independent estimate of the same per-matvec time, and on tunneled
+    backends a single stalled chain can be off by orders of magnitude (the
+    round-1 small-size CSVs were non-monotonic for exactly this reason); the
+    median rejects it where the mean absorbs it.
+    """
     times = time_matvec(
         fn, a, rhs, shardings=shardings, n_reps=n_reps, mode=mode,
-        measure=measure,
+        measure=measure, chain_samples=chain_samples,
     )
+    reported = np.median(times) if measure == "chain" else np.mean(times)
     return TimingResult(
         n_rows=a.shape[0],
         n_cols=a.shape[1],
@@ -279,7 +297,7 @@ def _run_benchmark(
         dtype=str(a.dtype),
         mode=mode,
         measure=measure,
-        mean_time_s=float(np.mean(times)),
+        mean_time_s=float(reported),
         times_s=tuple(times),
         n_reps=n_reps,
         n_rhs=n_rhs,
@@ -311,6 +329,7 @@ def benchmark_strategy(
     measure: str = "auto",
     kernel: str | Callable = "xla",
     gather_output: bool = True,
+    chain_samples: int = DEFAULT_CHAIN_SAMPLES,
 ) -> TimingResult:
     """Benchmark one (strategy, mesh, size) configuration — the body of the
     reference's per-config run (``src/multiplier_rowwise.c:54-176``) minus the
@@ -322,7 +341,7 @@ def benchmark_strategy(
     return _run_benchmark(
         fn=fn, a=a, rhs=x, shardings=strategy.shardings(mesh), mesh=mesh,
         strategy_name=strategy.name, n_rhs=1, n_reps=n_reps, mode=mode,
-        measure=measure,
+        measure=measure, chain_samples=chain_samples,
     )
 
 
@@ -338,6 +357,7 @@ def benchmark_gemm(
     measure: str = "auto",
     kernel: str | Callable = "xla",
     gather_output: bool = True,
+    chain_samples: int = DEFAULT_CHAIN_SAMPLES,
 ) -> TimingResult:
     """Benchmark one GEMM (strategy, mesh, size) configuration.
 
@@ -355,5 +375,5 @@ def benchmark_gemm(
     return _run_benchmark(
         fn=fn, a=a, rhs=b, shardings=gemm_shardings(name, mesh), mesh=mesh,
         strategy_name=f"gemm_{name}", n_rhs=b.shape[1], n_reps=n_reps,
-        mode=mode, measure=measure,
+        mode=mode, measure=measure, chain_samples=chain_samples,
     )

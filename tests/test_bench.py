@@ -40,9 +40,17 @@ def test_benchmark_strategy_basic(devices):
     assert res.n_devices == 4
     assert res.strategy == "rowwise"
     assert res.n_reps == 3
-    assert len(res.times_s) == 3  # chain measure: chain_samples estimates
-    assert res.mean_time_s == pytest.approx(np.mean(res.times_s))
+    assert len(res.times_s) == 5  # chain measure: chain_samples estimates
+    # Chain slopes report the MEDIAN (outlier-robust); sync reports the mean.
+    assert res.mean_time_s == pytest.approx(np.median(res.times_s))
     assert res.gflops > 0 and res.gbps > 0
+
+
+def test_chain_samples_validation(devices):
+    from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+    with pytest.raises(ConfigError, match="chain_samples"):
+        _bench(make_mesh(2), chain_samples=0)
 
 
 def test_benchmark_sync_measure(devices):
