@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""One-shot TPU measurement capture: everything the round needs, in order.
+
+The tunneled TPU in this environment wedges unpredictably (see bench.py's
+probe guard), so when it IS healthy every pending measurement should be
+captured in one pass, cheapest-first, each stage flushing its results to
+disk before the next starts — a wedge mid-run then loses only the stages
+after it. Stages:
+
+1. probe      — subprocess jax.devices() check (abort early if wedged);
+2. headline   — bench.py's blockwise bf16 bandwidth (prints the JSON line);
+3. sweeps     — square + asymmetric fp32 sweeps, median-of-5 chain slopes,
+                replacing the round-1 noise-dominated small-size rows;
+4. hostlink   — link model + derived reference-mode rows (the wedge-safe
+                Q5 substitute; never does per-rep transfers);
+5. gemm       — MXU-bound GEMM numbers (8192^2 bf16 xla + pallas tiers);
+6. baseline   — 65536^2 bf16 blockwise (BASELINE.json's north-star config;
+                8.6 GB of operands, generated on device).
+
+Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def probe(timeout_s: float = 120.0) -> bool:
+    r = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        timeout=timeout_s, capture_output=True, text=True,
+    )
+    return r.returncode == 0
+
+
+def run(cmd: list[str]) -> int:
+    print("+", " ".join(cmd), flush=True)
+    return subprocess.call(cmd, cwd=REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-root", default="data")
+    p.add_argument(
+        "--skip", nargs="*", default=[],
+        choices=["headline", "sweeps", "hostlink", "gemm", "baseline"],
+    )
+    args = p.parse_args(argv)
+    py = sys.executable
+
+    try:
+        if not probe():
+            print("probe FAILED (backend errored) — aborting", flush=True)
+            return 1
+    except subprocess.TimeoutExpired:
+        print("probe TIMED OUT (tunnel wedged) — aborting", flush=True)
+        return 1
+    print("probe OK — capturing all stages", flush=True)
+
+    rc = 0
+    if "headline" not in args.skip:
+        rc |= run([py, "bench.py"])
+    sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
+             "--data-root", args.data_root]
+    if "sweeps" not in args.skip:
+        rc |= run(sweep + ["--strategy", "all", "--sweep", "both",
+                           "--dtype", "float32", "--measure", "chain",
+                           "--chain-samples", "5", "--n-reps", "50"])
+    if "hostlink" not in args.skip:
+        rc |= run([py, "scripts/hostlink_study.py",
+                   "--data-root", args.data_root, "--max-mb", "256"])
+    if "gemm" not in args.skip:
+        rc |= run(sweep + ["--op", "gemm", "--strategy", "all",
+                           "--sizes", "8192", "--dtype", "bfloat16",
+                           "--measure", "chain", "--n-reps", "20"])
+        rc |= run(sweep + ["--op", "gemm", "--strategy", "blockwise",
+                           "--sizes", "8192", "--dtype", "bfloat16",
+                           "--kernel", "pallas", "--measure", "chain",
+                           "--n-reps", "20"])
+    if "baseline" not in args.skip:
+        env = dict(os.environ, MATVEC_BENCH_SIZE="65536")
+        print("+ MATVEC_BENCH_SIZE=65536 bench.py", flush=True)
+        r = subprocess.run(
+            [py, "bench.py"], cwd=REPO, env=env, capture_output=True, text=True
+        )
+        print(r.stdout.strip(), flush=True)
+        rc |= r.returncode
+        line = (r.stdout.strip().splitlines() or [""])[-1]
+        try:
+            payload = json.loads(line)
+            out = REPO / "BASELINE_65536_bf16.json"
+            out.write_text(json.dumps(payload, indent=2) + "\n")
+            print(f"wrote {out}", flush=True)
+        except json.JSONDecodeError:
+            print("baseline stage produced no JSON line", flush=True)
+    print(f"capture complete rc={rc}", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

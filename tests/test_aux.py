@@ -89,6 +89,42 @@ def test_is_main_process_multiprocess_fake(devices, monkeypatch):
     assert distributed.process_index() == 3
 
 
+def test_tpu_measure_all_stage_plumbing(monkeypatch):
+    # The capture script must abort before any stage when the probe fails,
+    # and run stages cheapest-first when it succeeds (mocked subprocesses —
+    # the real accelerator path can't run in tests).
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import tpu_measure_all
+
+    monkeypatch.setattr(tpu_measure_all, "probe", lambda **kw: False)
+    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+
+    calls = []
+    monkeypatch.setattr(tpu_measure_all, "probe", lambda **kw: True)
+    monkeypatch.setattr(
+        tpu_measure_all, "run", lambda cmd: calls.append(cmd) or 0
+    )
+    rc = tpu_measure_all.main(
+        ["--data-root", "x", "--skip", "baseline"]  # baseline spawns directly
+    )
+    assert rc == 0
+    joined = [" ".join(c) for c in calls]
+
+    def stage(substr):
+        hits = [i for i, c in enumerate(joined) if substr in c]
+        assert hits, f"stage {substr!r} never ran"
+        return hits[0]
+
+    # Cheapest-first ORDER is the wedge-safety property: a mid-run wedge
+    # must only lose the expensive later stages.
+    assert (
+        stage("bench.py") < stage("--sweep both")
+        < stage("hostlink_study") < stage("--op gemm")
+    )
+
+
 def test_profiling_trace(devices, tmp_path):
     with trace(tmp_path / "prof") as d:
         with annotate("matvec-region"):
