@@ -151,6 +151,12 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    if dtype == "float64":
+        # Without x64, astype('float64') under jit silently produces fp32
+        # while itemsize below still counts 8 bytes — a ~2x inflated,
+        # mislabeled number (same guard as timing._prepare_operands).
+        jax.config.update("jax_enable_x64", True)
+
     mesh = make_mesh()
     strategy = get_strategy("blockwise")
     strategy.validate(size, size, mesh)

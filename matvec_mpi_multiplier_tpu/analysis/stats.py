@@ -37,12 +37,20 @@ class ScalingPoint:
     speedup: float | None  # None when no p=1 baseline exists for this size
     efficiency: float | None
     strategy: str = ""
+    # Right-hand-side width: 1 = matvec (the reference's scope); >1 = GEMM
+    # rows (gemm_<strategy>.csv) — the throughput formulas depend on it.
+    n_rhs: int = 1
 
     def gflops(self) -> float:
-        return 2.0 * self.n_rows * self.n_cols / self.time_s / 1e9
+        return (
+            2.0 * self.n_rows * self.n_cols * self.n_rhs / self.time_s / 1e9
+        )
 
     def gbps(self, itemsize: int = 8) -> float:
-        elems = self.n_rows * self.n_cols + self.n_rows + self.n_cols
+        elems = (
+            self.n_rows * self.n_cols
+            + (self.n_rows + self.n_cols) * self.n_rhs
+        )
         return itemsize * elems / self.time_s / 1e9
 
 
@@ -55,9 +63,18 @@ def _mean_times(rows: Iterable[dict]) -> dict[tuple[int, int, int], float]:
     return {k: sum(v) / len(v) for k, v in acc.items()}
 
 
-def scaling_table(rows: Iterable[dict], strategy: str = "") -> list[ScalingPoint]:
+def scaling_table(
+    rows: Iterable[dict],
+    strategy: str = "",
+    n_rhs_lookup: dict[tuple[int, int, int], int] | None = None,
+) -> list[ScalingPoint]:
     """Compute S and E for every (size, p) against the p=1 row of the same
-    size (README.md:47-50)."""
+    size (README.md:47-50).
+
+    ``n_rhs_lookup`` maps (n_rows, n_cols, p) → RHS width for GEMM rows
+    (the reference CSV schema cannot carry it; the extended CSV can —
+    scripts/stats_visualization.py builds the lookup from it).
+    """
     means = _mean_times(rows)
     points = []
     for (m, n, p), t in sorted(means.items()):
@@ -68,16 +85,23 @@ def scaling_table(rows: Iterable[dict], strategy: str = "") -> list[ScalingPoint
                 n_rows=m, n_cols=n, n_processes=p, time_s=t,
                 speedup=s, efficiency=(s / p if s is not None else None),
                 strategy=strategy,
+                n_rhs=(n_rhs_lookup or {}).get((m, n, p), 1),
             )
         )
     return points
 
 
-def load_strategy_csv(path: str | os.PathLike, strategy: str = "") -> list[ScalingPoint]:
+def load_strategy_csv(
+    path: str | os.PathLike,
+    strategy: str = "",
+    n_rhs_lookup: dict[tuple[int, int, int], int] | None = None,
+) -> list[ScalingPoint]:
     path = Path(path)
     if not strategy:
         strategy = path.stem.replace("asymmetric_", "")
-    return scaling_table(read_csv(path), strategy=strategy)
+    return scaling_table(
+        read_csv(path), strategy=strategy, n_rhs_lookup=n_rhs_lookup
+    )
 
 
 def best_point(points: list[ScalingPoint], n_rows: int, n_cols: int) -> ScalingPoint:

@@ -33,14 +33,36 @@ from matvec_mpi_multiplier_tpu.analysis.plots import (
 from matvec_mpi_multiplier_tpu.analysis.stats import format_table, load_strategy_csv
 
 
+def _n_rhs_lookups(data_out: Path) -> dict[str, dict[tuple[int, int, int], int]]:
+    """Per-strategy (m, n, p) → n_rhs maps from the extended CSV.
+
+    The reference CSV schema cannot carry the GEMM RHS width; without the
+    lookup, GEMM GFLOP/s would be understated by a factor of n_rhs."""
+    from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
+
+    ext = data_out / "results_extended.csv"
+    lookups: dict[str, dict[tuple[int, int, int], int]] = {}
+    if ext.exists():
+        for r in read_csv(ext):
+            n_rhs = r.get("n_rhs", 1)
+            if isinstance(n_rhs, int) and n_rhs > 1:
+                key = (r["n_rows"], r["n_cols"], r["n_devices"])
+                lookups.setdefault(r["strategy"], {})[key] = n_rhs
+    return lookups
+
+
 def load_run(data_out: Path) -> dict[str, list]:
     """Load every per-strategy CSV in a data/out directory, keyed by stem
     (the one place the stem convention / results_extended exclusion lives)."""
+    lookups = _n_rhs_lookups(data_out)
     run: dict[str, list] = {}
     for path in sorted(data_out.glob("*.csv")):
         if path.stem == "results_extended":
             continue
-        run.setdefault(path.stem, []).extend(load_strategy_csv(path))
+        lookup = lookups.get(path.stem.replace("asymmetric_", ""))
+        run.setdefault(path.stem, []).extend(
+            load_strategy_csv(path, n_rhs_lookup=lookup)
+        )
     return run
 
 
@@ -110,18 +132,26 @@ def main(argv=None) -> int:
         else:
             print("\nno size shared by all overlay runs", file=sys.stderr)
 
-    # Comparison at the largest size shared by >1 strategy.
-    sizes: dict[tuple[int, int], int] = {}
-    for points in by_strategy.values():
-        for size in {(q.n_rows, q.n_cols) for q in points}:
-            sizes[size] = sizes.get(size, 0) + 1
-    shared = [s for s, c in sizes.items() if c > 1]
-    if shared:
-        m, n = max(shared, key=lambda s: s[0] * s[1])
-        fig = plot_comparison(
-            by_strategy, m, n, Path(args.fig_dir) / f"comparison_{m}x{n}.png"
-        )
-        print(f"\ncomparison figure: {fig}")
+    # Comparison at the largest size shared by >1 strategy — per op:
+    # matvec and GEMM curves never share a figure (different operations,
+    # different FLOP counts; a mixed plot would invite a false comparison).
+    for op, strategies in (
+        ("comparison", {k: v for k, v in by_strategy.items()
+                        if not k.startswith("gemm")}),
+        ("gemm_comparison", {k: v for k, v in by_strategy.items()
+                             if k.startswith("gemm")}),
+    ):
+        sizes: dict[tuple[int, int], int] = {}
+        for points in strategies.values():
+            for size in {(q.n_rows, q.n_cols) for q in points}:
+                sizes[size] = sizes.get(size, 0) + 1
+        shared = [s for s, c in sizes.items() if c > 1]
+        if shared:
+            m, n = max(shared, key=lambda s: s[0] * s[1])
+            fig = plot_comparison(
+                strategies, m, n, Path(args.fig_dir) / f"{op}_{m}x{n}.png"
+            )
+            print(f"\n{op} figure: {fig}")
     return 0
 
 

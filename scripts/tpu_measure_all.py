@@ -41,9 +41,26 @@ def probe(timeout_s: float = 120.0) -> bool:
     return r.returncode == 0
 
 
+# Generous per-stage budget: long enough that a healthy stage never gets
+# killed mid-transfer (the documented wedge trigger), short enough that a
+# mid-run wedge (child blocks forever in C++) doesn't hang the capture —
+# later stages would also wedge, so a timeout aborts the rest.
+STAGE_TIMEOUT_S = 5400.0
+
+
+class StageWedged(RuntimeError):
+    pass
+
+
 def run(cmd: list[str]) -> int:
     print("+", " ".join(cmd), flush=True)
-    return subprocess.call(cmd, cwd=REPO)
+    try:
+        return subprocess.call(cmd, cwd=REPO, timeout=STAGE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        raise StageWedged(
+            f"stage exceeded {STAGE_TIMEOUT_S:.0f}s (tunnel wedged mid-run); "
+            "aborting remaining stages — earlier stages already flushed"
+        ) from None
 
 
 def main(argv=None) -> int:
@@ -66,43 +83,63 @@ def main(argv=None) -> int:
     print("probe OK — capturing all stages", flush=True)
 
     rc = 0
-    if "headline" not in args.skip:
-        rc |= run([py, "bench.py"])
-    sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
-             "--data-root", args.data_root]
-    if "sweeps" not in args.skip:
-        rc |= run(sweep + ["--strategy", "all", "--sweep", "both",
-                           "--dtype", "float32", "--measure", "chain",
-                           "--chain-samples", "5", "--n-reps", "50"])
-    if "hostlink" not in args.skip:
-        rc |= run([py, "scripts/hostlink_study.py",
-                   "--data-root", args.data_root, "--max-mb", "256"])
-    if "gemm" not in args.skip:
-        rc |= run(sweep + ["--op", "gemm", "--strategy", "all",
-                           "--sizes", "8192", "--dtype", "bfloat16",
-                           "--measure", "chain", "--n-reps", "20"])
-        rc |= run(sweep + ["--op", "gemm", "--strategy", "blockwise",
-                           "--sizes", "8192", "--dtype", "bfloat16",
-                           "--kernel", "pallas", "--measure", "chain",
-                           "--n-reps", "20"])
-    if "baseline" not in args.skip:
-        env = dict(os.environ, MATVEC_BENCH_SIZE="65536")
-        print("+ MATVEC_BENCH_SIZE=65536 bench.py", flush=True)
-        r = subprocess.run(
-            [py, "bench.py"], cwd=REPO, env=env, capture_output=True, text=True
-        )
-        print(r.stdout.strip(), flush=True)
-        rc |= r.returncode
-        line = (r.stdout.strip().splitlines() or [""])[-1]
-        try:
-            payload = json.loads(line)
-            out = REPO / "BASELINE_65536_bf16.json"
-            out.write_text(json.dumps(payload, indent=2) + "\n")
-            print(f"wrote {out}", flush=True)
-        except json.JSONDecodeError:
-            print("baseline stage produced no JSON line", flush=True)
+    try:
+        if "headline" not in args.skip:
+            rc |= run([py, "bench.py"])
+        sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
+                 "--data-root", args.data_root]
+        if "sweeps" not in args.skip:
+            rc |= run(sweep + ["--strategy", "all", "--sweep", "both",
+                               "--dtype", "float32", "--measure", "chain",
+                               "--chain-samples", "5", "--n-reps", "50"])
+        if "hostlink" not in args.skip:
+            rc |= run([py, "scripts/hostlink_study.py",
+                       "--data-root", args.data_root, "--max-mb", "256"])
+        if "gemm" not in args.skip:
+            rc |= run(sweep + ["--op", "gemm", "--strategy", "all",
+                               "--sizes", "8192", "--dtype", "bfloat16",
+                               "--measure", "chain", "--n-reps", "20"])
+            rc |= run(sweep + ["--op", "gemm", "--strategy", "blockwise",
+                               "--sizes", "8192", "--dtype", "bfloat16",
+                               "--kernel", "pallas", "--measure", "chain",
+                               "--n-reps", "20"])
+        if "baseline" not in args.skip:
+            rc |= _baseline_stage(py)
+    except StageWedged as e:
+        print(f"ABORT: {e}", flush=True)
+        return 1
     print(f"capture complete rc={rc}", flush=True)
     return rc
+
+
+def _baseline_stage(py: str) -> int:
+    env = dict(os.environ, MATVEC_BENCH_SIZE="65536")
+    print("+ MATVEC_BENCH_SIZE=65536 bench.py", flush=True)
+    try:
+        r = subprocess.run(
+            [py, "bench.py"], cwd=REPO, env=env, capture_output=True,
+            text=True, timeout=STAGE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        raise StageWedged("baseline bench exceeded the stage budget") from None
+    print(r.stdout.strip(), flush=True)
+    line = (r.stdout.strip().splitlines() or [""])[-1]
+    try:
+        payload = json.loads(line)
+    except json.JSONDecodeError:
+        print("baseline stage produced no JSON line", flush=True)
+        return 1
+    if payload.get("backend") == "cpu-fallback":
+        # bench.py degraded (tunnel wedged between our probe and this
+        # stage): a CPU number must never be written as the 65536^2 bf16
+        # north-star artifact.
+        print("baseline stage got the CPU fallback — not writing the "
+              "baseline artifact", flush=True)
+        return 1
+    out = REPO / "BASELINE_65536_bf16.json"
+    out.write_text(json.dumps(payload, indent=2) + "\n")
+    print(f"wrote {out}", flush=True)
+    return r.returncode
 
 
 if __name__ == "__main__":

@@ -76,6 +76,53 @@ def test_scaling_table_averages_duplicates():
     assert p4.efficiency == pytest.approx(0.5)
 
 
+def test_scaling_table_gemm_n_rhs():
+    # GEMM rows (reference schema can't carry n_rhs) take the width from the
+    # lookup built off the extended CSV — without it GFLOP/s would be
+    # understated by a factor of n_rhs.
+    rows = [{"n_rows": 8, "n_cols": 4, "n_processes": 1, "time": 1e-9}]
+    (plain,) = scaling_table(rows)
+    assert plain.n_rhs == 1
+    assert plain.gflops() == pytest.approx(2 * 8 * 4)
+    (gemm,) = scaling_table(rows, n_rhs_lookup={(8, 4, 1): 16})
+    assert gemm.n_rhs == 16
+    assert gemm.gflops() == pytest.approx(2 * 8 * 4 * 16)
+    # bytes: A + B + C (reduces to A + x + y at n_rhs=1)
+    assert gemm.gbps(itemsize=1) == pytest.approx(8 * 4 + (8 + 4) * 16)
+
+
+def test_viz_script_separates_gemm_comparison(tmp_path):
+    # gemm_* stems get their own comparison figure and pick up n_rhs from
+    # the extended CSV; the matvec comparison never includes them.
+    import sys
+
+    sys.path.insert(0, "/root/repo/scripts")
+    import stats_visualization as viz
+
+    out = tmp_path / "out"
+    out.mkdir()
+    for stem in ("rowwise", "colwise"):
+        (out / f"{stem}.csv").write_text(
+            "n_rows, n_cols, n_processes, time\n8, 8, 1, 0.5\n8, 8, 2, 0.25\n"
+        )
+    for stem in ("gemm_rowwise", "gemm_colwise"):
+        (out / f"{stem}.csv").write_text(
+            "n_rows, n_cols, n_processes, time\n8, 8, 1, 0.5\n8, 8, 2, 0.25\n"
+        )
+    (out / "results_extended.csv").write_text(
+        "n_rows, n_cols, n_devices, time, strategy, dtype, mode, measure, "
+        "gflops, gbps, n_rhs\n"
+        "8, 8, 1, 0.5, gemm_rowwise, float64, amortized, sync, 0.1, 0.1, 8\n"
+    )
+    figs = tmp_path / "figs"
+    assert viz.main(["--data-out", str(out), "--fig-dir", str(figs)]) == 0
+    assert (figs / "comparison_8x8.png").exists()
+    assert (figs / "gemm_comparison_8x8.png").exists()
+    run = viz.load_run(out)
+    assert run["gemm_rowwise"][0].n_rhs == 8  # from the extended CSV
+    assert run["rowwise"][0].n_rhs == 1
+
+
 def test_format_table():
     points = load_strategy_csv(f"{REF_OUT}/rowwise.csv")
     md = format_table(points[:3])
