@@ -17,6 +17,12 @@ yields the canonical distributed matmul decompositions:
   over 'cols' on its contraction axis, local matmul, psum over 'cols', C
   sharded over 'rows' — the one-shot SUMMA step matching
   `src/multiplier_blockwise.c`'s grid decomposition.
+* ``colwise_ring`` / ``colwise_ring_overlap`` — the colwise decomposition
+  with the combine expressed as an explicit neighbor-ring reduce-scatter
+  (parallel/ring.py), C coming out row-sharded; the ``_overlap`` variant
+  moves the matmul into the ring (ring-SUMMA — each step's MXU tile rides
+  the previous hop's ppermute), the GEMM face of the long-context schedule
+  the matvec ``colwise_ring_overlap`` strategy ships.
 
 All three share the matvec numerics contract: local compute accumulates in
 fp32 for sub-fp32 storage (``preferred_element_type``), the cross-device
@@ -60,9 +66,42 @@ def _specs_blockwise(mesh: Mesh):
     )
 
 
+def _specs_colwise_ring(mesh: Mesh):
+    # Ring-SUMMA: A and B contraction-sharded like colwise, but C comes out
+    # ROW-sharded over the ring (each device ends holding its chunk of C
+    # rows) instead of replicated-by-psum.
+    axes = flat_axes(mesh)
+    return P(None, axes), P(axes, None), P(axes, None), axes
+
+
 _GEMM_SPECS.update(
-    rowwise=_specs_rowwise, colwise=_specs_colwise, blockwise=_specs_blockwise
+    rowwise=_specs_rowwise,
+    colwise=_specs_colwise,
+    blockwise=_specs_blockwise,
+    colwise_ring=_specs_colwise_ring,
+    colwise_ring_overlap=_specs_colwise_ring,
 )
+
+
+def _ring_body(name: str, mesh: Mesh, kern: Callable) -> Callable:
+    """Combine via the explicit neighbor ring (parallel/ring.py) — the
+    long-context schedule applied to GEMM. ``colwise_ring`` computes the
+    full local partial then ring-reduce-scatters it; the ``_overlap``
+    variant moves the matmul into the ring (ring-SUMMA: each step's
+    (m/p, k/p) @ (k/p, n) tile overlaps the previous hop's ppermute)."""
+    from ..parallel.ring import ring_matmul, ring_psum_scatter
+
+    axes = flat_axes(mesh)
+    overlap = name.endswith("_overlap")
+
+    def body(a_blk: Array, b_blk: Array) -> Array:
+        if overlap:
+            c = ring_matmul(a_blk, b_blk, axes, kern)
+        else:
+            c = ring_psum_scatter(kern(a_blk, b_blk), axes)
+        return c.astype(a_blk.dtype)
+
+    return body
 
 
 def available_gemm_strategies() -> list[str]:
@@ -83,6 +122,10 @@ def validate_gemm(
         check_divisible(m, p, "m (rows of A)", "number of devices")
     elif name == "colwise":
         check_divisible(k, p, "k (contraction dim)", "number of devices")
+    elif name.startswith("colwise_ring"):
+        check_divisible(k, p, "k (contraction dim)", "number of devices")
+        # The ring scatters C rows: each device ends with m/p of them.
+        check_divisible(m, p, "m (rows of A)", "number of devices")
     else:  # blockwise
         if (
             MESH_AXIS_ROWS not in mesh.axis_names
@@ -131,11 +174,14 @@ def build_gemm(
         # pallas interpret mode defeats the vma checker.
         check_vma = not getattr(kern, "relax_vma_check", False)
 
-    def body(a_blk: Array, b_blk: Array) -> Array:
-        partial = kern(a_blk, b_blk)
-        if reduce_axis is not None:
-            partial = jax.lax.psum(partial, reduce_axis)
-        return partial.astype(a_blk.dtype)
+    if name.startswith("colwise_ring"):
+        body = _ring_body(name, mesh, kern)
+    else:
+        def body(a_blk: Array, b_blk: Array) -> Array:
+            partial = kern(a_blk, b_blk)
+            if reduce_axis is not None:
+                partial = jax.lax.psum(partial, reduce_axis)
+            return partial.astype(a_blk.dtype)
 
     mapped = jax.shard_map(
         body, mesh=mesh, in_specs=(spec_a, spec_b), out_specs=spec_c,

@@ -47,14 +47,16 @@ def _ring_reduce(chunk_fn, axis_name: str):
 
 
 def ring_psum_scatter(x: Array, axis_name: str) -> Array:
-    """Ring reduce-scatter of a length-n array over ``axis_name``.
+    """Ring reduce-scatter over ``axis_name``, chunking along axis 0.
 
-    Must be called inside shard_map. Each device contributes a full-length
-    partial ``x``; device ``i`` returns chunk ``i`` of the elementwise sum
-    (length ``n // p``) — identical to
+    Must be called inside shard_map. Each device contributes a full partial
+    ``x`` (any rank — a length-n vector for matvec, an (m, n) partial C for
+    GEMM); device ``i`` returns chunk ``i`` of the elementwise sum (leading
+    dim ``x.shape[0] // p``) — identical to
     ``lax.psum_scatter(x, axis_name, tiled=True)``.
 
-    Requires ``n % p == 0`` (same constraint psum_scatter imposes tiled).
+    Requires ``x.shape[0] % p == 0`` (same constraint psum_scatter imposes
+    tiled).
     """
     p = jax.lax.axis_size(axis_name)
     if p == 1:
@@ -62,7 +64,7 @@ def ring_psum_scatter(x: Array, axis_name: str) -> Array:
     n = x.shape[0]
     if n % p != 0:
         raise ValueError(f"ring_psum_scatter: length {n} not divisible by {p}")
-    chunks = x.reshape(p, n // p)
+    chunks = x.reshape(p, n // p, *x.shape[1:])
     return _ring_reduce(
         lambda i: jnp.take(chunks, jnp.mod(i, p), axis=0), axis_name
     )
@@ -103,6 +105,20 @@ def ring_matvec(a_panel: Array, x_seg: Array, axis_name: str, kernel) -> Array:
         return kernel(tile, x_seg)
 
     return _ring_reduce(tile_gemv, axis_name)
+
+
+def ring_matmul(a_panel: Array, b_seg: Array, axis_name: str, kernel) -> Array:
+    """Overlapped ring matmul: :func:`ring_matvec` with a rank-2 RHS.
+
+    The walk is rank-agnostic — at each step the device computes the
+    ``(m/p, k/p) @ (k/p, n)`` tile feeding the C-row chunk currently held by
+    the accumulator, so per-step MXU work overlaps the previous hop's
+    ``ppermute``. Device ``i`` returns rows ``i`` of C (``(m/p, n)``,
+    accumulator dtype) — the same contract as
+    ``ring_psum_scatter(kernel(a_panel, b_seg), axis_name)``. This is the
+    ring-SUMMA schedule, the GEMM face of the long-context primitive.
+    """
+    return ring_matvec(a_panel, b_seg, axis_name, kernel)
 
 
 def ring_all_gather(x: Array, axis_name: str) -> Array:

@@ -55,13 +55,22 @@ def load_run(data_out: Path) -> dict[str, list]:
     """Load every per-strategy CSV in a data/out directory, keyed by stem
     (the one place the stem convention / results_extended exclusion lives)."""
     lookups = _n_rhs_lookups(data_out)
+
+    def strategy_of(stem: str) -> str:
+        # Strip the sweep-variant prefix and timing-mode suffixes so every
+        # file variant of a strategy (asymmetric_, _reference,
+        # _reference_derived) hits the same extended-CSV strategy key.
+        stem = stem.replace("asymmetric_", "")
+        for suffix in ("_reference_derived", "_reference"):
+            stem = stem.removesuffix(suffix)
+        return stem
+
     run: dict[str, list] = {}
     for path in sorted(data_out.glob("*.csv")):
         if path.stem == "results_extended":
             continue
-        lookup = lookups.get(path.stem.replace("asymmetric_", ""))
         run.setdefault(path.stem, []).extend(
-            load_strategy_csv(path, n_rhs_lookup=lookup)
+            load_strategy_csv(path, n_rhs_lookup=lookups.get(strategy_of(path.stem)))
         )
     return run
 

@@ -121,6 +121,13 @@ def test_viz_script_separates_gemm_comparison(tmp_path):
     run = viz.load_run(out)
     assert run["gemm_rowwise"][0].n_rhs == 8  # from the extended CSV
     assert run["rowwise"][0].n_rhs == 1
+    # Mode-suffixed file variants resolve to the same strategy lookup —
+    # reference-mode GEMM rows must not silently fall back to n_rhs=1.
+    (out / "gemm_rowwise_reference.csv").write_text(
+        "n_rows, n_cols, n_processes, time\n8, 8, 1, 0.5\n"
+    )
+    run = viz.load_run(out)
+    assert run["gemm_rowwise_reference"][0].n_rhs == 8
 
 
 def test_format_table():

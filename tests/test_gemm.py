@@ -15,12 +15,19 @@ from matvec_mpi_multiplier_tpu.utils.errors import ShardingError
 
 
 def test_registry():
-    assert available_gemm_strategies() == ["blockwise", "colwise", "rowwise"]
+    assert available_gemm_strategies() == [
+        "blockwise", "colwise", "colwise_ring", "colwise_ring_overlap",
+        "rowwise",
+    ]
     with pytest.raises(KeyError, match="unknown gemm strategy"):
         build_gemm("diagonal", make_mesh(1))
 
 
-@pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise"])
+@pytest.mark.parametrize(
+    "name",
+    ["rowwise", "colwise", "blockwise", "colwise_ring",
+     "colwise_ring_overlap"],
+)
 @pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
 def test_gemm_oracle(devices, rng, name, n_dev):
     m, k, n = 16, 24, 12
@@ -30,6 +37,47 @@ def test_gemm_oracle(devices, rng, name, n_dev):
     validate_gemm(name, m, k, n, mesh)
     c = np.asarray(build_gemm(name, mesh)(jnp.asarray(a), jnp.asarray(b)))
     np.testing.assert_allclose(c, a @ b, rtol=1e-10)
+
+
+def test_gemm_ring_equals_psum_scatter_schedule(devices, rng):
+    # The explicit ring combine must be bit-equivalent (fp64) to computing
+    # the full partial and psum-scattering it — same proof the matvec ring
+    # carries in tests/test_ring.py.
+    m, k, n = 16, 32, 8
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    mesh = make_mesh(8)
+    ring = build_gemm("colwise_ring", mesh)(jnp.asarray(a), jnp.asarray(b))
+    overlap = build_gemm("colwise_ring_overlap", mesh)(
+        jnp.asarray(a), jnp.asarray(b)
+    )
+    colwise = build_gemm("colwise", mesh)(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_array_equal(np.asarray(ring), np.asarray(overlap))
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(colwise), rtol=1e-12
+    )
+
+
+def test_gemm_ring_guards(devices):
+    mesh = make_mesh(8)
+    with pytest.raises(ShardingError, match="k \\(contraction dim\\)"):
+        validate_gemm("colwise_ring", 16, 12, 8, mesh)
+    with pytest.raises(ShardingError, match="m \\(rows of A\\)"):
+        validate_gemm("colwise_ring", 12, 16, 8, mesh)
+
+
+def test_gemm_ring_sharded_output(devices, rng):
+    from jax.sharding import PartitionSpec as P
+
+    m, k, n = 16, 16, 8
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    mesh = make_mesh(8)
+    c = build_gemm("colwise_ring", mesh, gather_output=False)(
+        jnp.asarray(a), jnp.asarray(b)
+    )
+    assert c.sharding.spec == P(("rows", "cols"))  # C rows ride the ring
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-10)
 
 
 @pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise"])
