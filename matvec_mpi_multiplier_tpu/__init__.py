@@ -21,6 +21,7 @@ from .models import (
     available_strategies,
     get_strategy,
 )
+from .models.gemm import available_gemm_strategies, build_gemm
 from .parallel.mesh import make_1d_mesh, make_mesh, mesh_grid_shape, most_square_factors
 from .utils import io
 from .utils.errors import ConfigError, DataFileError, MatvecError, ShardingError
@@ -35,6 +36,8 @@ __all__ = [
     "STRATEGIES",
     "get_strategy",
     "available_strategies",
+    "build_gemm",
+    "available_gemm_strategies",
     "make_mesh",
     "make_1d_mesh",
     "mesh_grid_shape",

@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 from pathlib import Path
 
+from ..parallel.distributed import is_main_process
 from ..utils.constants import CSV_HEADER, CSV_HEADER_EXTENDED, OUT_SUBDIR
 from ..utils.io import data_dir
 from .timing import TimingResult
@@ -82,9 +83,14 @@ def append_result(result: TimingResult, root: str | os.PathLike | None = None) -
 
     Row format mirrors ``fprintf(..., "%ld, %ld, %d, %f\\n", ...)`` at
     ``src/multiplier_rowwise.c:168``: comma+space separated, time with 6
-    decimal places.
+    decimal places. Multi-host: only the coordinator process writes — the
+    reference's ``rank == MAIN_PROCESS`` guard around its CSV block
+    (``src/multiplier_rowwise.c:159-170``); without it every process of a
+    multi-host run would append a duplicate row.
     """
     path = csv_path(result.strategy, root, mode=result.mode)
+    if not is_main_process():
+        return path
     row = (
         f"{result.n_rows}, {result.n_cols}, {result.n_devices}, "
         f"{result.mean_time_s:.6f}"

@@ -123,6 +123,22 @@ def test_csv_extended(devices, tmp_path):
     assert rows[0]["gflops"] > 0
 
 
+def test_csv_write_is_main_process_only(devices, tmp_path, monkeypatch):
+    # The reference guards its CSV block with rank == MAIN_PROCESS
+    # (src/multiplier_rowwise.c:159-170); on a faked non-zero rank no file
+    # may be written, or every process of a multi-host run would append a
+    # duplicate row.
+    import jax
+
+    res = _bench(make_mesh(2))
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    path = append_result(res, tmp_path)
+    assert not path.exists()
+    assert not extended_csv_path(tmp_path).exists()
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert append_result(res, tmp_path).exists()
+
+
 def test_csv_stale_header_rotated(devices, tmp_path):
     # A pre-existing file written under an older schema must not silently
     # receive misaligned rows: it is rotated to .bak and a fresh file started.
