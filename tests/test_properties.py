@@ -57,6 +57,32 @@ def test_strategy_matches_oracle(devices, name, case):
     np.testing.assert_allclose(y, a @ x, rtol=1e-9, atol=1e-6)
 
 
+@st.composite
+def gemm_case(draw):
+    # m, k divisible by 8 (every strategy's sharded dims on the 8-device
+    # mesh); n (RHS width) unconstrained.
+    m = draw(st.integers(1, 4)) * 8
+    k = draw(st.integers(1, 4)) * 8
+    n = draw(st.integers(1, 12))
+    a, _ = _operands(draw, m, k)
+    b, _ = _operands(draw, k, n)
+    return a, b
+
+
+@pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise",
+                                  "colwise_ring", "colwise_ring_overlap"])
+@given(case=gemm_case())
+@settings(**COMMON)
+def test_gemm_strategy_matches_oracle(devices, name, case):
+    from matvec_mpi_multiplier_tpu.models.gemm import build_gemm, validate_gemm
+
+    a, b = case
+    mesh = make_mesh(8)
+    validate_gemm(name, a.shape[0], a.shape[1], b.shape[1], mesh)
+    c = np.asarray(build_gemm(name, mesh)(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(c, a @ b, rtol=1e-9, atol=1e-6)
+
+
 @given(case=matvec_case(multiple_of=1))
 @settings(**COMMON)
 def test_kernels_agree(devices, case):
