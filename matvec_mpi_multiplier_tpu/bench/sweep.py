@@ -247,6 +247,13 @@ def run_sweep(args: argparse.Namespace) -> int:
     # but only deep inside the loop, after earlier configs already ran.
     from ..ops import available_gemm_kernels, available_kernels
 
+    if args.kernel == "native":
+        # The native FFI tier registers only when its .so exists; build it
+        # on demand so `--kernel native` works in a default checkout.
+        from ..ops.native_gemv import register_if_available
+
+        register_if_available(build=True)
+
     kernels = (
         available_gemm_kernels() if args.op == "gemm" else available_kernels()
     )

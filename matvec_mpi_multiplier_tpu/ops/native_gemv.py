@@ -133,5 +133,21 @@ def gemv_native(a: Array, x: Array) -> Array:
 # checker (same situation as pallas interpret mode — see models/base.py).
 gemv_native.relax_vma_check = True  # type: ignore[attr-defined]
 
-if native_available():
-    register_kernel("native", gemv_native)
+def register_if_available(build: bool = False) -> bool:
+    """Put the ``native`` tier in the kernel registry when its .so exists.
+
+    With ``build=True`` first attempts ``make -C native`` (no-op when the
+    library is already present) — used by the test conftest and the sweep
+    CLI so a default checkout exercises the FFI path without a manual build.
+    """
+    if build:
+        from ..utils.native_lib import ensure_built
+
+        ensure_built()
+    if native_available():
+        register_kernel("native", gemv_native)
+        return True
+    return False
+
+
+register_if_available()

@@ -1,0 +1,109 @@
+"""Real 2-process ``jax.distributed`` run on localhost CPU.
+
+Round-2 review finding: the multi-host semantics — ``_max_across_processes``
+(the ``MPI_Reduce(MPI_MAX)`` analog, ``src/multiplier_rowwise.c:147``) and
+``append_result``'s coordinator-only CSV guard (the reference's
+``rank == MAIN_PROCESS`` block, ``src/multiplier_rowwise.c:159-170``) — were
+pinned only behind monkeypatched ``jax.process_count``. This test launches two
+actual processes joined by ``jax.distributed.initialize`` and asserts the real
+wiring: the true max crosses processes and exactly one process writes the CSV.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = """
+import json, os, sys
+
+idx = int(sys.argv[1])
+port = sys.argv[2]
+root = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ""  # no inherited virtual-device forcing
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=idx
+)
+
+from matvec_mpi_multiplier_tpu.bench import metrics
+from matvec_mpi_multiplier_tpu.bench.timing import (
+    TimingResult,
+    _max_across_processes,
+)
+
+# Distinct per-process elapsed times: the reduce must pick process 1's.
+local_elapsed = 1.5 if idx == 0 else 3.5
+global_elapsed = _max_across_processes(local_elapsed)
+
+result = TimingResult(
+    n_rows=4, n_cols=8, n_devices=jax.device_count(), strategy="rowwise",
+    dtype="float64", mode="amortized", measure="sync",
+    mean_time_s=global_elapsed, times_s=(global_elapsed,), n_reps=1,
+)
+path = metrics.append_result(result, root)
+print(json.dumps({
+    "idx": idx,
+    "process_count": jax.process_count(),
+    "global_elapsed": global_elapsed,
+    "csv": str(path),
+}))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_max_reduce_and_coordinator_csv(tmp_path):
+    port = _free_port()
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(i), str(port), str(tmp_path)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    by_idx = {o["idx"]: o for o in outs}
+    assert by_idx[0]["process_count"] == 2
+    # Both processes must agree on the true (cross-process) max, not their
+    # local value — process 0's local 1.5 must have been replaced by 3.5.
+    assert by_idx[0]["global_elapsed"] == 3.5
+    assert by_idx[1]["global_elapsed"] == 3.5
+
+    # Exactly one row: only the coordinator appended (both called
+    # append_result with the same root).
+    csv = tmp_path / "out" / "rowwise.csv"
+    lines = csv.read_text().strip().splitlines()
+    assert lines[0] == "n_rows, n_cols, n_processes, time"
+    assert len(lines) == 2, f"expected 1 data row, got {lines[1:]}"
+    assert lines[1].startswith("4, 8, ")
+    ext = (tmp_path / "out" / "results_extended.csv").read_text().strip()
+    assert len(ext.splitlines()) == 2
