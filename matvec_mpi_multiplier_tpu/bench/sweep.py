@@ -137,8 +137,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--measure",
         choices=list(MEASURE_METHODS),
         default="auto",
-        help="'chain': slope between fenced execution chains (robust on "
-        "tunneled backends); 'sync': literal per-rep fence protocol — use on "
+        help="'loop': device-side fori_loop rep chain, one dispatch per "
+        "sample (immune to per-dispatch tunnel overhead; amortized default); "
+        "'chain': slope between host-driven fenced execution chains; "
+        "'sync': literal per-rep fence protocol — use on "
         "oversubscribed virtual-device CPU meshes, where long queued chains "
         "can starve a device thread past XLA's collective-rendezvous timeout",
     )

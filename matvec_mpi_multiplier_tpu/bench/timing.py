@@ -30,9 +30,17 @@ Compilation is warmed up before the loop in both modes — the C reference has
 no JIT, so including XLA compile time in rep 0 would measure nothing the
 reference measures.
 
-Two measurement methods:
+Three measurement methods:
 
-* ``chain`` (amortized default) — enqueue N executions back-to-back and time
+* ``loop`` (amortized default) — the rep loop runs ON DEVICE: a
+  ``lax.fori_loop`` of N dependent executions inside one jitted computation,
+  timed between a single dispatch and a single fetch, for two different N;
+  per-matvec time is the slope. One tunnel crossing per sample, so the
+  ~0.4-0.5 ms per-enqueue transport cost of the tunneled backend — which
+  swamped sub-millisecond kernels and made the round-1/2 small-size CSV rows
+  non-monotonic — never touches the measurement (see :func:`_build_looped`
+  for how dead-code elimination is prevented).
+* ``chain`` — enqueue N executions back-to-back and time
   the whole chain between two device fetches, for two different N; the
   per-matvec time is the slope ``(T(N2) - T(N1)) / (N2 - N1)``. Device
   execution is stream-ordered, so one small fetch at the end fences the whole
@@ -62,7 +70,7 @@ from ..utils.constants import DEFAULT_N_REPS
 from ..utils.errors import ConfigError
 
 TIMING_MODES = ("amortized", "reference")
-MEASURE_METHODS = ("auto", "chain", "sync")
+MEASURE_METHODS = ("auto", "loop", "chain", "sync")
 
 # Independent chain-slope estimates per config; the reported time is their
 # MEDIAN. 5 (not 3): on tunneled backends single slopes occasionally stall
@@ -135,6 +143,59 @@ def _fence(y) -> None:
     np.asarray(jnp.sum(y))
 
 
+def _build_looped(fn: Callable) -> Callable:
+    """Wrap ``fn`` in a device-side rep loop: ONE dispatch runs ``k`` reps.
+
+    The round-1/round-2 small-size CSV rows were non-monotonic because the
+    host-driven chain dispatches each rep across the tunneled backend
+    (~0.4-0.5 ms per enqueue), so for sub-millisecond kernels the chain slope
+    measures dispatch, not compute. Here the rep loop is a ``lax.fori_loop``
+    inside a single jitted computation: the tunnel is crossed once per
+    timing sample and the device executes ``k`` back-to-back ops.
+
+    The carry threads the right-hand side through every iteration with a
+    runtime-zero bump, ``carry + eps * sum(out)``: ``eps`` is a traced
+    runtime scalar (not a compile-time constant), so XLA cannot fold the
+    bump away, dead-code-eliminate the op, or hoist it out of the loop —
+    while at runtime ``eps = 0`` leaves the operand bit-identical every rep.
+    """
+
+    def chained(a, rhs, k, eps):
+        def body(_, carry):
+            out = fn(a, carry)
+            return carry + (eps * jnp.sum(out)).astype(carry.dtype)
+
+        return jax.lax.fori_loop(0, k, body, rhs)
+
+    return jax.jit(chained)
+
+
+def _loop_slope(
+    fn: Callable, a_dev, rhs_dev, n1: int, n2: int, samples: int
+) -> list[float]:
+    """Per-execution time as the slope between device-looped runs of n1 and
+    n2 reps (one dispatch each); the single dispatch+fence overhead cancels
+    in the difference just as in :func:`_chain_slope`."""
+    if samples < 1:
+        raise ConfigError(f"chain_samples must be >= 1, got {samples}")
+    chained = _build_looped(fn)
+    eps = jnp.asarray(0.0, jnp.float32)
+
+    def run(k: int) -> float:
+        start = time.perf_counter()
+        y = chained(a_dev, rhs_dev, jnp.asarray(k, jnp.int32), eps)
+        _fence(y)
+        return time.perf_counter() - start
+
+    run(1)  # compile (k is traced: one compile covers every k)
+    estimates = []
+    for _ in range(samples):
+        t1 = run(n1)
+        t2 = run(n2)
+        estimates.append(max((t2 - t1) / (n2 - n1), 1e-9))
+    return estimates
+
+
 def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int) -> list[float]:
     """Per-execution time as the slope between chains of n1 and n2 runs."""
     if samples < 1:
@@ -190,14 +251,15 @@ def resolve_measure(mode: str, measure: str) -> str:
             f"measure must be one of {MEASURE_METHODS}, got {measure!r}"
         )
     if measure == "auto":
-        # Chain for amortized (robust everywhere); literal per-rep protocol
-        # for reference mode, whose point is to include the transfer.
-        measure = "chain" if mode == "amortized" else "sync"
-    if mode == "reference" and measure == "chain":
+        # Device-looped reps for amortized (immune to per-dispatch tunnel
+        # overhead — the round-1/2 non-monotonic-CSV failure mode); literal
+        # per-rep protocol for reference mode, whose point is the transfer.
+        measure = "loop" if mode == "amortized" else "sync"
+    if mode == "reference" and measure in ("chain", "loop"):
         raise ConfigError(
-            "measure='chain' cannot time mode='reference': the per-rep "
+            f"measure={measure!r} cannot time mode='reference': the per-rep "
             "host->device transfer is the thing being measured and cannot "
-            "ride a fenced execution chain; use measure='sync'"
+            "ride a device-side execution chain; use measure='sync'"
         )
     return measure
 
@@ -227,14 +289,20 @@ def time_matvec(
         return jax.device_put(arr, sh)
 
     # Warm-up: compile + one run, outside the timed region (the C reference
-    # pays no compile cost; see module docstring).
+    # pays no compile cost; see module docstring). measure='loop' compiles
+    # and warms its own wrapped program inside _loop_slope — compiling the
+    # bare fn here too would double per-config compile cost for nothing.
     a_dev, x_dev = place(a, sh_a), place(x, sh_x)
-    _fence(fn(a_dev, x_dev))
+    if measure != "loop":
+        _fence(fn(a_dev, x_dev))
 
-    if mode == "amortized" and measure == "chain":
+    if mode == "amortized" and measure in ("chain", "loop"):
         n1 = max(1, n_reps // 10)
         n2 = n1 + n_reps
-        per = _chain_slope(lambda: fn(a_dev, x_dev), n1, n2, chain_samples)
+        if measure == "loop":
+            per = _loop_slope(fn, a_dev, x_dev, n1, n2, chain_samples)
+        else:
+            per = _chain_slope(lambda: fn(a_dev, x_dev), n1, n2, chain_samples)
         return [_max_across_processes(t) for t in per]
 
     times: list[float] = []
@@ -278,9 +346,9 @@ def _run_benchmark(
 
     Reported time: **mean** over the per-rep times for ``sync`` (the
     reference's own protocol, ``src/multiplier_rowwise.c:168``) but
-    **median** over slope estimates for ``chain`` — each chain sample is an
-    independent estimate of the same per-matvec time, and on tunneled
-    backends a single stalled chain can be off by orders of magnitude (the
+    **median** over slope estimates for ``chain``/``loop`` — each sample is
+    an independent estimate of the same per-matvec time, and on tunneled
+    backends a single stalled sample can be off by orders of magnitude (the
     round-1 small-size CSVs were non-monotonic for exactly this reason); the
     median rejects it where the mean absorbs it.
     """
@@ -288,7 +356,9 @@ def _run_benchmark(
         fn, a, rhs, shardings=shardings, n_reps=n_reps, mode=mode,
         measure=measure, chain_samples=chain_samples,
     )
-    reported = np.median(times) if measure == "chain" else np.mean(times)
+    reported = (
+        np.median(times) if measure in ("chain", "loop") else np.mean(times)
+    )
     return TimingResult(
         n_rows=a.shape[0],
         n_cols=a.shape[1],

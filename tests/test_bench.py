@@ -40,10 +40,41 @@ def test_benchmark_strategy_basic(devices):
     assert res.n_devices == 4
     assert res.strategy == "rowwise"
     assert res.n_reps == 3
-    assert len(res.times_s) == 5  # chain measure: chain_samples estimates
-    # Chain slopes report the MEDIAN (outlier-robust); sync reports the mean.
+    assert res.measure == "loop"  # amortized auto → device-looped reps
+    assert len(res.times_s) == 5  # chain_samples independent slope estimates
+    # Slope estimates report the MEDIAN (outlier-robust); sync reports the mean.
     assert res.mean_time_s == pytest.approx(np.median(res.times_s))
     assert res.gflops > 0 and res.gbps > 0
+
+
+def test_loop_measure_explicit(devices):
+    res = _bench(make_mesh(4), measure="loop", chain_samples=2)
+    assert res.measure == "loop"
+    assert len(res.times_s) == 2
+    assert all(t > 0 for t in res.times_s)
+
+
+def test_looped_wrapper_preserves_operand_and_computes():
+    """The fori_loop carry with runtime eps=0 must return the rhs unchanged
+    (bit-identical), and a nonzero eps must change it — proving the wrapped
+    op is really executed inside the loop, not dead-code-eliminated."""
+    import jax.numpy as jnp
+
+    from matvec_mpi_multiplier_tpu.bench.timing import _build_looped
+
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.standard_normal((8, 8)))
+    x = jnp.asarray(rng.standard_normal(8))
+    chained = _build_looped(lambda a_, x_: a_ @ x_)
+    out0 = chained(a, x, jnp.asarray(3, jnp.int32), jnp.asarray(0.0, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(x))
+    out1 = chained(a, x, jnp.asarray(3, jnp.int32), jnp.asarray(1.0, jnp.float32))
+    assert not np.array_equal(np.asarray(out1), np.asarray(x))
+
+
+def test_reference_mode_rejects_loop(devices):
+    with pytest.raises(ConfigError, match="loop"):
+        _bench(make_mesh(2), mode="reference", measure="loop")
 
 
 def test_chain_samples_validation(devices):
