@@ -178,6 +178,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-csv", action="store_true", help="print results without writing CSVs"
     )
     p.add_argument(
+        "--keep-going",
+        action="store_true",
+        help="on a runtime/backend error in one config (e.g. a transient "
+        "tunnel failure), record it and continue with the next config "
+        "instead of aborting the whole sweep; exit code reports whether "
+        "any config failed",
+    )
+    p.add_argument(
         "--profile-dir",
         default=None,
         metavar="DIR",
@@ -276,14 +284,13 @@ def run_sweep(args: argparse.Namespace) -> int:
         sizes = [(s, s) for s in SQUARE_SIZES] + list(ASYMMETRIC_SIZES)
     modes = list(TIMING_MODES) if args.mode == "both" else [args.mode]
 
-    n_ok = n_skip = 0
     meshes = {n_dev: make_mesh(n_dev) for n_dev in counts}
-    counters = [0, 0]  # [timed, skipped]
+    counters = [0, 0, 0]  # [timed, skipped, failed (--keep-going only)]
     # The trace must stop (and flush its file) on ANY exit — an exception
     # mid-sweep or Ctrl+C hours in must not lose the whole capture.
     with trace(args.profile_dir or "", enabled=args.profile_dir is not None):
         _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters)
-    n_ok, n_skip = counters
+    n_ok, n_skip, n_failed = counters
     if not args.no_csv:
         for name in strategies:
             csv_name = f"gemm_{name}" if args.op == "gemm" else name
@@ -291,8 +298,8 @@ def run_sweep(args: argparse.Namespace) -> int:
                 print(f"CSV: {csv_path(csv_name, args.data_root, mode=mode)}")
     if args.profile_dir is not None:
         print(f"trace: {args.profile_dir}")
-    print(f"{n_ok} configs timed, {n_skip} skipped")
-    return 0
+    print(f"{n_ok} configs timed, {n_skip} skipped, {n_failed} failed")
+    return 1 if n_failed else 0
 
 
 def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
@@ -324,24 +331,39 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                         a, x = operands(n_rows, n_cols, args)
                 for mode in modes:
                     label = f"{args.op}_{name}_{n_rows}x{n_cols}_p{n_dev}_{mode}"
-                    with annotate(label):
-                        bench_kwargs = dict(
-                            dtype=args.dtype,
-                            n_reps=args.n_reps,
-                            mode=mode,
-                            measure=args.measure,
-                            kernel=args.kernel,
+                    bench_kwargs = dict(
+                        dtype=args.dtype,
+                        n_reps=args.n_reps,
+                        mode=mode,
+                        measure=args.measure,
+                        kernel=args.kernel,
+                    )
+                    if args.chain_samples is not None:
+                        bench_kwargs["chain_samples"] = args.chain_samples
+                    try:
+                        with annotate(label):
+                            if gemm:
+                                result = benchmark_gemm(
+                                    name, mesh, a, x, **bench_kwargs
+                                )
+                            else:
+                                result = benchmark_strategy(
+                                    strat, mesh, a, x, **bench_kwargs
+                                )
+                    except MatvecError:
+                        raise  # config bugs must fail loudly, flag or not
+                    except Exception as e:
+                        if not args.keep_going:
+                            raise
+                        # Transient backend failure (tunneled TPU: compile
+                        # endpoint drop, claim loss): later configs may well
+                        # succeed — a flushed partial sweep beats an empty one.
+                        print(
+                            f"FAILED {label}: {type(e).__name__}: {e}",
+                            file=sys.stderr,
                         )
-                        if args.chain_samples is not None:
-                            bench_kwargs["chain_samples"] = args.chain_samples
-                        if gemm:
-                            result = benchmark_gemm(
-                                name, mesh, a, x, **bench_kwargs
-                            )
-                        else:
-                            result = benchmark_strategy(
-                                strat, mesh, a, x, **bench_kwargs
-                            )
+                        counters[2] += 1
+                        continue
                     if not args.no_csv:
                         append_result(result, args.data_root)
                     print(

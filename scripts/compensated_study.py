@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Compensated-kernel evidence: accuracy vs the fp64 oracle + bandwidth row.
+
+Round-2 review finding: ``ops/compensated.py`` claims fp64-grade accumulation
+for fp32 data (the reference computes in C ``double``,
+``src/matr_utils.c:86-96``), but the claim had only CPU property tests — no
+committed accuracy-vs-fp64 comparison and no bandwidth row. This study
+produces both, on whatever backend is active:
+
+* **Accuracy** — a cancellation-heavy GEMV (rows of large-magnitude pairs
+  summing to O(1) values: the case where naive fp32 loses all significant
+  bits) evaluated by the ``xla`` fp32 kernel, the ``compensated`` kernel, and
+  a numpy fp64 oracle; reports max relative error and max error in fp32 ulps
+  of the oracle value for both.
+* **Bandwidth** — the benchmark protocol at a real size with
+  ``kernel=compensated`` vs ``kernel=xla``, appended to the extended CSV via
+  the normal metrics path (``--data-root``; ``--no-csv`` to skip).
+
+Writes/updates a markdown report (default ``docs/COMPENSATED.md``).
+
+Usage::
+
+    python scripts/compensated_study.py --platform cpu --host-devices 8
+    python scripts/compensated_study.py --size 8192      # real backend (TPU)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def cancellation_case(n_rows: int, n_cols: int, rng) -> tuple:
+    """A matrix whose every row pairs +v with -v for large v, plus a small
+    O(1) residual — the dot product's true value is the residual sum, but
+    naive fp32 accumulation destroys it (catastrophic cancellation)."""
+    import numpy as np
+
+    assert n_cols % 2 == 0
+    big = rng.uniform(1e6, 1e7, size=(n_rows, n_cols // 2)).astype(np.float32)
+    small = rng.uniform(-1.0, 1.0, size=(n_rows, n_cols // 2)).astype(np.float32)
+    # Columns interleaved so the cancellation is spread across the row.
+    a = np.empty((n_rows, n_cols), np.float32)
+    a[:, 0::2] = big + small
+    a[:, 1::2] = -big
+    x = np.ones(n_cols, np.float32)
+    return a, x
+
+
+def ulp_error(y, oracle) -> float:
+    """Max |y - oracle| measured in fp32 ulps of the oracle value."""
+    import numpy as np
+
+    oracle32 = oracle.astype(np.float32).astype(np.float64)
+    ulp = np.spacing(np.abs(oracle32).astype(np.float32)).astype(np.float64)
+    return float(np.max(np.abs(y.astype(np.float64) - oracle) / ulp))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--host-devices", type=int, default=None)
+    p.add_argument("--size", type=int, default=4096)
+    p.add_argument("--acc-rows", type=int, default=512)
+    p.add_argument("--acc-cols", type=int, default=4096)
+    p.add_argument("--n-reps", type=int, default=25)
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--no-csv", action="store_true")
+    p.add_argument("--report", default="docs/COMPENSATED.md")
+    p.add_argument("--no-report", action="store_true")
+    args = p.parse_args(argv)
+
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+
+    configure_platform(args.platform, args.host_devices)
+
+    import jax
+    import numpy as np
+
+    from matvec_mpi_multiplier_tpu.bench.metrics import append_result
+    from matvec_mpi_multiplier_tpu.bench.timing import benchmark_strategy
+    from matvec_mpi_multiplier_tpu.models import get_strategy
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+
+    platform = jax.devices()[0].platform
+    n_dev = args.devices or len(jax.devices())
+    mesh = make_mesh(n_dev)
+    rng = np.random.default_rng(11)
+
+    # -- Accuracy on the cancellation-heavy case ---------------------------
+    a, x = cancellation_case(args.acc_rows, args.acc_cols, rng)
+    oracle = a.astype(np.float64) @ x.astype(np.float64)
+    strat = get_strategy("rowwise")
+    results = {}
+    for kernel in ("xla", "compensated"):
+        fn = strat.build(mesh, kernel=kernel)
+        y = np.asarray(fn(a, x))
+        rel = float(np.max(np.abs(y.astype(np.float64) - oracle)
+                           / np.maximum(np.abs(oracle), 1e-300)))
+        results[kernel] = {"rel": rel, "ulp": ulp_error(y, oracle)}
+        print(f"accuracy[{kernel}]: max rel err {rel:.3e}, "
+              f"max ulp err {results[kernel]['ulp']:.3g}")
+
+    # -- Bandwidth at a real size -----------------------------------------
+    n = args.size
+    ab = rng.standard_normal((n, n)).astype(np.float32)
+    xb = rng.standard_normal(n).astype(np.float32)
+    bw = {}
+    for kernel in ("xla", "compensated"):
+        res = benchmark_strategy(
+            strat, mesh, ab, xb, n_reps=args.n_reps, kernel=kernel,
+        )
+        bw[kernel] = res
+        if not args.no_csv:
+            # Relabel BOTH rows with the kernel so neither lands in the
+            # sweep's plain rowwise.csv (the reference schema carries no
+            # kernel column; a stray off-grid row would contaminate the
+            # SpeedUp/Efficiency averaging, see bench/metrics.py).
+            import dataclasses
+
+            append_result(
+                dataclasses.replace(res, strategy=f"rowwise_{kernel}"),
+                args.data_root,
+            )
+        print(f"bandwidth[{kernel}]: {res.mean_time_s*1e3:.3f} ms, "
+              f"{res.gbps:.2f} GB/s")
+
+    slowdown = bw["compensated"].mean_time_s / bw["xla"].mean_time_s
+    report = [
+        "# Compensated (double-float) kernel: measured evidence",
+        "",
+        f"Backend: **{platform}**, {n_dev}-device mesh; accuracy case "
+        f"{args.acc_rows}×{args.acc_cols} fp32 with interleaved ±10⁶..10⁷ "
+        "cancellation pairs (true row sums are O(1)); bandwidth at "
+        f"{n}² fp32, measure={bw['xla'].measure}, {args.n_reps} reps "
+        "(generated by `scripts/compensated_study.py`).",
+        "",
+        "| kernel | max rel err vs fp64 oracle | max err (fp32 ulps of "
+        "oracle) | time (ms) | effective GB/s |",
+        "|---|---|---|---|---|",
+    ]
+    for kernel in ("xla", "compensated"):
+        r, b = results[kernel], bw[kernel]
+        report.append(
+            f"| {kernel} | {r['rel']:.3e} | {r['ulp']:.3g} | "
+            f"{b.mean_time_s*1e3:.3f} | {b.gbps:.2f} |"
+        )
+    report += [
+        "",
+        f"Compensated/xla slowdown at {n}²: **{slowdown:.1f}×**.",
+        "",
+        "The cancellation case is the reference-parity stress test: the "
+        "reference accumulates in C `double` where this case is exact to "
+        "~1e-16; naive fp32 accumulation loses every significant bit "
+        "(rel err ≥ 1). `kernel=compensated` (`ops/compensated.py`, "
+        "error-free transformations + double-float tree reduction) must "
+        "recover the oracle to within a few fp32 ulps — fp64-grade "
+        "accuracy from fp32 hardware, at the measured bandwidth cost above.",
+    ]
+    text = "\n".join(report) + "\n"
+    print("\n" + text)
+    if not args.no_report:
+        out = Path(args.report)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(text)
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

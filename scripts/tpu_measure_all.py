@@ -9,8 +9,10 @@ after it. Stages:
 
 1. probe      — subprocess jax.devices() check (abort early if wedged);
 2. headline   — bench.py's blockwise bf16 bandwidth (prints the JSON line);
-3. sweeps     — square + asymmetric fp32 sweeps, median-of-5 chain slopes,
-                replacing the round-1 noise-dominated small-size rows;
+3. sweeps     — square + asymmetric fp32 sweeps, median-of-5 device-looped
+                slopes (--measure loop: the rep loop is a fori_loop on
+                device, so per-dispatch tunnel overhead never touches the
+                number), replacing the round-1 noise-dominated rows;
 4. hostlink   — link model + derived reference-mode rows (the wedge-safe
                 Q5 substitute; never does per-rep transfers);
 5. gemm       — MXU-bound GEMM numbers (8192^2 bf16 xla + pallas tiers);
@@ -87,10 +89,10 @@ def main(argv=None) -> int:
         if "headline" not in args.skip:
             rc |= run([py, "bench.py"])
         sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
-                 "--data-root", args.data_root]
+                 "--data-root", args.data_root, "--keep-going"]
         if "sweeps" not in args.skip:
             rc |= run(sweep + ["--strategy", "all", "--sweep", "both",
-                               "--dtype", "float32", "--measure", "chain",
+                               "--dtype", "float32", "--measure", "loop",
                                "--chain-samples", "5", "--n-reps", "50"])
         if "hostlink" not in args.skip:
             rc |= run([py, "scripts/hostlink_study.py",

@@ -217,6 +217,38 @@ def test_sweep_cli_end_to_end(devices, tmp_path, monkeypatch):
     assert rows[0]["n_rows"] == 16 and rows[0]["n_processes"] == 2
 
 
+def test_sweep_cli_keep_going_survives_backend_errors(
+    devices, tmp_path, capsys, monkeypatch
+):
+    """A transient backend failure in one config must not abort the sweep
+    when --keep-going is set (tunneled-TPU capture resilience); without the
+    flag it propagates."""
+    from matvec_mpi_multiplier_tpu.bench import sweep as sweep_mod
+
+    calls = []
+    real = sweep_mod.benchmark_strategy
+
+    def flaky(strategy, mesh, a, x, **kw):
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("UNAVAILABLE: tunnel dropped")
+        return real(strategy, mesh, a, x, **kw)
+
+    monkeypatch.setenv("MATVEC_DATA_DIR", str(tmp_path))
+    monkeypatch.setattr(sweep_mod, "benchmark_strategy", flaky)
+    args = ["--strategy", "rowwise", "--devices", "2", "--sizes", "16", "32",
+            "--n-reps", "2", "--dtype", "float64"]
+    rc = sweep_main(args + ["--keep-going"])
+    assert rc == 1  # a failure happened and is reported in the exit code
+    assert "FAILED" in capsys.readouterr().err
+    rows = read_csv(csv_path("rowwise", tmp_path))
+    assert len(rows) == 1 and rows[0]["n_rows"] == 32  # later config landed
+
+    calls.clear()
+    with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+        sweep_main(args)
+
+
 def test_sweep_cli_skips_indivisible(devices, tmp_path, capsys):
     rc = sweep_main([
         "--strategy", "rowwise", "--devices", "8", "--sizes", "12",
