@@ -117,18 +117,29 @@ def format_table(
     points: list[ScalingPoint],
     itemsize: int = 8,
     hbm_peak_gbps: float | None = None,
+    mxu_peak_tflops: float | None = None,
 ) -> str:
     """Markdown table in the BASELINE.md column layout.
 
     ``hbm_peak_gbps`` adds the roofline column (%-of-HBM-peak, the
     BASELINE.json north-star metric): aggregate peak = per-chip peak × p,
     e.g. 819 for TPU v5e, 1229 for v4.
+
+    ``mxu_peak_tflops`` adds the MFU column (%-of-MXU-peak — the
+    compute-roofline analog for GEMM rows, where the MXU, not HBM, is the
+    ceiling): aggregate peak = per-chip peak × p, e.g. 197 bf16 TFLOP/s for
+    TPU v5e. Matvec rows get an MFU too, but for them HBM is the binding
+    roof (arithmetic intensity ≈ 1 FLOP/byte).
     """
     roofline = hbm_peak_gbps is not None
+    mfu = mxu_peak_tflops is not None
     lines = [
         "| Strategy | Matrix | p | Time (s) | SpeedUp | Efficiency | GFLOP/s | GB/s |"
-        + (" % HBM peak |" if roofline else ""),
-        "|---|---|---|---|---|---|---|---|" + ("---|" if roofline else ""),
+        + (" % HBM peak |" if roofline else "")
+        + (" MFU % |" if mfu else ""),
+        "|---|---|---|---|---|---|---|---|"
+        + ("---|" if roofline else "")
+        + ("---|" if mfu else ""),
     ]
     for p in points:
         s = f"{p.speedup:.2f}" if p.speedup is not None else "—"
@@ -140,6 +151,9 @@ def format_table(
         )
         if roofline:
             pct = 100.0 * p.gbps(itemsize) / (hbm_peak_gbps * p.n_processes)
+            row += f" {pct:.1f} |"
+        if mfu:
+            pct = 100.0 * p.gflops() / (mxu_peak_tflops * 1e3 * p.n_processes)
             row += f" {pct:.1f} |"
         lines.append(row)
     return "\n".join(lines)

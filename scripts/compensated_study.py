@@ -40,7 +40,6 @@ def cancellation_case(n_rows: int, n_cols: int, rng) -> tuple:
     naive fp32 accumulation destroys it (catastrophic cancellation)."""
     import numpy as np
 
-    assert n_cols % 2 == 0
     big = rng.uniform(1e6, 1e7, size=(n_rows, n_cols // 2)).astype(np.float32)
     small = rng.uniform(-1.0, 1.0, size=(n_rows, n_cols // 2)).astype(np.float32)
     # Columns interleaved so the cancellation is spread across the row.
@@ -71,9 +70,11 @@ def main(argv=None) -> int:
     p.add_argument("--devices", type=int, default=None)
     p.add_argument("--data-root", default=None)
     p.add_argument("--no-csv", action="store_true")
-    p.add_argument("--report", default="docs/COMPENSATED.md")
+    p.add_argument("--report", default=str(REPO / "docs" / "COMPENSATED.md"))
     p.add_argument("--no-report", action="store_true")
     args = p.parse_args(argv)
+    if args.acc_cols % 2:
+        p.error("--acc-cols must be even (cancellation pairs are interleaved)")
 
     from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
 
@@ -96,6 +97,9 @@ def main(argv=None) -> int:
     a, x = cancellation_case(args.acc_rows, args.acc_cols, rng)
     oracle = a.astype(np.float64) @ x.astype(np.float64)
     strat = get_strategy("rowwise")
+    # Fail with the typed ShardingError (not a deep XLA partitioning error)
+    # when acc-rows doesn't divide the mesh, as every other entry point does.
+    strat.validate(a.shape[0], a.shape[1], mesh)
     results = {}
     for kernel in ("xla", "compensated"):
         fn = strat.build(mesh, kernel=kernel)

@@ -143,7 +143,7 @@ def main(argv=None) -> int:
     p.add_argument("--devices", type=int, default=None,
                    help="mesh size (default: all available)")
     p.add_argument("--profile-dir", default=None)
-    p.add_argument("--report", default="docs/OVERLAP.md")
+    p.add_argument("--report", default=str(REPO / "docs" / "OVERLAP.md"))
     p.add_argument("--no-report", action="store_true")
     args = p.parse_args(argv)
 

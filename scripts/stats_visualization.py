@@ -89,6 +89,12 @@ def main(argv=None) -> int:
         "(BASELINE.json north star), e.g. 819 for TPU v5e",
     )
     p.add_argument(
+        "--mxu-peak", type=float, default=None, metavar="TFLOPS",
+        help="per-chip MXU peak TFLOP/s; adds the MFU (%%-of-MXU-peak) "
+        "column — the compute roofline for GEMM rows, e.g. 197 bf16 "
+        "TFLOP/s for TPU v5e",
+    )
+    p.add_argument(
         "--overlay", nargs="+", default=None, metavar="LABEL=DIR",
         help="overlay runs from multiple data/out dirs in one figure at the "
         "largest shared size, e.g. --overlay 'reference=/root/reference/"
@@ -98,6 +104,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     if args.hbm_peak is not None and args.hbm_peak <= 0:
         p.error("--hbm-peak must be positive")
+    if args.mxu_peak is not None and args.mxu_peak <= 0:
+        p.error("--mxu-peak must be positive")
 
     data_out = Path(args.data_out)
     by_strategy = load_run(data_out)
@@ -109,7 +117,8 @@ def main(argv=None) -> int:
         print(f"\n## {name}\n")
         print(
             format_table(
-                points, itemsize=args.itemsize, hbm_peak_gbps=args.hbm_peak
+                points, itemsize=args.itemsize, hbm_peak_gbps=args.hbm_peak,
+                mxu_peak_tflops=args.mxu_peak,
             )
         )
         fig = plot_strategy(points, Path(args.fig_dir) / f"{name}.png",

@@ -169,6 +169,21 @@ def test_format_table_roofline_column():
     assert "% HBM peak" not in format_table([pt], itemsize=4)
 
 
+def test_format_table_mfu_column():
+    from matvec_mpi_multiplier_tpu.analysis.stats import ScalingPoint, format_table
+
+    # A GEMM-shaped point: 4096^3-ish FLOPs in 1 ms on one chip.
+    pt = ScalingPoint(
+        n_rows=4096, n_cols=4096, n_processes=1, time_s=0.001,
+        speedup=1.0, efficiency=1.0, strategy="gemm_blockwise", n_rhs=4096,
+    )
+    out = format_table([pt], itemsize=2, mxu_peak_tflops=197.0)
+    assert "MFU %" in out
+    # gflops = 2*4096^3/1e-3/1e9 = 137439; MFU = 100*137439/(197e3) ~ 69.8
+    assert "| 69.8 |" in out
+    assert "MFU %" not in format_table([pt], itemsize=2)
+
+
 def test_plot_overlay(tmp_path):
     pytest.importorskip("matplotlib")
     from matvec_mpi_multiplier_tpu.analysis.plots import plot_overlay
