@@ -11,10 +11,13 @@ baseline is the reference's best aggregate effective bandwidth anywhere in its
 committed data: 4.13 GB/s (blockwise 10200² p=12, BASELINE.md), since the
 reference is bandwidth-bound and GB/s is the dtype-fair comparison.
 
-Timing uses the chain-slope method (bench/timing.py): per-matvec time is the
-slope between back-to-back execution chains of two lengths, fenced by scalar
-fetches — robust on tunneled PJRT backends where block_until_ready returns
-early and a single fetch costs a ~30-70 ms round-trip.
+Timing uses the device-looped slope method by default (bench/timing.py,
+measure='loop'): the rep loop is a lax.fori_loop inside one jitted
+computation, so per-matvec time is the slope between two loop lengths with
+ONE dispatch and one fence each — robust on tunneled PJRT backends where
+block_until_ready returns early, a fetch costs a ~30-70 ms round-trip, and
+each dispatch pays ~0.5 ms transport. MATVEC_BENCH_MEASURE=chain selects the
+host-driven chain variant.
 
 Environment overrides: MATVEC_BENCH_SIZE (default 32768), MATVEC_BENCH_REPS
 (default 50), MATVEC_BENCH_DTYPE (default bfloat16), MATVEC_BENCH_KERNEL
@@ -32,7 +35,7 @@ import sys
 import numpy as np
 
 from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
-from matvec_mpi_multiplier_tpu.bench.timing import time_fn_chained
+from matvec_mpi_multiplier_tpu.bench.timing import time_fn_chained, time_fn_looped
 
 # Reference best: blockwise 10200^2 p=12, 0.201654 s -> 4.13 GB/s aggregate
 # (data/out/blockwise.csv:37; derivation in BASELINE.md).
@@ -183,7 +186,21 @@ def main() -> int:
     # Median of DEFAULT_CHAIN_SAMPLES independent slope samples after a
     # multi-run warm-up: a cold process under-reports on its first chains,
     # and the median rejects the stray slow sample the mean would absorb.
-    times = time_fn_chained(fn, (a, x), n_reps=n_reps, warmup=8)
+    # Default 'loop' runs the rep loop on device (one dispatch per sample —
+    # per-dispatch tunnel transport never touches the number); 'chain' is
+    # the host-driven variant, adequate at this size where per-op time
+    # (~3 ms) dwarfs dispatch cost.
+    measure = os.environ.get("MATVEC_BENCH_MEASURE", "loop")
+    if measure not in ("loop", "chain"):
+        print(
+            f"MATVEC_BENCH_MEASURE must be 'loop' or 'chain', got {measure!r}",
+            file=sys.stderr,
+        )
+        return 2
+    if measure == "loop":
+        times = time_fn_looped(fn, (a, x), n_reps=n_reps, warmup=3)
+    else:
+        times = time_fn_chained(fn, (a, x), n_reps=n_reps, warmup=8)
     mean_t = float(np.median(times))
     itemsize = jnp.dtype(dtype).itemsize
     gbps = itemsize * (size * size + 2 * size) / mean_t / 1e9
@@ -194,6 +211,7 @@ def main() -> int:
                 "value": round(gbps, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(gbps / REFERENCE_BEST_GBPS, 2),
+                "measure": measure,
             }
         )
     )

@@ -171,11 +171,16 @@ def _build_looped(fn: Callable) -> Callable:
 
 
 def _loop_slope(
-    fn: Callable, a_dev, rhs_dev, n1: int, n2: int, samples: int
+    fn: Callable, a_dev, rhs_dev, n1: int, n2: int, samples: int,
+    warmup: int = 0,
 ) -> list[float]:
     """Per-execution time as the slope between device-looped runs of n1 and
     n2 reps (one dispatch each); the single dispatch+fence overhead cancels
-    in the difference just as in :func:`_chain_slope`."""
+    in the difference just as in :func:`_chain_slope`.
+
+    ``warmup``: extra fenced n1-length runs after the compile — a cold
+    process under-reports bandwidth on its first runs (clock ramp / cold
+    caches), so headline callers warm for a few."""
     if samples < 1:
         raise ConfigError(f"chain_samples must be >= 1, got {samples}")
     chained = _build_looped(fn)
@@ -188,12 +193,31 @@ def _loop_slope(
         return time.perf_counter() - start
 
     run(1)  # compile (k is traced: one compile covers every k)
+    for _ in range(max(0, warmup)):
+        run(n1)
     estimates = []
     for _ in range(samples):
         t1 = run(n1)
         t2 = run(n2)
         estimates.append(max((t2 - t1) / (n2 - n1), 1e-9))
     return estimates
+
+
+def time_fn_looped(
+    fn: Callable, args: tuple, *, n_reps: int = DEFAULT_N_REPS,
+    samples: int = DEFAULT_CHAIN_SAMPLES, warmup: int = 1,
+) -> list[float]:
+    """Device-looped slope timing of an arbitrary device function on
+    device-resident args (the ``measure='loop'`` face of
+    :func:`time_fn_chained`): one dispatch per sample instead of one per
+    rep, so per-dispatch transport cost on tunneled backends never touches
+    the estimate. Used by bench.py with device-side operand generation."""
+    a_dev, rhs_dev = args
+    n1 = max(1, n_reps // 10)
+    per = _loop_slope(
+        fn, a_dev, rhs_dev, n1, n1 + n_reps, samples, warmup=warmup
+    )
+    return [_max_across_processes(t) for t in per]
 
 
 def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int) -> list[float]:

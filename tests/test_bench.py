@@ -77,6 +77,19 @@ def test_reference_mode_rejects_loop(devices):
         _bench(make_mesh(2), mode="reference", measure="loop")
 
 
+def test_time_fn_looped(devices):
+    """bench.py's headline path: device-resident args, device-looped reps."""
+    import jax.numpy as jnp
+
+    from matvec_mpi_multiplier_tpu.bench.timing import time_fn_looped
+
+    a = jnp.asarray(np.random.default_rng(0).standard_normal((32, 32)))
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(32))
+    times = time_fn_looped(lambda a_, x_: a_ @ x_, (a, x), n_reps=4, samples=2)
+    assert len(times) == 2
+    assert all(t > 0 for t in times)
+
+
 def test_chain_samples_validation(devices):
     from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
 
