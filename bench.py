@@ -134,6 +134,14 @@ def main() -> int:
     size = int(os.environ.get("MATVEC_BENCH_SIZE", 32768))
     n_reps = int(os.environ.get("MATVEC_BENCH_REPS", 50))
     dtype = os.environ.get("MATVEC_BENCH_DTYPE", "bfloat16")
+    measure = os.environ.get("MATVEC_BENCH_MEASURE", "loop")
+    if measure not in ("loop", "chain"):
+        # Validate before the 90s probe / mesh build / 8.6 GB operand gen.
+        print(
+            f"MATVEC_BENCH_MEASURE must be 'loop' or 'chain', got {measure!r}",
+            file=sys.stderr,
+        )
+        return 2
 
     probe_error = _backend_reachable()
     if probe_error is not None:
@@ -190,13 +198,6 @@ def main() -> int:
     # per-dispatch tunnel transport never touches the number); 'chain' is
     # the host-driven variant, adequate at this size where per-op time
     # (~3 ms) dwarfs dispatch cost.
-    measure = os.environ.get("MATVEC_BENCH_MEASURE", "loop")
-    if measure not in ("loop", "chain"):
-        print(
-            f"MATVEC_BENCH_MEASURE must be 'loop' or 'chain', got {measure!r}",
-            file=sys.stderr,
-        )
-        return 2
     if measure == "loop":
         times = time_fn_looped(fn, (a, x), n_reps=n_reps, warmup=3)
     else:

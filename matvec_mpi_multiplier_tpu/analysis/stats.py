@@ -40,6 +40,11 @@ class ScalingPoint:
     # Right-hand-side width: 1 = matvec (the reference's scope); >1 = GEMM
     # rows (gemm_<strategy>.csv) — the throughput formulas depend on it.
     n_rhs: int = 1
+    # Bytes per element when known for THIS row (from the extended CSV's
+    # dtype column); None → the caller-supplied table default. Without it a
+    # mixed-dtype dataset (fp32 matvec + bf16 GEMM) would misstate GB/s for
+    # whichever rows the single global itemsize doesn't match.
+    itemsize: int | None = None
 
     def gflops(self) -> float:
         return (
@@ -51,7 +56,7 @@ class ScalingPoint:
             self.n_rows * self.n_cols
             + (self.n_rows + self.n_cols) * self.n_rhs
         )
-        return itemsize * elems / self.time_s / 1e9
+        return (self.itemsize or itemsize) * elems / self.time_s / 1e9
 
 
 def _mean_times(rows: Iterable[dict]) -> dict[tuple[int, int, int], float]:
@@ -67,13 +72,15 @@ def scaling_table(
     rows: Iterable[dict],
     strategy: str = "",
     n_rhs_lookup: dict[tuple[int, int, int], int] | None = None,
+    itemsize_lookup: dict[tuple[int, int, int], int] | None = None,
 ) -> list[ScalingPoint]:
     """Compute S and E for every (size, p) against the p=1 row of the same
     size (README.md:47-50).
 
-    ``n_rhs_lookup`` maps (n_rows, n_cols, p) → RHS width for GEMM rows
-    (the reference CSV schema cannot carry it; the extended CSV can —
-    scripts/stats_visualization.py builds the lookup from it).
+    ``n_rhs_lookup`` maps (n_rows, n_cols, p) → RHS width for GEMM rows and
+    ``itemsize_lookup`` the same key → operand bytes-per-element (the
+    reference CSV schema cannot carry either; the extended CSV can —
+    scripts/stats_visualization.py builds both lookups from it).
     """
     means = _mean_times(rows)
     points = []
@@ -86,6 +93,7 @@ def scaling_table(
                 speedup=s, efficiency=(s / p if s is not None else None),
                 strategy=strategy,
                 n_rhs=(n_rhs_lookup or {}).get((m, n, p), 1),
+                itemsize=(itemsize_lookup or {}).get((m, n, p)),
             )
         )
     return points
@@ -95,12 +103,14 @@ def load_strategy_csv(
     path: str | os.PathLike,
     strategy: str = "",
     n_rhs_lookup: dict[tuple[int, int, int], int] | None = None,
+    itemsize_lookup: dict[tuple[int, int, int], int] | None = None,
 ) -> list[ScalingPoint]:
     path = Path(path)
     if not strategy:
         strategy = path.stem.replace("asymmetric_", "")
     return scaling_table(
-        read_csv(path), strategy=strategy, n_rhs_lookup=n_rhs_lookup
+        read_csv(path), strategy=strategy, n_rhs_lookup=n_rhs_lookup,
+        itemsize_lookup=itemsize_lookup,
     )
 
 

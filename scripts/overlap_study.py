@@ -160,6 +160,15 @@ def main(argv=None) -> int:
     from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
 
     n_dev = args.devices or len(jax.devices())
+    if n_dev < 2:
+        # p=1 short-circuits the ring (zero permute hops): the study would
+        # produce an empty schedule table and clobber a meaningful report.
+        print(
+            "overlap study needs >= 2 devices (ring has no hops at p=1); "
+            "nothing to measure on this backend — skipping",
+            file=sys.stderr,
+        )
+        return 0
     mesh = make_mesh(n_dev)
     platform = jax.devices()[0].platform
     n = args.size

@@ -33,28 +33,44 @@ from matvec_mpi_multiplier_tpu.analysis.plots import (
 from matvec_mpi_multiplier_tpu.analysis.stats import format_table, load_strategy_csv
 
 
-def _n_rhs_lookups(data_out: Path) -> dict[str, dict[tuple[int, int, int], int]]:
-    """Per-strategy (m, n, p) → n_rhs maps from the extended CSV.
+_ITEMSIZE = {"float64": 8, "float32": 4, "bfloat16": 2, "float16": 2}
 
-    The reference CSV schema cannot carry the GEMM RHS width; without the
-    lookup, GEMM GFLOP/s would be understated by a factor of n_rhs."""
+
+def _ext_lookups(
+    data_out: Path,
+) -> tuple[
+    dict[str, dict[tuple[int, int, int], int]],
+    dict[str, dict[tuple[int, int, int], int]],
+]:
+    """Per-strategy (m, n, p) → n_rhs and → itemsize maps from the extended
+    CSV.
+
+    The reference CSV schema cannot carry the GEMM RHS width or the operand
+    dtype; without the lookups, GEMM GFLOP/s would be understated by a
+    factor of n_rhs, and a mixed-dtype dataset (fp32 matvec sweeps + bf16
+    GEMM rows) would have GB/s misstated for whichever rows the single
+    global --itemsize doesn't match."""
     from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
 
     ext = data_out / "results_extended.csv"
-    lookups: dict[str, dict[tuple[int, int, int], int]] = {}
+    n_rhs_l: dict[str, dict[tuple[int, int, int], int]] = {}
+    item_l: dict[str, dict[tuple[int, int, int], int]] = {}
     if ext.exists():
         for r in read_csv(ext):
+            key = (r["n_rows"], r["n_cols"], r["n_devices"])
             n_rhs = r.get("n_rhs", 1)
             if isinstance(n_rhs, int) and n_rhs > 1:
-                key = (r["n_rows"], r["n_cols"], r["n_devices"])
-                lookups.setdefault(r["strategy"], {})[key] = n_rhs
-    return lookups
+                n_rhs_l.setdefault(r["strategy"], {})[key] = n_rhs
+            isz = _ITEMSIZE.get(str(r.get("dtype", "")))
+            if isz is not None:
+                item_l.setdefault(r["strategy"], {})[key] = isz
+    return n_rhs_l, item_l
 
 
 def load_run(data_out: Path) -> dict[str, list]:
     """Load every per-strategy CSV in a data/out directory, keyed by stem
     (the one place the stem convention / results_extended exclusion lives)."""
-    lookups = _n_rhs_lookups(data_out)
+    lookups, item_lookups = _ext_lookups(data_out)
 
     def strategy_of(stem: str) -> str:
         # Strip the sweep-variant prefix and timing-mode suffixes so every
@@ -70,7 +86,11 @@ def load_run(data_out: Path) -> dict[str, list]:
         if path.stem == "results_extended":
             continue
         run.setdefault(path.stem, []).extend(
-            load_strategy_csv(path, n_rhs_lookup=lookups.get(strategy_of(path.stem)))
+            load_strategy_csv(
+                path,
+                n_rhs_lookup=lookups.get(strategy_of(path.stem)),
+                itemsize_lookup=item_lookups.get(strategy_of(path.stem)),
+            )
         )
     return run
 

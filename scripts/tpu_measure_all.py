@@ -16,8 +16,13 @@ after it. Stages:
 4. hostlink   — link model + derived reference-mode rows (the wedge-safe
                 Q5 substitute; never does per-rep transfers);
 5. gemm       — MXU-bound GEMM numbers (8192^2 bf16 xla + pallas tiers);
-6. baseline   — 65536^2 bf16 blockwise (BASELINE.json's north-star config;
-                8.6 GB of operands, generated on device).
+6. overlap    — scripts/overlap_study.py on the real backend (async
+                collective-permute pair evidence; self-skips at p=1);
+7. compensated— scripts/compensated_study.py on the chip (accuracy vs the
+                fp64 oracle + bandwidth rows);
+8. baseline   — 65536^2 bf16 blockwise (BASELINE.json's north-star config;
+                8.6 GB of operands, generated on device);
+9. figures    — regenerate figures/tpu with HBM-roofline and MFU columns.
 
 Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
 """
@@ -70,7 +75,15 @@ def main(argv=None) -> int:
     p.add_argument("--data-root", default="data")
     p.add_argument(
         "--skip", nargs="*", default=[],
-        choices=["headline", "sweeps", "hostlink", "gemm", "baseline"],
+        choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
+                 "compensated", "baseline", "figures"],
+    )
+    p.add_argument(
+        "--wipe-stale-csvs", action="store_true",
+        help="move any pre-existing data/out/*.csv aside (to *.csv.stale) "
+        "before the sweeps stage, so the capture produces a fresh, "
+        "internally consistent dataset instead of appending to rows "
+        "measured under an older protocol",
     )
     args = p.parse_args(argv)
     py = sys.executable
@@ -91,6 +104,8 @@ def main(argv=None) -> int:
         sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
                  "--data-root", args.data_root, "--keep-going"]
         if "sweeps" not in args.skip:
+            if args.wipe_stale_csvs:
+                _wipe_stale_csvs(Path(args.data_root) / "out")
             rc |= run(sweep + ["--strategy", "all", "--sweep", "both",
                                "--dtype", "float32", "--measure", "loop",
                                "--chain-samples", "5", "--n-reps", "50"])
@@ -100,18 +115,49 @@ def main(argv=None) -> int:
         if "gemm" not in args.skip:
             rc |= run(sweep + ["--op", "gemm", "--strategy", "all",
                                "--sizes", "8192", "--dtype", "bfloat16",
-                               "--measure", "chain", "--n-reps", "20"])
+                               "--measure", "loop", "--n-reps", "20"])
             rc |= run(sweep + ["--op", "gemm", "--strategy", "blockwise",
                                "--sizes", "8192", "--dtype", "bfloat16",
-                               "--kernel", "pallas", "--measure", "chain",
+                               "--kernel", "pallas", "--measure", "loop",
                                "--n-reps", "20"])
+        if "overlap" not in args.skip:
+            # Real-backend overlap evidence: async collective-permute
+            # start/done pairs in the compiled module + TPU timings
+            # (docs/OVERLAP.md regenerated with backend=tpu).
+            rc |= run([py, "scripts/overlap_study.py", "--size", "8192"])
+        if "compensated" not in args.skip:
+            # fp64-parity evidence on the chip: accuracy vs the fp64 oracle
+            # + bandwidth rows (docs/COMPENSATED.md, backend=tpu).
+            rc |= run([py, "scripts/compensated_study.py", "--size", "8192",
+                       "--data-root", args.data_root])
         if "baseline" not in args.skip:
             rc |= _baseline_stage(py)
+        if "figures" not in args.skip:
+            rc |= run([py, "scripts/stats_visualization.py",
+                       "--data-out", str(Path(args.data_root) / "out"),
+                       "--fig-dir", "figures/tpu", "--itemsize", "4",
+                       "--hbm-peak", "819", "--mxu-peak", "197"])
     except StageWedged as e:
         print(f"ABORT: {e}", flush=True)
         return 1
     print(f"capture complete rc={rc}", flush=True)
     return rc
+
+
+def _wipe_stale_csvs(out_dir: Path) -> None:
+    """Move pre-existing top-level CSVs aside (never touches cpu_mesh/).
+
+    Backups are never overwritten: a second capture run must not clobber the
+    first run's set-aside data with its own (possibly wedge-truncated) CSVs.
+    """
+    for csv in sorted(out_dir.glob("*.csv")):
+        stale = csv.with_suffix(".csv.stale")
+        n = 2
+        while stale.exists():
+            stale = csv.with_suffix(f".csv.stale{n}")
+            n += 1
+        print(f"moving stale {csv} -> {stale}", flush=True)
+        csv.replace(stale)
 
 
 def _baseline_stage(py: str) -> int:

@@ -169,6 +169,23 @@ def test_format_table_roofline_column():
     assert "% HBM peak" not in format_table([pt], itemsize=4)
 
 
+def test_per_point_itemsize_overrides_table_default():
+    from matvec_mpi_multiplier_tpu.analysis.stats import ScalingPoint
+
+    pt = ScalingPoint(
+        n_rows=1000, n_cols=1000, n_processes=1, time_s=0.001,
+        speedup=1.0, efficiency=1.0, strategy="gemm_blockwise",
+        n_rhs=1, itemsize=2,
+    )
+    # bf16 row in a table rendered with --itemsize 4: the row's own dtype
+    # wins, so GB/s is not overstated 2x.
+    assert pt.gbps(itemsize=4) == pytest.approx(pt.gbps(itemsize=2))
+    assert ScalingPoint(
+        n_rows=1000, n_cols=1000, n_processes=1, time_s=0.001,
+        speedup=1.0, efficiency=1.0,
+    ).gbps(itemsize=4) == pytest.approx(2 * pt.gbps(itemsize=4))
+
+
 def test_format_table_mfu_column():
     from matvec_mpi_multiplier_tpu.analysis.stats import ScalingPoint, format_table
 
