@@ -20,9 +20,11 @@ after it. Stages:
                 collective-permute pair evidence; self-skips at p=1);
 7. compensated— scripts/compensated_study.py on the chip (accuracy vs the
                 fp64 oracle + bandwidth rows);
-8. baseline   — 65536^2 bf16 blockwise (BASELINE.json's north-star config;
+8. autotune   — scripts/autotune_pallas.py (bm, bk) tile search at the
+                headline size vs the committed defaults;
+9. baseline   — 65536^2 bf16 blockwise (BASELINE.json's north-star config;
                 8.6 GB of operands, generated on device);
-9. figures    — regenerate figures/tpu with HBM-roofline and MFU columns.
+10. figures   — regenerate figures/tpu with HBM-roofline and MFU columns.
 
 Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
 """
@@ -76,7 +78,7 @@ def main(argv=None) -> int:
     p.add_argument(
         "--skip", nargs="*", default=[],
         choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
-                 "compensated", "baseline", "figures"],
+                 "compensated", "autotune", "baseline", "figures"],
     )
     p.add_argument(
         "--wipe-stale-csvs", action="store_true",
@@ -130,6 +132,10 @@ def main(argv=None) -> int:
             # + bandwidth rows (docs/COMPENSATED.md, backend=tpu).
             rc |= run([py, "scripts/compensated_study.py", "--size", "8192",
                        "--data-root", args.data_root])
+        if "autotune" not in args.skip:
+            # Pallas tile search at the headline size: if a tile beats the
+            # committed (512, 4096) defaults the report says which.
+            rc |= run([py, "scripts/autotune_pallas.py"])
         if "baseline" not in args.skip:
             rc |= _baseline_stage(py)
         if "figures" not in args.skip:
