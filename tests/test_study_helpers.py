@@ -1,0 +1,46 @@
+"""Pin the measurement-study helpers (scripts/compensated_study.py,
+scripts/tpu_measure_all.py) that carry numeric or data-safety contracts."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "scripts"))
+
+from compensated_study import cancellation_case, ulp_error  # noqa: E402
+from tpu_measure_all import _wipe_stale_csvs  # noqa: E402
+
+
+def test_cancellation_case_true_sums_are_small(rng):
+    a, x = cancellation_case(16, 64, rng)
+    assert a.dtype == np.float32 and x.dtype == np.float32
+    oracle = a.astype(np.float64) @ x.astype(np.float64)
+    # The big ±pairs cancel exactly in fp64; what's left is the sum of 32
+    # O(1) residuals per row.
+    assert np.all(np.abs(oracle) < 64)
+    # While naive fp32 accumulation is destroyed (loses the residual).
+    naive = (a @ x).astype(np.float64)
+    assert np.max(np.abs(naive - oracle)) > 1.0
+
+
+def test_ulp_error_zero_iff_exact(rng):
+    oracle = rng.uniform(1.0, 2.0, 8)
+    exact = oracle.astype(np.float32).astype(np.float64)
+    assert ulp_error(exact, oracle.astype(np.float32).astype(np.float64)) == 0
+    off = exact + np.spacing(exact.astype(np.float32)).astype(np.float64)
+    assert ulp_error(off, exact) >= 1.0
+
+
+def test_wipe_stale_csvs_never_clobbers_backups(tmp_path):
+    out = tmp_path / "out"
+    out.mkdir()
+    (out / "rowwise.csv").write_text("first capture\n")
+    _wipe_stale_csvs(out)
+    assert (out / "rowwise.csv.stale").read_text() == "first capture\n"
+    (out / "rowwise.csv").write_text("second capture\n")
+    _wipe_stale_csvs(out)
+    # The first backup survives; the second goes to a counter suffix.
+    assert (out / "rowwise.csv.stale").read_text() == "first capture\n"
+    assert (out / "rowwise.csv.stale2").read_text() == "second capture\n"
+    assert not (out / "rowwise.csv").exists()
