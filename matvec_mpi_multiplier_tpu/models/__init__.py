@@ -10,6 +10,7 @@ from __future__ import annotations
 from .base import MatvecStrategy
 from .blockwise import BlockwiseStrategy
 from .colwise import (
+    ColwiseAllToAllStrategy,
     ColwiseRingOverlapStrategy,
     ColwiseRingStrategy,
     ColwiseStrategy,
@@ -21,6 +22,7 @@ STRATEGIES: dict[str, type[MatvecStrategy]] = {
     ColwiseStrategy.name: ColwiseStrategy,
     ColwiseRingStrategy.name: ColwiseRingStrategy,
     ColwiseRingOverlapStrategy.name: ColwiseRingOverlapStrategy,
+    ColwiseAllToAllStrategy.name: ColwiseAllToAllStrategy,
     BlockwiseStrategy.name: BlockwiseStrategy,
 }
 
@@ -45,6 +47,7 @@ __all__ = [
     "ColwiseStrategy",
     "ColwiseRingStrategy",
     "ColwiseRingOverlapStrategy",
+    "ColwiseAllToAllStrategy",
     "BlockwiseStrategy",
     "STRATEGIES",
     "get_strategy",

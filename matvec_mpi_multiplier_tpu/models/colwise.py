@@ -110,3 +110,37 @@ class ColwiseRingOverlapStrategy(ColwiseRingStrategy):
 
     def __init__(self):
         super().__init__(overlap=True)
+
+
+class ColwiseAllToAllStrategy(ColwiseStrategy):
+    """Colwise with the combine as an explicit all-to-all + local reduce —
+    the Ulysses-style face of sequence parallelism, completing the combine
+    family (one-shot ``psum_scatter`` / neighbor ``ring`` / balanced
+    ``all_to_all``).
+
+    Reference analog: the same ``MPI_Reduce(SUM)`` combine
+    (``src/multiplier_colwise.c:124``), decomposed the way all-to-all
+    sequence-parallel schemes reshard between sequence- and head-parallel
+    layouts: each device splits its full-length partial y into p row
+    chunks, one ``lax.all_to_all`` delivers chunk j to device j (a single
+    balanced exchange using every ICI link at once, where the ring takes
+    p−1 neighbor hops), and a local sum over the p received contributions
+    completes the reduce-scatter. Output is always row-sharded; matches
+    ``psum_scatter`` up to reduction order.
+    """
+
+    name = "colwise_a2a"
+
+    def __init__(self):
+        super().__init__(scatter_output=True)
+
+    def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
+        from ..parallel.ring import a2a_psum_scatter
+
+        axes = flat_axes(mesh)
+
+        def body(a_panel, x_seg):
+            partial = kernel(a_panel, x_seg)  # (m,), accumulator dtype
+            return a2a_psum_scatter(partial, axes).astype(a_panel.dtype)
+
+        return body

@@ -80,6 +80,9 @@ _GEMM_SPECS.update(
     blockwise=_specs_blockwise,
     colwise_ring=_specs_colwise_ring,
     colwise_ring_overlap=_specs_colwise_ring,
+    # Same layout contract as the ring variants (C row-sharded); the combine
+    # is one balanced all_to_all + local reduce instead of p-1 ring hops.
+    colwise_a2a=_specs_colwise_ring,
 )
 
 
@@ -104,6 +107,22 @@ def _ring_body(name: str, mesh: Mesh, kern: Callable) -> Callable:
     return body
 
 
+def _a2a_body(mesh: Mesh, kern: Callable) -> Callable:
+    """Combine via one balanced all_to_all + local reduce (the Ulysses-style
+    face — parallel/ring.py::a2a_psum_scatter, the rank-agnostic helper
+    shared with the matvec ColwiseAllToAllStrategy), applied to GEMM: the
+    exchange delivers row-chunk j of each (m, n) partial C to device j."""
+    from ..parallel.ring import a2a_psum_scatter
+
+    axes = flat_axes(mesh)
+
+    def body(a_blk: Array, b_blk: Array) -> Array:
+        partial = kern(a_blk, b_blk)  # (m, n) accumulator dtype
+        return a2a_psum_scatter(partial, axes).astype(a_blk.dtype)
+
+    return body
+
+
 def available_gemm_strategies() -> list[str]:
     return sorted(_GEMM_SPECS)
 
@@ -122,9 +141,9 @@ def validate_gemm(
         check_divisible(m, p, "m (rows of A)", "number of devices")
     elif name == "colwise":
         check_divisible(k, p, "k (contraction dim)", "number of devices")
-    elif name.startswith("colwise_ring"):
+    elif name.startswith("colwise_ring") or name == "colwise_a2a":
         check_divisible(k, p, "k (contraction dim)", "number of devices")
-        # The ring scatters C rows: each device ends with m/p of them.
+        # Both scatter C rows: each device ends with m/p of them.
         check_divisible(m, p, "m (rows of A)", "number of devices")
     else:  # blockwise
         if (
@@ -176,6 +195,8 @@ def build_gemm(
 
     if name.startswith("colwise_ring"):
         body = _ring_body(name, mesh, kern)
+    elif name == "colwise_a2a":
+        body = _a2a_body(mesh, kern)
     else:
         def body(a_blk: Array, b_blk: Array) -> Array:
             partial = kern(a_blk, b_blk)

@@ -121,6 +121,32 @@ def ring_matmul(a_panel: Array, b_seg: Array, axis_name: str, kernel) -> Array:
     return ring_matvec(a_panel, b_seg, axis_name, kernel)
 
 
+def a2a_psum_scatter(x: Array, axis_name: str) -> Array:
+    """Reduce-scatter as ONE balanced all-to-all + local reduce — the
+    Ulysses-style schedule, the third member of the combine family beside
+    ``lax.psum_scatter`` (XLA-scheduled) and :func:`ring_psum_scatter`
+    (p−1 neighbor hops). Each device splits its full partial into p leading
+    chunks, ``lax.all_to_all`` delivers chunk j to device j across every
+    link at once, and the local sum over the p received contributions
+    yields this device's chunk. Rank-agnostic (vector partials for matvec,
+    (m, n) partials for GEMM); same contract and constraint
+    (``x.shape[0] % p == 0``) as :func:`ring_psum_scatter`.
+    """
+    p = jax.lax.axis_size(axis_name)
+    if p == 1:
+        return x
+    n = x.shape[0]
+    if n % p != 0:
+        raise ValueError(f"a2a_psum_scatter: length {n} not divisible by {p}")
+    chunks = x.reshape(p, n // p, *x.shape[1:])
+    # After the exchange, leading index i holds device i's contribution to
+    # THIS device's chunk; the local sum completes the reduce-scatter.
+    recv = jax.lax.all_to_all(
+        chunks, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    return recv.sum(axis=0)
+
+
 def ring_all_gather(x: Array, axis_name: str) -> Array:
     """Ring all-gather: each device's chunk circulates p−1 hops; the result
     is the axis-ordered concatenation, identical to

@@ -177,8 +177,8 @@ def test_registry():
     from matvec_mpi_multiplier_tpu import available_strategies
 
     assert available_strategies() == [
-        "blockwise", "colwise", "colwise_ring", "colwise_ring_overlap",
-        "rowwise",
+        "blockwise", "colwise", "colwise_a2a", "colwise_ring",
+        "colwise_ring_overlap", "rowwise",
     ]
     with pytest.raises(KeyError, match="unknown strategy"):
         get_strategy("diagonal")
