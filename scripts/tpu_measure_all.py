@@ -63,8 +63,14 @@ class StageWedged(RuntimeError):
 
 def run(cmd: list[str]) -> int:
     print("+", " ".join(cmd), flush=True)
+    # Persistent XLA compilation cache shared across stages: a re-capture
+    # after a mid-run wedge skips every already-compiled config's compile
+    # round-trips (each one is tunnel exposure). Harmlessly ignored by
+    # backends that don't support it.
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", str(REPO / ".jax_cache"))
     try:
-        return subprocess.call(cmd, cwd=REPO, timeout=STAGE_TIMEOUT_S)
+        return subprocess.call(cmd, cwd=REPO, env=env, timeout=STAGE_TIMEOUT_S)
     except subprocess.TimeoutExpired:
         raise StageWedged(
             f"stage exceeded {STAGE_TIMEOUT_S:.0f}s (tunnel wedged mid-run); "
@@ -168,6 +174,7 @@ def _wipe_stale_csvs(out_dir: Path) -> None:
 
 def _baseline_stage(py: str) -> int:
     env = dict(os.environ, MATVEC_BENCH_SIZE="65536")
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", str(REPO / ".jax_cache"))
     print("+ MATVEC_BENCH_SIZE=65536 bench.py", flush=True)
     try:
         r = subprocess.run(
