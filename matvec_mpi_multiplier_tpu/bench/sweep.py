@@ -258,11 +258,12 @@ def run_sweep(args: argparse.Namespace) -> int:
     from ..ops import available_gemm_kernels, available_kernels
 
     if args.kernel == "native":
-        # The native FFI tier registers only when its .so exists; build it
+        # The native FFI tiers register only when the .so exists; build it
         # on demand so `--kernel native` works in a default checkout.
-        from ..ops.native_gemv import register_if_available
+        from ..ops import native_gemm, native_gemv
 
-        register_if_available(build=True)
+        native_gemv.register_if_available(build=True)
+        native_gemm.register_if_available(build=True)
 
     kernels = (
         available_gemm_kernels() if args.op == "gemm" else available_kernels()

@@ -18,6 +18,7 @@ from .gemm_kernels import (
     register_gemm_kernel,
 )
 from . import pallas_gemm  # noqa: F401
+from . import native_gemm  # noqa: F401
 
 __all__ = [
     "gemv",

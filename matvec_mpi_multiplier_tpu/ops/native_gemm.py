@@ -1,0 +1,139 @@
+"""Native C++ GEMM tier — the rank-2 face of the native kernel path.
+
+Mirrors ops/native_gemv.py for ``C = A @ B`` (see that module and
+``native/gemm.cc`` for the two-surface design: ctypes oracle + XLA FFI
+CPU custom call). The reference's compute layer is matvec-only
+(``src/matr_utils.c:86-96``); this completes the GEMM kernel registry's
+tier set (xla / pallas / native) to match the GEMV registry's.
+
+Registers as ``"native"`` in the GEMM kernel registry when the shared
+library has been built (``make -C native``, auto-built by the test
+conftest / sweep CLI).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import jax
+import numpy as np
+from jax import Array
+
+from .gemm_kernels import register_gemm_kernel
+from .native_gemv import _lib_path
+
+_GEMM_ARGTYPES_SET = False
+_FFI_TARGETS_REGISTERED = False
+
+
+def _load() -> ctypes.CDLL | None:
+    """The shared library handle with the GEMM argtypes declared."""
+    global _GEMM_ARGTYPES_SET
+    from ..utils.native_lib import load_library
+
+    lib = load_library()
+    if lib is None:
+        return None
+    if not hasattr(lib, "matvec_gemm_f32"):
+        # A stale .so from before the GEMM kernel existed: treat the GEMM
+        # tier as unavailable rather than crash at first call.
+        return None
+    if not _GEMM_ARGTYPES_SET:
+        from ..utils.native_lib import declare_ctypes_sig
+
+        declare_ctypes_sig(lib, "matvec_gemm_f32", ctypes.c_float, 3, 3)
+        declare_ctypes_sig(lib, "matvec_gemm_f64", ctypes.c_double, 3, 3)
+        _GEMM_ARGTYPES_SET = True
+    return lib
+
+
+def native_gemm_available() -> bool:
+    return _load() is not None
+
+
+def gemm_ctypes(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Host-side native GEMM (numpy in/out) — the JAX-free oracle path."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(
+            f"native library (with GEMM) not found at {_lib_path()}; "
+            "run `make -C native`"
+        )
+    a = np.ascontiguousarray(a)
+    b = np.ascontiguousarray(b, dtype=a.dtype)
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+        # The C kernel trusts its dims; a mismatch here would be an
+        # out-of-bounds heap read, not a Python error.
+        raise ValueError(
+            f"gemm shape mismatch: a {a.shape} @ b {b.shape}"
+        )
+    if a.dtype == np.float32:
+        fn, ctype = lib.matvec_gemm_f32, ctypes.c_float
+    elif a.dtype == np.float64:
+        fn, ctype = lib.matvec_gemm_f64, ctypes.c_double
+    else:
+        raise TypeError(f"native gemm supports float32/float64, got {a.dtype}")
+    m, k = a.shape
+    n = b.shape[1]
+    c = np.empty((m, n), dtype=a.dtype)
+    ptr = lambda arr: arr.ctypes.data_as(ctypes.POINTER(ctype))
+    fn(ptr(a), ptr(b), ptr(c), m, k, n)
+    return c
+
+
+def _register_ffi_targets() -> bool:
+    global _FFI_TARGETS_REGISTERED
+    if _FFI_TARGETS_REGISTERED:
+        return True
+    lib = _load()
+    if lib is None:
+        return False
+    from ..utils.native_lib import register_ffi_targets
+
+    register_ffi_targets(lib, (("matvec_gemm_f32_ffi", "GemmF32"),
+                               ("matvec_gemm_f64_ffi", "GemmF64")))
+    _FFI_TARGETS_REGISTERED = True
+    return True
+
+
+def gemm_native(a: Array, b: Array) -> Array:
+    """The C++ GEMM as an XLA custom call (CPU backend only).
+
+    Same contract caveat as gemv_native: accumulates in storage dtype
+    (f32/f64 only, where storage == preferred accumulator).
+    """
+    if not _register_ffi_targets():
+        raise RuntimeError(
+            f"native library (with GEMM) not found at {_lib_path()}; "
+            "run `make -C native`"
+        )
+    if a.dtype == np.float32:
+        target = "matvec_gemm_f32_ffi"
+    elif a.dtype == np.float64:
+        target = "matvec_gemm_f64_ffi"
+    else:
+        raise TypeError(f"native gemm supports float32/float64, got {a.dtype}")
+    call = jax.ffi.ffi_call(
+        target, jax.ShapeDtypeStruct((a.shape[0], b.shape[1]), a.dtype)
+    )
+    return call(a, b)
+
+
+gemm_native.relax_vma_check = True  # type: ignore[attr-defined]
+
+
+def register_if_available(build: bool = False) -> bool:
+    """Put the ``native`` tier in the GEMM kernel registry when available
+    (same shape as ops/native_gemv.register_if_available; ensure_built is
+    idempotent, so both tiers may pass build=True independently)."""
+    if build:
+        from ..utils.native_lib import ensure_built
+
+        ensure_built()
+    if native_gemm_available():
+        register_gemm_kernel("native", gemm_native)
+        return True
+    return False
+
+
+register_if_available()

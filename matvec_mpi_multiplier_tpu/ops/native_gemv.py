@@ -46,17 +46,10 @@ def _load() -> ctypes.CDLL | None:
     if lib is None:
         return None
     if not _GEMV_ARGTYPES_SET:
-        for sym, ctype in (("matvec_gemv_f32", ctypes.c_float),
-                           ("matvec_gemv_f64", ctypes.c_double)):
-            fn = getattr(lib, sym)
-            fn.restype = None
-            fn.argtypes = [
-                ctypes.POINTER(ctype),
-                ctypes.POINTER(ctype),
-                ctypes.POINTER(ctype),
-                ctypes.c_int64,
-                ctypes.c_int64,
-            ]
+        from ..utils.native_lib import declare_ctypes_sig
+
+        declare_ctypes_sig(lib, "matvec_gemv_f32", ctypes.c_float, 3, 2)
+        declare_ctypes_sig(lib, "matvec_gemv_f64", ctypes.c_double, 3, 2)
         _GEMV_ARGTYPES_SET = True
     return lib
 
@@ -95,12 +88,10 @@ def _register_ffi_targets() -> bool:
     lib = _load()
     if lib is None:
         return False
-    for target, symbol in (("matvec_gemv_f32_ffi", "GemvF32"),
-                           ("matvec_gemv_f64_ffi", "GemvF64")):
-        handler = getattr(lib, symbol)
-        jax.ffi.register_ffi_target(
-            target, jax.ffi.pycapsule(handler), platform="cpu"
-        )
+    from ..utils.native_lib import register_ffi_targets
+
+    register_ffi_targets(lib, (("matvec_gemv_f32_ffi", "GemvF32"),
+                               ("matvec_gemv_f64_ffi", "GemvF64")))
     _FFI_TARGETS_REGISTERED = True
     return True
 

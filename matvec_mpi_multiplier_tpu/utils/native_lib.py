@@ -28,8 +28,48 @@ def lib_path() -> Path:
     return Path(__file__).resolve().parents[2] / "native" / "libmatvec_gemv.so"
 
 
+def _stale(lib: Path, native_dir: Path) -> bool:
+    """True when any source (or the Makefile) is newer than the built .so —
+    e.g. a checkout that built before a new kernel file existed would
+    otherwise keep exporting a library missing its symbols forever."""
+    try:
+        built = lib.stat().st_mtime
+    except OSError:
+        return True
+    sources = [*native_dir.glob("*.cc"), native_dir / "Makefile"]
+    return any(
+        src.exists() and src.stat().st_mtime > built for src in sources
+    )
+
+
+def declare_ctypes_sig(
+    lib: ctypes.CDLL, symbol: str, scalar_ctype, n_arrays: int, n_ints: int
+) -> None:
+    """Declare ``symbol``'s signature: ``n_arrays`` pointers to
+    ``scalar_ctype`` followed by ``n_ints`` int64s, returning void — the
+    shape every kernel entry point in native/ uses."""
+    fn = getattr(lib, symbol)
+    fn.restype = None
+    fn.argtypes = (
+        [ctypes.POINTER(scalar_ctype)] * n_arrays
+        + [ctypes.c_int64] * n_ints
+    )
+
+
+def register_ffi_targets(lib: ctypes.CDLL, pairs) -> None:
+    """Register ``(target_name, exported_symbol)`` pairs as CPU XLA FFI
+    custom-call targets. jax is imported lazily so this module stays
+    jax-free at import time (utils/io.py depends on that)."""
+    import jax
+
+    for target, symbol in pairs:
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(getattr(lib, symbol)), platform="cpu"
+        )
+
+
 def ensure_built(timeout_s: float = 300.0) -> bool:
-    """Build the native library with ``make -C native`` if absent.
+    """Build the native library with ``make -C native`` if absent or stale.
 
     The reference's native tier needs no build step beyond ``mpicc`` in the
     sweep driver (``test.sh:10`` recompiles every run); the analog here is
@@ -45,16 +85,17 @@ def ensure_built(timeout_s: float = 300.0) -> bool:
     rename — a reader can never dlopen a half-linked .so, and a build killed
     by the timeout leaves nothing behind.
     """
-    if lib_path().exists():
-        return True
     if _LIB_ENV in os.environ:
-        return False
+        # An explicit override is never second-guessed or rebuilt.
+        return lib_path().exists()
+    native_dir = lib_path().parent
+    if lib_path().exists() and not _stale(lib_path(), native_dir):
+        return True
     make = shutil.which("make")
     # First word only: CXX may legitimately carry arguments ("ccache g++").
     cxx = shutil.which(os.environ.get("CXX", "g++").split()[0])
     if make is None or cxx is None:
-        return False
-    native_dir = lib_path().parent
+        return lib_path().exists()  # stale-but-present beats nothing
     if not (native_dir / "Makefile").exists():
         return False
 
@@ -63,7 +104,8 @@ def ensure_built(timeout_s: float = 300.0) -> bool:
     try:
         with open(native_dir / ".build.lock", "w") as lockf:
             fcntl.flock(lockf, fcntl.LOCK_EX)
-            if lib_path().exists():  # another process built it while we waited
+            # Another process may have (re)built it while we waited.
+            if lib_path().exists() and not _stale(lib_path(), native_dir):
                 return True
             tmp_name = f"{lib_path().name}.build-{os.getpid()}"
             tmp = native_dir / tmp_name
@@ -85,6 +127,10 @@ def ensure_built(timeout_s: float = 300.0) -> bool:
                 tmp.unlink(missing_ok=True)
                 return False
             os.replace(tmp, lib_path())
+            # Drop any handle to the replaced file so the next
+            # load_library() maps the fresh build (with its new symbols).
+            global _lib
+            _lib = None
     except OSError as e:
         # Read-only checkout / no flock support: degrade to "not built",
         # the contract every caller relies on, instead of crashing pytest

@@ -1,0 +1,93 @@
+// Native C++ GEMM kernel: the host-side native tier's rank-2 face.
+//
+// Reference analog: the reference's compute layer is matvec-only
+// (multiply_std_rowwise, src/matr_utils.c:86-96); GEMM is this framework's
+// extension of the same native-kernel pattern (see gemv.cc) to C = A @ B.
+// Exposed the same two ways:
+//   * extern "C" entry points (matvec_gemm_f32/f64) for ctypes oracle use;
+//   * typed XLA FFI handlers (GemmF32/GemmF64) registered as CPU custom
+//     calls, so the native kernel runs inside jitted/shard_mapped programs.
+//
+// Kernel shape: i-l-j loops with a k-strip block. The innermost j loop
+// streams one row of B against a scalar of A — contiguous loads/stores the
+// compiler vectorizes — while the l-strip keeps the active rows of B hot in
+// L1/L2 across the i sweep.
+
+#include <cstdint>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+template <typename T>
+void GemmKernel(const T* a, const T* b, T* c, int64_t m, int64_t k,
+                int64_t n) {
+  constexpr int64_t kStrip = 64;
+  for (int64_t i = 0; i < m; ++i) {
+    T* crow = c + i * n;
+    for (int64_t j = 0; j < n; ++j) crow[j] = 0;
+  }
+  for (int64_t l0 = 0; l0 < k; l0 += kStrip) {
+    const int64_t l1 = (l0 + kStrip < k) ? l0 + kStrip : k;
+    for (int64_t i = 0; i < m; ++i) {
+      const T* arow = a + i * k;
+      T* crow = c + i * n;
+      for (int64_t l = l0; l < l1; ++l) {
+        const T av = arow[l];
+        const T* brow = b + l * n;
+        for (int64_t j = 0; j < n; ++j) crow[j] += av * brow[j];
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void matvec_gemm_f32(const float* a, const float* b, float* c, int64_t m,
+                     int64_t k, int64_t n) {
+  GemmKernel(a, b, c, m, k, n);
+}
+
+void matvec_gemm_f64(const double* a, const double* b, double* c, int64_t m,
+                     int64_t k, int64_t n) {
+  GemmKernel(a, b, c, m, k, n);
+}
+
+}  // extern "C"
+
+template <ffi::DataType DT>
+static ffi::Error GemmImpl(ffi::Buffer<DT> a, ffi::Buffer<DT> b,
+                           ffi::ResultBuffer<DT> c) {
+  auto adims = a.dimensions();
+  auto bdims = b.dimensions();
+  if (adims.size() != 2 || bdims.size() != 2) {
+    return ffi::Error::InvalidArgument("gemm: a and b must be rank 2");
+  }
+  const int64_t m = adims[0];
+  const int64_t k = adims[1];
+  const int64_t n = bdims[1];
+  if (bdims[0] != k) {
+    return ffi::Error::InvalidArgument("gemm: b rows must equal a cols");
+  }
+  if (c->element_count() != m * n) {
+    return ffi::Error::InvalidArgument("gemm: c must hold m*n elements");
+  }
+  GemmKernel(a.typed_data(), b.typed_data(), c->typed_data(), m, k, n);
+  return ffi::Error::Success();
+}
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GemmF32, GemmImpl<ffi::F32>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Arg<ffi::Buffer<ffi::F32>>()
+                                  .Ret<ffi::Buffer<ffi::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(GemmF64, GemmImpl<ffi::F64>,
+                              ffi::Ffi::Bind()
+                                  .Arg<ffi::Buffer<ffi::F64>>()
+                                  .Arg<ffi::Buffer<ffi::F64>>()
+                                  .Ret<ffi::Buffer<ffi::F64>>());

@@ -29,12 +29,13 @@ jax.config.update("jax_enable_x64", True)
 import numpy as np
 import pytest
 
-# Build + register the native C++ tier on demand so its 14 tests run in a
+# Build + register the native C++ tier on demand so its tests run in a
 # default checkout (they skip at collection time when the library is absent,
 # so this must happen here, before test modules are collected).
-from matvec_mpi_multiplier_tpu.ops.native_gemv import register_if_available
+from matvec_mpi_multiplier_tpu.ops import native_gemm, native_gemv
 
-register_if_available(build=True)
+native_gemv.register_if_available(build=True)
+native_gemm.register_if_available(build=True)
 
 
 @pytest.fixture(scope="session")

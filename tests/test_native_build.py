@@ -26,6 +26,25 @@ def test_override_env_is_never_built_over(monkeypatch, tmp_path):
     assert not missing.exists()
 
 
+def test_stale_library_is_rebuilt():
+    """A .so older than any native source must be rebuilt (a checkout built
+    before a new kernel file existed would otherwise export a library
+    missing its symbols forever)."""
+    import os
+    import shutil as sh
+    import time
+
+    if sh.which("make") is None or sh.which("g++") is None:
+        pytest.skip("no C++ toolchain on this host")
+    assert ensure_built()
+    lib = lib_path()
+    old = time.time() - 3600
+    os.utime(lib, (old, old))  # pretend the build predates the sources
+    before = lib.stat().st_mtime
+    assert ensure_built()
+    assert lib.stat().st_mtime > before  # rebuilt, not short-circuited
+
+
 def test_corrupt_library_is_not_loaded(monkeypatch, tmp_path, capsys):
     """A truncated/garbage .so must degrade to 'not built', not crash the
     import chain (ctypes.CDLL raises OSError on it)."""

@@ -1,0 +1,75 @@
+"""Native C++ GEMM tier tests (ctypes oracle + XLA FFI custom call).
+
+The rank-2 face of the native tier (native/gemm.cc, ops/native_gemm.py) —
+same pinning pattern as tests/test_native.py: exact numpy agreement, the
+FFI path under jit, registry integration, and use inside sharded GEMM
+strategies on the CPU mesh. Skipped wholesale when the library (with the
+GEMM symbols) hasn't been built.
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu.ops import native_gemm
+
+pytestmark = pytest.mark.skipif(
+    not native_gemm.native_gemm_available(),
+    reason="native/libmatvec_gemv.so lacks GEMM symbols (run `make -C native`)",
+)
+
+
+def test_ctypes_oracle_fp64(rng):
+    a = rng.standard_normal((32, 48))
+    b = rng.standard_normal((48, 24))
+    np.testing.assert_allclose(native_gemm.gemm_ctypes(a, b), a @ b, rtol=1e-13)
+
+
+def test_ctypes_oracle_fp32(rng):
+    a = rng.standard_normal((16, 80)).astype(np.float32)
+    b = rng.standard_normal((80, 8)).astype(np.float32)
+    c = native_gemm.gemm_ctypes(a, b)
+    assert c.dtype == np.float32
+    np.testing.assert_allclose(c, a @ b, rtol=1e-4)
+
+
+def test_ctypes_rejects_shape_mismatch(rng):
+    a = rng.standard_normal((8, 12))
+    b = rng.standard_normal((10, 4))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        native_gemm.gemm_ctypes(a, b)
+
+
+def test_ctypes_rejects_unsupported_dtype(rng):
+    a = rng.standard_normal((4, 4)).astype(np.float16)
+    with pytest.raises(TypeError, match="float32/float64"):
+        native_gemm.gemm_ctypes(a, a)
+
+
+def test_ffi_under_jit(rng):
+    import jax
+
+    a = rng.standard_normal((24, 40))
+    b = rng.standard_normal((40, 16))
+    c = jax.jit(native_gemm.gemm_native)(a, b)
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-13)
+
+
+def test_registry_has_native():
+    from matvec_mpi_multiplier_tpu.ops import (
+        available_gemm_kernels,
+        get_gemm_kernel,
+    )
+
+    assert "native" in available_gemm_kernels()
+    assert get_gemm_kernel("native") is native_gemm.gemm_native
+
+
+@pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise"])
+def test_gemm_strategies_with_native_kernel(devices, rng, name):
+    from matvec_mpi_multiplier_tpu import make_mesh
+    from matvec_mpi_multiplier_tpu.models.gemm import build_gemm
+
+    a = rng.standard_normal((16, 32))
+    b = rng.standard_normal((32, 8))
+    c = build_gemm(name, make_mesh(8), kernel="native")(a, b)
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-12)
