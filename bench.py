@@ -180,8 +180,14 @@ def main() -> int:
     # value-independent.
     @jax.jit
     def gen():
-        ia = jax.lax.iota(jnp.int32, size * size).reshape(size, size)
-        a = (ia % 1024).astype(dtype) * (10.0 / 1024.0)
+        # 2-D broadcasted iotas, NOT a flat iota of size*size elements: at
+        # the 65536^2 north-star config a 1-D int32 iota has 4.3e9 > 2^31
+        # elements (index overflow) and would be a 17 GB intermediate if
+        # XLA ever materialized it; the broadcasted form keeps every value
+        # <= 2*size and fuses into the bf16 output write.
+        ir = jax.lax.broadcasted_iota(jnp.int32, (size, size), 0)
+        ic = jax.lax.broadcasted_iota(jnp.int32, (size, size), 1)
+        a = ((ir + ic) % 1024).astype(dtype) * (10.0 / 1024.0)
         ix = jax.lax.iota(jnp.int32, size)
         x = (ix % 1024).astype(dtype) * (10.0 / 1024.0)
         return (
