@@ -178,6 +178,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-csv", action="store_true", help="print results without writing CSVs"
     )
     p.add_argument(
+        "--label-suffix",
+        default=None,
+        metavar="SUFFIX",
+        help="append _SUFFIX to the strategy name in CSV rows (e.g. "
+        "--kernel native --label-suffix native lands rows as "
+        "rowwise_native.csv) — the reference schema has no kernel column, "
+        "and unlabeled kernel-variant rows would contaminate per-strategy "
+        "SpeedUp/Efficiency averaging",
+    )
+    p.add_argument(
         "--keep-going",
         action="store_true",
         help="on a runtime/backend error in one config (e.g. a transient "
@@ -365,6 +375,13 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                         )
                         counters[2] += 1
                         continue
+                    if args.label_suffix:
+                        import dataclasses
+
+                        result = dataclasses.replace(
+                            result,
+                            strategy=f"{result.strategy}_{args.label_suffix}",
+                        )
                     if not args.no_csv:
                         append_result(result, args.data_root)
                     print(

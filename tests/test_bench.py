@@ -262,6 +262,20 @@ def test_sweep_cli_keep_going_survives_backend_errors(
         sweep_main(args)
 
 
+def test_sweep_cli_label_suffix(devices, tmp_path, monkeypatch):
+    """Kernel-variant rows land under a suffixed strategy name so they never
+    blend into the plain per-strategy SpeedUp/Efficiency averaging."""
+    monkeypatch.setenv("MATVEC_DATA_DIR", str(tmp_path))
+    rc = sweep_main([
+        "--strategy", "rowwise", "--devices", "2", "--sizes", "16",
+        "--n-reps", "2", "--dtype", "float64", "--label-suffix", "variant",
+    ])
+    assert rc == 0
+    rows = read_csv(csv_path("rowwise_variant", tmp_path))
+    assert rows[0]["n_rows"] == 16
+    assert not csv_path("rowwise", tmp_path).exists()
+
+
 def test_sweep_cli_skips_indivisible(devices, tmp_path, capsys):
     rc = sweep_main([
         "--strategy", "rowwise", "--devices", "8", "--sizes", "12",
