@@ -32,13 +32,12 @@ def test_stale_library_is_rebuilt():
     missing its symbols forever)."""
     import os
     import shutil as sh
-    import time
 
     if sh.which("make") is None or sh.which("g++") is None:
         pytest.skip("no C++ toolchain on this host")
     assert ensure_built()
     lib = lib_path()
-    old = time.time() - 3600
+    old = 1.0  # epoch: unconditionally older than every source file
     os.utime(lib, (old, old))  # pretend the build predates the sources
     before = lib.stat().st_mtime
     assert ensure_built()
