@@ -249,13 +249,13 @@ def configure_platform(platform: str | None, host_devices: int | None) -> None:
 
 
 def run_sweep(args: argparse.Namespace) -> int:
-    if args.measure == "chain" and args.mode in ("reference", "both"):
+    if args.measure in ("chain", "loop") and args.mode in ("reference", "both"):
         # Reject up front: time_matvec raises the same ConfigError, but only
         # deep inside the loop, after earlier configs already burned minutes.
         raise SystemExit(
-            "--measure chain cannot time --mode reference (the per-rep "
-            "host->device transfer cannot ride a fenced execution chain); "
-            "use --measure sync or auto"
+            f"--measure {args.measure} cannot time --mode reference (the "
+            "per-rep host->device transfer cannot ride a device-side "
+            "execution chain); use --measure sync or auto"
         )
     if args.op == "gemm" and args.use_files:
         raise SystemExit(
@@ -305,6 +305,8 @@ def run_sweep(args: argparse.Namespace) -> int:
     if not args.no_csv:
         for name in strategies:
             csv_name = f"gemm_{name}" if args.op == "gemm" else name
+            if args.label_suffix:
+                csv_name = f"{csv_name}_{args.label_suffix}"
             for mode in modes:
                 print(f"CSV: {csv_path(csv_name, args.data_root, mode=mode)}")
     if args.profile_dir is not None:

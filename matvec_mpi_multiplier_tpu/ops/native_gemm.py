@@ -22,7 +22,7 @@ from jax import Array
 from .gemm_kernels import register_gemm_kernel
 from .native_gemv import _lib_path
 
-_GEMM_ARGTYPES_SET = False
+_GEMM_ARGTYPES_SET = None  # the CDLL the argtypes were declared on
 _FFI_TARGETS_REGISTERED = False
 
 
@@ -38,12 +38,14 @@ def _load() -> ctypes.CDLL | None:
         # A stale .so from before the GEMM kernel existed: treat the GEMM
         # tier as unavailable rather than crash at first call.
         return None
-    if not _GEMM_ARGTYPES_SET:
+    # Keyed to the CDLL instance (see native_gemv._load): a mid-process
+    # rebuild swaps the handle and the fresh one needs declarations.
+    if _GEMM_ARGTYPES_SET is not lib:
         from ..utils.native_lib import declare_ctypes_sig
 
         declare_ctypes_sig(lib, "matvec_gemm_f32", ctypes.c_float, 3, 3)
         declare_ctypes_sig(lib, "matvec_gemm_f64", ctypes.c_double, 3, 3)
-        _GEMM_ARGTYPES_SET = True
+        _GEMM_ARGTYPES_SET = lib
     return lib
 
 

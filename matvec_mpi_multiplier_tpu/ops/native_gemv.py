@@ -28,7 +28,7 @@ from jax import Array
 from .gemv import register_kernel
 
 _FFI_TARGETS_REGISTERED = False
-_GEMV_ARGTYPES_SET = False
+_GEMV_ARGTYPES_SET = None  # the CDLL the argtypes were declared on
 
 
 def _lib_path() -> Path:
@@ -45,12 +45,15 @@ def _load() -> ctypes.CDLL | None:
     lib = load_library()
     if lib is None:
         return None
-    if not _GEMV_ARGTYPES_SET:
+    # Keyed to the CDLL instance, not a once-only boolean: ensure_built can
+    # rebuild and swap the library mid-process, and the fresh handle needs
+    # its own argtype declarations.
+    if _GEMV_ARGTYPES_SET is not lib:
         from ..utils.native_lib import declare_ctypes_sig
 
         declare_ctypes_sig(lib, "matvec_gemv_f32", ctypes.c_float, 3, 2)
         declare_ctypes_sig(lib, "matvec_gemv_f64", ctypes.c_double, 3, 2)
-        _GEMV_ARGTYPES_SET = True
+        _GEMV_ARGTYPES_SET = lib
     return lib
 
 

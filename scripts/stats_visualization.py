@@ -63,7 +63,15 @@ def _ext_lookups(
                 n_rhs_l.setdefault(r["strategy"], {})[key] = n_rhs
             isz = _ITEMSIZE.get(str(r.get("dtype", "")))
             if isz is not None:
-                item_l.setdefault(r["strategy"], {})[key] = isz
+                per = item_l.setdefault(r["strategy"], {})
+                # Same (size, p) swept at two dtypes: the averaged row has
+                # no single true itemsize — mark ambiguous (None) so the
+                # table falls back to the explicit --itemsize rather than
+                # silently taking whichever row came last.
+                per[key] = isz if per.get(key, isz) == isz else None
+    for per in item_l.values():
+        for key in [k for k, v in per.items() if v is None]:
+            del per[key]
     return n_rhs_l, item_l
 
 

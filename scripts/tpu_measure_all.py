@@ -127,7 +127,10 @@ def main(argv=None) -> int:
             rc |= run(sweep + ["--op", "gemm", "--strategy", "blockwise",
                                "--sizes", "8192", "--dtype", "bfloat16",
                                "--kernel", "pallas", "--measure", "loop",
-                               "--n-reps", "20"])
+                               "--n-reps", "20",
+                               # Own label: unlabeled pallas rows would be
+                               # averaged with the xla rows at the same key.
+                               "--label-suffix", "pallas"])
         if "overlap" not in args.skip:
             # Real-backend overlap evidence: async collective-permute
             # start/done pairs in the compiled module + TPU timings

@@ -35,7 +35,11 @@ def test_a2a_psum_scatter_matches_lax(devices, rng, p):
 
     ours = run(lambda x: a2a_psum_scatter(x[0], "r"))
     theirs = run(lambda x: jax.lax.psum_scatter(x[0], "r", tiled=True))
-    np.testing.assert_array_equal(np.asarray(ours), np.asarray(theirs))
+    # Tolerance, not bitwise: psum_scatter's reduction order is a backend/
+    # version choice the a2a decomposition need not reproduce.
+    np.testing.assert_allclose(
+        np.asarray(ours), np.asarray(theirs), rtol=1e-13
+    )
 
 
 @pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
