@@ -31,7 +31,7 @@ from .gemv import gemv_xla, register_kernel
 
 # Default tile sizes: bm rows of A per grid step, bk contraction elements.
 # (8, 128) is the fp32 min tile. (512, 4096) measured best on v5e at
-# 32768² bf16 — sustained ~750-780 GB/s (92-95% of HBM peak, vs ~10% lower
+# 32768² bf16 — sustained ~750 GB/s (~92% of HBM peak, vs ~10% lower
 # for the pre-tuning (256, 1024) tiles and for the XLA dot) — the 4 MB bf16
 # A-tile (8 MB double-buffered) keeps the HBM stream long while fitting
 # comfortably in VMEM. Smaller shapes degrade gracefully via
