@@ -39,7 +39,7 @@ from ..models import available_strategies, get_strategy
 from ..models.gemm import available_gemm_strategies, validate_gemm
 from ..parallel.mesh import make_mesh
 from ..utils import io
-from ..utils.errors import MatvecError
+from ..utils.errors import MatvecError, TimingError
 from .metrics import append_result, csv_path
 from .profiling import annotate, trace
 from .timing import (
@@ -363,6 +363,17 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                                 result = benchmark_strategy(
                                     strat, mesh, a, x, **bench_kwargs
                                 )
+                    except TimingError as e:
+                        # Measurement failure (jitter beat the signal), not a
+                        # config bug: skippable like any transient backend
+                        # fault so a long capture survives a noisy window.
+                        if not args.keep_going:
+                            raise
+                        print(
+                            f"UNMEASURABLE {label}: {e}", file=sys.stderr
+                        )
+                        counters[2] += 1
+                        continue
                     except MatvecError:
                         raise  # config bugs must fail loudly, flag or not
                     except Exception as e:

@@ -67,7 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.constants import DEFAULT_N_REPS
-from ..utils.errors import ConfigError
+from ..utils.errors import ConfigError, TimingError
 
 TIMING_MODES = ("amortized", "reference")
 MEASURE_METHODS = ("auto", "loop", "chain", "sync")
@@ -154,20 +154,87 @@ def _build_looped(fn: Callable) -> Callable:
     timing sample and the device executes ``k`` back-to-back ops.
 
     The carry threads the right-hand side through every iteration with a
-    runtime-zero bump, ``carry + eps * sum(out)``: ``eps`` is a traced
+    runtime-zero bump, ``carry + eps * sum(out * out)``: ``eps`` is a traced
     runtime scalar (not a compile-time constant), so XLA cannot fold the
-    bump away, dead-code-eliminate the op, or hoist it out of the loop —
-    while at runtime ``eps = 0`` leaves the operand bit-identical every rep.
+    bump away or dead-code-eliminate the op — while at runtime ``eps = 0``
+    leaves the operand bit-identical every rep.
+
+    The bump must be NONLINEAR in ``out``. A linear reduction like
+    ``sum(out)`` is algebraically transparent: ``sum(A @ x)`` equals
+    ``dot(colsum(A), x)``, and ``colsum(A)`` is loop-invariant, so XLA's
+    simplifier + loop-invariant code motion turn every iteration into an
+    O(n) vector dot — the loop then "measures" a matvec without ever
+    re-reading the matrix (this produced fp32 rows at 2x the HBM peak).
+    ``sum(out**2)`` (= x'A'Ax) admits no such factoring short of forming
+    A'A, which XLA will not do, so every iteration must materialize ``out``
+    and therefore stream the full matrix. The square is computed in at least
+    float32 (never demoting fp64) to keep the (runtime-dead) bump value
+    finite in low-precision dtypes.
     """
 
     def chained(a, rhs, k, eps):
         def body(_, carry):
             out = fn(a, carry)
-            return carry + (eps * jnp.sum(out)).astype(carry.dtype)
+            acc = jnp.promote_types(out.dtype, jnp.float32)
+            bump = eps * jnp.sum(jnp.square(out.astype(acc)))
+            return carry + bump.astype(carry.dtype)
 
         return jax.lax.fori_loop(0, k, body, rhs)
 
     return jax.jit(chained)
+
+
+# Bounds for the adaptive rep-spread growth in _loop_slope. The tunneled
+# backend's per-dispatch overhead is tens of milliseconds with multi-
+# millisecond jitter; a slope over a spread whose device time is smaller than
+# that jitter measures noise, not the kernel (the round-1/2 physically
+# impossible CSV rows — e.g. fp32 matvec "bandwidths" 2x the HBM peak — were
+# exactly this). The spread therefore grows until the endpoint-time delta
+# dominates the measured dispatch overhead.
+_LOOP_REP_CAP = 1_000_000
+_LOOP_MAX_RUN_S = 2.0
+_LOOP_TARGET_FLOOR_S = 0.005
+_LOOP_JITTER_FACTOR = 3.0
+
+
+def _min2(run: Callable[[int], float], k: int) -> float:
+    """Min of two runs at ``k`` — min filters dispatch-latency spikes, the
+    dominant noise over a tunneled backend.
+
+    Both runs are always taken, even when the first already exceeds the
+    growth cap: a single dispatch spike masquerading as a heavy run would
+    otherwise halt ``_grow_spread`` at a jitter-dominated spread (the
+    garbage-CSV failure mode). The repeat is bounded — for a genuinely heavy
+    kernel it doubles only the one probe at which growth stops anyway."""
+    return min(run(k), run(k))
+
+
+def _grow_spread(
+    run: Callable[[int], float], n1: int, delta: int, *,
+    target_delta_s: float, rep_cap: int = _LOOP_REP_CAP,
+    max_run_s: float = _LOOP_MAX_RUN_S,
+) -> tuple[int, float, float]:
+    """Widen the rep spread until the timing signal beats dispatch jitter.
+
+    Returns ``(delta, t1, t2)`` — the chosen spread plus the min-of-2 endpoint
+    times measured at it (reusable as the first slope sample). Growth is
+    driven by *measured* run times, never by an extrapolated per-rep estimate,
+    so a misestimate can never request an unboundedly long run: expansion
+    stops as soon as the endpoint delta reaches ``target_delta_s``, a single
+    run reaches ``max_run_s``, or the spread reaches ``rep_cap``.
+
+    Each endpoint is unconditionally the min of two runs (``_min2``): a lone
+    dispatch spike must never be able to satisfy the ``max_run_s`` stop
+    condition and halt growth at a jitter-dominated spread.
+    """
+    if delta <= 0:
+        raise ConfigError(f"rep spread must be positive, got {delta}")
+    t1 = _min2(run, n1)
+    while True:
+        t2 = _min2(run, n1 + delta)
+        if t2 - t1 >= target_delta_s or t2 >= max_run_s or delta >= rep_cap:
+            return delta, t1, t2
+        delta = min(delta * 4, rep_cap)
 
 
 def _loop_slope(
@@ -177,6 +244,16 @@ def _loop_slope(
     """Per-execution time as the slope between device-looped runs of n1 and
     n2 reps (one dispatch each); the single dispatch+fence overhead cancels
     in the difference just as in :func:`_chain_slope`.
+
+    The requested spread ``n2 - n1`` is a lower bound: it is adaptively
+    widened (``_grow_spread``) until the endpoint-time difference is at least
+    ``_LOOP_JITTER_FACTOR`` x the post-compile dispatch overhead (floored at
+    ``_LOOP_TARGET_FLOOR_S``), and each endpoint is the min of two runs —
+    otherwise, over a high-latency tunnel, the slope measures dispatch jitter
+    rather than the kernel. The overhead is *measured* (a post-compile k=1
+    run), so the same code self-calibrates on fast local backends (sub-ms
+    dispatch → small spreads) and the tunneled TPU (~70 ms dispatch → spreads
+    sized to drown it).
 
     ``warmup``: extra fenced n1-length runs after the compile — a cold
     process under-reports bandwidth on its first runs (clock ramp / cold
@@ -190,16 +267,40 @@ def _loop_slope(
         start = time.perf_counter()
         y = chained(a_dev, rhs_dev, jnp.asarray(k, jnp.int32), eps)
         _fence(y)
-        return time.perf_counter() - start
+        # Max-reduce at the SOURCE, not just on the final estimates: every
+        # control-flow decision below (growth stops, the TimingError raise)
+        # must be identical on every process, or a multi-host run would
+        # issue divergent dispatch counts of the same sharded program and
+        # deadlock. Max across processes is also the reference's per-run
+        # semantics (MPI_Reduce(MPI_MAX), src/multiplier_rowwise.c:147).
+        # Single-process (the common case) returns the local value untouched.
+        return _max_across_processes(time.perf_counter() - start)
 
     run(1)  # compile (k is traced: one compile covers every k)
+    t_dispatch = _min2(run, 1)  # ~pure dispatch+fence
     for _ in range(max(0, warmup)):
         run(n1)
-    estimates = []
-    for _ in range(samples):
-        t1 = run(n1)
-        t2 = run(n2)
-        estimates.append(max((t2 - t1) / (n2 - n1), 1e-9))
+    target = max(_LOOP_TARGET_FLOOR_S, _LOOP_JITTER_FACTOR * t_dispatch)
+    delta, t1, t2 = _grow_spread(run, n1, n2 - n1, target_delta_s=target)
+    n2 = n1 + delta
+    estimates = [(t2 - t1) / delta]
+    while len(estimates) < samples:
+        t1 = _min2(run, n1)
+        t2 = _min2(run, n2)
+        estimates.append((t2 - t1) / delta)
+    # No clamping: a non-positive slope means jitter beat the signal — a
+    # clamped value would reach the CSV as an absurd-but-finite row (the
+    # round-1/2 failure mode). Individual negative samples are tolerated as
+    # visible noise, but a non-positive MEDIAN is a failed measurement.
+    if float(np.median(estimates)) <= 0.0:
+        raise TimingError(
+            f"device-looped slope not measurable: median of {samples} "
+            f"samples at spread {delta} reps is <= 0 against a "
+            f"{t_dispatch * 1e3:.1f} ms dispatch overhead — the backend is "
+            "too noisy at this spread (growth stops at "
+            f"{_LOOP_MAX_RUN_S:.0f} s/run or {_LOOP_REP_CAP} reps); retry "
+            "when the backend is quieter"
+        )
     return estimates
 
 
@@ -214,10 +315,11 @@ def time_fn_looped(
     the estimate. Used by bench.py with device-side operand generation."""
     a_dev, rhs_dev = args
     n1 = max(1, n_reps // 10)
-    per = _loop_slope(
+    # Estimates are already max-reduced across processes at the source
+    # (inside _loop_slope's run), so no re-reduction here.
+    return _loop_slope(
         fn, a_dev, rhs_dev, n1, n1 + n_reps, samples, warmup=warmup
     )
-    return [_max_across_processes(t) for t in per]
 
 
 def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int) -> list[float]:
@@ -231,15 +333,27 @@ def _chain_slope(run_once: Callable[[], object], n1: int, n2: int, samples: int)
         for _ in range(n):
             y = run_once()
         _fence(y)
-        return time.perf_counter() - start
+        # Max-reduced at the source so the TimingError decision below is
+        # identical on every process (see the matching comment in
+        # _loop_slope; a divergent raise would strand the other processes
+        # in their next collective).
+        return _max_across_processes(time.perf_counter() - start)
 
     estimates = []
     for _ in range(samples):
         t1 = chain(n1)
         t2 = chain(n2)
-        # Clamp: host-timer noise can make t2 < t1 for sub-microsecond
-        # kernels; keep estimates positive so derived GB/s stays finite.
-        estimates.append(max((t2 - t1) / (n2 - n1), 1e-9))
+        estimates.append((t2 - t1) / (n2 - n1))
+    # Same doctrine as _loop_slope: host-timer noise can drive individual
+    # slopes negative (tolerated, visible), but a non-positive MEDIAN means
+    # the chain spread carries no signal — raise rather than clamp to a
+    # value that would reach the CSV as an absurd-but-finite row.
+    if float(np.median(estimates)) <= 0.0:
+        raise TimingError(
+            f"chain slope not measurable: median of {samples} samples over "
+            f"a {n2 - n1}-rep spread is <= 0 — the kernel is too fast for "
+            "host-driven chaining here; use measure='loop'"
+        )
     return estimates
 
 
@@ -260,10 +374,9 @@ def time_fn_chained(
         y = fn(*args)
     _fence(y)
     n1 = max(1, n_reps // 10)
-    return [
-        _max_across_processes(t)
-        for t in _chain_slope(lambda: fn(*args), n1, n1 + n_reps, samples)
-    ]
+    # Estimates are already max-reduced across processes at the source
+    # (inside _chain_slope's chain), so no re-reduction here.
+    return _chain_slope(lambda: fn(*args), n1, n1 + n_reps, samples)
 
 
 def resolve_measure(mode: str, measure: str) -> str:
@@ -307,6 +420,8 @@ def time_matvec(
     (see module docstring for the two measurement methods).
     """
     measure = resolve_measure(mode, measure)
+    if n_reps < 1:
+        raise ConfigError(f"n_reps must be >= 1, got {n_reps}")
     sh_a, sh_x = shardings if shardings is not None else (None, None)
 
     def place(arr, sh):
@@ -323,11 +438,11 @@ def time_matvec(
     if mode == "amortized" and measure in ("chain", "loop"):
         n1 = max(1, n_reps // 10)
         n2 = n1 + n_reps
+        # Slope estimates are max-reduced across processes at the source
+        # (inside _loop_slope/_chain_slope), so no re-reduction here.
         if measure == "loop":
-            per = _loop_slope(fn, a_dev, x_dev, n1, n2, chain_samples)
-        else:
-            per = _chain_slope(lambda: fn(a_dev, x_dev), n1, n2, chain_samples)
-        return [_max_across_processes(t) for t in per]
+            return _loop_slope(fn, a_dev, x_dev, n1, n2, chain_samples)
+        return _chain_slope(lambda: fn(a_dev, x_dev), n1, n2, chain_samples)
 
     times: list[float] = []
     for _ in range(n_reps):

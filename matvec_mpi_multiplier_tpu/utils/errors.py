@@ -40,6 +40,18 @@ class ConfigError(MatvecError):
     """Invalid benchmark / sweep configuration."""
 
 
+class TimingError(MatvecError):
+    """A timing measurement failed to produce a usable number.
+
+    Raised instead of emitting a clamped/garbage value: a benchmark row that
+    cannot be measured must be absent (and the sweep's ``--keep-going`` can
+    skip it), never present-but-wrong. The reference has no analog — its
+    timing loop cannot fail — but its committed CSVs are the contract this
+    protects: every row in ``data/out/*.csv`` is a real measurement
+    (``src/multiplier_rowwise.c:135-151``).
+    """
+
+
 def check_divisible(value: int, divisor: int, what: str, by_what: str) -> None:
     """Raise ShardingError unless ``value % divisor == 0``.
 

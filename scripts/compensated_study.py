@@ -86,6 +86,7 @@ def main(argv=None) -> int:
     from matvec_mpi_multiplier_tpu.bench.metrics import append_result
     from matvec_mpi_multiplier_tpu.bench.timing import benchmark_strategy
     from matvec_mpi_multiplier_tpu.models import get_strategy
+    from matvec_mpi_multiplier_tpu.utils.errors import TimingError
     from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
 
     platform = jax.devices()[0].platform
@@ -116,10 +117,22 @@ def main(argv=None) -> int:
     xb = rng.standard_normal(n).astype(np.float32)
     bw = {}
     for kernel in ("xla", "compensated"):
-        res = benchmark_strategy(
-            strat, mesh, ab, xb, n_reps=args.n_reps, kernel=kernel,
-        )
+        # Retry once, then degrade: a noisy tunnel window must not discard
+        # the accuracy evidence already computed above — the report is
+        # written either way, with the bandwidth cell marked unmeasurable.
+        res = None
+        for attempt in (1, 2):
+            try:
+                res = benchmark_strategy(
+                    strat, mesh, ab, xb, n_reps=args.n_reps, kernel=kernel,
+                )
+                break
+            except TimingError as e:
+                print(f"bandwidth[{kernel}] attempt {attempt}: "
+                      f"UNMEASURABLE ({e})", file=sys.stderr)
         bw[kernel] = res
+        if res is None:
+            continue
         if not args.no_csv:
             # Relabel BOTH rows with the kernel so neither lands in the
             # sweep's plain rowwise.csv (the reference schema carries no
@@ -134,14 +147,18 @@ def main(argv=None) -> int:
         print(f"bandwidth[{kernel}]: {res.mean_time_s*1e3:.3f} ms, "
               f"{res.gbps:.2f} GB/s")
 
-    slowdown = bw["compensated"].mean_time_s / bw["xla"].mean_time_s
+    slowdown = (
+        bw["compensated"].mean_time_s / bw["xla"].mean_time_s
+        if bw["xla"] is not None and bw["compensated"] is not None else None
+    )
+    measure_label = bw["xla"].measure if bw["xla"] is not None else "loop"
     report = [
         "# Compensated (double-float) kernel: measured evidence",
         "",
         f"Backend: **{platform}**, {n_dev}-device mesh; accuracy case "
         f"{args.acc_rows}×{args.acc_cols} fp32 with interleaved ±10⁶..10⁷ "
         "cancellation pairs (true row sums are O(1)); bandwidth at "
-        f"{n}² fp32, measure={bw['xla'].measure}, {args.n_reps} reps "
+        f"{n}² fp32, measure={measure_label}, {args.n_reps} reps "
         "(generated by `scripts/compensated_study.py`).",
         "",
         "| kernel | max rel err vs fp64 oracle | max err (fp32 ulps of "
@@ -150,13 +167,18 @@ def main(argv=None) -> int:
     ]
     for kernel in ("xla", "compensated"):
         r, b = results[kernel], bw[kernel]
+        timing_cells = (
+            f"{b.mean_time_s*1e3:.3f} | {b.gbps:.2f}"
+            if b is not None else "unmeasurable | —"
+        )
         report.append(
-            f"| {kernel} | {r['rel']:.3e} | {r['ulp']:.3g} | "
-            f"{b.mean_time_s*1e3:.3f} | {b.gbps:.2f} |"
+            f"| {kernel} | {r['rel']:.3e} | {r['ulp']:.3g} | {timing_cells} |"
         )
     report += [
         "",
-        f"Compensated/xla slowdown at {n}²: **{slowdown:.1f}×**.",
+        (f"Compensated/xla slowdown at {n}²: **{slowdown:.1f}×**."
+         if slowdown is not None else
+         f"Compensated/xla slowdown at {n}²: unmeasurable this window."),
         "",
         "The cancellation case is the reference-parity stress test: the "
         "reference accumulates in C `double` where this case is exact to "
