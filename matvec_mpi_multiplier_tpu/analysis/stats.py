@@ -123,17 +123,27 @@ def best_point(points: list[ScalingPoint], n_rows: int, n_cols: int) -> ScalingP
     return min(cands, key=lambda p: p.time_s)
 
 
+# Per-chip VMEM capacity (TPU v5e: 128 MiB). An operand set at or under this
+# can be served from on-chip memory across a device-side rep loop, so its
+# effective GB/s is not an HBM fraction — the roofline column flags it.
+VMEM_BYTES = 128 * 1024 * 1024
+
+
 def format_table(
     points: list[ScalingPoint],
     itemsize: int = 8,
     hbm_peak_gbps: float | None = None,
     mxu_peak_tflops: float | None = None,
+    vmem_bytes: int = VMEM_BYTES,
 ) -> str:
     """Markdown table in the BASELINE.md column layout.
 
     ``hbm_peak_gbps`` adds the roofline column (%-of-HBM-peak, the
     BASELINE.json north-star metric): aggregate peak = per-chip peak × p,
-    e.g. 819 for TPU v5e, 1229 for v4.
+    e.g. 819 for TPU v5e, 1229 for v4. Rows whose matrix fits in per-chip
+    VMEM (``vmem_bytes``) are marked ``(VMEM)``: on-chip residency across
+    the rep loop can legitimately push effective bandwidth past the HBM
+    roofline, so their percentage is not an HBM fraction.
 
     ``mxu_peak_tflops`` adds the MFU column (%-of-MXU-peak — the
     compute-roofline analog for GEMM rows, where the MXU, not HBM, is the
@@ -161,7 +171,15 @@ def format_table(
         )
         if roofline:
             pct = 100.0 * p.gbps(itemsize) / (hbm_peak_gbps * p.n_processes)
-            row += f" {pct:.1f} |"
+            # Same per-point itemsize override the gbps above honors, so a
+            # bf16 row in an fp32-default table is classified by its real
+            # footprint.
+            per_chip_bytes = (
+                (p.itemsize or itemsize) * p.n_rows * p.n_cols
+                / max(1, p.n_processes)
+            )
+            mark = " (VMEM)" if per_chip_bytes <= vmem_bytes else ""
+            row += f" {pct:.1f}{mark} |"
         if mfu:
             pct = 100.0 * p.gflops() / (mxu_peak_tflops * 1e3 * p.n_processes)
             row += f" {pct:.1f} |"

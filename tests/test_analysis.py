@@ -163,10 +163,30 @@ def test_format_table_roofline_column():
     )
     out = format_table([pt], itemsize=4, hbm_peak_gbps=819.0)
     assert "% HBM peak" in out
-    # gbps = 4*(1e6+2e3)/1e-3/1e9 ~ 4.008; pct = 100*4.008/(819*2) ~ 0.245
-    assert "| 0.2 |" in out
+    # gbps = 4*(1e6+2e3)/1e-3/1e9 ~ 4.008; pct = 100*4.008/(819*2) ~ 0.245.
+    # A 4 MB matrix fits in VMEM, so the cell carries the (VMEM) regime
+    # marker: on-chip residency means the number is not an HBM fraction.
+    assert "| 0.2 (VMEM) |" in out
     # Without the argument the column is absent (backward compatible).
     assert "% HBM peak" not in format_table([pt], itemsize=4)
+
+    big = ScalingPoint(
+        n_rows=16384, n_cols=16384, n_processes=1, time_s=0.0015,
+        speedup=None, efficiency=None, strategy="blockwise",
+    )
+    # 16384^2 fp32 = 1 GiB per chip: HBM-resident, no marker.
+    out_big = format_table([big], itemsize=4, hbm_peak_gbps=819.0)
+    assert "(VMEM)" not in out_big
+    assert "% HBM peak" in out_big
+
+    # Residency classification honors the per-point itemsize override, like
+    # the bandwidth it annotates: 8192^2 bf16 = 128 MiB fits in VMEM even
+    # when the table default is fp32 (which would compute 256 MiB).
+    bf16 = ScalingPoint(
+        n_rows=8192, n_cols=8192, n_processes=1, time_s=0.001,
+        speedup=None, efficiency=None, strategy="blockwise", itemsize=2,
+    )
+    assert "(VMEM)" in format_table([bf16], itemsize=4, hbm_peak_gbps=819.0)
 
 
 def test_per_point_itemsize_overrides_table_default():
