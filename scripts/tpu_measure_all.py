@@ -3,27 +3,32 @@
 
 The tunneled TPU in this environment wedges unpredictably (see bench.py's
 probe guard), so when it IS healthy every pending measurement should be
-captured in one pass, cheapest-first, each stage flushing its results to
-disk before the next starts — a wedge mid-run then loses only the stages
-after it. Stages:
+captured in one pass, highest-leverage-first, each stage flushing its
+results to disk before the next starts — a wedge mid-run then loses only
+the stages after it, and the stages it can least afford to lose ran first.
+Stages:
 
 1. probe      — subprocess jax.devices() check (abort early if wedged);
 2. headline   — bench.py's blockwise bf16 bandwidth (prints the JSON line);
-3. sweeps     — square + asymmetric fp32 sweeps, median-of-5 device-looped
+3. baseline   — 65536^2 bf16 blockwise (BASELINE.json's north-star config;
+                8.6 GB of operands, generated on device). Runs IMMEDIATELY
+                after the headline: it is the single highest-leverage
+                artifact, and a capture that wedges mid-sweep must not
+                lose it again (that is how round 3's first attempt died);
+4. sweeps     — square + asymmetric fp32 sweeps, median-of-5 device-looped
                 slopes (--measure loop: the rep loop is a fori_loop on
-                device, so per-dispatch tunnel overhead never touches the
-                number), replacing the round-1 noise-dominated rows;
-4. hostlink   — link model + derived reference-mode rows (the wedge-safe
+                device with a jitter-calibrated spread, so per-dispatch
+                tunnel overhead never touches the number), replacing the
+                round-1 noise-dominated rows;
+5. hostlink   — link model + derived reference-mode rows (the wedge-safe
                 Q5 substitute; never does per-rep transfers);
-5. gemm       — MXU-bound GEMM numbers (8192^2 bf16 xla + pallas tiers);
-6. overlap    — scripts/overlap_study.py on the real backend (async
+6. gemm       — MXU-bound GEMM numbers (8192^2 bf16 xla + pallas tiers);
+7. overlap    — scripts/overlap_study.py on the real backend (async
                 collective-permute pair evidence; self-skips at p=1);
-7. compensated— scripts/compensated_study.py on the chip (accuracy vs the
+8. compensated— scripts/compensated_study.py on the chip (accuracy vs the
                 fp64 oracle + bandwidth rows);
-8. autotune   — scripts/autotune_pallas.py (bm, bk) tile search at the
+9. autotune   — scripts/autotune_pallas.py (bm, bk) tile search at the
                 headline size vs the committed defaults;
-9. baseline   — 65536^2 bf16 blockwise (BASELINE.json's north-star config;
-                8.6 GB of operands, generated on device);
 10. figures   — regenerate figures/tpu with HBM-roofline and MFU columns.
 
 Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
@@ -109,14 +114,24 @@ def main(argv=None) -> int:
     try:
         if "headline" not in args.skip:
             rc |= run([py, "bench.py"])
+        if "baseline" not in args.skip:
+            # North-star first (after the cheap headline): the one artifact
+            # a mid-capture wedge must never cost again.
+            rc |= _baseline_stage(py)
         sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
                  "--data-root", args.data_root, "--keep-going"]
         if "sweeps" not in args.skip:
             if args.wipe_stale_csvs:
                 _wipe_stale_csvs(Path(args.data_root) / "out")
-            rc |= run(sweep + ["--strategy", "all", "--sweep", "both",
-                               "--dtype", "float32", "--measure", "loop",
-                               "--chain-samples", "5", "--n-reps", "50"])
+            # One invocation per sweep kind, each with its own stage budget:
+            # the jitter-calibrated spreads make a combined square+asymmetric
+            # run (~114 configs incl. compiles) brush the per-stage timeout,
+            # and a timeout would abort every later stage.
+            for sweep_kind in ("square", "asymmetric"):
+                rc |= run(sweep + ["--strategy", "all",
+                                   "--sweep", sweep_kind,
+                                   "--dtype", "float32", "--measure", "loop",
+                                   "--chain-samples", "5", "--n-reps", "50"])
         if "hostlink" not in args.skip:
             rc |= run([py, "scripts/hostlink_study.py",
                        "--data-root", args.data_root, "--max-mb", "256"])
@@ -145,8 +160,6 @@ def main(argv=None) -> int:
             # Pallas tile search at the headline size: if a tile beats the
             # committed (512, 4096) defaults the report says which.
             rc |= run([py, "scripts/autotune_pallas.py"])
-        if "baseline" not in args.skip:
-            rc |= _baseline_stage(py)
         if "figures" not in args.skip:
             rc |= run([py, "scripts/stats_visualization.py",
                        "--data-out", str(Path(args.data_root) / "out"),

@@ -106,9 +106,13 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
     monkeypatch.setattr(
         tpu_measure_all, "run", lambda cmd: calls.append(cmd) or 0
     )
-    rc = tpu_measure_all.main(
-        ["--data-root", "x", "--skip", "baseline"]  # baseline spawns directly
+    # _baseline_stage spawns its subprocess directly (not via run); stub it
+    # with a marker so its position in the order is still pinned.
+    monkeypatch.setattr(
+        tpu_measure_all, "_baseline_stage",
+        lambda py: calls.append(["BASELINE-STAGE"]) or 0,
     )
+    rc = tpu_measure_all.main(["--data-root", "x"])
     assert rc == 0
     joined = [" ".join(c) for c in calls]
 
@@ -117,12 +121,23 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
         assert hits, f"stage {substr!r} never ran"
         return hits[0]
 
-    # Cheapest-first ORDER is the wedge-safety property: a mid-run wedge
-    # must only lose the expensive later stages.
+    # Highest-leverage-first ORDER is the wedge-safety property: a mid-run
+    # wedge must only lose the later, cheaper-to-lose stages. The 65536^2
+    # north-star runs right after the cheap headline — a wedge mid-sweep
+    # must never cost it again. The square and asymmetric sweeps run as
+    # separate invocations so each gets its own stage budget.
     assert (
-        stage("bench.py") < stage("--sweep both")
+        stage("bench.py") < stage("BASELINE-STAGE")
+        < stage("--sweep square") < stage("--sweep asymmetric")
         < stage("hostlink_study") < stage("--op gemm")
     )
+
+    # --skip must actually suppress a stage (the baseline is 8.6 GB of
+    # operands — a mis-spelled skip key silently running it would be costly).
+    calls.clear()
+    assert tpu_measure_all.main(["--data-root", "x", "--skip", "baseline"]) == 0
+    assert not any("BASELINE-STAGE" in " ".join(c) for c in calls)
+    assert any("--sweep square" in " ".join(c) for c in calls)
 
 
 def test_profiling_trace(devices, tmp_path):
