@@ -1,0 +1,75 @@
+"""Shared skeleton for the Pallas tile autotuners.
+
+Both autotuners (scripts/autotune_pallas.py — HBM-bound GEMV tiles;
+scripts/autotune_pallas_gemm.py — MXU-bound GEMM tiles) share their CLI,
+platform guard, candidate timing, and report-writing logic; this module
+holds it once so a fix to one face (e.g. the platform override or the
+TimingError path) cannot silently drift from the other.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def build_parser(doc: str, *, default_size: int, default_report: str
+                 ) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=doc)
+    p.add_argument("--size", type=int, default=default_size)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--n-reps", type=int, default=20)
+    p.add_argument("--samples", type=int, default=3)
+    p.add_argument("--allow-interpret", action="store_true")
+    p.add_argument("--platform", default=None,
+                   help="jax platform override (e.g. cpu for smoke tests; "
+                   "the env var alone is outranked by the preinstalled "
+                   "accelerator plugin's jax.config pin)")
+    p.add_argument("--report", default=str(REPO / "docs" / default_report))
+    p.add_argument("--no-report", action="store_true")
+    return p
+
+
+def setup_backend(args: argparse.Namespace) -> bool | None:
+    """Apply the platform override and enforce the TPU-only default.
+
+    Returns ``on_tpu``, or None when the script must exit (off-TPU without
+    --allow-interpret: interpret-mode pallas at real sizes would effectively
+    hang).
+    """
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+
+    configure_platform(args.platform, None)
+    from matvec_mpi_multiplier_tpu.ops.pallas_gemv import _on_tpu
+
+    on_tpu = _on_tpu()
+    if not on_tpu and not args.allow_interpret:
+        print("not on TPU (pallas would run in interpret mode); "
+              "pass --allow-interpret --size <small> to smoke-test",
+              file=sys.stderr)
+        return None
+    return on_tpu
+
+
+def measure_median(fn, operands, args: argparse.Namespace) -> float:
+    """Median device-looped slope for one candidate (TimingError propagates
+    to the caller, which records the candidate as unmeasurable/failed)."""
+    import numpy as np
+
+    from matvec_mpi_multiplier_tpu.bench.timing import time_fn_looped
+
+    return float(np.median(time_fn_looped(
+        fn, operands, n_reps=args.n_reps, samples=args.samples,
+    )))
+
+
+def write_report(text: str, args: argparse.Namespace) -> None:
+    print("\n" + text)
+    if not args.no_report:
+        out = Path(args.report)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(text)
+        print(f"wrote {out}")

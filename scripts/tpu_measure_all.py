@@ -29,7 +29,9 @@ Stages:
                 fp64 oracle + bandwidth rows);
 9. autotune   — scripts/autotune_pallas.py (bm, bk) tile search at the
                 headline size vs the committed defaults;
-10. figures   — regenerate figures/tpu with HBM-roofline and MFU columns.
+10. autotune_gemm — scripts/autotune_pallas_gemm.py (bm, bn, bk) search at
+                8192^2 bf16, reported as MFU vs the 197 TFLOP/s MXU peak;
+11. figures   — regenerate figures/tpu with HBM-roofline and MFU columns.
 
 Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
 """
@@ -89,7 +91,8 @@ def main(argv=None) -> int:
     p.add_argument(
         "--skip", nargs="*", default=[],
         choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
-                 "compensated", "autotune", "baseline", "figures"],
+                 "compensated", "autotune", "autotune_gemm", "baseline",
+                 "figures"],
     )
     p.add_argument(
         "--wipe-stale-csvs", action="store_true",
@@ -160,6 +163,9 @@ def main(argv=None) -> int:
             # Pallas tile search at the headline size: if a tile beats the
             # committed (512, 4096) defaults the report says which.
             rc |= run([py, "scripts/autotune_pallas.py"])
+        if "autotune_gemm" not in args.skip:
+            # MXU tile search: the MFU face of the autotune story.
+            rc |= run([py, "scripts/autotune_pallas_gemm.py"])
         if "figures" not in args.skip:
             rc |= run([py, "scripts/stats_visualization.py",
                        "--data-out", str(Path(args.data_root) / "out"),

@@ -140,6 +140,49 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
     assert any("--sweep square" in " ".join(c) for c in calls)
 
 
+def test_autotune_gemv_cli_smoke(monkeypatch, tmp_path):
+    """End-to-end plumbing of the GEMV tile autotuner on the CPU backend:
+    interpret-mode candidates, report generation, winner line."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import autotune_pallas
+
+    monkeypatch.setattr(autotune_pallas, "BMS", (64,))
+    monkeypatch.setattr(autotune_pallas, "BKS", (128,))
+    report = tmp_path / "AUTOTUNE.md"
+    rc = autotune_pallas.main([
+        "--platform", "cpu", "--allow-interpret", "--size", "128",
+        "--n-reps", "1", "--samples", "1", "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    assert "pallas 64x128" in text
+    assert "Best tile" in text
+
+
+def test_autotune_gemm_cli_smoke(monkeypatch, tmp_path):
+    """Same plumbing smoke for the MXU (GEMM) tile autotuner, MFU report."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import autotune_pallas_gemm
+
+    monkeypatch.setattr(autotune_pallas_gemm, "BMS", (128,))
+    monkeypatch.setattr(autotune_pallas_gemm, "BNS", (128,))
+    monkeypatch.setattr(autotune_pallas_gemm, "BKS", (128,))
+    report = tmp_path / "AUTOTUNE_GEMM.md"
+    rc = autotune_pallas_gemm.main([
+        "--platform", "cpu", "--allow-interpret", "--size", "256",
+        "--n-reps", "1", "--samples", "1", "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    assert "pallas 128x128x128" in text
+    assert "MFU" in text
+    assert "Best tile" in text
+
+
 def test_profiling_trace(devices, tmp_path):
     with trace(tmp_path / "prof") as d:
         with annotate("matvec-region"):
