@@ -31,7 +31,10 @@ Stages:
                 headline size vs the committed defaults;
 10. autotune_gemm — scripts/autotune_pallas_gemm.py (bm, bn, bk) search at
                 8192^2 bf16, reported as MFU vs the 197 TFLOP/s MXU peak;
-11. figures   — regenerate figures/tpu with HBM-roofline and MFU columns.
+11. figures   — regenerate figures/tpu with HBM-roofline and MFU columns;
+12. notebook  — re-execute stats_visualization.ipynb in place so its
+                committed outputs match the dataset the capture just wrote
+                (wedge-safe: the notebook reads CSVs, never the chip).
 
 Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
 """
@@ -92,7 +95,7 @@ def main(argv=None) -> int:
         "--skip", nargs="*", default=[],
         choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
                  "compensated", "autotune", "autotune_gemm", "baseline",
-                 "figures"],
+                 "figures", "notebook"],
     )
     p.add_argument(
         "--wipe-stale-csvs", action="store_true",
@@ -171,6 +174,13 @@ def main(argv=None) -> int:
                        "--data-out", str(Path(args.data_root) / "out"),
                        "--fig-dir", "figures/tpu", "--itemsize", "4",
                        "--hbm-peak", "819", "--mxu-peak", "197"])
+        if "notebook" not in args.skip:
+            # Committed notebook outputs must match the dataset just written
+            # (the reference's C13 role). Wedge-safe: reads CSVs only.
+            rc |= run([py, "-m", "jupyter", "nbconvert", "--to", "notebook",
+                       "--execute", "--inplace",
+                       "--ExecutePreprocessor.timeout=600",
+                       "stats_visualization.ipynb"])
     except StageWedged as e:
         print(f"ABORT: {e}", flush=True)
         return 1
