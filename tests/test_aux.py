@@ -112,7 +112,9 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
         tpu_measure_all, "_baseline_stage",
         lambda py: calls.append(["BASELINE-STAGE"]) or 0,
     )
-    rc = tpu_measure_all.main(["--data-root", "x"])
+    # Default data root (all subprocesses are stubbed, nothing touches
+    # data/): the notebook stage only fires for the default root.
+    rc = tpu_measure_all.main([])
     assert rc == 0
     joined = [" ".join(c) for c in calls]
 
@@ -131,6 +133,17 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
         < stage("--sweep square") < stage("--sweep asymmetric")
         < stage("hostlink_study") < stage("--op gemm")
     )
+
+    # The notebook re-execution is LAST (it renders whatever dataset the
+    # earlier stages finished writing)...
+    assert stage("stats_visualization.py") < stage("nbconvert")
+    assert stage("nbconvert") == len(joined) - 1
+    # ...and only runs against the default data root — the notebook reads
+    # the committed data/out, so a custom-root capture must not refresh its
+    # outputs over a dataset it did not read.
+    calls.clear()
+    assert tpu_measure_all.main(["--data-root", "other"]) == 0
+    assert not any("nbconvert" in " ".join(c) for c in calls)
 
     # --skip must actually suppress a stage (the baseline is 8.6 GB of
     # operands — a mis-spelled skip key silently running it would be costly).
