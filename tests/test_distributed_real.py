@@ -101,10 +101,74 @@ print(json.dumps({"idx": idx, "err": err, "n_dev": jax.device_count()}))
 """
 
 
+LOOP_WORKER = """
+import json, os, sys
+
+idx = int(sys.argv[1])
+port = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ""  # 1 local CPU device per process -> 2 global
+
+import jax
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=idx
+)
+
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.bench.timing import benchmark_strategy
+
+mesh = make_mesh(2)
+strat = get_strategy("rowwise")
+rng = np.random.default_rng(7)  # same seed everywhere: same global operands
+a = rng.standard_normal((32, 16))
+x = rng.standard_normal(16)
+res = benchmark_strategy(
+    strat, mesh, a, x, dtype="float64", n_reps=4, measure="loop",
+    chain_samples=2,
+)
+print(json.dumps({
+    "idx": idx, "times": list(res.times_s), "mean": res.mean_time_s,
+}))
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _run_workers(tmp_path, worker_src: str, *extra_argv: str) -> dict:
+    """Launch two coordinated worker processes and return their JSON outputs
+    keyed by process index. Asserts both exit 0."""
+    port = _free_port()
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(worker_src)
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_py), str(i), str(port), *extra_argv],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return {o["idx"]: o for o in outs}
 
 
 def test_two_process_distributed_matvec(tmp_path):
@@ -112,61 +176,28 @@ def test_two_process_distributed_matvec(tmp_path):
     one device each, one global mesh, the rowwise strategy's actual SPMD
     program — the reference's multi-rank execution model
     (``mpiexec -n p``, ``test.sh:11``) run for real, not behind fakes."""
-    port = _free_port()
-    worker_py = tmp_path / "matvec_worker.py"
-    worker_py.write_text(MATVEC_WORKER)
-    env = dict(os.environ, PYTHONPATH=str(REPO))
-
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker_py), str(i), str(port)],
-            cwd=REPO, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=300)
-            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-
-    for o in outs:
+    by_idx = _run_workers(tmp_path, MATVEC_WORKER)
+    for o in by_idx.values():
         assert o["n_dev"] == 2
         assert o["err"] < 1e-12  # fp64 exactness vs the local numpy oracle
 
 
+def test_two_process_loop_measure_lockstep(tmp_path):
+    """The device-looped slope measure across two REAL jax.distributed
+    processes. Every probe time inside ``_loop_slope`` is max-reduced at the
+    source, so both processes make identical spread-growth and TimingError
+    decisions — divergent control flow would dispatch different numbers of
+    the sharded program and deadlock (caught here by the subprocess
+    timeout). Identical per-sample estimates on both sides prove the
+    lockstep held end-to-end."""
+    by_idx = _run_workers(tmp_path, LOOP_WORKER)
+    assert by_idx[0]["times"] == by_idx[1]["times"]
+    assert by_idx[0]["mean"] == by_idx[1]["mean"]
+    assert by_idx[0]["mean"] > 0
+
+
 def test_two_process_max_reduce_and_coordinator_csv(tmp_path):
-    port = _free_port()
-    worker_py = tmp_path / "worker.py"
-    worker_py.write_text(WORKER)
-    env = dict(os.environ, PYTHONPATH=str(REPO))
-
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker_py), str(i), str(port), str(tmp_path)],
-            cwd=REPO, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=300)
-            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-
-    by_idx = {o["idx"]: o for o in outs}
+    by_idx = _run_workers(tmp_path, WORKER, str(tmp_path))
     assert by_idx[0]["process_count"] == 2
     # Both processes must agree on the true (cross-process) max, not their
     # local value — process 0's local 1.5 must have been replaced by 3.5.
