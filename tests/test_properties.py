@@ -45,7 +45,8 @@ def matvec_case(draw, multiple_of=8):
 
 
 @pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise",
-                                  "colwise_ring", "colwise_ring_overlap"])
+                                  "colwise_ring", "colwise_ring_overlap",
+                                  "colwise_a2a"])
 @given(case=matvec_case())
 @settings(**COMMON)
 def test_strategy_matches_oracle(devices, name, case):
@@ -70,7 +71,8 @@ def gemm_case(draw):
 
 
 @pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise",
-                                  "colwise_ring", "colwise_ring_overlap"])
+                                  "colwise_ring", "colwise_ring_overlap",
+                                  "colwise_a2a"])
 @given(case=gemm_case())
 @settings(**COMMON)
 def test_gemm_strategy_matches_oracle(devices, name, case):
