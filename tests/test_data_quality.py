@@ -100,6 +100,31 @@ def test_cpu_mesh_bandwidth_physically_possible():
         )
 
 
+def test_cpu_mesh_rows_monotone_in_size():
+    """The CPU-mesh study's sync-measure rows (its current protocol) must be
+    monotone the same way: within one series, a >=4x-bytes problem may not be
+    reported meaningfully faster. The committed dataset passes with zero
+    violations over ~3200 qualifying pairs."""
+    series: dict[tuple, list] = {}
+    for row in _rows(CPU_EXTENDED):
+        key = (row["strategy"], row["n_devices"], row["dtype"], row["mode"],
+               row["measure"], row["n_rhs"])
+        series.setdefault(key, []).append((_matrix_bytes(row), row["time"]))
+    checked = 0
+    for key, entries in series.items():
+        entries.sort(key=lambda e: (e[0], e[1]))
+        for i, (b1, t1) in enumerate(entries):
+            for b2, t2 in entries[i + 1:]:
+                if b2 >= 4 * b1:
+                    checked += 1
+                    assert t2 >= 0.8 * t1, (
+                        f"non-monotone cpu_mesh rows for {key}: "
+                        f"{b1 / 1e6:.1f} MB at {t1}s vs {b2 / 1e6:.1f} MB "
+                        f"at {t2}s"
+                    )
+    assert checked > 0
+
+
 def test_tpu_loop_rows_monotone_in_size():
     """Within one (strategy, devices, dtype, mode, n_rhs) series measured
     under the current ``loop`` protocol, a problem with >= 4x the operand
