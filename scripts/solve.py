@@ -20,12 +20,6 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--size", nargs=2, type=int, default=[512, 256],
@@ -36,9 +30,24 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ckpt-dir", default=None)
     p.add_argument("--ckpt-every", type=int, default=50)
+    p.add_argument("--platform", default=None,
+                   help="jax platform override (e.g. cpu; the env var alone "
+                   "is outranked by the preinstalled accelerator plugin's "
+                   "jax.config pin)")
+    p.add_argument("--host-devices", type=int, default=None,
+                   help="virtual CPU device count (the mpiexec -n analog)")
     args = p.parse_args(argv)
     if args.ckpt_every < 1:
         p.error("--ckpt-every must be >= 1")
+
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+
+    configure_platform(args.platform, args.host_devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
 
     from matvec_mpi_multiplier_tpu import make_mesh
     from matvec_mpi_multiplier_tpu.models import trainer

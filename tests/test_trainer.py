@@ -51,3 +51,29 @@ def test_single_device_matches_multi(devices, rng):
     _, l1 = trainer.fit(make_mesh(1), a, b, n_steps=20, dtype=jnp.float64)
     _, l8 = trainer.fit(make_mesh(8), a, b, n_steps=20, dtype=jnp.float64)
     np.testing.assert_allclose(l1, l8, rtol=1e-9)
+
+
+def test_solve_cli_end_to_end(devices, tmp_path, monkeypatch, capsys):
+    """The solver CLI (scripts/solve.py): run, checkpoint, resume — the
+    user-facing face of the trainer, exercised in-process on the virtual
+    mesh (--platform cpu is a no-op under the test conftest)."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(
+        str(Path(__file__).parents[1] / "scripts")
+    )
+    import solve
+
+    ck = tmp_path / "ck"
+    args = ["--size", "64", "32", "--steps", "6", "--platform", "cpu",
+            "--ckpt-dir", str(ck), "--ckpt-every", "3"]
+    assert solve.main(args) == 0
+    first = capsys.readouterr().out
+    assert "done: steps=6" in first
+    assert (ck / "step_6").exists()
+
+    # Resume: a longer run picks up from the saved step instead of step 0.
+    assert solve.main(args[:4] + ["10"] + args[5:]) == 0
+    second = capsys.readouterr().out
+    assert "resumed from" in second and "at step 6" in second
+    assert "done: steps=10" in second
