@@ -142,3 +142,68 @@ def plot_comparison(
     comparison figures at the largest sweep size) — the single-run special
     case of :func:`plot_overlay`."""
     return plot_overlay({"": by_strategy}, n_rows, n_cols, out_path)
+
+
+def plot_roofline(
+    by_strategy: dict[str, list[ScalingPoint]],
+    out_path: str | os.PathLike,
+    *,
+    itemsize: int = 4,
+    hbm_peak_gbps: float,
+    vmem_bytes: int | None = None,
+    n_processes: int = 1,
+) -> Path | None:
+    """Effective bandwidth vs per-chip operand bytes, against the HBM roof.
+
+    The memory-side counterpart of the Time/SpeedUp panels: one line per
+    strategy (matvec rows at ``n_processes`` devices), x = per-chip matrix
+    bytes (log), y = effective GB/s, a horizontal line at the per-chip HBM
+    peak, and a vertical band boundary at VMEM capacity — sizes left of it
+    may legitimately sit above the HBM roof via on-chip residency (see
+    ``stats.format_table``'s (VMEM) marker). Returns None when no matvec
+    rows match ``n_processes`` (e.g. an empty or GEMM-only dataset).
+    """
+    from .stats import VMEM_BYTES
+
+    vmem = VMEM_BYTES if vmem_bytes is None else vmem_bytes
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    drew = False
+    for name, points in sorted(by_strategy.items()):
+        rows = sorted(
+            (p for p in points
+             if p.n_rhs == 1 and p.n_processes == n_processes),
+            key=lambda p: p.n_rows * p.n_cols,
+        )
+        xs = [(p.itemsize or itemsize) * p.n_rows * p.n_cols / n_processes
+              for p in rows]
+        ys = [p.gbps(itemsize) for p in rows]
+        if xs:
+            ax.plot(xs, ys, marker="o", ms=3, label=name)
+            drew = True
+    if not drew:
+        plt.close(fig)
+        return None
+    # gbps() is AGGREGATE bandwidth (total bytes / max-across-process time),
+    # so the roof scales with device count — same convention as
+    # stats.format_table's %-of-peak column.
+    roof = hbm_peak_gbps * n_processes
+    ax.axhline(roof, color="k", ls="--", lw=1,
+               label=f"HBM peak ({roof:.0f} GB/s aggregate, p={n_processes})")
+    ax.axvline(vmem, color="gray", ls=":", lw=1,
+               label=f"VMEM capacity ({vmem // (1024 * 1024)} MiB)")
+    ax.set_xscale("log")
+    ax.set_xlabel(f"per-chip matrix bytes (p={n_processes})")
+    ax.set_ylabel("effective GB/s")
+    ax.grid(True, alpha=0.3)
+    ax.legend(fontsize=7)
+    ax.set_title(
+        "Bandwidth roofline (left of VMEM line: on-chip residency)",
+        fontsize=10,
+    )
+    fig.tight_layout()
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path

@@ -73,7 +73,8 @@ def main(argv=None) -> int:
         latest = checkpoint.latest_step_dir(args.ckpt_dir)
         if latest is not None:
             state = checkpoint.restore_state(latest, state)
-            print(f"resumed from {latest} at step {int(state.step)}")
+            if distributed.is_main_process():
+                print(f"resumed from {latest} at step {int(state.step)}")
 
     start = int(state.step)
     loss = None

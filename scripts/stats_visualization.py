@@ -28,6 +28,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from matvec_mpi_multiplier_tpu.analysis.plots import (
     plot_comparison,
     plot_overlay,
+    plot_roofline,
     plot_strategy,
 )
 from matvec_mpi_multiplier_tpu.analysis.stats import format_table, load_strategy_csv
@@ -152,6 +153,17 @@ def main(argv=None) -> int:
         fig = plot_strategy(points, Path(args.fig_dir) / f"{name}.png",
                             title=name)
         print(f"\nfigure: {fig}")
+
+    if args.hbm_peak is not None and by_strategy:
+        # Memory-side roofline: matvec bandwidth vs per-chip operand bytes
+        # against the HBM peak, with the VMEM-residency boundary drawn.
+        fig = plot_roofline(
+            {k: v for k, v in by_strategy.items() if not k.startswith("gemm")},
+            Path(args.fig_dir) / "roofline.png",
+            itemsize=args.itemsize, hbm_peak_gbps=args.hbm_peak,
+        )
+        if fig is not None:
+            print(f"\nroofline figure: {fig}")
 
     if args.overlay:
         runs: dict[str, dict[str, list]] = {}

@@ -154,6 +154,29 @@ def test_plots_render(tmp_path):
     assert f2.exists() and f2.stat().st_size > 1000
 
 
+def test_plot_roofline(tmp_path):
+    from matvec_mpi_multiplier_tpu.analysis.plots import plot_roofline
+
+    by = {"rowwise": load_strategy_csv(f"{REF_OUT}/rowwise.csv")}
+    f = plot_roofline(
+        by, tmp_path / "roof.png", itemsize=8, hbm_peak_gbps=819.0,
+    )
+    assert f is not None and f.exists() and f.stat().st_size > 1000
+
+    # GEMM-only / empty datasets draw nothing and return None (no file).
+    import dataclasses
+
+    gemm_only = {
+        "gemm_rowwise": [
+            dataclasses.replace(p, n_rhs=4) for p in by["rowwise"]
+        ]
+    }
+    assert plot_roofline(
+        gemm_only, tmp_path / "none.png", itemsize=8, hbm_peak_gbps=819.0,
+    ) is None
+    assert not (tmp_path / "none.png").exists()
+
+
 def test_format_table_roofline_column():
     from matvec_mpi_multiplier_tpu.analysis.stats import ScalingPoint, format_table
 
