@@ -192,8 +192,12 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="on a runtime/backend error in one config (e.g. a transient "
         "tunnel failure), record it and continue with the next config "
-        "instead of aborting the whole sweep; exit code reports whether "
-        "any config failed",
+        "instead of aborting the whole sweep; exit code 1 = some config "
+        "hard-failed (backend fault — worth retrying the capture), "
+        "3 = completed with only unmeasurable (TimingError) skips — a "
+        "re-run would re-hit the same noise floor, so callers should "
+        "treat 3 as a soft success (3, not 2: argparse exits 2 on usage "
+        "errors, which must never read as soft)",
     )
     p.add_argument(
         "--profile-dir",
@@ -296,12 +300,19 @@ def run_sweep(args: argparse.Namespace) -> int:
     modes = list(TIMING_MODES) if args.mode == "both" else [args.mode]
 
     meshes = {n_dev: make_mesh(n_dev) for n_dev in counts}
-    counters = [0, 0, 0]  # [timed, skipped, failed (--keep-going only)]
+    # [timed, skipped, unmeasurable, failed] — the last two only fill under
+    # --keep-going. Unmeasurable (TimingError) is separated from hard
+    # failures because the two demand opposite reactions from a capture
+    # watcher: re-running a hard-failed sweep may succeed (transient tunnel
+    # fault), re-running an unmeasurable config just re-hits the same noise
+    # floor — a watcher that retried the whole capture over it would burn
+    # the healthy window the --keep-going skip was meant to protect.
+    counters = [0, 0, 0, 0]
     # The trace must stop (and flush its file) on ANY exit — an exception
     # mid-sweep or Ctrl+C hours in must not lose the whole capture.
     with trace(args.profile_dir or "", enabled=args.profile_dir is not None):
         _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters)
-    n_ok, n_skip, n_failed = counters
+    n_ok, n_skip, n_unmeasurable, n_failed = counters
     if not args.no_csv:
         for name in strategies:
             csv_name = f"gemm_{name}" if args.op == "gemm" else name
@@ -311,8 +322,15 @@ def run_sweep(args: argparse.Namespace) -> int:
                 print(f"CSV: {csv_path(csv_name, args.data_root, mode=mode)}")
     if args.profile_dir is not None:
         print(f"trace: {args.profile_dir}")
-    print(f"{n_ok} configs timed, {n_skip} skipped, {n_failed} failed")
-    return 1 if n_failed else 0
+    print(
+        f"{n_ok} configs timed, {n_skip} skipped, "
+        f"{n_unmeasurable} unmeasurable, {n_failed} failed"
+    )
+    if n_failed:
+        return 1
+    # 3, not 2: argparse's usage-error convention is exit 2, and a capture
+    # orchestrator must never read a broken command line as a soft skip.
+    return 3 if n_unmeasurable else 0
 
 
 def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
@@ -386,7 +404,7 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                             f"FAILED {label}: {type(e).__name__}: {e}",
                             file=sys.stderr,
                         )
-                        counters[2] += 1
+                        counters[3] += 1
                         continue
                     if args.label_suffix:
                         import dataclasses

@@ -106,6 +106,10 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
     monkeypatch.setattr(
         tpu_measure_all, "run", lambda cmd: calls.append(cmd) or 0
     )
+    # Pin the stage decision: nbconvert lives in the [analysis] extra, so a
+    # [test]-only environment would silently skip the stage and fail the
+    # order assertions below for the wrong reason.
+    monkeypatch.setattr(tpu_measure_all, "_has_nbconvert", lambda: True)
     # _baseline_stage spawns its subprocess directly (not via run); stub it
     # with a marker so its position in the order is still pinned.
     monkeypatch.setattr(
@@ -151,6 +155,54 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
     assert tpu_measure_all.main(["--data-root", "x", "--skip", "baseline"]) == 0
     assert not any("BASELINE-STAGE" in " ".join(c) for c in calls)
     assert any("--sweep square" in " ".join(c) for c in calls)
+
+
+def test_tpu_measure_all_soft_vs_hard_rc(monkeypatch, capsys):
+    """Sweep rc=3 (completed, only unmeasurable skips) must NOT fail the
+    capture — the watcher would otherwise re-run the whole thing over rows a
+    retry cannot improve. rc=2 from ANY stage (argparse usage-error
+    convention, even a sweep) and rc=1 from anywhere stay hard failures."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import tpu_measure_all
+
+    monkeypatch.setattr(tpu_measure_all, "probe", lambda **kw: True)
+    monkeypatch.setattr(tpu_measure_all, "_baseline_stage", lambda py: 0)
+    monkeypatch.setattr(tpu_measure_all, "_has_nbconvert", lambda: False)
+
+    def rc_for(cmd):
+        return 3 if "--sweep" in " ".join(cmd) else 0
+
+    monkeypatch.setattr(tpu_measure_all, "run", rc_for)
+    assert tpu_measure_all.main(["--data-root", "x"]) == 0
+    out = capsys.readouterr().out
+    assert "soft-skip" in out and "0 hard-failed" in out
+
+    # argparse's usage-error exit (2) from a sweep stage must stay hard: a
+    # broken sweep command line writes zero rows, and "capture succeeded"
+    # over that would waste the healthy window without anyone noticing.
+    monkeypatch.setattr(
+        tpu_measure_all, "run",
+        lambda cmd: 2 if "--sweep" in " ".join(cmd) else 0,
+    )
+    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+
+    # An overlap-stage crash (rc=1) is a hard failure worth retrying...
+    monkeypatch.setattr(
+        tpu_measure_all, "run",
+        lambda cmd: 1 if "overlap_study" in " ".join(cmd) else 0,
+    )
+    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+    assert "overlap" in capsys.readouterr().out
+
+    # ...and so is rc=2 from a non-sweep stage (argparse usage error: a
+    # retry is pointless, but "capture succeeded" would be a lie).
+    monkeypatch.setattr(
+        tpu_measure_all, "run",
+        lambda cmd: 2 if "hostlink_study" in " ".join(cmd) else 0,
+    )
+    assert tpu_measure_all.main(["--data-root", "x"]) == 1
 
 
 def test_autotune_gemv_cli_smoke(monkeypatch, tmp_path):

@@ -389,7 +389,11 @@ def test_sweep_cli_keep_going_skips_unmeasurable(
     args = ["--strategy", "rowwise", "--devices", "2", "--sizes", "16", "32",
             "--n-reps", "2", "--dtype", "float64"]
     rc = sweep_main(args + ["--keep-going"])
-    assert rc == 1
+    # rc=3, not 1: unmeasurable-only is a soft outcome — a capture watcher
+    # must not burn a healthy window re-running rows that would only re-hit
+    # the same noise floor (a hard backend failure still exits 1, and 3
+    # rather than 2 keeps argparse usage errors unambiguous).
+    assert rc == 3
     assert "UNMEASURABLE" in capsys.readouterr().err
     rows = read_csv(csv_path("rowwise", tmp_path))
     assert len(rows) == 1 and rows[0]["n_rows"] == 32
