@@ -65,7 +65,7 @@ class MatvecStrategy(abc.ABC):
         mesh: Mesh,
         *,
         kernel: str | Callable = "xla",
-        gather_output: bool = True,
+        gather_output: bool | str = True,
         check_vma: bool | None = None,
     ) -> Callable[[Array, Array], Array]:
         """Return jitted ``matvec(a, x) -> y`` for this strategy on ``mesh``.
@@ -75,7 +75,13 @@ class MatvecStrategy(abc.ABC):
         ``src/multiplier_rowwise.c:141``, ``src/multiplier_colwise.c:124``,
         ``src/multiplier_blockwise.c:144-210``). ``gather_output=False`` keeps
         ``y`` in its native distributed sharding, the honest TPU mode for
-        chained computation.
+        chained computation. ``gather_output="ring"`` materializes the same
+        replicated ``y`` through the explicit neighbor-ring all-gather
+        (``parallel.ring.ring_all_gather`` — the ``MPI_Gather`` of
+        ``src/multiplier_rowwise.c:141`` as p−1 single-link hops instead of
+        one XLA-scheduled all-gather); for a strategy whose native output is
+        already replicated (plain colwise) there is nothing to gather and it
+        behaves like ``True``.
         """
         kern = get_kernel(kernel)
         spec_a, spec_x, spec_y = self.specs(mesh)
@@ -93,6 +99,26 @@ class MatvecStrategy(abc.ABC):
             check_vma=check_vma,
         )
 
+        ring_gather = None
+        if gather_output == "ring" and spec_y != P():
+            from ..parallel.ring import ring_all_gather
+
+            # The axes y is sharded over (its leading-dim spec entry): the
+            # flat mesh for the 1-D strategies, the 'rows' axis alone for
+            # blockwise — devices along excluded axes hold replicas and run
+            # identical independent rings. Its own shard_map, with the vma
+            # check off just for this stage: ppermute outputs stay marked
+            # axis-varying even though the gathered value is replicated
+            # (ring_all_gather's docstring), and building the whole matvec
+            # with check_vma=False would also waive the psum/out_specs
+            # checks on the compute body, which this way stay enforced.
+            y_axes = spec_y[0]
+            ring_gather = jax.shard_map(
+                lambda y_blk: ring_all_gather(y_blk, y_axes),
+                mesh=mesh, in_specs=(spec_y,), out_specs=P(),
+                check_vma=False,
+            )
+
         @jax.jit
         def matvec(a: Array, x: Array) -> Array:
             # Shapes are concrete at trace time: run the divisibility guards
@@ -100,7 +126,9 @@ class MatvecStrategy(abc.ABC):
             # messages) instead of an opaque shard_map uneven-partition error.
             self.validate(a.shape[0], a.shape[1], mesh)
             y = mapped(a, x)
-            if gather_output:
+            if ring_gather is not None:
+                y = ring_gather(y)
+            elif gather_output:
                 y = jax.lax.with_sharding_constraint(y, NamedSharding(mesh, P()))
             return y
 

@@ -154,11 +154,14 @@ def ring_all_gather(x: Array, axis_name: str) -> Array:
 
     The rowwise strategy's final gather (``MPI_Gather``,
     ``src/multiplier_rowwise.c:141``) expressed as neighbor traffic.
+    Reachable from every sharded-output strategy via
+    ``build(gather_output="ring")`` (``models/base.py``), which wraps it in
+    its own gather-stage shard_map.
 
     Note: the result is replicated in *value*, but shard_map's vma checker
     cannot prove it (ppermute outputs stay marked axis-varying), so callers
     returning it through ``out_specs=P()`` must build their shard_map with
-    ``check_vma=False``.
+    ``check_vma=False`` — ``build`` scopes that to the gather stage only.
     """
     p = jax.lax.axis_size(axis_name)
     if p == 1:
