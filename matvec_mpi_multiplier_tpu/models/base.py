@@ -83,6 +83,14 @@ class MatvecStrategy(abc.ABC):
         already replicated (plain colwise) there is nothing to gather and it
         behaves like ``True``.
         """
+        if not isinstance(gather_output, bool) and gather_output != "ring":
+            # Fail at build: any other string is truthy and would silently
+            # run the plain gather — a benchmark comparing "ring" vs a typo
+            # would measure the same code path twice.
+            raise ValueError(
+                f"gather_output must be True, False or 'ring'; "
+                f"got {gather_output!r}"
+            )
         kern = get_kernel(kernel)
         spec_a, spec_x, spec_y = self.specs(mesh)
         if check_vma is None:

@@ -9,6 +9,7 @@ from .gemv import available_kernels, get_kernel, gemv_xla, register_kernel
 from . import pallas_gemv  # noqa: F401
 from . import native_gemv  # noqa: F401
 from . import compensated  # noqa: F401
+from . import ozaki  # noqa: F401
 
 # The GEMM kernel tier (same registry pattern, rank-2 right-hand side).
 from .gemm_kernels import (

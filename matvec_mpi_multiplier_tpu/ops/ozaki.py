@@ -1,0 +1,184 @@
+"""Ozaki-style split-matrix GEMV: fast fp64-grade accumulation on the MXU.
+
+The ``compensated`` kernel (``ops/compensated.py``) answers the reference's
+fp64-end-to-end accumulation (``multiply_std_rowwise``,
+``src/matr_utils.c:86-96``) exactly, but every one of its error-free
+transformations is VPU (elementwise) work — measured ~100-150× slower than
+the XLA dot (docs/COMPENSATED.md has the current backend's numbers). This
+tier closes the speed gap by moving the
+bulk of the arithmetic onto the MXU, where the machine's FLOPs actually
+are, and keeping only a b-fold-smaller combine on the VPU.
+
+The idea (Ozaki et al., "Error-free transformations of matrix
+multiplication", 2012 — here specialised to GEMV on bf16/fp32 hardware):
+
+1. **Block** the contraction axis into chunks of ``b = 256``.
+2. **Slice** each operand into ``s`` addends of at most 8 mantissa bits,
+   aligned to a shared per-(row, block) power-of-two scale:
+   ``a = a_0 + a_1 + ... + a_{s-1} + r`` with ``a_i = q_i * 2^(E-8(i+1))``,
+   ``|q_i| <= 2^8`` an integer. Each slice is **exactly** representable in
+   bfloat16 (8-bit significand), and the residual ``r`` is below
+   ``2^(E-8s)`` of the block's max element.
+3. **Multiply on the MXU**: all ``s × s`` slice pairs ``(i, j)`` in one
+   batched bf16×bf16→fp32 contraction ``sum_k a_i[.., k] * x_j[k]`` (block
+   index batched, so each slice array streams once). Every term is an
+   integer multiple of a common scale bounded by ``2^16``, so each partial
+   sum of up to 256 terms is ≤ 2^24 — *exactly representable in fp32*: the
+   MXU's fp32 accumulation commits **no rounding at all**, in any order.
+   (This is the whole trick: the exactness the compensated kernel buys with
+   TwoProd/TwoSum comes free from alignment. And it must be every pair, not
+   an ``i + j`` cutoff: under deep cancellation the high-slice products
+   cancel and a dropped low cross-term would be the largest surviving
+   contribution.)
+4. **Combine** the ``s² × (k/b)`` exact per-block partials per output
+   row with the double-float tree reduction from ``ops/compensated.py`` —
+   VPU work shrunk by ~``b / s²`` = 16× relative to the compensated
+   kernel's per-element pipeline, and the heavy per-element arithmetic
+   (the slicing) is 3 cheap elementwise ops per slice that XLA fuses.
+
+The result is the EXACT dot of the sliced representations, so the error is
+(finite fp32 inputs): operand bits truncated below ``2^(E_block - 8s)`` of
+each block max, plus ~2^-48 of the running partial magnitudes from the
+double-float combine. With the default ``s = 4`` everything within 32 bits
+of each block max is captured — in particular any block whose elements lie
+within ``2^8`` of each other is represented *exactly* (e.g. the
+cancellation stress case in ``scripts/compensated_study.py``, where fp32
+has ~4×10³ rel err and this kernel matches the fp64 oracle to 0 ulps).
+It is fp64-*parity* for fp32 data, not fp64: block dynamic range beyond
+``2^(8(s-3))`` starts shaving low bits of the smallest elements, degrading
+gracefully toward (still compensated) fp32-window accuracy — ``ozaki6``
+(s = 6) widens the window to 48 bits.
+
+fp64 inputs skip the machinery: on an fp64-capable backend the plain fp64
+dot already *is* the reference's accumulation. Blocks whose max magnitude
+falls outside ``[2^-79, 2^96)`` are exactly prescaled into the window by a
+power of two (undone on the block dots), so the full finite fp32 range is
+handled without inf/NaN; only results whose TRUE value over- or underflows
+fp32 degrade.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from .compensated import _df_reduce_lastaxis
+from .gemv import register_kernel
+
+# Contraction block length. Exactness needs b * (2^8)^2 <= 2^24, i.e.
+# b <= 256: every partial sum of slice products stays an integer multiple
+# of the block scale below 2^24, hence exact in fp32.
+_BLOCK = 256
+# Bits per slice = bf16 significand.
+_SLICE_BITS = 8
+
+
+# Block exponents are confined to this window before slicing. The low end
+# keeps every slice scale normal (smallest: 2^(EXP_LO - 8*6) = 2^-126 at
+# s = 6 — TPU flushes subnormals, which would silently zero low slices);
+# the high end keeps the q = ±2^8 carry slice (value 2^exp) finite in
+# bf16/fp32 (2^128 overflows; bf16 shares fp32's exponent range). Blocks
+# outside the window are exactly prescaled by the out-of-window shift and
+# the (power-of-two) correction is applied to the block partials instead.
+_EXP_LO, _EXP_HI = -78, 96
+
+
+def _split_blocked(v: Array, n_slices: int) -> tuple[Array, Array]:
+    """Slice ``v`` (..., nb, b) into ``n_slices`` bf16-exact addends.
+
+    Returns ``(slices, shift)``: (n_slices, ..., nb, b) bfloat16 with
+    ``sum_i slices[i] ≈ v * 2^shift`` (exact up to the sub-``2^(E-8s)``
+    residual), where ``E`` is the per-(..., nb) block max exponent and
+    ``shift`` (..., nb, 1) int32 is the exponent-window prescale — zero for
+    blocks whose max lies in ``[2^(_EXP_LO-1), 2^_EXP_HI)``, i.e. all
+    ordinary data. Callers undo it on the (scale-covariant) block dots.
+    All-zero blocks produce all-zero slices (frexp(0) = (0, 0) keeps the
+    scales finite).
+    """
+    block_max = jnp.max(jnp.abs(v), axis=-1, keepdims=True)
+    _, exp = jnp.frexp(block_max)  # block_max = f * 2^exp, f in [0.5, 1)
+    shift = jnp.clip(exp, _EXP_LO, _EXP_HI) - exp
+    # Broadcast-multiply by the tiny per-block 2^shift (exact: |shift| is
+    # bounded so the factor is always a normal power of two; almost always
+    # 2^0) instead of ldexp over the full array — ldexp's exponent surgery
+    # per element costs a multiple of the whole split otherwise.
+    v = v * jnp.ldexp(jnp.ones((), v.dtype), shift)
+    exp = exp + shift
+    slices = []
+    r = v
+    for i in range(n_slices):
+        scale = jnp.ldexp(jnp.ones((), v.dtype), exp - _SLICE_BITS * (i + 1))
+        q = jnp.round(r / scale)  # integer, |q| <= 2^8 (incl. the carry case)
+        s = q * scale  # exact: 8-bit int times a power of two
+        slices.append(s.astype(jnp.bfloat16))  # exact cast by construction
+        r = r - s  # exact: s matches r's leading bits
+    return jnp.stack(slices), shift
+
+
+def _gemv_ozaki(a: Array, x: Array, n_slices: int) -> Array:
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    if acc == jnp.float64:
+        # fp64-capable backend: the plain fp64 dot is already the
+        # reference's accumulation (src/matr_utils.c:86-96); slicing to
+        # bf16 would only lose bits.
+        return jnp.matmul(a.astype(acc), x.astype(acc))
+    a = a.astype(jnp.float32)  # bf16/fp16 embed exactly
+    x = x.astype(jnp.float32)
+    m, k = a.shape
+    if k == 0:
+        return jnp.zeros((m,), acc)
+    pad = (-k) % _BLOCK
+    if pad:
+        a = jnp.pad(a, ((0, 0), (0, pad)))  # exact zeros: identity terms
+        x = jnp.pad(x, ((0, pad),))
+    nb = a.shape[1] // _BLOCK
+    a_s, a_shift = _split_blocked(a.reshape(m, nb, _BLOCK), n_slices)
+    x_s, x_shift = _split_blocked(x.reshape(nb, _BLOCK), n_slices)
+    if jax.default_backend() != "tpu":
+        # Slices are 8-bit integers times a power of two — exact in bf16
+        # AND fp32. The TPU MXU wants bf16 operands (native lane format,
+        # half the HBM traffic); CPU/GPU backends emulate bf16 matmuls
+        # scalar-slowly, so hand them the same values as fp32.
+        a_s = a_s.astype(jnp.float32)
+        x_s = x_s.astype(jnp.float32)
+
+    # ALL s×s slice pairs in one batched →fp32 contraction, block index as
+    # the batch dim (each slice array streams once; each batch element is a
+    # clean (s·m, b) @ (b, s) GEMM every backend recognizes): the output
+    # holds per-block partials, each EXACT (module docstring). All pairs,
+    # not an i+j cutoff: under deep cancellation the high-slice products
+    # cancel and a dropped low cross-term (i+j >= s) would be the LARGEST
+    # surviving contribution — keeping every pair makes the result the
+    # exact product of the sliced representations.
+    lhs = a_s.transpose(2, 0, 1, 3).reshape(nb, n_slices * m, _BLOCK)
+    rhs = x_s.transpose(1, 2, 0)  # (nb, b, s)
+    partials = jnp.matmul(lhs, rhs, preferred_element_type=jnp.float32)
+    # (m, nb, s, s): this block's s^2 partials, still in prescaled space.
+    partials = partials.reshape(nb, n_slices, m, n_slices).transpose(2, 0, 1, 3)
+    # Double-float combine in two stages — the only rounding in the kernel
+    # (~2^-48 of the running sums). Per block FIRST, while still in the
+    # block's prescaled space: an individual slice partial may overshoot
+    # the representable range once corrected (round-to-nearest slices can
+    # exceed the value they approximate — at 3.4e38 inputs the (0,0)
+    # partial alone overflows where the block total does not), so the
+    # exponent-window correction must be applied to the combined per-block
+    # value, where it is an exact power-of-two rescale of both df
+    # components whenever the true block dot is representable.
+    s2 = partials.reshape(m, nb, n_slices * n_slices)
+    hi_b, lo_b = _df_reduce_lastaxis(s2, jnp.zeros_like(s2))  # (m, nb)
+    total_shift = a_shift[:, :, 0] + x_shift[:, 0][None, :]  # (m, nb)
+    hi_b = jnp.ldexp(hi_b, -total_shift)
+    lo_b = jnp.ldexp(lo_b, -total_shift)
+    # Then across blocks (shifts undone, so magnitudes are commensurable).
+    hi, lo = _df_reduce_lastaxis(hi_b, lo_b)
+    return (hi + lo).astype(acc)
+
+
+gemv_ozaki = partial(_gemv_ozaki, n_slices=4)
+gemv_ozaki6 = partial(_gemv_ozaki, n_slices=6)
+
+register_kernel("ozaki", gemv_ozaki)
+register_kernel("ozaki6", gemv_ozaki6)

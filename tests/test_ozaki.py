@@ -1,0 +1,209 @@
+"""Ozaki-style split-matrix GEMV kernel: fp64-parity accuracy at MXU speed.
+
+The ``compensated`` kernel answers the reference's fp64 end-to-end
+accumulation (src/matr_utils.c:86-96) but is VPU-bound (~100-150x the XLA
+dot, docs/COMPENSATED.md). ``ozaki`` must match its accuracy — the block dots of
+8-bit-aligned slices are exact in fp32, so the only rounding is the shared
+double-float combine — while doing the bulk arithmetic as one batched
+contraction.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.ops.compensated import gemv_compensated
+from matvec_mpi_multiplier_tpu.ops.gemv import available_kernels, gemv_xla
+from matvec_mpi_multiplier_tpu.ops.ozaki import (
+    _BLOCK,
+    _split_blocked,
+    gemv_ozaki,
+    gemv_ozaki6,
+)
+
+
+def _ulps(y, truth):
+    t32 = truth.astype(np.float32)
+    return np.abs(y.astype(np.float64) - truth) / np.spacing(np.abs(t32))
+
+
+def test_registered():
+    assert "ozaki" in available_kernels()
+    assert "ozaki6" in available_kernels()
+
+
+def test_split_is_exact_and_bf16_representable():
+    """Slices must sum back to the input exactly when the in-block dynamic
+    range fits the documented window (elements within 2^8 of the block
+    max), and each slice must be bf16-exact — the two pillars of the
+    exact-block-dot argument."""
+    rng = np.random.default_rng(0)
+    mag = rng.uniform(1e6, 1e7, (4, 2, _BLOCK))  # ratio 10 < 2^8
+    sign = rng.choice([-1.0, 1.0], mag.shape)
+    v = jnp.asarray((mag * sign).astype(np.float32))
+    slices, shift = _split_blocked(v, 4)
+    assert not np.any(np.asarray(shift))  # ordinary data: no prescale
+    # bf16 round-trip is the identity: every slice is 8 significand bits.
+    np.testing.assert_array_equal(
+        np.asarray(slices.astype(jnp.bfloat16).astype(jnp.float32)),
+        np.asarray(slices.astype(jnp.float32)),
+    )
+    recon = np.asarray(slices.astype(jnp.float32), np.float64).sum(0)
+    np.testing.assert_array_equal(recon, np.asarray(v, np.float64))
+
+
+def test_split_wide_range_residual_within_envelope():
+    """Unbounded dynamic range (elements far below the block max) loses
+    bits BELOW 2^(E - 8s) of the block max — never more: the documented
+    graceful-degradation envelope."""
+    rng = np.random.default_rng(7)
+    v = rng.uniform(-1e7, 1e7, (4, 2, _BLOCK)).astype(np.float32)
+    slices, _ = _split_blocked(jnp.asarray(v), 4)
+    recon = np.asarray(slices.astype(jnp.float32), np.float64).sum(0)
+    _, exp = np.frexp(np.abs(v).max(axis=-1, keepdims=True))
+    bound = np.ldexp(1.0, exp - 8 * 4)  # 2^(E - 32), elementwise per block
+    assert np.all(np.abs(recon - v.astype(np.float64)) <= bound)
+
+
+def test_split_zero_block():
+    slices, _ = _split_blocked(jnp.zeros((1, 1, _BLOCK), jnp.float32), 4)
+    assert not np.any(np.asarray(slices.astype(jnp.float32)))
+    assert np.all(np.isfinite(np.asarray(slices.astype(jnp.float32))))
+
+
+def test_cancellation_stress_matches_fp64(devices):
+    """The compensated-study stress case: interleaved ±1e6..1e7 pairs with
+    O(1) true row sums — fp32 loses every significant bit, ozaki must match
+    the fp64 oracle exactly (in-block range is far inside the 32-bit
+    window, so the sliced representation is exact and so are the block
+    dots; x = ones has one nonzero slice)."""
+    rng = np.random.default_rng(11)
+    m, k = 64, 2048
+    big = rng.uniform(1e6, 1e7, size=(m, k // 2)).astype(np.float32)
+    small = rng.uniform(-1.0, 1.0, size=(m, k // 2)).astype(np.float32)
+    a = np.empty((m, k), np.float32)
+    a[:, 0::2] = big + small
+    a[:, 1::2] = -big
+    x = np.ones(k, np.float32)
+    oracle = a.astype(np.float64) @ x.astype(np.float64)
+    plain = np.asarray(gemv_xla(jnp.asarray(a), jnp.asarray(x)))
+    assert _ulps(plain, oracle).max() > 1e6  # fp32 is garbage here
+    for fn in (gemv_ozaki, gemv_ozaki6):
+        y = np.asarray(fn(jnp.asarray(a), jnp.asarray(x)))
+        assert _ulps(y, oracle).max() <= 2.0
+
+
+def test_random_matches_compensated_bitwise_class(devices):
+    """On well-scaled random data ozaki and compensated must both sit within
+    ~1 ulp of the fp64 oracle (they share the double-float combine; the
+    paths differ only in where exactness comes from)."""
+    rng = np.random.default_rng(1)
+    m, k = 64, 4096 + 100  # non-multiple of _BLOCK: exercises the padding
+    a64 = rng.standard_normal((m, k))
+    x64 = rng.standard_normal(k)
+    a32 = jnp.asarray(a64, jnp.float32)
+    x32 = jnp.asarray(x64, jnp.float32)
+    oracle = np.asarray(a32, np.float64) @ np.asarray(x32, np.float64)
+    oz = np.asarray(gemv_ozaki(a32, x32))
+    comp = np.asarray(gemv_compensated(a32, x32))
+    assert _ulps(oz, oracle).max() <= 2.0
+    assert _ulps(comp, oracle).max() <= 2.0
+
+
+def test_long_contraction_beats_plain_fp32(devices):
+    rng = np.random.default_rng(2)
+    m, k = 8, 1 << 15
+    a64 = rng.uniform(0.0, 10.0, (m, k))
+    x64 = rng.uniform(0.0, 10.0, k)
+    truth = (
+        np.asarray(a64, np.float32).astype(np.float64)
+        @ np.asarray(x64, np.float32).astype(np.float64)
+    )
+    a32 = jnp.asarray(a64, jnp.float32)
+    x32 = jnp.asarray(x64, jnp.float32)
+    plain = np.asarray(gemv_xla(a32, x32))
+    oz = np.asarray(gemv_ozaki(a32, x32))
+    assert _ulps(oz, truth).max() <= 2.0
+    assert _ulps(oz, truth).max() * 10 < _ulps(plain, truth).max()
+
+
+@pytest.mark.parametrize("name", ["rowwise", "colwise", "blockwise"])
+def test_strategies_with_ozaki_kernel(devices, name):
+    rng = np.random.default_rng(3)
+    m, k = 64, 512
+    a64 = rng.uniform(0.0, 10.0, (m, k))
+    x64 = rng.uniform(0.0, 10.0, k)
+    mesh = make_mesh(8)
+    fn = get_strategy(name).build(mesh, kernel="ozaki")
+    y = np.asarray(
+        fn(jnp.asarray(a64, jnp.float32), jnp.asarray(x64, jnp.float32))
+    )
+    assert _ulps(y, a64 @ x64).max() <= 4.0
+
+
+def test_bf16_inputs_upcast_exactly(devices):
+    rng = np.random.default_rng(4)
+    a = jnp.asarray(rng.standard_normal((16, 512)), jnp.bfloat16)
+    x = jnp.asarray(rng.standard_normal(512), jnp.bfloat16)
+    oracle = np.asarray(a, np.float64) @ np.asarray(x, np.float64)
+    y = np.asarray(gemv_ozaki(a, x))
+    assert y.dtype == np.float32  # accumulator dtype contract (ops/gemv.py)
+    assert _ulps(y, oracle).max() <= 2.0
+
+
+def test_fp64_inputs_use_plain_fp64_dot(devices):
+    rng = np.random.default_rng(5)
+    a = rng.uniform(0.0, 10.0, (8, 128))
+    x = rng.uniform(0.0, 10.0, 128)
+    y = np.asarray(gemv_ozaki(jnp.asarray(a), jnp.asarray(x)))
+    assert y.dtype == np.float64
+    np.testing.assert_allclose(y, a @ x, rtol=1e-15)
+
+
+def test_empty_contraction(devices):
+    y = np.asarray(
+        gemv_ozaki(jnp.zeros((4, 0), jnp.float32), jnp.zeros((0,), jnp.float32))
+    )
+    np.testing.assert_array_equal(y, np.zeros(4, np.float32))
+
+
+def test_short_contraction_single_padded_block(devices):
+    # k < _BLOCK: one zero-padded block must still be exact.
+    rng = np.random.default_rng(6)
+    a = jnp.asarray(rng.standard_normal((4, 7)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal(7), jnp.float32)
+    oracle = np.asarray(a, np.float64) @ np.asarray(x, np.float64)
+    y = np.asarray(gemv_ozaki(a, x))
+    assert _ulps(y, oracle).max() <= 1.0
+
+
+def test_exponent_extremes_no_nan(devices):
+    """Finite inputs across the whole fp32 exponent range must never yield
+    inf/NaN: blocks outside the slicing window are exactly prescaled in and
+    the power-of-two correction is undone on the block dots."""
+    cases = [
+        3.4e38,   # near fp32 max: the q=256 carry would be 2^128 unscaled
+        2.0**-120,  # far below the unscaled window: scales would flush
+        np.float32(np.finfo(np.float32).tiny),  # min normal
+    ]
+    for mag in cases:
+        a = np.zeros((1, _BLOCK), np.float32)
+        a[0, 0] = mag
+        x = np.ones(_BLOCK, np.float32)
+        y = np.asarray(gemv_ozaki(jnp.asarray(a), jnp.asarray(x)))
+        oracle = a.astype(np.float64) @ x.astype(np.float64)
+        assert np.all(np.isfinite(y)), (mag, y)
+        np.testing.assert_allclose(y, oracle.astype(np.float32), rtol=1e-6)
+    # Mixed extremes: huge a against tiny x — true value is O(1).
+    a = np.full((2, _BLOCK), 1e30, np.float32)
+    x = np.full(_BLOCK, 1e-30, np.float32)
+    y = np.asarray(gemv_ozaki(jnp.asarray(a), jnp.asarray(x)))
+    oracle = a.astype(np.float64) @ x.astype(np.float64)
+    np.testing.assert_allclose(y, oracle, rtol=1e-6)
+
+
+def test_gather_output_rejects_unknown_string(devices):
+    mesh = make_mesh(2)
+    with pytest.raises(ValueError, match="ring"):
+        get_strategy("rowwise").build(mesh, gather_output="rings")
