@@ -237,6 +237,22 @@ def _grow_spread(
         delta = min(delta * 4, rep_cap)
 
 
+def _dispatch_overhead(run: Callable[[int], float]) -> float:
+    """Pure dispatch+fence overhead estimate from k=1 and k=2 runs.
+
+    A k=1 run contains one full kernel execution, so the (k=2 − k=1)
+    one-rep estimate is subtracted — otherwise a kernel whose single rep
+    rivals the dispatch overhead inflates the jitter target (and with it
+    every run in the spread search) by its own runtime for no signal gain.
+    Both terms are min-of-2 and clamped, so a stray spike can only
+    overestimate the overhead (costing wall-time, never correctness — the
+    slope itself is measured at the grown spread).
+    """
+    t_k1 = _min2(run, 1)
+    t_k2 = _min2(run, 2)
+    return max(0.0, t_k1 - max(0.0, t_k2 - t_k1))
+
+
 def _loop_slope(
     fn: Callable, a_dev, rhs_dev, n1: int, n2: int, samples: int,
     warmup: int = 0,
@@ -277,7 +293,7 @@ def _loop_slope(
         return _max_across_processes(time.perf_counter() - start)
 
     run(1)  # compile (k is traced: one compile covers every k)
-    t_dispatch = _min2(run, 1)  # ~pure dispatch+fence
+    t_dispatch = _dispatch_overhead(run)
     for _ in range(max(0, warmup)):
         run(n1)
     target = max(_LOOP_TARGET_FLOOR_S, _LOOP_JITTER_FACTOR * t_dispatch)

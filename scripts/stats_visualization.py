@@ -157,13 +157,26 @@ def main(argv=None) -> int:
     if args.hbm_peak is not None and by_strategy:
         # Memory-side roofline: matvec bandwidth vs per-chip operand bytes
         # against the HBM peak, with the VMEM-residency boundary drawn.
-        fig = plot_roofline(
-            {k: v for k, v in by_strategy.items() if not k.startswith("gemm")},
-            Path(args.fig_dir) / "roofline.png",
-            itemsize=args.itemsize, hbm_peak_gbps=args.hbm_peak,
-        )
-        if fig is not None:
-            print(f"\nroofline figure: {fig}")
+        # One figure per device count PRESENT in the dataset (the roof and
+        # per-chip bytes both scale with p; a hard-coded p=1 would silently
+        # drop every multi-device row from the figure).
+        matvec = {
+            k: v for k, v in by_strategy.items() if not k.startswith("gemm")
+        }
+        counts = sorted({
+            q.n_processes for pts in matvec.values() for q in pts
+            if q.n_rhs == 1
+        })
+        for n_proc in counts:
+            suffix = "" if n_proc == 1 else f"_p{n_proc}"
+            fig = plot_roofline(
+                matvec,
+                Path(args.fig_dir) / f"roofline{suffix}.png",
+                itemsize=args.itemsize, hbm_peak_gbps=args.hbm_peak,
+                n_processes=n_proc,
+            )
+            if fig is not None:
+                print(f"\nroofline figure (p={n_proc}): {fig}")
 
     if args.overlay:
         runs: dict[str, dict[str, list]] = {}

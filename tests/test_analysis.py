@@ -261,3 +261,26 @@ def test_plot_overlay(tmp_path):
         8, 8, tmp_path / "overlay.png",
     )
     assert out.exists() and out.stat().st_size > 0
+
+def test_viz_script_roofline_per_device_count(tmp_path):
+    """The CLI must emit one roofline per device count observed in the
+    dataset — a hard-coded p=1 silently dropped every multi-device row
+    (round-3 advisor finding)."""
+    import sys
+
+    sys.path.insert(0, "/root/repo/scripts")
+    import stats_visualization as viz
+
+    out = tmp_path / "out"
+    out.mkdir()
+    (out / "rowwise.csv").write_text(
+        "n_rows, n_cols, n_processes, time\n"
+        "512, 512, 1, 0.5\n512, 512, 2, 0.25\n1024, 1024, 2, 0.9\n"
+    )
+    figs = tmp_path / "figs"
+    rc = viz.main([
+        "--data-out", str(out), "--fig-dir", str(figs), "--hbm-peak", "819",
+    ])
+    assert rc == 0
+    assert (figs / "roofline.png").exists()      # p=1 keeps the plain name
+    assert (figs / "roofline_p2.png").exists()   # p=2 rows get their own

@@ -480,3 +480,23 @@ def test_sweep_cli_profile_dir(devices, tmp_path):
     assert rc == 0
     # jax.profiler writes a plugins/profile/<ts>/ tree with trace artifacts.
     assert any((tmp_path / "trace").rglob("*"))
+
+
+def test_dispatch_overhead_subtracts_one_rep():
+    """The jitter-target base must be dispatch+fence alone: a k=1 run
+    includes one kernel execution, and for kernels whose rep time rivals
+    the overhead the old t(k=1) estimate tripled measurement wall-time
+    (round-3 advisor finding)."""
+    from matvec_mpi_multiplier_tpu.bench.timing import _dispatch_overhead
+
+    # Deterministic linear cost model: t(k) = dispatch + rep * k.
+    assert _dispatch_overhead(lambda k: 0.070 + 0.010 * k) == pytest.approx(
+        0.070
+    )
+    # Rep time dominating dispatch: estimate stays the dispatch, not 0.5+.
+    assert _dispatch_overhead(lambda k: 0.002 + 0.5 * k) == pytest.approx(
+        0.002
+    )
+    # Degenerate noise (k=2 cheaper than k=1, or negative differences)
+    # clamps instead of going negative.
+    assert _dispatch_overhead(lambda k: 0.1 - 0.03 * k) >= 0.0
