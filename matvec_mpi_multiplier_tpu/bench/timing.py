@@ -237,20 +237,23 @@ def _grow_spread(
         delta = min(delta * 4, rep_cap)
 
 
-def _dispatch_overhead(run: Callable[[int], float]) -> float:
-    """Pure dispatch+fence overhead estimate from k=1 and k=2 runs.
+def _dispatch_overhead(run: Callable[[int], float]) -> tuple[float, float]:
+    """Dispatch+fence overhead estimate from k=1 and k=2 runs.
 
-    A k=1 run contains one full kernel execution, so the (k=2 − k=1)
-    one-rep estimate is subtracted — otherwise a kernel whose single rep
-    rivals the dispatch overhead inflates the jitter target (and with it
-    every run in the spread search) by its own runtime for no signal gain.
-    Both terms are min-of-2 and clamped, so a stray spike can only
-    overestimate the overhead (costing wall-time, never correctness — the
-    slope itself is measured at the grown spread).
+    Returns ``(pure, t_k1)``. A k=1 run contains one full kernel
+    execution, so ``pure`` subtracts the (k=2 − k=1) one-rep estimate —
+    otherwise a kernel whose single rep rivals the dispatch overhead
+    inflates the jitter target (and with it every run in the spread
+    search) by its own runtime for no signal gain. The subtraction can
+    UNDER-estimate when a latency burst spans both k=2 runs (min-of-2 only
+    filters independent spikes), which is why ``t_k1`` — the conservative
+    estimate that can only overestimate — is returned alongside: callers
+    floor their jitter target at it, so a burst can cost wall-time but
+    can never collapse the anti-jitter guard.
     """
     t_k1 = _min2(run, 1)
     t_k2 = _min2(run, 2)
-    return max(0.0, t_k1 - max(0.0, t_k2 - t_k1))
+    return max(0.0, t_k1 - max(0.0, t_k2 - t_k1)), t_k1
 
 
 def _loop_slope(
@@ -293,10 +296,16 @@ def _loop_slope(
         return _max_across_processes(time.perf_counter() - start)
 
     run(1)  # compile (k is traced: one compile covers every k)
-    t_dispatch = _dispatch_overhead(run)
+    t_dispatch, t_k1 = _dispatch_overhead(run)
     for _ in range(max(0, warmup)):
         run(n1)
-    target = max(_LOOP_TARGET_FLOOR_S, _LOOP_JITTER_FACTOR * t_dispatch)
+    # Floored at t_k1 (dispatch + one rep): if the one-rep subtraction was
+    # fooled by a correlated burst, the target still cannot drop below the
+    # scale the old conservative estimate enforced — jitter-dominated
+    # spreads (the round-1/2 impossible-CSV mode) stay locked out.
+    target = max(
+        _LOOP_TARGET_FLOOR_S, _LOOP_JITTER_FACTOR * t_dispatch, t_k1
+    )
     delta, t1, t2 = _grow_spread(run, n1, n2 - n1, target_delta_s=target)
     n2 = n1 + delta
     estimates = [(t2 - t1) / delta]

@@ -20,6 +20,7 @@ from .gemm_kernels import (
 )
 from . import pallas_gemm  # noqa: F401
 from . import native_gemm  # noqa: F401
+from . import ozaki_gemm  # noqa: F401
 
 __all__ = [
     "gemv",

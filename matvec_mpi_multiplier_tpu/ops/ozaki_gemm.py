@@ -1,0 +1,167 @@
+"""Ozaki-style split-matrix GEMM: fp64-parity accumulation on the int8 MXU.
+
+The GEMM extension (``ops/gemm_kernels.py``) inherits the reference's
+accumulation question — it computes in C ``double`` end-to-end
+(``src/matr_utils.c:86-96``) — at rank 2, where per-element EFT arithmetic
+(``ops/compensated.py``) is hopeless: the VPU work would dwarf the MXU's
+O(m·k·n) FLOPs. This tier is the rank-2 face of ``ops/ozaki.py``, rebuilt
+around the MXU's *integer* mode, which changes the exactness budget:
+
+* Operands are sliced along the contraction axis into ``s`` addends of at
+  most **7 bits**, truncated toward zero against a shared per-row (A) /
+  per-column (B) power-of-two scale: each slice is an int8 array
+  (``|q| <= 127``) and ``a ≈ sum_i q_i * 2^(E - 7(i+1))`` down to
+  ``2^(E - 7s)`` of the row max.
+* Each slice-pair product runs as one ``int8 × int8 → int32`` matmul —
+  integer arithmetic, so the contraction is **exact** as long as it cannot
+  overflow: ``k * 127² < 2^31`` holds through ``k = 2^17``; longer
+  contractions are chunked (``_I8_BLOCK``) and the chunk partials combined
+  like everything else. No 256-block machinery, no per-block scales: the
+  int32 accumulator buys 7 extra exactness bits over fp32's 24.
+* Each int32 partial splits exactly into two fp32 halves (high/low 16
+  bits), which are rescaled by the *original* row/column exponents
+  (``2^(ea + eb - 7(i+j+2))`` — the window prescale cancels algebraically)
+  and folded into a running double-float accumulator: ~2·s² cheap VPU ops
+  per output element against ``2k`` MXU ops — vanishing for real k.
+
+Accuracy envelope (finite fp32 inputs): bits below ``2^(E_row - 7s)`` of
+each row/column max are rounded away; everything kept is exact up to the
+double-float combine, whose error is ~2^-48 of the *contraction
+magnitude* — the compensated tier's profile, and fp64's own under
+sequential summation: ulp-level output except at entries whose true value
+is deeply cancelled. Default ``s = 4`` (28-bit windows — exact for
+operands whose per-row/column dynamic range stays within ~2^4, and ~1-ulp
+for well-scaled data); ``ozaki6`` gives 42-bit windows. Rows/columns whose
+max magnitude lies below ``2^-78`` are exactly prescaled into range (the
+same trick as ``ops/ozaki.py``, per line instead of per block).
+
+The GEMV registry gets the same machinery as ``ozaki_i8`` (``x`` as a
+one-column B): on integer-capable MXUs it is the faster formulation, and
+committing both lets the study measure the pair on real hardware.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import Array
+
+from .compensated import df_add
+from .gemm_kernels import register_gemm_kernel
+from .gemv import register_kernel
+
+_I8_BITS = 7
+# Longest exactly-accumulable contraction: k * 127^2 < 2^31 allows 2^17;
+# one power of two of margin.
+_I8_BLOCK = 1 << 16
+# Per-line exponent window (same reasoning as ozaki._EXP_LO: keeps every
+# slice scale a normal fp32 number for s up to 6).
+_EXP_LO = -78
+
+
+def _split_int8(v: Array, n_slices: int, axis: int) -> tuple[Array, Array]:
+    """Slice ``v`` (fp32) into int8 addends against per-line scales.
+
+    ``axis`` is the contraction axis (reduced when computing the line max:
+    scales are per row of A / per column of B). Returns
+    ``(slices, exp)`` with ``slices`` (n_slices, *v.shape) int8 and ``exp``
+    the ORIGINAL line max exponents (keepdims) — the value satisfies
+    ``v ≈ sum_i slices[i] * 2^(exp - 7(i+1))`` down to ``2^(exp - 7s)``
+    (window-prescaled lines cancel the shift algebraically, so callers
+    only ever see ``exp``). All-zero lines yield zero slices.
+    """
+    line_max = jnp.max(jnp.abs(v), axis=axis, keepdims=True)
+    _, exp = jnp.frexp(line_max)  # line_max = f * 2^exp, f in [0.5, 1)
+    shift = jnp.clip(exp, _EXP_LO, None) - exp  # >= 0; 0 for normal data
+    v = v * jnp.ldexp(jnp.ones((), v.dtype), shift)
+    exp_w = exp + shift
+    slices = []
+    r = v
+    for i in range(n_slices):
+        scale_exp = exp_w - _I8_BITS * (i + 1)
+        # Multiply by the inverse scale (both are exact powers of two well
+        # inside the normal range thanks to the window). Round to NEAREST,
+        # not toward zero: truncation is signed-biased, and over a length-k
+        # contraction the per-element residuals then accumulate linearly
+        # (measured ~100x worse than the random-walk of unbiased rounding).
+        # Nearest can carry to ±128, which int8 lacks — clip to ±127; the
+        # residual of a clipped lane is still < scale, which the next
+        # slice level absorbs (its q stays within the same clip bound).
+        q = jnp.clip(
+            jnp.round(r * jnp.ldexp(jnp.ones((), r.dtype), -scale_exp)),
+            -127.0, 127.0,
+        )
+        slices.append(q.astype(jnp.int8))
+        r = r - q * jnp.ldexp(jnp.ones((), r.dtype), scale_exp)
+    return jnp.stack(slices), exp
+
+
+def _int32_halves(p: Array) -> tuple[Array, Array]:
+    """Split int32 into exactly-representable fp32 (high, low) 16-bit parts."""
+    hi = p >> 16
+    lo = p - (hi << 16)
+    return hi.astype(jnp.float32) * jnp.float32(65536.0), lo.astype(jnp.float32)
+
+
+def _matmul_ozaki_i8(a: Array, b: Array, n_slices: int) -> Array:
+    acc = jnp.promote_types(jnp.promote_types(a.dtype, b.dtype), jnp.float32)
+    if acc == jnp.float64:
+        # fp64 backend: the plain fp64 matmul IS the reference's accumulation.
+        return jnp.matmul(a.astype(acc), b.astype(acc))
+    a = a.astype(jnp.float32)
+    x_vector = b.ndim == 1
+    if x_vector:
+        b = b[:, None]
+    b = b.astype(jnp.float32)
+    m, k = a.shape
+    n = b.shape[1]
+    if k == 0:
+        c = jnp.zeros((m, n), acc)
+        return c[:, 0] if x_vector else c
+    a_s, ea = _split_int8(a, n_slices, axis=1)  # (s, m, k), (m, 1)
+    b_s, eb = _split_int8(b, n_slices, axis=0)  # (s, k, n), (1, n)
+
+    # Running double-float accumulator per output element. Loop over slice
+    # pairs and k-chunks; each product is ONE int8 matmul whose int32
+    # result is exact (the k-chunk bound), split into fp32 halves, rescaled
+    # by the pair's power-of-two exponent, and df-folded. s^2 (+ chunking)
+    # unrolled matmuls: at real sizes each is MXU-bound; the df folds are
+    # O(m·n) VPU work per pair, vanishing against O(m·k·n).
+    hi_acc = jnp.zeros((m, n), jnp.float32)
+    lo_acc = jnp.zeros_like(hi_acc)
+    starts = range(0, k, _I8_BLOCK)
+    for i in range(n_slices):
+        for j in range(n_slices):
+            e_pair = ea + eb - _I8_BITS * (i + j + 2)  # (m, n) via broadcast
+            # Chunk partials fold in UNSCALED integer space first (halves
+            # are ≤ 2^31, safely fp32-df): cross-chunk cancellation must
+            # happen before the pair's ldexp, or a transiently-huge chunk
+            # partial could overflow fp32 where the cancelled full-k pair
+            # value is representable (ozaki.py's overshoot lesson, at
+            # chunk granularity).
+            hi_p = jnp.zeros((m, n), jnp.float32)
+            lo_p = jnp.zeros_like(hi_p)
+            for s0 in starts:
+                sl = slice(s0, min(s0 + _I8_BLOCK, k))
+                p = jnp.matmul(
+                    a_s[i][:, sl], b_s[j][sl, :],
+                    preferred_element_type=jnp.int32,
+                )
+                p_hi, p_lo = _int32_halves(p)
+                hi_p, lo_p = df_add(hi_p, lo_p, p_hi, p_lo)
+            hi_acc, lo_acc = df_add(
+                hi_acc, lo_acc,
+                jnp.ldexp(hi_p, e_pair), jnp.ldexp(lo_p, e_pair),
+            )
+    c = (hi_acc + lo_acc).astype(acc)
+    return c[:, 0] if x_vector else c
+
+
+matmul_ozaki = partial(_matmul_ozaki_i8, n_slices=4)
+matmul_ozaki6 = partial(_matmul_ozaki_i8, n_slices=6)
+
+register_gemm_kernel("ozaki", matmul_ozaki)
+register_gemm_kernel("ozaki6", matmul_ozaki6)
+# The GEMV face of the int8 formulation (b arrives as a vector).
+register_kernel("ozaki_i8", matmul_ozaki)
