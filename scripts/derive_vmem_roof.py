@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Derive the sub-VMEM sanity ceiling from committed loop-measure rows.
+
+``tests/test_data_quality.py`` bounds small-operand (VMEM-resident) TPU rows
+by a sanity ceiling. Before any trusted on-chip measurement exists that
+ceiling is a generous flat 5 TB/s — enough to catch clamp artifacts
+(10^5-10^6 "GB/s") but loose enough that dispatch-jitter garbage under it
+would pass (round-3 review, "what's weak" #2). This script replaces the
+flat constant with a measurement-derived one, as a capture stage: read the
+freshly-captured ``measure=loop`` rows, take the fastest *sub-VMEM*
+bandwidth actually measured on the chip, and write
+``data/out/vmem_roof.json`` holding that maximum plus the derived ceiling
+(max × a documented head-room factor). The data-quality gate uses the
+derived ceiling whenever the file exists, so the bound tightens from
+5 TB/s to ~1.5× the best physically-measured value the moment a capture
+lands — small-size garbage can no longer hide under the flat bound.
+
+Wedge-safe: reads CSVs only, never touches the backend.
+
+Usage: python scripts/derive_vmem_roof.py [--data-root data] [--min-rows 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# Keep in sync with tests/test_data_quality.py (VMEM_BYTES) — the
+# residency boundary deciding which rows the roof derives from.
+VMEM_BYTES = 128 * 1024 * 1024
+# Head room over the fastest measured sub-VMEM row: tolerates run-to-run
+# variance and modestly faster future configs without re-derivation, while
+# staying ~3x tighter than the flat 5 TB/s for any plausible measurement.
+HEADROOM = 1.5
+
+ITEMSIZE = {"float64": 8, "float32": 4, "bfloat16": 2, "float16": 2}
+
+
+def derive(data_root: Path, min_rows: int = 3) -> dict | None:
+    from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
+
+    ext = data_root / "out" / "results_extended.csv"
+    if not ext.exists():
+        return None
+    rows = [
+        r for r in read_csv(ext)
+        if r["measure"] == "loop"
+        and ITEMSIZE[r["dtype"]] * r["n_rows"] * r["n_cols"] / r["n_devices"]
+        <= VMEM_BYTES
+    ]
+    if len(rows) < min_rows:
+        return None
+    best = max(rows, key=lambda r: r["gbps"] / r["n_devices"])
+    per_chip = best["gbps"] / best["n_devices"]
+    return {
+        "measured_max_per_chip_gbps": per_chip,
+        "ceiling_per_chip_gbps": per_chip * HEADROOM,
+        "headroom_factor": HEADROOM,
+        "n_subvmem_loop_rows": len(rows),
+        "source_row": {
+            k: best[k]
+            for k in ("strategy", "n_rows", "n_cols", "n_devices", "dtype",
+                      "gbps")
+        },
+        "derivation": (
+            "max over committed measure=loop rows with per-chip operand "
+            f"bytes <= {VMEM_BYTES} of (gbps / n_devices), times "
+            f"{HEADROOM} head room; consumed by tests/test_data_quality.py "
+            "in place of the flat pre-measurement 5 TB/s sanity bound"
+        ),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-root", default="data")
+    p.add_argument(
+        "--min-rows", type=int, default=3,
+        help="refuse to derive a roof from fewer sub-VMEM loop rows than "
+        "this (one stray row must not set the gate for the whole dataset)",
+    )
+    args = p.parse_args(argv)
+    data_root = Path(args.data_root)
+    payload = derive(data_root, args.min_rows)
+    if payload is None:
+        print(
+            "no roof derived: need at least "
+            f"{args.min_rows} sub-VMEM measure=loop rows in "
+            f"{data_root / 'out' / 'results_extended.csv'}",
+        )
+        return 0  # not a capture failure: the gate just keeps the flat bound
+    out = data_root / "out" / "vmem_roof.json"
+    out.write_text(json.dumps(payload, indent=2) + "\n")
+    print(
+        f"wrote {out}: ceiling "
+        f"{payload['ceiling_per_chip_gbps']:.1f} GB/s/chip "
+        f"(= {HEADROOM} x measured "
+        f"{payload['measured_max_per_chip_gbps']:.1f} from "
+        f"{payload['n_subvmem_loop_rows']} rows)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,6 +158,12 @@ def main(argv=None) -> int:
                               "--dtype", "float32", "--measure", "loop",
                               "--chain-samples", "5", "--n-reps", "50"],
                      sweep_stage=True)
+            # Wedge-safe (reads the CSVs just written): derive the
+            # measurement-based sub-VMEM sanity ceiling so the data-quality
+            # gate tightens from the flat pre-measurement bound the moment
+            # loop rows exist (tests/test_data_quality.py reads the JSON).
+            step("vmem_roof", [py, "scripts/derive_vmem_roof.py",
+                               "--data-root", args.data_root])
         if "hostlink" not in args.skip:
             step("hostlink", [py, "scripts/hostlink_study.py",
                               "--data-root", args.data_root, "--max-mb", "256"])
@@ -175,6 +181,16 @@ def main(argv=None) -> int:
                           # Own label: unlabeled pallas rows would be
                           # averaged with the xla rows at the same key.
                           "--label-suffix", "pallas"],
+                 sweep_stage=True)
+            # fp64-parity GEMM on the int8 MXU (ops/ozaki_gemm.py): the
+            # accuracy story is pinned by tests; this lands its measured
+            # on-chip cost next to the xla/pallas tiers.
+            step("gemm_ozaki",
+                 sweep + ["--op", "gemm", "--strategy", "blockwise",
+                          "--sizes", "8192", "--dtype", "float32",
+                          "--kernel", "ozaki", "--measure", "loop",
+                          "--n-reps", "10",
+                          "--label-suffix", "ozaki"],
                  sweep_stage=True)
         if "overlap" not in args.skip:
             # Real-backend overlap evidence: async collective-permute

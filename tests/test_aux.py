@@ -135,8 +135,13 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
     assert (
         stage("bench.py") < stage("BASELINE-STAGE")
         < stage("--sweep square") < stage("--sweep asymmetric")
-        < stage("hostlink_study") < stage("--op gemm")
+        # The measured sub-VMEM ceiling derives from the sweep CSVs just
+        # written, so its stage must directly follow the sweeps.
+        < stage("derive_vmem_roof") < stage("hostlink_study")
+        < stage("--op gemm")
     )
+    # The fp64-parity GEMM tier's on-chip cost lands with the capture.
+    assert any("--kernel ozaki" in c for c in joined)
 
     # The notebook re-execution is LAST (it renders whatever dataset the
     # earlier stages finished writing)...
