@@ -156,7 +156,8 @@ def test_ring_matvec_rejects_indivisible_rows(devices):
 
 
 @pytest.mark.parametrize(
-    "kernel", ["xla", "xla_colwise", "pallas", "compensated"]
+    "kernel",
+    ["xla", "xla_colwise", "pallas", "compensated", "ozaki", "ozaki_i8"],
 )
 def test_colwise_ring_overlap_kernel_matrix(devices, rng, kernel):
     # ring_matvec hands each registered kernel small (m/p, k/p) dynamic-sliced
@@ -228,3 +229,17 @@ def test_ring_gather_output_replicated_native_is_plain_gather(devices, rng):
     )
     assert y.sharding.is_fully_replicated
     np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-10)
+
+
+@pytest.mark.parametrize("kernel", ["ozaki", "ozaki_i8"])
+def test_colwise_ring_overlap_ozaki_fp32_slicing(devices, rng, kernel):
+    """fp32 operands force the ozaki kernels' actual slicing path (fp64
+    inputs delegate to the plain fp64 dot) inside ring_matvec's dynamic
+    tile slices — frexp/round/int casts must all trace under shard_map."""
+    a = rng.standard_normal((16, 32)).astype(np.float32)
+    x = rng.standard_normal(32).astype(np.float32)
+    mesh = make_mesh(8)
+    y = get_strategy("colwise_ring_overlap").build(mesh, kernel=kernel)(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-5)
