@@ -157,7 +157,8 @@ def test_ring_matvec_rejects_indivisible_rows(devices):
 
 @pytest.mark.parametrize(
     "kernel",
-    ["xla", "xla_colwise", "pallas", "compensated", "ozaki", "ozaki_i8"],
+    ["xla", "xla_colwise", "pallas", "compensated", "ozaki", "ozaki6",
+     "ozaki_i8"],
 )
 def test_colwise_ring_overlap_kernel_matrix(devices, rng, kernel):
     # ring_matvec hands each registered kernel small (m/p, k/p) dynamic-sliced
