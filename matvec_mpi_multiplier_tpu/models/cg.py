@@ -1,0 +1,153 @@
+"""Distributed conjugate-gradient solver on the strategy shardings.
+
+The reference benchmarks one distributed matvec in isolation; every real
+consumer of such a kernel runs it inside an *iteration* — and CG for SPD
+systems is the canonical one: one distributed matvec per step plus a
+handful of dots and axpys. This module is the framework's demonstration
+that the strategy layer composes into a full Krylov solver under one
+``jit``, with the strategy's gather-combine (``models/base.py``) as the
+solver's per-iteration communication:
+
+* ``A`` is sharded by the chosen strategy's own spec (rowwise's row blocks,
+  blockwise's 2-D grid — ``strategy.specs(mesh)``), never replicated;
+* the per-iteration matvec is the strategy's ``local_body`` under
+  shard_map, exactly the benchmarked program;
+* vectors live replicated (they are O(n); A is O(n²) — the same asymmetry
+  that lets the reference broadcast x while scattering A,
+  ``src/multiplier_rowwise.c:12-51``), and the strategy's gather brings
+  each ``A·p`` back to replicated form — for rowwise that gather IS the
+  ``MPI_Gather`` analog, so the solver's per-iteration communication is
+  precisely the benchmarked combine;
+* the stopping rule is a ``lax.while_loop`` on the residual norm — the
+  XLA-correct data-dependent control flow (no Python-level iteration, one
+  compiled program regardless of how many steps it takes, SURVEY.md §7's
+  "compiler-friendly control flow" stance);
+* all iteration arithmetic runs in the kernel registry's accumulator
+  dtype, so bf16/fp32 storage never degrades the recurrences (same
+  contract as the strategies' psum, ``ops/gemv.py``).
+
+CG's convergence theory assumes exact arithmetic; in fp32 the residual
+recurrence drifts, so the solver recomputes the TRUE residual every
+``recompute_every`` steps (a standard restarted-CG hygiene) — and the
+``kernel`` knob accepts the fp64-parity tiers (``ozaki``, ``compensated``)
+for ill-conditioned systems, giving the reference's "solve in double"
+behavior on fp64-less hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .base import MatvecStrategy
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class CGResult:
+    """Solution + convergence telemetry (all device-resident)."""
+
+    x: Array
+    n_iters: Array
+    residual_norm: Array
+    converged: Array
+
+
+def build_cg(
+    strategy: MatvecStrategy,
+    mesh: Mesh,
+    *,
+    kernel: str | Callable = "xla",
+    tol: float = 1e-6,
+    max_iters: int = 1000,
+    recompute_every: int = 50,
+) -> Callable[[Array, Array], CGResult]:
+    """Return jitted ``cg(a, b) -> CGResult`` solving ``A x = b`` (A SPD).
+
+    The returned function validates shapes through the strategy's own
+    guards at trace time (the same typed ShardingError the benchmark
+    entry points raise) and runs entirely on device: one strategy matvec
+    + O(n) vector work per iteration inside ``lax.while_loop``.
+    """
+    matvec = strategy.build(mesh, kernel=kernel, gather_output=True)
+    replicated = NamedSharding(mesh, P())
+
+    @jax.jit
+    def cg(a: Array, b: Array) -> CGResult:
+        strategy.validate(a.shape[0], a.shape[1], mesh)
+        if a.shape[0] != a.shape[1]:
+            # CG is defined for SPD (hence square) A; the strategies
+            # themselves happily multiply rectangular matrices.
+            raise ValueError(
+                f"cg needs a square matrix, got {a.shape[0]}x{a.shape[1]}"
+            )
+        acc = jnp.promote_types(a.dtype, jnp.float32)
+        b_acc = jax.lax.with_sharding_constraint(b.astype(acc), replicated)
+        b_norm = jnp.sqrt(jnp.sum(b_acc * b_acc))
+        # Absolute threshold from the relative tol: ||r|| <= tol * ||b||
+        # (the standard scipy.sparse.linalg.cg semantics).
+        threshold = tol * b_norm
+
+        def mv(v: Array) -> Array:
+            # The strategy's storage dtype in, accumulator out; vectors are
+            # kept replicated between iterations (they are O(n)).
+            y = matvec(a, v.astype(a.dtype)).astype(acc)
+            return jax.lax.with_sharding_constraint(y, replicated)
+
+        x0 = jnp.zeros_like(b_acc)
+        r0 = b_acc  # r = b - A @ 0
+        state0 = (x0, r0, r0, jnp.sum(r0 * r0), jnp.asarray(0, jnp.int32))
+
+        def cond(state):
+            _, _, _, rr, k = state
+            return (jnp.sqrt(rr) > threshold) & (k < max_iters)
+
+        def body(state):
+            x, r, p, rr, k = state
+            ap = mv(p)
+            # p'Ap > 0 for SPD A; guard against a zero/negative breakdown
+            # (indefinite or numerically-degenerate input) by stalling
+            # rather than emitting inf/NaN — the loop then exits on
+            # max_iters with converged=False.
+            pap = jnp.sum(p * ap)
+            safe = pap > 0
+            alpha = jnp.where(safe, rr / jnp.where(safe, pap, 1.0), 0.0)
+            x = x + alpha * p
+            r_rec = r - alpha * ap
+            # Periodic true-residual refresh: the recurrence drifts in
+            # finite precision; every recompute_every steps pay one extra
+            # matvec for the exact r = b - A x. lax.cond, not jnp.where:
+            # where would evaluate both branches and run the extra matvec
+            # every iteration.
+            r = jax.lax.cond(
+                (k + 1) % recompute_every == 0,
+                lambda: b_acc - mv(x),
+                lambda: r_rec,
+            )
+            rr_new = jnp.sum(r * r)
+            beta = jnp.where(safe, rr_new / jnp.where(rr > 0, rr, 1.0), 0.0)
+            p = r + beta * p
+            return (x, r, p, rr_new, k + 1)
+
+        x, r, _, rr, k = jax.lax.while_loop(cond, body, state0)
+        return CGResult(
+            x=x,
+            n_iters=k,
+            residual_norm=jnp.sqrt(rr),
+            converged=jnp.sqrt(rr) <= threshold,
+        )
+
+    return cg
+
+
+def solve_cg(
+    strategy: MatvecStrategy, mesh: Mesh, a: Array, b: Array, **kwargs
+) -> CGResult:
+    """Convenience one-shot: build and run (kwargs go to :func:`build_cg`)."""
+    return build_cg(strategy, mesh, **kwargs)(a, b)

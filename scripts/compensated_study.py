@@ -34,7 +34,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 # First entry is the speed baseline the slowdown column is measured against.
-KERNELS = ("xla", "compensated", "ozaki", "ozaki6")
+KERNELS = ("xla", "compensated", "ozaki", "ozaki6", "ozaki_i8")
 
 
 def cancellation_case(n_rows: int, n_cols: int, rng) -> tuple:
@@ -197,7 +197,13 @@ def main(argv=None) -> int:
         "dots are exact in fp32 — the bulk arithmetic becomes one batched "
         "MXU contraction instead of per-element VPU transformations, "
         "closing most of the compensated tier's speed gap (`ozaki6` widens "
-        "the per-block accuracy window from 32 to 48 bits).",
+        "the per-block accuracy window from 32 to 48 bits). "
+        "`kernel=ozaki_i8` (`ops/ozaki_gemm.py`) is the int8 "
+        "formulation of the same idea — 7-bit slices, exact int32 "
+        "contraction through k=2^16 per dot, the natural form for "
+        "the MXU's integer mode and the registry's rank-2 GEMM "
+        "tier, registered for GEMV so both formulations are "
+        "measured side by side.",
     ]
     text = "\n".join(report) + "\n"
     print("\n" + text)
