@@ -34,6 +34,10 @@ def main(argv=None) -> int:
     p.add_argument("--tol", type=float, default=1e-6,
                    help="relative tolerance: stop at ||r|| <= tol * ||b||")
     p.add_argument("--max-iters", type=int, default=1000)
+    p.add_argument("--precondition", choices=["none", "jacobi"],
+                   default="none",
+                   help="jacobi: diag(A) preconditioner — the cheap win "
+                   "when rows live on very different scales")
     p.add_argument("--devices", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
@@ -71,6 +75,7 @@ def main(argv=None) -> int:
     cg = build_cg(
         strategy, mesh, kernel=args.kernel, tol=args.tol,
         max_iters=args.max_iters,
+        precondition=False if args.precondition == "none" else args.precondition,
     )
     # Device-resident operands OUTSIDE the timed region: the reported ms
     # is the solve, not an n^2 host->device transfer (the amortized-mode
