@@ -109,8 +109,11 @@ def append_result(result: TimingResult, root: str | os.PathLike | None = None) -
 
 def read_csv(path: str | os.PathLike) -> list[dict]:
     """Parse a reference-schema or extended CSV into row dicts (numbers
-    converted). Tolerates both the spaced reference header and the no-space
-    header of the reference's asymmetric CSVs (quirk Q10)."""
+    converted). Tolerates both the spaced header the reference's CODE
+    writes (src/multiplier_rowwise.c:86 — the convention this module
+    emits) and the no-space header of every CSV the reference actually
+    COMMITTED (not just the asymmetric ones, as SURVEY quirk Q10 implies:
+    its square files predate the committed source's fprintf too)."""
     path = Path(path)
     lines = [ln.strip() for ln in path.read_text().splitlines() if ln.strip()]
     if not lines:
