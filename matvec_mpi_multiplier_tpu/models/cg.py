@@ -132,14 +132,17 @@ def build_cg(
         state0 = (
             x0, r0, z0, jnp.sum(r0 * z0), jnp.sum(r0 * r0),
             jnp.asarray(0, jnp.int32),
+            x0, jnp.sum(r0 * r0),  # best-so-far (x, ||r||^2)
         )
 
         def cond(state):
-            _, _, _, _, rr, k = state
+            _, _, _, _, rr, k, _, rr_best = state
+            # Keep going while the CURRENT iterate is above tolerance; the
+            # best-so-far is what gets returned either way.
             return (jnp.sqrt(rr) > threshold) & (k < max_iters)
 
         def body(state):
-            x, r, p, rz, _, k = state
+            x, r, p, rz, _, k, x_best, rr_best = state
             ap = mv(p)
             # p'Ap > 0 for SPD A; guard against a zero/negative breakdown
             # (indefinite or numerically-degenerate input) by stalling
@@ -164,14 +167,31 @@ def build_cg(
             rz_new = jnp.sum(r * z)
             beta = jnp.where(safe, rz_new / jnp.where(rz != 0, rz, 1.0), 0.0)
             p = z + beta * p
-            return (x, r, p, rz_new, jnp.sum(r * r), k + 1)
+            rr_new = jnp.sum(r * r)
+            # Best-so-far tracking: finite-precision CG pushed past its
+            # attainable floor (a tolerance below ~cond(A)*eps) loses
+            # conjugacy and can run AWAY from the solution; returning the
+            # best visited iterate makes an unreachable tolerance cost
+            # only wall-time, never the answer.
+            better = rr_new < rr_best
+            x_best = jnp.where(better, x, x_best)
+            rr_best = jnp.where(better, rr_new, rr_best)
+            return (x, r, p, rz_new, rr_new, k + 1, x_best, rr_best)
 
-        x, r, _, _, rr, k = jax.lax.while_loop(cond, body, state0)
+        _, _, _, _, _, k, x_best, _ = jax.lax.while_loop(
+            cond, body, state0
+        )
+        # Report the TRUE residual of the returned iterate (one extra
+        # matvec): rr_best is a min over recurrence estimates, which drift
+        # between refreshes — a min over noisy underestimates is biased
+        # low and could claim convergence the returned x does not have.
+        r_true = b_acc - mv(x_best)
+        rnorm_true = jnp.sqrt(jnp.sum(r_true * r_true))
         return CGResult(
-            x=x,
+            x=x_best,
             n_iters=k,
-            residual_norm=jnp.sqrt(rr),
-            converged=jnp.sqrt(rr) <= threshold,
+            residual_norm=rnorm_true,
+            converged=rnorm_true <= threshold,
         )
 
     return cg
@@ -182,3 +202,136 @@ def solve_cg(
 ) -> CGResult:
     """Convenience one-shot: build and run (kwargs go to :func:`build_cg`)."""
     return build_cg(strategy, mesh, **kwargs)(a, b)
+
+
+def build_refined(
+    strategy: MatvecStrategy,
+    mesh: Mesh,
+    *,
+    residual_kernel: str | Callable = "ozaki",
+    inner_tol: float = 1e-2,
+    tol: float = 5e-7,
+    max_refinements: int = 10,
+    **cg_kwargs,
+) -> Callable[[Array, Array], CGResult]:
+    """Mixed-precision iterative refinement: fp32 CG speed, fp64-parity
+    residuals — the textbook application of the accuracy kernel tiers.
+    Returns ``refined(a, b) -> CGResult``; the compiled inner-CG and
+    residual programs are built once and reused across calls (per operand
+    shape), so a warm second call pays no retracing.
+
+    Plain fp32 CG's forward error grows as ``cond(A) * u_fp32``: at
+    condition 10^5 half the digits are gone. Wilkinson-style refinement
+    restores them at working precision: repeat ``r = b - A x`` in HIGH
+    precision, solve the correction ``A d = r`` cheaply in fp32, update
+    ``x += d`` — forward error lands at ~fp32 ulp as long as
+    ``cond(A) * u < 1``, with the expensive O(n²) work still the fp32 MXU
+    path. The reference gets this for free by computing in C ``double``
+    end-to-end (``src/matr_utils.c:86-96``); here the high-precision
+    residual is one strategy matvec with an fp64-parity tier
+    (``residual_kernel`` — ``ozaki`` by default, ``compensated`` for the
+    exact-but-slow extreme).
+
+    Two details carry the accuracy:
+
+    * the residual is evaluated as an augmented matvec ``[A | b] @ [x;-1]``
+      through the accurate kernel, so the catastrophic ``b - A x``
+      cancellation happens inside its extended-precision accumulation,
+      never in an fp32 subtraction of two large finished values;
+    * ``x`` accumulates across trips as a DOUBLE-FLOAT pair (hi, lo):
+      stored-fp32 x floors the residual at ``u * ||A|| * ||x||`` — the
+      refinement then stalls around ``cond * u`` forward error — while the
+      df pair pushes the storage floor to ~2^-48 so trips keep paying all
+      the way down to (near) working-precision forward error. The lo part
+      costs one extra accurate matvec per trip (``A @ x_lo``).
+
+    The outer loop is host-driven (a handful of trips, each launching the
+    compiled CG and residual programs); ``tol``/``max_refinements`` bound
+    it, ``inner_tol`` is the per-correction CG tolerance (loose on
+    purpose: refinement only needs a few digits per trip). Returns a
+    :func:`CGResult` whose ``n_iters`` counts refinement trips and whose
+    ``residual_norm`` is the high-precision ``||b - A x||``.
+    """
+    from ..ops.compensated import df_add
+    from ..parallel.mesh import make_mesh
+    from ..utils.errors import ShardingError
+    from .rowwise import RowwiseStrategy
+
+    inner = build_cg(strategy, mesh, tol=inner_tol, **cg_kwargs)
+    # The augmented residual matvec: k+1 columns can break the strategy's
+    # divisibility guards, so it runs on a rowwise sharding regardless of
+    # the inner strategy; whether n+1 rows/cols divide THIS mesh is a
+    # per-shape question, so both the mesh and the 1-device-fallback
+    # builds exist up front (compiled lazily on whichever a shape needs).
+    res_strat = RowwiseStrategy()
+    accurate_mesh = res_strat.build(mesh, kernel=residual_kernel)
+    accurate_1dev = res_strat.build(make_mesh(1), kernel=residual_kernel)
+
+    @partial(jax.jit, static_argnums=0)
+    def residual(accurate_mv, a_aug: Array, a: Array,
+                 x_hi: Array, x_lo: Array) -> Array:
+        # r = b - A (x_hi + x_lo): the hi part rides the augmented matvec
+        # ([A | b] @ [x_hi; -1] = A x_hi - b, cancellation inside the
+        # accurate accumulation), the lo part is a second accurate matvec.
+        acc = x_hi.dtype
+        v = jnp.concatenate([x_hi, -jnp.ones((1,), x_hi.dtype)])
+        r_hi = accurate_mv(a_aug, v.astype(a.dtype)).astype(acc)
+        r_lo = accurate_mv(a, x_lo.astype(a.dtype))
+        return -(r_hi + r_lo.astype(acc))
+
+    def refined(a: Array, b: Array) -> CGResult:
+        if a.shape[0] != a.shape[1]:
+            raise ValueError(
+                f"refined solve needs a square matrix, got "
+                f"{a.shape[0]}x{a.shape[1]}"
+            )
+        try:
+            res_strat.validate(a.shape[0], a.shape[1] + 1, mesh)
+            accurate_mv = accurate_mesh
+        except ShardingError:
+            accurate_mv = accurate_1dev
+        a_aug = jnp.concatenate([a, b[:, None].astype(a.dtype)], axis=1)
+        acc = jnp.promote_types(a.dtype, jnp.float32)
+        b_acc = b.astype(acc)
+        b_norm = float(jnp.sqrt(jnp.sum(b_acc * b_acc)))
+        threshold = tol * b_norm
+
+        res = partial(residual, accurate_mv, a_aug, a)
+        x_hi = jnp.zeros_like(b_acc)
+        x_lo = jnp.zeros_like(b_acc)
+        r = res(x_hi, x_lo)
+        rnorm = float(jnp.sqrt(jnp.sum(r * r)))
+        trips = 0
+        # Refine until STAGNATION, not until the residual threshold: under
+        # ill-conditioning a small residual does not yet mean a small
+        # forward error (the gap is the condition number) — keep going
+        # while each trip still meaningfully contracts the residual, stop
+        # when one fails to halve it. ``tol`` remains the
+        # reported-convergence criterion.
+        while trips < max_refinements and rnorm > 0.0:
+            d = inner(a, r.astype(a.dtype)).x.astype(acc)
+            nh, nl = df_add(x_hi, x_lo, d, jnp.zeros_like(d))
+            r_new = res(nh, nl)
+            new_norm = float(jnp.sqrt(jnp.sum(r_new * r_new)))
+            trips += 1
+            if new_norm >= 0.5 * rnorm:
+                # Stagnation: keep whichever iterate is better and stop.
+                if new_norm < rnorm:
+                    x_hi, x_lo, rnorm = nh, nl, new_norm
+                break
+            x_hi, x_lo, r, rnorm = nh, nl, r_new, new_norm
+        return CGResult(
+            x=(x_hi.astype(acc) + x_lo.astype(acc)).astype(a.dtype),
+            n_iters=jnp.asarray(trips, jnp.int32),
+            residual_norm=jnp.asarray(rnorm, acc),
+            converged=jnp.asarray(rnorm <= threshold),
+        )
+
+    return refined
+
+
+def solve_refined(
+    strategy: MatvecStrategy, mesh: Mesh, a: Array, b: Array, **kwargs
+) -> CGResult:
+    """Convenience one-shot (kwargs go to :func:`build_refined`)."""
+    return build_refined(strategy, mesh, **kwargs)(a, b)

@@ -38,6 +38,11 @@ def main(argv=None) -> int:
                    default="none",
                    help="jacobi: diag(A) preconditioner — the cheap win "
                    "when rows live on very different scales")
+    p.add_argument("--refine", action="store_true",
+                   help="mixed-precision iterative refinement: fp32 CG "
+                   "corrections + fp64-parity (ozaki) residuals + "
+                   "double-float x — ~fp32-ulp solutions where plain fp32 "
+                   "CG floors at cond(A)*eps")
     p.add_argument("--devices", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
@@ -57,7 +62,7 @@ def main(argv=None) -> int:
     import numpy as np
 
     from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
-    from matvec_mpi_multiplier_tpu.models.cg import build_cg
+    from matvec_mpi_multiplier_tpu.models.cg import build_cg, build_refined
     from matvec_mpi_multiplier_tpu.parallel import distributed
 
     distributed.initialize()
@@ -72,27 +77,38 @@ def main(argv=None) -> int:
     b_host = a_host @ x_true
 
     strategy = get_strategy(args.strategy)
-    cg = build_cg(
-        strategy, mesh, kernel=args.kernel, tol=args.tol,
-        max_iters=args.max_iters,
-        precondition=False if args.precondition == "none" else args.precondition,
-    )
+    precondition = False if args.precondition == "none" else args.precondition
+    if args.refine:
+        # Built ONCE: the compiled inner-CG and residual programs are
+        # reused by the timed second call (--kernel drives the inner CG;
+        # the residual always runs the fp64-parity ozaki tier).
+        run = build_refined(
+            strategy, mesh, kernel=args.kernel, tol=args.tol,
+            max_iters=args.max_iters, precondition=precondition,
+        )
+        label = f"{args.kernel}+refine(ozaki)"
+    else:
+        run = build_cg(
+            strategy, mesh, kernel=args.kernel, tol=args.tol,
+            max_iters=args.max_iters, precondition=precondition,
+        )
+        label = args.kernel
     # Device-resident operands OUTSIDE the timed region: the reported ms
     # is the solve, not an n^2 host->device transfer (the amortized-mode
     # stance of bench/timing.py).
     a_dev = jnp.asarray(a_host)
     b_dev = jnp.asarray(b_host)
-    res = cg(a_dev, b_dev)  # compile + run
+    res = run(a_dev, b_dev)  # compile + run
     jax.block_until_ready(res.x)
     t0 = time.perf_counter()
-    res = cg(a_dev, b_dev)
+    res = run(a_dev, b_dev)
     jax.block_until_ready(res.x)
     dt = time.perf_counter() - t0
 
     err = float(np.max(np.abs(np.asarray(res.x) - x_true)))
     if distributed.is_main_process():
         print(
-            f"cg[{args.strategy}/{args.kernel}] n={n} p={mesh.devices.size}: "
+            f"cg[{args.strategy}/{label}] n={n} p={mesh.devices.size}: "
             f"converged={bool(res.converged)} iters={int(res.n_iters)} "
             f"||r||={float(res.residual_norm):.3e} max|x-x_true|={err:.3e} "
             f"{dt * 1e3:.1f} ms"
