@@ -101,8 +101,8 @@ def main(argv=None) -> int:
     p.add_argument(
         "--skip", nargs="*", default=[],
         choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
-                 "compensated", "autotune", "autotune_gemm", "baseline",
-                 "figures", "notebook"],
+                 "compensated", "refine", "autotune", "autotune_gemm",
+                 "baseline", "figures", "notebook"],
     )
     p.add_argument(
         "--wipe-stale-csvs", action="store_true",
@@ -203,6 +203,11 @@ def main(argv=None) -> int:
             step("compensated",
                  [py, "scripts/compensated_study.py", "--size", "8192",
                   "--data-root", args.data_root])
+        if "refine" not in args.skip:
+            # Solver-level accuracy evidence on the chip: iterative
+            # refinement's forward-error ladder (docs/REFINEMENT.md,
+            # backend=tpu) — the accuracy tiers working inside a solver.
+            step("refine", [py, "scripts/refine_study.py", "--size", "2048"])
         if "autotune" not in args.skip:
             # Pallas tile search at the headline size: if a tile beats the
             # committed (512, 4096) defaults the report says which.

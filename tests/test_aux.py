@@ -322,3 +322,23 @@ def test_generate_data_cli_requires_args():
     import generate_data
     with pytest.raises(SystemExit):
         generate_data.main([])
+
+
+def test_refine_study_cli_smoke(monkeypatch, tmp_path):
+    """End-to-end plumbing of the refinement study on the CPU backend:
+    tiny ladder, report generation, measured-gain line."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import refine_study
+
+    monkeypatch.setattr(refine_study, "CONDS", (1e2,))
+    report = tmp_path / "REFINEMENT.md"
+    rc = refine_study.main([
+        "--platform", "cpu", "--size", "64", "--max-iters", "500",
+        "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    assert "| 1e+02 |" in text
+    assert "refined" in text
