@@ -1,0 +1,162 @@
+"""Spectral estimates through the strategy matvec: power iteration.
+
+Round-4 companion to the solver family (``models/cg.py``): CG's iteration
+count scales with ``sqrt(cond(A))`` and iterative refinement's payoff is
+governed by ``cond(A) * eps`` — both are statements about the spectrum,
+so the toolkit should be able to *estimate* it with the same distributed
+matvec it solves with. Two classic estimators, each one compiled
+``lax.while_loop``:
+
+* :func:`spectral_norm` — power iteration for ``λ_max(A)`` (the 2-norm for
+  SPD A): repeated strategy matvec + normalize, stop when the Rayleigh
+  quotient stabilizes. One matvec per step.
+* :func:`condition_estimate` — ``λ_max`` via power iteration and
+  ``λ_min`` via INVERSE iteration, with each ``A⁻¹ v`` application an
+  inner CG solve (``models/cg.py``) — the solver estimating the quantity
+  that governs its own convergence. Host-driven outer loop (a handful of
+  trips, like refinement).
+
+Estimates, not guarantees: power iteration converges at the eigenvalue
+gap ratio; a (tiny) random start vector makes a degenerate orthogonal
+start measure-zero.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .base import MatvecStrategy
+from .cg import build_cg
+
+
+def build_spectral_norm(
+    strategy: MatvecStrategy,
+    mesh: Mesh,
+    *,
+    kernel: str | Callable = "xla",
+    tol: float = 1e-4,
+    max_iters: int = 500,
+) -> Callable[[Array, Array], Array]:
+    """Return jitted ``power(a, v0) -> lambda_max`` (Rayleigh estimate).
+
+    ``v0`` is the start vector (callers pass a seeded random vector; a
+    deterministic start could be orthogonal to the dominant eigenvector).
+    Stops when the Rayleigh quotient's relative step falls under ``tol``.
+    """
+    matvec = strategy.build(mesh, kernel=kernel, gather_output=True)
+    replicated = NamedSharding(mesh, P())
+
+    @jax.jit
+    def power(a: Array, v0: Array) -> Array:
+        strategy.validate(a.shape[0], a.shape[1], mesh)
+        if a.shape[0] != a.shape[1]:
+            raise ValueError(
+                f"spectral_norm needs a square matrix, got "
+                f"{a.shape[0]}x{a.shape[1]}"
+            )
+        acc = jnp.promote_types(a.dtype, jnp.float32)
+
+        def mv(v: Array) -> Array:
+            y = matvec(a, v.astype(a.dtype)).astype(acc)
+            return jax.lax.with_sharding_constraint(y, replicated)
+
+        v = v0.astype(acc)
+        v = v / jnp.sqrt(jnp.sum(v * v))
+        state0 = (v, jnp.asarray(0.0, acc), jnp.asarray(jnp.inf, acc),
+                  jnp.asarray(0, jnp.int32))
+
+        def cond(state):
+            _, lam, prev, k = state
+            rel_step = jnp.abs(lam - prev) / jnp.maximum(jnp.abs(lam), 1e-30)
+            return (rel_step > tol) & (k < max_iters)
+
+        def body(state):
+            v, lam, _, k = state
+            av = mv(v)
+            new_lam = jnp.sum(v * av)  # Rayleigh quotient (unit v)
+            norm = jnp.sqrt(jnp.sum(av * av))
+            v = av / jnp.maximum(norm, 1e-30)
+            return (v, new_lam, lam, k + 1)
+
+        _, lam, _, _ = jax.lax.while_loop(cond, body, state0)
+        return lam
+
+    return power
+
+
+def spectral_norm(
+    strategy: MatvecStrategy, mesh: Mesh, a: Array, *, seed: int = 0, **kwargs
+) -> float:
+    """Convenience one-shot ``lambda_max`` estimate with a seeded start."""
+    v0 = jnp.asarray(
+        np.random.default_rng(seed).standard_normal(a.shape[1]), jnp.float32
+    )
+    return float(build_spectral_norm(strategy, mesh, **kwargs)(a, v0))
+
+
+def condition_estimate(
+    strategy: MatvecStrategy,
+    mesh: Mesh,
+    a: Array,
+    *,
+    kernel: str | Callable = "xla",
+    seed: int = 0,
+    inverse_iters: int = 8,
+    cg_tol: float = 1e-6,
+    cg_max_iters: int = 2000,
+    **power_kwargs,
+) -> float:
+    """Estimate ``cond_2(A) = λ_max / λ_min`` for SPD ``A``.
+
+    ``λ_max`` by power iteration; ``λ_min`` by inverse iteration, each
+    ``A⁻¹ v`` an inner CG solve. ``kernel`` drives BOTH halves (the power
+    iteration and the inner CG), so the whole estimate runs at one
+    accuracy tier. The inverse loop is host-driven and short
+    (``inverse_iters``): inverse iteration converges fast because the
+    INVERSE spectrum's dominance ratio is ``λ_min⁻¹ / λ_next⁻¹``.
+    Returns a float estimate (a lower bound, up to CG solve accuracy:
+    both Rayleigh quotients approach from inside the spectrum). If any
+    inner solve fails to converge — the deeply-ill-conditioned regime
+    where fp32 CG hits its floor — a ``RuntimeWarning`` flags that the
+    λ_min half (and hence the estimate) is unreliable.
+    """
+    rng = np.random.default_rng(seed)
+    lam_max = spectral_norm(
+        strategy, mesh, a, seed=seed, kernel=kernel, **power_kwargs
+    )
+    cg = build_cg(strategy, mesh, tol=cg_tol, max_iters=cg_max_iters,
+                  kernel=kernel)
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    v = jnp.asarray(rng.standard_normal(a.shape[1]), acc)
+    v = v / jnp.sqrt(jnp.sum(v * v))
+    mu = 0.0  # Rayleigh estimate of λ_min
+    stalled = False
+    for _ in range(inverse_iters):
+        res = cg(a, v.astype(a.dtype))
+        stalled = stalled or not bool(res.converged)
+        w = res.x.astype(acc)  # w ≈ A⁻¹ v
+        nw2 = float(jnp.sum(w * w))
+        if nw2 == 0.0:
+            break
+        # Rayleigh quotient of w under A without an extra matvec:
+        # A w ≈ v (to cg_tol), so μ = wᵀA w / wᵀw ≈ (w·v) / ||w||².
+        mu = float(jnp.sum(w * v)) / nw2
+        v = w / float(np.sqrt(nw2))
+    if stalled:
+        import warnings
+
+        warnings.warn(
+            "condition_estimate: an inner CG solve did not converge "
+            f"(tol={cg_tol}); the λ_min half of the estimate is "
+            "unreliable — the true condition number is likely LARGER "
+            "than reported",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    return lam_max / mu if mu > 0 else float("inf")

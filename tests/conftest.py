@@ -69,3 +69,12 @@ def fixture_4x8():
     a, x, y = FIXTURE_MATRIX, FIXTURE_VECTOR, FIXTURE_PRODUCT
     np.testing.assert_allclose(a @ x, y, rtol=1e-12)  # sanity on the fixture itself
     return a, x
+
+
+def spd_with_spectrum(n: int, eigs, seed: int = 0):
+    """SPD matrix with the prescribed spectrum: Q diag(eigs) Q' for a
+    seeded random orthogonal Q. Shared by the solver and spectral test
+    suites (one construction, one place to fix)."""
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return (q * np.asarray(eigs)) @ q.T

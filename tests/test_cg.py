@@ -181,12 +181,12 @@ def test_pcg_rejects_unknown_preconditioner(devices):
 
 
 def _ill_conditioned_spd(n, cond, seed):
-    """SPD with prescribed spectral condition number (Q diag Q')."""
-    rng = np.random.default_rng(seed)
-    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
-    eigs = np.logspace(0, np.log10(cond), n)
-    a = (q * eigs) @ q.T
-    x_true = rng.standard_normal(n)
+    """SPD with prescribed spectral condition number (shared construction
+    in conftest.spd_with_spectrum) plus a matching system."""
+    from tests.conftest import spd_with_spectrum
+
+    a = spd_with_spectrum(n, np.logspace(0, np.log10(cond), n), seed=seed)
+    x_true = np.random.default_rng(seed).standard_normal(n)
     return a, x_true, a @ x_true
 
 
