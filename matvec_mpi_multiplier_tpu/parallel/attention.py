@@ -1,0 +1,138 @@
+"""Ring attention: sequence-parallel exact attention over ppermute hops.
+
+``parallel/ring.py`` builds the combine-side ring primitives (reduce-
+scatter, overlapped ring matvec — the schedule skeleton of ring
+attention); this module is the full long-context operator itself
+(SURVEY.md §5.7: "ring attention / sequence parallelism" is the modern
+workload the reference's colwise contraction-sharding foreshadows).
+
+Layout: ``Q, K, V`` are ``(s, d)`` with the SEQUENCE axis sharded over
+the mesh's flat device axis — each device owns an ``(s/p, d)`` block of
+all three. The KV pair circulates the ring: at step ``t`` device ``i``
+holds the KV block originally owned by device ``(i - t) mod p``, computes
+its local ``Q_i K_j^T`` tile, and folds it into an ONLINE-SOFTMAX
+accumulator (the flash-attention recurrence: running row-max ``m``,
+normalizer ``l``, and value accumulator — numerically stable, never
+materializing the full ``s × s`` score matrix). After ``p − 1``
+single-neighbor hops every Q block has seen every KV block and holds its
+exact attention output, still sequence-sharded. Per-device memory is
+``O(s/p · d)`` and each hop's ``ppermute`` rides one ICI link while the
+current tile's MXU work overlaps it under XLA's async collectives —
+the property that makes million-token contexts feasible.
+
+Causal masking uses global positions reconstructed from the ring step
+(device ``i`` processing step ``t`` knows block ``j = i − t`` starts at
+``j · s/p``), so the mask needs no materialized position arrays beyond
+one iota per block.
+
+Accumulation runs in fp32 regardless of storage dtype (bf16 Q/K/V is the
+TPU-native input; softmax statistics in bf16 would destroy long-context
+tails) — the same accumulator contract as the kernel registry.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring import _ring_perm
+
+
+def _online_update(m, l, acc, scores, v_blk):
+    """Fold one score tile into the flash-attention running state.
+
+    ``scores``: (q_blk, k_blk) fp32 logits (already masked); ``v_blk``:
+    (k_blk, d). Rows with no unmasked entries contribute -inf maxima and
+    zero weight — handled because ``l`` only accumulates finite terms.
+    """
+    tile_max = jnp.max(scores, axis=1)  # (q_blk,)
+    new_m = jnp.maximum(m, tile_max)
+    # Guard -inf - -inf (fully masked row against fully masked history).
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    p_tile = jnp.exp(scores - safe_m[:, None])  # exp(-inf) = 0 for masked
+    l = l * correction + jnp.sum(p_tile, axis=1)
+    acc = acc * correction[:, None] + p_tile @ v_blk
+    return new_m, l, acc
+
+
+def ring_attention(
+    q: Array, k: Array, v: Array, axis_name, *, causal: bool = False
+) -> Array:
+    """Exact attention with the sequence axis sharded over ``axis_name``.
+
+    Must be called inside shard_map. ``q, k, v``: local ``(blk, d)``
+    sequence blocks (same ``blk`` on every device). Returns the local
+    ``(blk, d)`` block of ``softmax(Q Kᵀ / sqrt(d)) V`` (fp32), exactly —
+    the ring changes the schedule, not the math.
+    """
+    p = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    blk, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+    kv = (k.astype(jnp.float32), v.astype(jnp.float32))
+
+    m = jnp.full((blk,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((blk,), jnp.float32)
+    acc = jnp.zeros((blk, d), jnp.float32)
+    perm = _ring_perm(p)
+    rows = jax.lax.iota(jnp.int32, blk)
+
+    for t in range(p):
+        if t > 0:
+            kv = jax.lax.ppermute(kv, axis_name, perm)
+        k_blk, v_blk = kv
+        scores = qf @ k_blk.T  # (blk, blk)
+        if causal:
+            # Global positions: this device's Q rows start at idx*blk; the
+            # KV block in hand at step t came from device (idx - t) mod p.
+            src = jnp.mod(idx - t, p)
+            q_pos = idx * blk + rows[:, None]
+            k_pos = src * blk + rows[None, :]
+            scores = jnp.where(k_pos <= q_pos, scores, -jnp.inf)
+        m, l, acc = _online_update(m, l, acc, scores, v_blk)
+
+    # Fully-masked rows (can't happen causally: position t attends itself)
+    # would have l == 0; guard the division anyway.
+    return acc / jnp.maximum(l, 1e-30)[:, None]
+
+
+def build_ring_attention(
+    mesh: Mesh, *, causal: bool = False, gather_output: bool = False
+):
+    """Return jitted ``attn(q, k, v) -> o`` over ``mesh``'s flat axis.
+
+    Inputs are global ``(s, d)`` arrays, sequence-sharded by the returned
+    function's sharding constraints; ``s`` must divide the device count.
+    ``gather_output=True`` replicates the result (for small-scale
+    verification; the honest long-context mode keeps o sequence-sharded).
+    """
+    axes = tuple(mesh.axis_names)
+    spec = P(axes)
+
+    mapped = jax.shard_map(
+        partial(ring_attention, axis_name=axes, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+    @jax.jit
+    def attn(q: Array, k: Array, v: Array) -> Array:
+        s = q.shape[0]
+        p = int(mesh.devices.size)
+        if s % p != 0:
+            raise ValueError(
+                f"sequence length {s} not divisible by {p} devices"
+            )
+        o = mapped(q, k, v)
+        if gather_output:
+            o = jax.lax.with_sharding_constraint(o, NamedSharding(mesh, P()))
+        return o
+
+    return attn
