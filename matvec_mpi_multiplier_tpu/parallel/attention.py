@@ -102,6 +102,67 @@ def ring_attention(
     return acc / jnp.maximum(l, 1e-30)[:, None]
 
 
+def _dense_block_attention(q, k, v, *, causal: bool) -> Array:
+    """Plain fp32 attention over full local arrays (per-head local step of
+    the Ulysses schedule; (s, d) in, (s, d) out)."""
+    d = q.shape[-1]
+    scores = (q @ k.T) * (1.0 / (d ** 0.5))
+    if causal:
+        s = q.shape[0]
+        rows = jax.lax.iota(jnp.int32, s)
+        scores = jnp.where(rows[None, :] <= rows[:, None], scores, -jnp.inf)
+    m = jnp.max(scores, axis=1, keepdims=True)
+    w = jnp.exp(scores - m)
+    return (w @ v) / jnp.sum(w, axis=1, keepdims=True)
+
+
+def ulysses_attention(
+    q: Array, k: Array, v: Array, axis_name, *, causal: bool = False
+) -> Array:
+    """Exact multi-head attention, sequence-parallel via ONE all-to-all
+    each way — the Ulysses schedule, the balanced-exchange counterpart of
+    :func:`ring_attention` (SURVEY.md §5.7's second long-context family).
+
+    Must be called inside shard_map. ``q, k, v``: local
+    ``(s/p, h, d_head)`` blocks (sequence-sharded). One ``all_to_all``
+    reshards to head-parallel ``(s, h/p, d_head)`` — full sequence, a
+    slice of heads — where attention is a plain per-head dense step using
+    every link at once instead of p−1 neighbor hops; a second
+    ``all_to_all`` reshards back. Requires ``h % p == 0``. Trade-off vs
+    the ring: one balanced exchange (lower latency on all-to-all-capable
+    fabrics) against O(s²) per-head local scores (the ring never
+    materializes them) — which is why both live in the toolkit.
+    Returns the local ``(s/p, h, d_head)`` output block (fp32).
+    """
+    p = jax.lax.axis_size(axis_name)
+    blk, h, dh = q.shape
+    if p == 1:
+        return jax.vmap(
+            partial(_dense_block_attention, causal=causal),
+            in_axes=1, out_axes=1,
+        )(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+    if h % p != 0:
+        raise ValueError(f"ulysses_attention: {h} heads not divisible by {p}")
+
+    def to_heads(x):
+        # (s/p, h, dh) -> (s, h/p, dh): split heads across devices, gather
+        # the sequence — one balanced exchange.
+        return jax.lax.all_to_all(
+            x.astype(jnp.float32), axis_name, split_axis=1, concat_axis=0,
+            tiled=True,
+        )
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    oh = jax.vmap(
+        partial(_dense_block_attention, causal=causal),
+        in_axes=1, out_axes=1,
+    )(qh, kh, vh)
+    # (s, h/p, dh) -> (s/p, h, dh): the inverse exchange.
+    return jax.lax.all_to_all(
+        oh, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+
+
 def build_ring_attention(
     mesh: Mesh, *, causal: bool = False, gather_output: bool = False
 ):
@@ -130,6 +191,43 @@ def build_ring_attention(
             raise ValueError(
                 f"sequence length {s} not divisible by {p} devices"
             )
+        o = mapped(q, k, v)
+        if gather_output:
+            o = jax.lax.with_sharding_constraint(o, NamedSharding(mesh, P()))
+        return o
+
+    return attn
+
+
+def build_ulysses_attention(
+    mesh: Mesh, *, causal: bool = False, gather_output: bool = False
+):
+    """Return jitted ``attn(q, k, v) -> o`` for the all-to-all schedule.
+
+    Inputs are global ``(s, h, d_head)`` arrays, sequence-sharded on the
+    flat axis; ``s`` must divide the device count and ``h`` must divide
+    it too (the head-parallel intermediate layout).
+    """
+    axes = tuple(mesh.axis_names)
+    spec = P(axes)
+
+    mapped = jax.shard_map(
+        partial(ulysses_attention, axis_name=axes, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+
+    @jax.jit
+    def attn(q: Array, k: Array, v: Array) -> Array:
+        s, h = q.shape[0], q.shape[1]
+        p = int(mesh.devices.size)
+        if s % p != 0:
+            raise ValueError(
+                f"sequence length {s} not divisible by {p} devices"
+            )
+        if h % p != 0:
+            raise ValueError(f"{h} heads not divisible by {p} devices")
         o = mapped(q, k, v)
         if gather_output:
             o = jax.lax.with_sharding_constraint(o, NamedSharding(mesh, P()))
