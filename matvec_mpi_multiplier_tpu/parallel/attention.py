@@ -45,18 +45,21 @@ from .ring import _ring_perm
 def _online_update(m, l, acc, scores, v_blk):
     """Fold one score tile into the flash-attention running state.
 
-    ``scores``: (q_blk, k_blk) fp32 logits (already masked); ``v_blk``:
-    (k_blk, d). Rows with no unmasked entries contribute -inf maxima and
-    zero weight — handled because ``l`` only accumulates finite terms.
+    ``scores``: (h, q_blk, k_blk) fp32 logits (already masked); ``v_blk``:
+    (k_blk, h, d). ``m, l``: (h, q_blk); ``acc``: (h, q_blk, d). Rows with
+    no unmasked entries contribute -inf maxima and zero weight — handled
+    because ``l`` only accumulates finite terms.
     """
-    tile_max = jnp.max(scores, axis=1)  # (q_blk,)
+    tile_max = jnp.max(scores, axis=-1)  # (h, q_blk)
     new_m = jnp.maximum(m, tile_max)
     # Guard -inf - -inf (fully masked row against fully masked history).
     safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
     correction = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-    p_tile = jnp.exp(scores - safe_m[:, None])  # exp(-inf) = 0 for masked
-    l = l * correction + jnp.sum(p_tile, axis=1)
-    acc = acc * correction[:, None] + p_tile @ v_blk
+    p_tile = jnp.exp(scores - safe_m[..., None])  # exp(-inf) = 0 for masked
+    l = l * correction + jnp.sum(p_tile, axis=-1)
+    acc = acc * correction[..., None] + jnp.einsum(
+        "hqk,khd->hqd", p_tile, v_blk
+    )
     return new_m, l, acc
 
 
@@ -66,20 +69,25 @@ def ring_attention(
     """Exact attention with the sequence axis sharded over ``axis_name``.
 
     Must be called inside shard_map. ``q, k, v``: local ``(blk, d)``
-    sequence blocks (same ``blk`` on every device). Returns the local
-    ``(blk, d)`` block of ``softmax(Q Kᵀ / sqrt(d)) V`` (fp32), exactly —
-    the ring changes the schedule, not the math.
+    single-head or ``(blk, h, d_head)`` multi-head sequence blocks (same
+    ``blk`` on every device; heads batch through the same ring walk).
+    Returns the local block of ``softmax(Q Kᵀ / sqrt(d)) V`` (fp32, input
+    rank preserved), exactly — the ring changes the schedule, not the
+    math.
     """
     p = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
-    blk, d = q.shape
+    single_head = q.ndim == 2
+    if single_head:
+        q, k, v = q[:, None, :], k[:, None, :], v[:, None, :]
+    blk, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     qf = q.astype(jnp.float32) * scale
     kv = (k.astype(jnp.float32), v.astype(jnp.float32))
 
-    m = jnp.full((blk,), -jnp.inf, jnp.float32)
-    l = jnp.zeros((blk,), jnp.float32)
-    acc = jnp.zeros((blk, d), jnp.float32)
+    m = jnp.full((h, blk), -jnp.inf, jnp.float32)
+    l = jnp.zeros((h, blk), jnp.float32)
+    acc = jnp.zeros((h, blk, d), jnp.float32)
     perm = _ring_perm(p)
     rows = jax.lax.iota(jnp.int32, blk)
 
@@ -87,19 +95,24 @@ def ring_attention(
         if t > 0:
             kv = jax.lax.ppermute(kv, axis_name, perm)
         k_blk, v_blk = kv
-        scores = qf @ k_blk.T  # (blk, blk)
+        scores = jnp.einsum("qhd,khd->hqk", qf, k_blk)  # (h, blk, blk)
         if causal:
             # Global positions: this device's Q rows start at idx*blk; the
             # KV block in hand at step t came from device (idx - t) mod p.
             src = jnp.mod(idx - t, p)
             q_pos = idx * blk + rows[:, None]
             k_pos = src * blk + rows[None, :]
-            scores = jnp.where(k_pos <= q_pos, scores, -jnp.inf)
+            scores = jnp.where(
+                (k_pos <= q_pos)[None, :, :], scores, -jnp.inf
+            )
         m, l, acc = _online_update(m, l, acc, scores, v_blk)
 
     # Fully-masked rows (can't happen causally: position t attends itself)
     # would have l == 0; guard the division anyway.
-    return acc / jnp.maximum(l, 1e-30)[:, None]
+    o = acc / jnp.maximum(l, 1e-30)[..., None]  # (h, blk, d)
+    if single_head:
+        return o[0]  # the lone head, already (blk, d)
+    return jnp.transpose(o, (1, 0, 2))  # back to (blk, h, d)
 
 
 def _dense_block_attention(q, k, v, *, causal: bool) -> Array:
@@ -168,8 +181,9 @@ def build_ring_attention(
 ):
     """Return jitted ``attn(q, k, v) -> o`` over ``mesh``'s flat axis.
 
-    Inputs are global ``(s, d)`` arrays, sequence-sharded by the returned
-    function's sharding constraints; ``s`` must divide the device count.
+    Inputs are global ``(s, d)`` single-head or ``(s, h, d_head)``
+    multi-head arrays, sequence-sharded by the returned function's
+    sharding constraints; ``s`` must divide the device count.
     ``gather_output=True`` replicates the result (for small-scale
     verification; the honest long-context mode keeps o sequence-sharded).
     """

@@ -101,8 +101,8 @@ def main(argv=None) -> int:
     p.add_argument(
         "--skip", nargs="*", default=[],
         choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
-                 "compensated", "refine", "autotune", "autotune_gemm",
-                 "baseline", "figures", "notebook"],
+                 "compensated", "refine", "attention", "autotune",
+                 "autotune_gemm", "baseline", "figures", "notebook"],
     )
     p.add_argument(
         "--wipe-stale-csvs", action="store_true",
@@ -208,6 +208,15 @@ def main(argv=None) -> int:
             # refinement's forward-error ladder (docs/REFINEMENT.md,
             # backend=tpu) — the accuracy tiers working inside a solver.
             step("refine", [py, "scripts/refine_study.py", "--size", "2048"])
+        if "attention" not in args.skip:
+            # Long-context evidence on the chip: ring vs Ulysses vs the
+            # replicated dense baseline (docs/ATTENTION.md, backend=tpu).
+            # Single chip: schedules collapse to p=1, where every variant
+            # materializes the (h, s, s) scores — 8192 tops out around
+            # 2.1 GB fp32 per buffer, safely inside HBM; 16384 would be
+            # 8.6 GB per intermediate and OOM the stage.
+            step("attention", [py, "scripts/attention_study.py",
+                               "--seqs", "4096", "8192", "--causal"])
         if "autotune" not in args.skip:
             # Pallas tile search at the headline size: if a tile beats the
             # committed (512, 4096) defaults the report says which.

@@ -342,3 +342,22 @@ def test_refine_study_cli_smoke(monkeypatch, tmp_path):
     text = report.read_text()
     assert "| 1e+02 |" in text
     assert "refined" in text
+
+
+def test_attention_study_cli_smoke(monkeypatch, tmp_path):
+    """End-to-end plumbing of the attention study on the CPU backend:
+    tiny ladder, correctness asserts, report generation."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import attention_study
+
+    report = tmp_path / "ATTENTION.md"
+    rc = attention_study.main([
+        "--platform", "cpu", "--seqs", "64", "--heads", "8", "--d-head", "8",
+        "--n-reps", "2", "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    assert "| 64 |" in text
+    assert "ulysses" in text
