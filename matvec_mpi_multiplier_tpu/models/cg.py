@@ -112,10 +112,10 @@ def build_cg(
 
         if use_jacobi:
             d = jnp.diagonal(a).astype(acc)
-            # SPD diagonals are positive; degenerate entries fall back to
-            # the identity rather than poisoning the solve.
-            minv = jnp.where(jnp.abs(d) > 0, 1.0 / jnp.where(d != 0, d, 1.0),
-                             1.0)
+            # SPD diagonals are positive; degenerate (zero) entries fall
+            # back to the identity rather than poisoning the solve.
+            nonzero = d != 0
+            minv = jnp.where(nonzero, 1.0 / jnp.where(nonzero, d, 1.0), 1.0)
             minv = jax.lax.with_sharding_constraint(minv, replicated)
         else:
             minv = jnp.ones_like(b_acc)  # M = I: plain CG, same recurrence
@@ -202,6 +202,12 @@ def solve_cg(
 ) -> CGResult:
     """Convenience one-shot: build and run (kwargs go to :func:`build_cg`)."""
     return build_cg(strategy, mesh, **kwargs)(a, b)
+
+
+def _host_norm(v) -> float:
+    """Euclidean norm fetched to host (the refinement loop's control flow
+    is host-driven, unlike build_cg's device-side while_loop)."""
+    return float(jnp.sqrt(jnp.sum(v * v)))
 
 
 def build_refined(
@@ -293,14 +299,14 @@ def build_refined(
         a_aug = jnp.concatenate([a, b[:, None].astype(a.dtype)], axis=1)
         acc = jnp.promote_types(a.dtype, jnp.float32)
         b_acc = b.astype(acc)
-        b_norm = float(jnp.sqrt(jnp.sum(b_acc * b_acc)))
+        b_norm = _host_norm(b_acc)
         threshold = tol * b_norm
 
         res = partial(residual, accurate_mv, a_aug, a)
         x_hi = jnp.zeros_like(b_acc)
         x_lo = jnp.zeros_like(b_acc)
         r = res(x_hi, x_lo)
-        rnorm = float(jnp.sqrt(jnp.sum(r * r)))
+        rnorm = _host_norm(r)
         trips = 0
         # Refine until STAGNATION, not until the residual threshold: under
         # ill-conditioning a small residual does not yet mean a small
@@ -312,7 +318,7 @@ def build_refined(
             d = inner(a, r.astype(a.dtype)).x.astype(acc)
             nh, nl = df_add(x_hi, x_lo, d, jnp.zeros_like(d))
             r_new = res(nh, nl)
-            new_norm = float(jnp.sqrt(jnp.sum(r_new * r_new)))
+            new_norm = _host_norm(r_new)
             trips += 1
             if new_norm >= 0.5 * rnorm:
                 # Stagnation: keep whichever iterate is better and stop.

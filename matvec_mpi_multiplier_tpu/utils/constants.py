@@ -43,3 +43,16 @@ CSV_HEADER_EXTENDED: str = (
 # from get_2_most_closest_multipliers, src/utils.c:26-37).
 MESH_AXIS_ROWS: str = "rows"
 MESH_AXIS_COLS: str = "cols"
+
+# TPU v5e per-chip memory model, shared by the data-quality gates
+# (tests/test_data_quality.py) and the roof derivation
+# (scripts/derive_vmem_roof.py) so the residency boundary can never drift
+# between the gate and the deriver. HBM peak per BASELINE.json (~819 GB/s);
+# VMEM capacity ~128 MiB on v5e.
+TPU_HBM_PEAK_GBPS: float = 819.0
+VMEM_BYTES: int = 128 * 1024 * 1024
+
+# Bytes per element by dtype name (CSV rows carry dtype as a string).
+DTYPE_ITEMSIZE: dict[str, int] = {
+    "float64": 8, "float32": 4, "bfloat16": 2, "float16": 2,
+}

@@ -30,15 +30,15 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-# Keep in sync with tests/test_data_quality.py (VMEM_BYTES) — the
-# residency boundary deciding which rows the roof derives from.
-VMEM_BYTES = 128 * 1024 * 1024
+from matvec_mpi_multiplier_tpu.utils.constants import (  # noqa: E402
+    DTYPE_ITEMSIZE as ITEMSIZE,
+    VMEM_BYTES,
+)
+
 # Head room over the fastest measured sub-VMEM row: tolerates run-to-run
 # variance and modestly faster future configs without re-derivation, while
 # staying ~3x tighter than the flat 5 TB/s for any plausible measurement.
 HEADROOM = 1.5
-
-ITEMSIZE = {"float64": 8, "float32": 4, "bfloat16": 2, "float16": 2}
 
 
 def derive(data_root: Path, min_rows: int = 3) -> dict | None:

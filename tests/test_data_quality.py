@@ -31,9 +31,14 @@ REPO = Path(__file__).resolve().parent.parent
 TPU_EXTENDED = REPO / "data" / "out" / "results_extended.csv"
 CPU_EXTENDED = REPO / "data" / "out" / "cpu_mesh" / "results_extended.csv"
 
-# v5e per-chip HBM peak (BASELINE.json cites ~819 GB/s) + 10% measurement
-# tolerance. Applies to operand sets that cannot be VMEM-resident.
-TPU_HBM_PEAK_GBPS = 819.0
+from matvec_mpi_multiplier_tpu.utils.constants import (
+    DTYPE_ITEMSIZE as ITEMSIZE,
+    TPU_HBM_PEAK_GBPS,
+    VMEM_BYTES,
+)
+
+# + 10% measurement tolerance over the per-chip HBM peak. Applies to
+# operand sets that cannot be VMEM-resident.
 PEAK_TOLERANCE = 1.10
 # Operands at or under VMEM capacity (~128 MiB on v5e) may legitimately be
 # served from on-chip memory across the device-side rep loop, so their
@@ -43,7 +48,6 @@ PEAK_TOLERANCE = 1.10
 # scripts/derive_vmem_roof.py writes data/out/vmem_roof.json (1.5x the
 # fastest measured sub-VMEM loop row) and the measured ceiling replaces
 # the flat one — small-size garbage can no longer hide under it.
-VMEM_BYTES = 128 * 1024 * 1024
 _FLAT_VMEM_SANITY_GBPS = 5000.0
 
 
@@ -64,8 +68,6 @@ def _vmem_sanity_gbps() -> float:
 # The benchmark host is a small container; 200 GB/s is far above any
 # plausible DRAM bandwidth it can deliver, yet far below clamp artifacts.
 CPU_SANITY_GBPS = 200.0
-
-ITEMSIZE = {"float64": 8, "float32": 4, "bfloat16": 2, "float16": 2}
 
 
 def _rows(path: Path) -> list[dict]:
