@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Render the README per-size results table from the committed TPU dataset.
+
+The README's Results section cites a per-size table that lands with the
+loop-protocol capture; this renders it mechanically from
+``data/out/results_extended.csv`` so landing the capture is a paste, not
+an exercise (and reruns stay consistent with the data). Markdown goes to
+stdout: one row per size, one column per strategy, cell = time (ms) with
+aggregate effective GB/s.
+
+Usage::
+
+    python scripts/results_table.py                       # committed data
+    python scripts/results_table.py --data-root /tmp/x --measure sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-root", default="data")
+    p.add_argument("--measure", default="loop",
+                   help="protocol filter (loop = the trusted TPU protocol)")
+    p.add_argument("--mode", default="amortized")
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--devices", type=int, default=1)
+    p.add_argument("--shape", choices=["square", "asym", "all"],
+                   default="square")
+    args = p.parse_args(argv)
+
+    from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
+
+    ext = Path(args.data_root) / "out" / "results_extended.csv"
+    if not ext.exists():
+        print(f"no dataset at {ext}", file=sys.stderr)
+        return 1
+    rows = [
+        r for r in read_csv(ext)
+        if r["measure"] == args.measure and r["mode"] == args.mode
+        and r["dtype"] == args.dtype and r["n_devices"] == args.devices
+        and r["n_rhs"] == 1
+    ]
+    if args.shape != "all":
+        want_square = args.shape == "square"
+        rows = [r for r in rows if (r["n_rows"] == r["n_cols"]) == want_square]
+    if not rows:
+        print(
+            f"no {args.measure}/{args.mode}/{args.dtype} p={args.devices} "
+            f"rows in {ext}", file=sys.stderr,
+        )
+        return 1
+
+    # cell[(size)][strategy] = (time, gbps); keep the last row per key
+    # (append-only CSV: later rows supersede).
+    cells: dict[tuple, dict] = defaultdict(dict)
+    strategies: list[str] = []
+    for r in rows:
+        if r["strategy"] not in strategies:
+            strategies.append(r["strategy"])
+        cells[(r["n_rows"], r["n_cols"])][r["strategy"]] = (
+            r["time"], r["gbps"]
+        )
+    strategies.sort()
+
+    header = "| size | " + " | ".join(strategies) + " |"
+    sep = "|---" * (len(strategies) + 1) + "|"
+    lines = [header, sep]
+    for (m, n) in sorted(cells, key=lambda s: (s[0] * s[1], s)):
+        label = f"{m}²" if m == n else f"{m}×{n}"
+        row = [label]
+        for s in strategies:
+            if s in cells[(m, n)]:
+                t, g = cells[(m, n)][s]
+                row.append(f"{t * 1e3:.3f} ms ({g:.0f} GB/s)")
+            else:
+                row.append("—")
+        lines.append("| " + " | ".join(row) + " |")
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -284,3 +284,34 @@ def test_viz_script_roofline_per_device_count(tmp_path):
     assert rc == 0
     assert (figs / "roofline.png").exists()      # p=1 keeps the plain name
     assert (figs / "roofline_p2.png").exists()   # p=2 rows get their own
+
+
+def test_results_table_cli(tmp_path, capsys):
+    """The README results-table renderer: loop/mode/dtype/devices filters,
+    last-row-wins on the append-only CSV, markdown shape."""
+    import sys
+
+    sys.path.insert(0, "/root/repo/scripts")
+    import results_table
+
+    out = tmp_path / "out"
+    out.mkdir()
+    (out / "results_extended.csv").write_text(
+        "n_rows, n_cols, n_devices, time, strategy, dtype, mode, measure, "
+        "gflops, gbps, n_rhs\n"
+        "600, 600, 1, 0.001, rowwise, float32, amortized, loop, 1, 2.0, 1\n"
+        "600, 600, 1, 0.0005, rowwise, float32, amortized, loop, 1, 4.0, 1\n"
+        "600, 600, 1, 0.002, colwise, float32, amortized, loop, 1, 1.0, 1\n"
+        "600, 600, 1, 0.009, rowwise, float32, amortized, chain, 1, 0.1, 1\n"
+        "120, 60000, 1, 0.003, rowwise, float32, amortized, loop, 1, 9.0, 1\n"
+    )
+    rc = results_table.main(["--data-root", str(tmp_path)])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "| 600² |" in text
+    assert "0.500 ms (4 GB/s)" in text     # later row supersedes
+    assert "chain" not in text and "0.009" not in text  # protocol filter
+    assert "60000" not in text             # square shape filter
+    rc = results_table.main(["--data-root", str(tmp_path), "--shape", "asym"])
+    assert rc == 0
+    assert "120×60000" in capsys.readouterr().out
