@@ -266,3 +266,21 @@ def test_refined_compensated_residual_kernel(devices):
         )
         < 1e-4
     )
+
+
+def test_cg_cli_refine_smoke(monkeypatch, capsys):
+    from pathlib import Path
+    import sys
+
+    monkeypatch.syspath_prepend(
+        str(Path(__file__).parents[1] / "scripts")
+    )
+    import solve_cg
+
+    rc = solve_cg.main([
+        "--size", "64", "--strategy", "rowwise", "--devices", "4",
+        "--refine",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "refine(ozaki)" in out and "converged=True" in out
