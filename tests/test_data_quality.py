@@ -11,9 +11,11 @@ problem size, analyzed in its README. These tests hold this repo's committed
   (per-chip HBM peak for operand sets too large to live in VMEM);
 * ``measure=loop`` rows (the current jitter-proof protocol,
   ``bench/timing.py``) must be monotone: a strictly larger problem may not
-  be reported meaningfully faster. Rows from the older ``chain`` protocol
-  are exempt from monotonicity — they are superseded and replaced as
-  captures land — but still subject to the physical bounds.
+  be reported meaningfully faster. Rows from the retired ``chain``
+  protocol are quarantined under ``data/out/superseded/`` (round 4) and
+  no longer read by these gates at all; the protocol marker exemption
+  below remains so a stray future chain row is bounds-checked rather
+  than silently trusted for monotonicity.
 
 These tests run on whatever is committed: if a capture lands rows that
 refute themselves, the suite goes red — the property the round-2 review
