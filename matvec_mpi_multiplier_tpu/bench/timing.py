@@ -266,8 +266,9 @@ def _loop_slope(
 
     The requested spread ``n2 - n1`` is a lower bound: it is adaptively
     widened (``_grow_spread``) until the endpoint-time difference is at least
-    ``_LOOP_JITTER_FACTOR`` x the post-compile dispatch overhead (floored at
-    ``_LOOP_TARGET_FLOOR_S``), and each endpoint is the min of two runs —
+    ``_LOOP_JITTER_FACTOR`` x the measured post-compile dispatch overhead,
+    floored at the one-rep run time and ``_LOOP_TARGET_FLOOR_S``, and each
+    endpoint is the min of two runs —
     otherwise, over a high-latency tunnel, the slope measures dispatch jitter
     rather than the kernel. The overhead is *measured* (a post-compile k=1
     run), so the same code self-calibrates on fast local backends (sub-ms
@@ -299,10 +300,17 @@ def _loop_slope(
     t_dispatch, t_k1 = _dispatch_overhead(run)
     for _ in range(max(0, warmup)):
         run(n1)
-    # Floored at t_k1 (dispatch + one rep): if the one-rep subtraction was
-    # fooled by a correlated burst, the target still cannot drop below the
-    # scale the old conservative estimate enforced — jitter-dominated
-    # spreads (the round-1/2 impossible-CSV mode) stay locked out.
+    # Jitter margin on the PURE dispatch estimate, floored at t_k1
+    # (dispatch + one rep, un-multiplied). The two terms cover different
+    # failure modes: 3x t_dispatch drowns dispatch jitter without tripling
+    # wall-time for rep-dominated kernels (whose t_k1 >> t_dispatch — the
+    # round-3 wall-time finding, pinned by
+    # test_dispatch_overhead_subtracts_one_rep); the t_k1 floor preserves
+    # the dispatch+one-rep SCALE (not the old 3x-of-it target) when a
+    # correlated burst fools the one-rep subtraction and t_dispatch
+    # collapses toward zero — a weaker margin than 3x in that regime, paid
+    # for by the min-of-2 endpoints and the non-positive-median TimingError
+    # downstream.
     target = max(
         _LOOP_TARGET_FLOOR_S, _LOOP_JITTER_FACTOR * t_dispatch, t_k1
     )

@@ -326,8 +326,12 @@ def build_refined(
                     x_hi, x_lo, rnorm = nh, nl, new_norm
                 break
             x_hi, x_lo, r, rnorm = nh, nl, r_new, new_norm
+        # Accumulator dtype out, matching build_cg: casting back to a bf16
+        # storage dtype would floor the forward error at bf16 ulp and
+        # silently discard the double-float refinement the solve just paid
+        # for.
         return CGResult(
-            x=(x_hi.astype(acc) + x_lo.astype(acc)).astype(a.dtype),
+            x=x_hi.astype(acc) + x_lo.astype(acc),
             n_iters=jnp.asarray(trips, jnp.int32),
             residual_norm=jnp.asarray(rnorm, acc),
             converged=jnp.asarray(rnorm <= threshold),

@@ -92,7 +92,6 @@ def main(argv=None) -> int:
 
     ring = build_ring_attention(mesh, causal=args.causal)
     uly = build_ulysses_attention(mesh, causal=args.causal)
-    variants = {"dense_replicated": dense, "ring": None, "ulysses": None}
 
     rows = []
     for s in args.seqs:

@@ -1,7 +1,10 @@
 #!/bin/bash
 # Poll the tunneled TPU until it answers a probe, then run the full capture —
 # and if the capture itself dies mid-run (tunnel wedge), go back to probing
-# and try again at the next healthy window, up to $MAX_ATTEMPTS times.
+# and try again at the next healthy window. By default it retries FOREVER
+# (WATCH_MAX_ATTEMPTS=0): observed wedges run 8+ hours, so any finite budget
+# risks sitting idle through the one healthy window that matters. Failed
+# probes never count against the budget — only started captures do.
 #
 # The tunnel wedges unpredictably (jax.devices() blocks in C++; see
 # BASELINE.json's blockwise_65536_bf16_hbm_sweep.mapping_note). This watcher
@@ -17,12 +20,15 @@ set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${WATCH_INTERVAL_S:-180}"
 PROBE_TIMEOUT="${WATCH_PROBE_TIMEOUT_S:-120}"
-MAX_ATTEMPTS="${WATCH_MAX_ATTEMPTS:-3}"
+MAX_ATTEMPTS="${WATCH_MAX_ATTEMPTS:-0}"   # 0 = unlimited
 attempt=0
-while [ "$attempt" -lt "$MAX_ATTEMPTS" ]; do
+while [ "$MAX_ATTEMPTS" -eq 0 ] || [ "$attempt" -lt "$MAX_ATTEMPTS" ]; do
+  if [ "$MAX_ATTEMPTS" -eq 0 ] && [ "$attempt" -ge 1000 ]; then
+    break  # runaway backstop far above any real session
+  fi
   if timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     attempt=$((attempt + 1))
-    echo "$(date -u +%FT%TZ) probe OK — capture attempt $attempt/$MAX_ATTEMPTS" >&2
+    echo "$(date -u +%FT%TZ) probe OK — capture attempt $attempt/${MAX_ATTEMPTS/#0/inf}" >&2
     if python scripts/tpu_measure_all.py "$@"; then
       echo "$(date -u +%FT%TZ) capture succeeded on attempt $attempt" >&2
       exit 0
@@ -33,5 +39,5 @@ while [ "$attempt" -lt "$MAX_ATTEMPTS" ]; do
   fi
   sleep "$INTERVAL"
 done
-echo "$(date -u +%FT%TZ) giving up after $MAX_ATTEMPTS capture attempts" >&2
+echo "$(date -u +%FT%TZ) giving up after $attempt capture attempts" >&2
 exit 1

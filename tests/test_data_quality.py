@@ -60,12 +60,18 @@ def _vmem_sanity_gbps() -> float:
 
         payload = json.loads(roof_file.read_text())
         ceiling = payload["ceiling_per_chip_gbps"]
-        assert 0 < ceiling <= _FLAT_VMEM_SANITY_GBPS, (
-            f"derived VMEM roof {ceiling} outside (0, "
-            f"{_FLAT_VMEM_SANITY_GBPS}] — regenerate "
+        assert ceiling > 0, (
+            f"derived VMEM roof {ceiling} is non-positive — regenerate "
             "data/out/vmem_roof.json (scripts/derive_vmem_roof.py)"
         )
-        return ceiling
+        # Clamp the DERIVED ceiling (1.5x the fastest sub-VMEM row) to the
+        # flat bound instead of hard-asserting it below: a roof derived
+        # from rows in (3.3, 5] TB/s would otherwise turn this helper
+        # permanently red with a "regenerate" hint regeneration cannot
+        # satisfy. The flat 5 TB/s bound itself stays the absolute sanity
+        # ceiling — a ROW above it still fails the bandwidth gate, by
+        # design (no v5e memory tier delivers it).
+        return min(ceiling, _FLAT_VMEM_SANITY_GBPS)
     return _FLAT_VMEM_SANITY_GBPS
 # The benchmark host is a small container; 200 GB/s is far above any
 # plausible DRAM bandwidth it can deliver, yet far below clamp artifacts.
