@@ -361,3 +361,96 @@ def test_attention_study_cli_smoke(monkeypatch, tmp_path):
     text = report.read_text()
     assert "| 64 |" in text
     assert "ulysses" in text
+
+
+def _watcher_env(tmp_path, probe_failures: int, capture_rcs: list[int]) -> dict:
+    """PATH-shadow ``python`` so scripts/watch_and_capture.sh runs against a
+    scripted backend: the probe (a ``python -c`` call) fails
+    ``probe_failures`` times then succeeds; each capture invocation
+    (``python scripts/tpu_measure_all.py``) pops the next rc from
+    ``capture_rcs`` (empty list -> always 1)."""
+    import os
+    import stat
+
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    (tmp_path / "probe_failures").write_text(str(probe_failures))
+    (tmp_path / "capture_rcs").write_text(
+        "\n".join(str(rc) for rc in capture_rcs)
+    )
+    stub = bin_dir / "python"
+    stub.write_text(f"""#!/bin/bash
+state={tmp_path}
+case "$*" in
+  *tpu_measure_all.py*)
+    rcs=$(cat "$state/capture_rcs")
+    rc=${{rcs%%$'\\n'*}}; [ -z "$rc" ] && rc=1
+    rest=${{rcs#*$'\\n'}}; [ "$rest" = "$rcs" ] && rest=""
+    printf '%s' "$rest" > "$state/capture_rcs"
+    exit "$rc" ;;
+  *)
+    n=$(cat "$state/probe_failures")
+    if [ "$n" -gt 0 ]; then echo $((n - 1)) > "$state/probe_failures"; exit 1; fi
+    exit 0 ;;
+esac
+""")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env["PATH"] = f"{bin_dir}:{env['PATH']}"
+    env["WATCH_INTERVAL_S"] = "0"
+    env["WATCH_PROBE_TIMEOUT_S"] = "10"
+    return env
+
+
+def test_watcher_failed_probes_never_consume_the_attempt_budget(tmp_path):
+    """8+ hour wedges are the observed norm: a watcher whose budget could
+    expire on failed probes would sit idle through the one healthy window
+    that matters. Five failed probes, then a healthy one, then a capture
+    that succeeds — with a budget of ONE capture attempt."""
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).parents[1]
+    env = _watcher_env(tmp_path, probe_failures=5, capture_rcs=[0])
+    env["WATCH_MAX_ATTEMPTS"] = "1"
+    r = subprocess.run(
+        ["bash", str(repo / "scripts" / "watch_and_capture.sh")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "capture succeeded on attempt 1" in r.stderr
+    assert r.stderr.count("probe failed/hung") == 5
+
+
+def test_watcher_gives_up_after_the_configured_capture_attempts(tmp_path):
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).parents[1]
+    env = _watcher_env(tmp_path, probe_failures=0, capture_rcs=[1, 1])
+    env["WATCH_MAX_ATTEMPTS"] = "2"
+    r = subprocess.run(
+        ["bash", str(repo / "scripts" / "watch_and_capture.sh")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 1, r.stderr
+    # The give-up line reports attempts actually made (not a raw 0 budget).
+    assert "giving up after 2 capture attempts" in r.stderr
+
+
+def test_watcher_default_budget_is_unlimited(tmp_path):
+    """The default (WATCH_MAX_ATTEMPTS unset -> 0) must keep retrying past
+    any finite budget: 7 failed captures, then one success."""
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).parents[1]
+    env = _watcher_env(tmp_path, probe_failures=0, capture_rcs=[1] * 7 + [0])
+    env.pop("WATCH_MAX_ATTEMPTS", None)
+    r = subprocess.run(
+        ["bash", str(repo / "scripts" / "watch_and_capture.sh")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "capture succeeded on attempt 8" in r.stderr
+    assert "attempt 8/inf" in r.stderr
