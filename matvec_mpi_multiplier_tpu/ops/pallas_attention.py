@@ -1,0 +1,230 @@
+"""Pallas TPU flash-attention kernel for the local attention block.
+
+The long-context operators (``parallel/attention.py``: ring + Ulysses
+schedules) do their per-device work as "attention of a Q block against one
+KV block". The pure-JAX path materializes the (h, bq, bk) score tile in HBM
+between the two matmuls; this kernel is the fused tier — scores, online
+softmax, and the weighted-V product in one VMEM pipeline, the score tile
+never leaving the chip. It is the attention-shaped sibling of
+``ops/pallas_gemv.py`` (same role as the reference's single hand-written
+compute kernel, ``src/matr_utils.c:86-96``, which both distributed
+executables share): one explicit kernel, every schedule reuses it.
+
+The kernel computes a **partial**, not a finished attention:
+
+    o_unnorm[h, q, :] = sum_k exp(s[h, q, k] - m[h, q]) * v[h, k, :]
+    m[h, q]           = max_k s[h, q, k]          (-inf if all masked)
+    l[h, q]           = sum_k exp(s[h, q, k] - m[h, q])
+
+with ``s = (Q_pre_scaled) @ K^T`` plus optional causal masking by GLOBAL
+positions (the ring hands a device KV blocks that came from elsewhere in
+the sequence, so masking needs ``q_pos``/``k_pos`` vectors, not local
+indices). Partials compose: the ring folds one per hop with the standard
+flash rescaling identity, Ulysses normalizes a single full-block partial
+(``o = o_unnorm / l``). Numerics follow the house accumulator contract —
+fp32 statistics and accumulation regardless of storage dtype.
+
+Internally: grid ``(h, sq/bq, sk/bk)``, KV-block axis innermost; the
+running (m, l, acc) state lives in VMEM scratch carried across the
+sequential KV steps (TPU grids iterate in order), written to the outputs
+at the last step. Shapes that don't admit aligned tiles fall back to an
+equivalent plain-JAX partial — same contract, same results, so callers
+never branch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_gemv import _largest_divisor_leq, _on_tpu
+
+# (bq, bk) score tiles: 512x512 fp32 = 1 MiB in VMEM, comfortably
+# double-bufferable beside the (bq, d) accumulator and the KV tiles.
+DEFAULT_BQ = 512
+DEFAULT_BK = 512
+
+# Stats scratch keeps the (bq,) running max / normalizer broadcast across a
+# full 128-lane register row — the canonical TPU layout for per-row scalars
+# (a (bq, 1) buffer would fight the lane tiling for no memory win).
+_STATS_LANES = 128
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, qpos_ref, kpos_ref,
+    o_ref, m_ref, l_ref,
+    acc_s, m_s, l_s,
+    *, causal: bool,
+):
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, -jnp.inf)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0].astype(jnp.float32)            # (bq, d), pre-scaled
+    k = k_ref[0].astype(jnp.float32)            # (bk, d)
+    s = jax.lax.dot_general(                    # (bq, bk) on the MXU
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        q_pos = qpos_ref[0]                     # (bq,) global positions
+        k_pos = kpos_ref[0]                     # (bk,)
+        s = jnp.where(k_pos[None, :] <= q_pos[:, None], s, -jnp.inf)
+
+    m_prev = m_s[...][:, 0]                     # (bq,)
+    tile_max = jnp.max(s, axis=1)
+    new_m = jnp.maximum(m_prev, tile_max)
+    # -inf - -inf guard: a fully-masked history meets a fully-masked tile.
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - safe_m), 0.0)
+    p = jnp.exp(s - safe_m[:, None])            # exp(-inf) = 0 when masked
+    l_new = l_s[...][:, 0] * corr + jnp.sum(p, axis=1)
+    acc_s[...] = acc_s[...] * corr[:, None] + jax.lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_s[...] = jnp.broadcast_to(new_m[:, None], m_s.shape)
+    l_s[...] = jnp.broadcast_to(l_new[:, None], l_s.shape)
+
+    @pl.when(kj == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0] = acc_s[...]
+        m_ref[0] = m_s[...][:, 0]
+        l_ref[0] = l_s[...][:, 0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "bq", "bk", "interpret")
+)
+def _pallas_partial(
+    q: Array, k: Array, v: Array, q_pos: Array, k_pos: Array,
+    *, causal: bool, bq: int, bk: int, interpret: bool,
+):
+    h, sq, d = q.shape
+    sk = k.shape[1]
+    grid = (h, sq // bq, sk // bk)
+    # Same vma alignment dance as _pallas_gemv: under shard_map the output
+    # avals must declare the union of the inputs' varying mesh axes.
+    vma = frozenset()
+    for x in (q, k, v, q_pos, k_pos):
+        vma |= frozenset(jax.typeof(x).vma)
+    aligned = []
+    for x in (q, k, v, q_pos, k_pos):
+        missing = tuple(vma - frozenset(jax.typeof(x).vma))
+        aligned.append(jax.lax.pcast(x, missing, to="varying"))
+    q, k, v, q_pos, k_pos = aligned
+    o, m, l = pl.pallas_call(
+        functools.partial(_flash_kernel, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda hi, qi, ki: (hi, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda hi, qi, ki: (hi, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda hi, qi, ki: (hi, ki, 0)),
+            pl.BlockSpec((1, bq), lambda hi, qi, ki: (0, qi)),
+            pl.BlockSpec((1, bk), lambda hi, qi, ki: (0, ki)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda hi, qi, ki: (hi, qi, 0)),
+            pl.BlockSpec((1, bq), lambda hi, qi, ki: (hi, qi)),
+            pl.BlockSpec((1, bq), lambda hi, qi, ki: (hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((h, sq, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((h, sq), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((h, sq), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
+            pltpu.VMEM((bq, _STATS_LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, q_pos[None, :], k_pos[None, :])
+    return o, m, l
+
+
+def _reference_partial(q, k, v, q_pos, k_pos, *, causal: bool):
+    """The same partial in plain JAX — the fallback for non-tiling shapes
+    and the oracle the kernel is tested against."""
+    s = jnp.einsum(
+        "hqd,hkd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    )
+    if causal:
+        s = jnp.where(k_pos[None, None, :] <= q_pos[None, :, None], s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                     # (h, sq); -inf if all masked
+    safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("hqk,hkd->hqd", p, v.astype(jnp.float32))
+    return o, m, l
+
+
+def flash_path_available(
+    sq: int, sk: int, d: int, *, bq: int = DEFAULT_BQ, bk: int = DEFAULT_BK
+) -> bool:
+    """True iff these block shapes admit the Pallas kernel (sublane-multiple
+    q tiles, lane-multiple k tiles and head dim) — the single predicate both
+    :func:`flash_block_partial` and measurement tooling use, so a benchmark
+    can tell kernel timings from fallback timings instead of guessing."""
+    return (
+        _largest_divisor_leq(sq, bq, 8) is not None
+        and _largest_divisor_leq(sk, bk, 128) is not None
+        and d % 128 == 0
+    )
+
+
+def flash_block_partial(
+    q: Array, k: Array, v: Array, q_pos: Array, k_pos: Array,
+    *, causal: bool = False,
+    bq: int = DEFAULT_BQ, bk: int = DEFAULT_BK,
+) -> tuple[Array, Array, Array]:
+    """Attention partial of Q (h, sq, d) against one KV block (h, sk, d).
+
+    ``q`` must be pre-scaled (callers own the 1/sqrt(d) factor, as the ring
+    does once instead of per hop). ``q_pos``/``k_pos``: (sq,)/(sk,) int32
+    global sequence positions, used only under ``causal``. Returns
+    ``(o_unnorm, m, l)`` — see the module docstring for the contract.
+    Falls back to the plain-JAX partial when
+    :func:`flash_path_available` says the shape doesn't tile, same as
+    ``gemv_pallas``'s contract.
+    """
+    h, sq, d = q.shape
+    sk = k.shape[1]
+    if not flash_path_available(sq, sk, d, bq=bq, bk=bk):
+        return _reference_partial(q, k, v, q_pos, k_pos, causal=causal)
+    return _pallas_partial(
+        q, k, v, q_pos, k_pos,
+        causal=causal,
+        bq=_largest_divisor_leq(sq, bq, 8),
+        bk=_largest_divisor_leq(sk, bk, 128),
+        interpret=not _on_tpu(),
+    )
+
+
+def merge_partials(a, b):
+    """Merge two attention partials via the rescaling identity.
+
+    Both arguments and the result are ``(o_unnorm, m, l)`` triples in
+    exactly the order :func:`flash_block_partial` returns — one layout
+    everywhere, so partials chain without permutation. Commutative up to
+    rounding and associative, which is what lets the ring fold hops in
+    arrival order.
+    """
+    o_a, m_a, l_a = a
+    o_b, m_b, l_b = b
+    new_m = jnp.maximum(m_a, m_b)
+    safe_m = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+    c_a = jnp.where(jnp.isfinite(m_a), jnp.exp(m_a - safe_m), 0.0)
+    c_b = jnp.where(jnp.isfinite(m_b), jnp.exp(m_b - safe_m), 0.0)
+    l = l_a * c_a + l_b * c_b
+    o = o_a * c_a[..., None] + o_b * c_b[..., None]
+    return o, new_m, l

@@ -39,7 +39,22 @@ import jax.numpy as jnp
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.pallas_attention import flash_block_partial, merge_partials
 from .ring import _ring_perm
+
+# Local-block attention tiers, mirroring the GEMV/GEMM kernel registries:
+# "xla" materializes the (h, bq, bk) score tile between two XLA matmuls;
+# "flash" fuses scores + online softmax + weighted-V in one Pallas VMEM
+# pipeline (ops/pallas_attention.py), the tile never reaching HBM.
+ATTENTION_KERNELS = ("xla", "flash")
+
+
+def _check_kernel(kernel: str) -> None:
+    if kernel not in ATTENTION_KERNELS:
+        raise ValueError(
+            f"unknown attention kernel {kernel!r}; "
+            f"options: {', '.join(ATTENTION_KERNELS)}"
+        )
 
 
 def _online_update(m, l, acc, scores, v_blk):
@@ -64,7 +79,8 @@ def _online_update(m, l, acc, scores, v_blk):
 
 
 def ring_attention(
-    q: Array, k: Array, v: Array, axis_name, *, causal: bool = False
+    q: Array, k: Array, v: Array, axis_name, *, causal: bool = False,
+    kernel: str = "xla",
 ) -> Array:
     """Exact attention with the sequence axis sharded over ``axis_name``.
 
@@ -73,8 +89,11 @@ def ring_attention(
     ``blk`` on every device; heads batch through the same ring walk).
     Returns the local block of ``softmax(Q Kᵀ / sqrt(d)) V`` (fp32, input
     rank preserved), exactly — the ring changes the schedule, not the
-    math.
+    math. ``kernel`` picks the per-hop tile implementation
+    (:data:`ATTENTION_KERNELS`); both fold the same online-softmax state,
+    so they agree to fp32 rounding.
     """
+    _check_kernel(kernel)
     p = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     single_head = q.ndim == 2
@@ -90,16 +109,30 @@ def ring_attention(
     acc = jnp.zeros((h, blk, d), jnp.float32)
     perm = _ring_perm(p)
     rows = jax.lax.iota(jnp.int32, blk)
+    if kernel == "flash":
+        # The kernel wants head-major operands: transpose Q once and
+        # circulate the KV pair ALREADY head-major, rather than paying two
+        # (blk, h, d) transposes per hop on the path the fused tier exists
+        # to speed up.
+        q_heads = jnp.transpose(qf, (1, 0, 2))  # (h, blk, d)
+        kv = tuple(jnp.transpose(x, (1, 0, 2)) for x in kv)
 
     for t in range(p):
         if t > 0:
             kv = jax.lax.ppermute(kv, axis_name, perm)
         k_blk, v_blk = kv
+        # Global positions: this device's Q rows start at idx*blk; the
+        # KV block in hand at step t came from device (idx - t) mod p.
+        src = jnp.mod(idx - t, p)
+        if kernel == "flash":
+            part = flash_block_partial(
+                q_heads, k_blk, v_blk,
+                idx * blk + rows, src * blk + rows, causal=causal,
+            )
+            acc, m, l = merge_partials((acc, m, l), part)
+            continue
         scores = jnp.einsum("qhd,khd->hqk", qf, k_blk)  # (h, blk, blk)
         if causal:
-            # Global positions: this device's Q rows start at idx*blk; the
-            # KV block in hand at step t came from device (idx - t) mod p.
-            src = jnp.mod(idx - t, p)
             q_pos = idx * blk + rows[:, None]
             k_pos = src * blk + rows[None, :]
             scores = jnp.where(
@@ -129,8 +162,29 @@ def _dense_block_attention(q, k, v, *, causal: bool) -> Array:
     return (w @ v) / jnp.sum(w, axis=1, keepdims=True)
 
 
+def _local_heads_attention(q, k, v, *, causal: bool, kernel: str) -> Array:
+    """Full local attention over (s, h, d_head) fp32 arrays — the per-head
+    step both Ulysses branches share, in the requested kernel tier."""
+    if kernel == "flash":
+        s, _, dh = q.shape
+        pos = jax.lax.iota(jnp.int32, s)
+        o_u, _, l = flash_block_partial(
+            jnp.transpose(q, (1, 0, 2)) * (1.0 / (dh ** 0.5)),
+            jnp.transpose(k, (1, 0, 2)),
+            jnp.transpose(v, (1, 0, 2)),
+            pos, pos, causal=causal,
+        )
+        o = o_u / jnp.maximum(l, 1e-30)[..., None]
+        return jnp.transpose(o, (1, 0, 2))
+    return jax.vmap(
+        partial(_dense_block_attention, causal=causal),
+        in_axes=1, out_axes=1,
+    )(q, k, v)
+
+
 def ulysses_attention(
-    q: Array, k: Array, v: Array, axis_name, *, causal: bool = False
+    q: Array, k: Array, v: Array, axis_name, *, causal: bool = False,
+    kernel: str = "xla",
 ) -> Array:
     """Exact multi-head attention, sequence-parallel via ONE all-to-all
     each way — the Ulysses schedule, the balanced-exchange counterpart of
@@ -147,13 +201,14 @@ def ulysses_attention(
     materializes them) — which is why both live in the toolkit.
     Returns the local ``(s/p, h, d_head)`` output block (fp32).
     """
+    _check_kernel(kernel)
     p = jax.lax.axis_size(axis_name)
     blk, h, dh = q.shape
     if p == 1:
-        return jax.vmap(
-            partial(_dense_block_attention, causal=causal),
-            in_axes=1, out_axes=1,
-        )(q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32))
+        return _local_heads_attention(
+            q.astype(jnp.float32), k.astype(jnp.float32),
+            v.astype(jnp.float32), causal=causal, kernel=kernel,
+        )
     if h % p != 0:
         raise ValueError(f"ulysses_attention: {h} heads not divisible by {p}")
 
@@ -166,10 +221,7 @@ def ulysses_attention(
         )
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    oh = jax.vmap(
-        partial(_dense_block_attention, causal=causal),
-        in_axes=1, out_axes=1,
-    )(qh, kh, vh)
+    oh = _local_heads_attention(qh, kh, vh, causal=causal, kernel=kernel)
     # (s, h/p, dh) -> (s/p, h, dh): the inverse exchange.
     return jax.lax.all_to_all(
         oh, axis_name, split_axis=0, concat_axis=1, tiled=True
@@ -177,7 +229,8 @@ def ulysses_attention(
 
 
 def build_ring_attention(
-    mesh: Mesh, *, causal: bool = False, gather_output: bool = False
+    mesh: Mesh, *, causal: bool = False, gather_output: bool = False,
+    kernel: str = "xla",
 ):
     """Return jitted ``attn(q, k, v) -> o`` over ``mesh``'s flat axis.
 
@@ -186,15 +239,21 @@ def build_ring_attention(
     sharding constraints; ``s`` must divide the device count.
     ``gather_output=True`` replicates the result (for small-scale
     verification; the honest long-context mode keeps o sequence-sharded).
+    ``kernel``: per-hop tile tier (:data:`ATTENTION_KERNELS`).
     """
+    _check_kernel(kernel)
     axes = tuple(mesh.axis_names)
     spec = P(axes)
 
     mapped = jax.shard_map(
-        partial(ring_attention, axis_name=axes, causal=causal),
+        partial(ring_attention, axis_name=axes, causal=causal, kernel=kernel),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # Interpret-mode pallas mixes unvarying internals into the body in
+        # ways the vma checker cannot track (same relaxation models/base.py
+        # applies for the pallas GEMV tier); the xla tier keeps the check.
+        check_vma=(kernel != "flash"),
     )
 
     @jax.jit
@@ -214,22 +273,28 @@ def build_ring_attention(
 
 
 def build_ulysses_attention(
-    mesh: Mesh, *, causal: bool = False, gather_output: bool = False
+    mesh: Mesh, *, causal: bool = False, gather_output: bool = False,
+    kernel: str = "xla",
 ):
     """Return jitted ``attn(q, k, v) -> o`` for the all-to-all schedule.
 
     Inputs are global ``(s, h, d_head)`` arrays, sequence-sharded on the
     flat axis; ``s`` must divide the device count and ``h`` must divide
     it too (the head-parallel intermediate layout).
+    ``kernel``: local per-head tile tier (:data:`ATTENTION_KERNELS`).
     """
+    _check_kernel(kernel)
     axes = tuple(mesh.axis_names)
     spec = P(axes)
 
     mapped = jax.shard_map(
-        partial(ulysses_attention, axis_name=axes, causal=causal),
+        partial(ulysses_attention, axis_name=axes, causal=causal,
+                kernel=kernel),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # Same vma relaxation as build_ring_attention's flash tier.
+        check_vma=(kernel != "flash"),
     )
 
     @jax.jit

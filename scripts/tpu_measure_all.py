@@ -209,12 +209,14 @@ def main(argv=None) -> int:
             # backend=tpu) — the accuracy tiers working inside a solver.
             step("refine", [py, "scripts/refine_study.py", "--size", "2048"])
         if "attention" not in args.skip:
-            # Long-context evidence on the chip: ring vs Ulysses vs the
-            # replicated dense baseline (docs/ATTENTION.md, backend=tpu).
-            # Single chip: schedules collapse to p=1, where every variant
-            # materializes the (h, s, s) scores — 8192 tops out around
+            # Long-context evidence on the chip: ring vs Ulysses (xla AND
+            # fused-pallas tiers) vs the replicated dense baseline
+            # (docs/ATTENTION.md, backend=tpu). Single chip: schedules
+            # collapse to p=1. The dense oracle check and the xla tiers
+            # materialize the (h, s, s) scores — 8192 tops out around
             # 2.1 GB fp32 per buffer, safely inside HBM; 16384 would be
-            # 8.6 GB per intermediate and OOM the stage.
+            # 8.6 GB per intermediate and OOM those variants (the flash
+            # tiers alone would fit, but the stage times all of them).
             step("attention", [py, "scripts/attention_study.py",
                                "--seqs", "4096", "8192", "--causal"])
         if "autotune" not in args.skip:

@@ -167,3 +167,180 @@ def test_ring_attention_multihead_matches_dense(devices, rng, causal):
             q[:, head], k[:, head], v[:, head], causal=causal
         )
         np.testing.assert_allclose(o[:, head], oracle, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# The Pallas flash tier (ops/pallas_attention.py) — interpret mode on the
+# CPU mesh, same strategy as the pallas GEMV/GEMM tiers. d_head=128 (lane
+# width) exercises the kernel; unaligned shapes exercise its fallback.
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_partial_matches_reference(rng, causal):
+    from matvec_mpi_multiplier_tpu.ops.pallas_attention import (
+        _reference_partial,
+        flash_block_partial,
+    )
+
+    h, sq, sk, d = 2, 256, 512, 128
+    q = jnp.asarray(rng.standard_normal((h, sq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((h, sk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, sk, d)), jnp.float32)
+    # Offset q positions: the ring's cross-device case, where the KV block
+    # in hand belongs to an earlier sequence segment.
+    q_pos = jnp.arange(sq, dtype=jnp.int32) + 96
+    k_pos = jnp.arange(sk, dtype=jnp.int32)
+    got = flash_block_partial(
+        q, k, v, q_pos, k_pos, causal=causal, bq=128, bk=128
+    )
+    want = _reference_partial(q, k, v, q_pos, k_pos, causal=causal)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=2e-5, atol=2e-5
+        )
+
+
+def test_flash_partial_fully_masked_rows(rng):
+    """Rows whose every key is causally masked must come back as an empty
+    partial (m=-inf, l=0, finite o) — NOT NaN: the ring folds partials
+    from blocks a Q row may entirely precede."""
+    from matvec_mpi_multiplier_tpu.ops.pallas_attention import (
+        flash_block_partial,
+    )
+
+    h, s, d = 1, 128, 128
+    q = jnp.asarray(rng.standard_normal((h, s, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((h, s, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, s, d)), jnp.float32)
+    q_pos = jnp.arange(s, dtype=jnp.int32)          # positions 0..127
+    k_pos = jnp.arange(s, dtype=jnp.int32) + 1000   # all in the future
+    o, m, l = flash_block_partial(
+        q, k, v, q_pos, k_pos, causal=True, bq=128, bk=128
+    )
+    assert np.all(np.asarray(l) == 0.0)
+    assert np.all(np.isneginf(np.asarray(m)))
+    assert not np.any(np.isnan(np.asarray(o)))
+
+
+def test_merge_partials_matches_single_block(rng):
+    """Splitting the key axis and merging the two partials must equal the
+    one-shot partial over the full block — the identity the ring's
+    per-hop fold depends on."""
+    from matvec_mpi_multiplier_tpu.ops.pallas_attention import (
+        _reference_partial,
+        merge_partials,
+    )
+
+    h, sq, sk, d = 2, 32, 64, 16
+    q = jnp.asarray(rng.standard_normal((h, sq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((h, sk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((h, sk, d)), jnp.float32)
+    q_pos = jnp.arange(sq, dtype=jnp.int32) + 16
+    k_pos = jnp.arange(sk, dtype=jnp.int32)
+    o_full, m_full, l_full = _reference_partial(
+        q, k, v, q_pos, k_pos, causal=True
+    )
+    half = sk // 2
+    p1 = _reference_partial(
+        q, k[:, :half], v[:, :half], q_pos, k_pos[:half], causal=True
+    )
+    p2 = _reference_partial(
+        q, k[:, half:], v[:, half:], q_pos, k_pos[half:], causal=True
+    )
+    o, m, l = merge_partials(p1, p2)
+    np.testing.assert_allclose(np.asarray(m), np.asarray(m_full), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(l), np.asarray(l_full), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(o_full), rtol=1e-5, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_kernel_matches_xla(devices, rng, causal):
+    """The fused tier changes the schedule of the tile math, not the
+    function: ring(kernel="flash") must agree with ring(kernel="xla") and
+    the dense oracle at fp32 rounding. d_head=128 so the pallas path (not
+    its fallback) runs."""
+    s, h, dh = 1024, 2, 128
+    q = rng.standard_normal((s, h, dh)).astype(np.float32)
+    k = rng.standard_normal((s, h, dh)).astype(np.float32)
+    v = rng.standard_normal((s, h, dh)).astype(np.float32)
+    mesh = make_mesh(8)
+    xla = build_ring_attention(mesh, causal=causal, gather_output=True)
+    flash = build_ring_attention(
+        mesh, causal=causal, gather_output=True, kernel="flash"
+    )
+    o_x = np.asarray(xla(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    o_f = np.asarray(flash(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(o_f, o_x, rtol=2e-4, atol=2e-4)
+    for head in range(h):
+        oracle = _dense_attention(
+            q[:, head], k[:, head], v[:, head], causal=causal
+        )
+        np.testing.assert_allclose(
+            o_f[:, head], oracle, rtol=2e-4, atol=2e-4
+        )
+
+
+def test_ulysses_attention_flash_kernel_matches_xla(devices, rng):
+    from matvec_mpi_multiplier_tpu.parallel.attention import (
+        build_ulysses_attention,
+    )
+
+    s, h, dh = 1024, 8, 128
+    q = rng.standard_normal((s, h, dh)).astype(np.float32)
+    k = rng.standard_normal((s, h, dh)).astype(np.float32)
+    v = rng.standard_normal((s, h, dh)).astype(np.float32)
+    mesh = make_mesh(8)
+    xla = build_ulysses_attention(mesh, causal=True, gather_output=True)
+    flash = build_ulysses_attention(
+        mesh, causal=True, gather_output=True, kernel="flash"
+    )
+    o_x = np.asarray(xla(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    o_f = np.asarray(flash(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(o_f, o_x, rtol=2e-4, atol=2e-4)
+
+
+def test_flash_kernel_fallback_on_unaligned_shapes(devices, rng):
+    """d_head=16 cannot tile to the 128-lane layout: the flash tier must
+    quietly use its plain-JAX fallback and still match the oracle (the
+    gemv_pallas fallback contract)."""
+    s, h, dh = 64, 4, 16
+    q = rng.standard_normal((s, h, dh)).astype(np.float32)
+    k = rng.standard_normal((s, h, dh)).astype(np.float32)
+    v = rng.standard_normal((s, h, dh)).astype(np.float32)
+    mesh = make_mesh(8)
+    attn = build_ring_attention(
+        mesh, causal=True, gather_output=True, kernel="flash"
+    )
+    o = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    for head in range(h):
+        oracle = _dense_attention(q[:, head], k[:, head], v[:, head], causal=True)
+        np.testing.assert_allclose(o[:, head], oracle, rtol=2e-5, atol=2e-5)
+
+
+def test_unknown_attention_kernel_rejected(devices):
+    from matvec_mpi_multiplier_tpu.parallel.attention import (
+        build_ulysses_attention,
+    )
+
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="unknown attention kernel"):
+        build_ring_attention(mesh, kernel="bogus")
+    with pytest.raises(ValueError, match="unknown attention kernel"):
+        build_ulysses_attention(mesh, kernel="bogus")
+
+
+def test_flash_path_available_predicate():
+    """The tiling predicate the tier branches on — and measurement tooling
+    uses to label fallback timings — must match the shapes the kernel
+    actually accepts."""
+    from matvec_mpi_multiplier_tpu.ops.pallas_attention import (
+        flash_path_available,
+    )
+
+    assert flash_path_available(128, 128, 128)
+    assert flash_path_available(8, 256, 128)      # tiny q tile is fine
+    assert not flash_path_available(64, 64, 128)  # k block under one lane row
+    assert not flash_path_available(128, 128, 64)  # head dim not lane-aligned
+    assert not flash_path_available(30, 128, 128)  # q not sublane-divisible
