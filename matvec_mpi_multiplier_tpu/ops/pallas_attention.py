@@ -182,6 +182,56 @@ def flash_path_available(
     )
 
 
+def _partial_impl(q, k, v, q_pos, k_pos, causal, bq, bk):
+    h, sq, d = q.shape
+    sk = k.shape[1]
+    if not flash_path_available(sq, sk, d, bq=bq, bk=bk):
+        return _reference_partial(q, k, v, q_pos, k_pos, causal=causal)
+    return _pallas_partial(
+        q, k, v, q_pos, k_pos,
+        causal=causal,
+        bq=_largest_divisor_leq(sq, bq, 8),
+        bk=_largest_divisor_leq(sk, bk, 128),
+        interpret=not _on_tpu(),
+    )
+
+
+# pallas_call has no autodiff rule, so the tier carries the canonical
+# flash-attention gradient strategy: fused kernel forward, backward by
+# RECOMPUTING the block's scores with the plain-JAX partial and pulling
+# cotangents through that (jax.vjp). Memory stays block-granular — the
+# backward materializes one (h, bq_block, bk_block)-shaped score tile per
+# partial, never the full (s, s) matrix — and the gradient is exactly the
+# reference partial's, i.e. the gradient of a function the kernel matches
+# to fp32 rounding.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _partial_diff(q, k, v, q_pos, k_pos, causal, bq, bk):
+    return _partial_impl(q, k, v, q_pos, k_pos, causal, bq, bk)
+
+
+def _partial_fwd(q, k, v, q_pos, k_pos, causal, bq, bk):
+    out = _partial_impl(q, k, v, q_pos, k_pos, causal, bq, bk)
+    return out, (q, k, v, q_pos, k_pos)
+
+
+def _partial_bwd(causal, bq, bk, res, cts):
+    q, k, v, q_pos, k_pos = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_partial(
+            q_, k_, v_, q_pos, k_pos, causal=causal
+        ),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(cts)
+    import numpy as np
+
+    zero_pos = lambda x: np.zeros(x.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, zero_pos(q_pos), zero_pos(k_pos)
+
+
+_partial_diff.defvjp(_partial_fwd, _partial_bwd)
+
+
 def flash_block_partial(
     q: Array, k: Array, v: Array, q_pos: Array, k_pos: Array,
     *, causal: bool = False,
@@ -195,19 +245,16 @@ def flash_block_partial(
     ``(o_unnorm, m, l)`` — see the module docstring for the contract.
     Falls back to the plain-JAX partial when
     :func:`flash_path_available` says the shape doesn't tile, same as
-    ``gemv_pallas``'s contract.
+    ``gemv_pallas``'s contract. Differentiable: backward recomputes the
+    block with the reference partial (see ``_partial_diff``). The
+    fallback branch is taken OUTSIDE the custom_vjp wrapper so non-tiling
+    shapes keep full native autodiff (including forward-mode, which
+    custom_vjp functions cannot provide).
     """
     h, sq, d = q.shape
-    sk = k.shape[1]
-    if not flash_path_available(sq, sk, d, bq=bq, bk=bk):
+    if not flash_path_available(sq, k.shape[1], d, bq=bq, bk=bk):
         return _reference_partial(q, k, v, q_pos, k_pos, causal=causal)
-    return _pallas_partial(
-        q, k, v, q_pos, k_pos,
-        causal=causal,
-        bq=_largest_divisor_leq(sq, bq, 8),
-        bk=_largest_divisor_leq(sk, bk, 128),
-        interpret=not _on_tpu(),
-    )
+    return _partial_diff(q, k, v, q_pos, k_pos, causal, bq, bk)
 
 
 def merge_partials(a, b):

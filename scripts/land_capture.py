@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Validate and land a completed TPU capture — the checklist as code.
+
+``scripts/watch_and_capture.sh`` → ``scripts/tpu_measure_all.py`` writes
+the round's evidence (loop-protocol CSVs, the 65536² bf16 north-star
+artifact, the VMEM roof, figures, study docs) but deliberately does not
+commit or re-narrate it. This script runs the landing steps that
+previously lived in a prose checklist, so capture day is one command and
+zero forgotten steps:
+
+1. **Artifact inventory** — every file the capture should have produced,
+   present or named as missing.
+2. **Data-quality gates** — ``tests/test_data_quality.py`` must pass with
+   ZERO skips: a skip means a gate that should now be biting is dormant.
+3. **North star** — ``BASELINE.json``'s ``blockwise_65536_bf16_hbm_sweep``
+   entry is updated from the capture's ``BASELINE_65536_bf16.json``
+   (status → published, measured GB/s filled in).
+4. **README table** — the per-size results table is rendered from the
+   committed rows (``scripts/results_table.py``) and spliced between the
+   ``TPU_RESULTS_TABLE`` markers in ``README.md``.
+5. **Summary** — what changed, what to `git add`, and what (if anything)
+   still needs a human: retiring ``data/out/superseded/`` is offered via
+   ``--retire-superseded`` because PARITY.md promises wholesale
+   replacement of the quarantined rows, and deleting data should be an
+   explicit choice.
+
+Read-only by default: without ``--apply`` every step reports what it
+WOULD do. ``--apply`` performs steps 3–4 (and honors
+``--retire-superseded``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+# MATVEC_REPO_ROOT lets tests rehearse the landing against a synthetic
+# repo tree (artifacts, README, BASELINE) without touching the real ones;
+# CODE (tests, scripts) always runs from the real checkout.
+CODE_ROOT = Path(__file__).resolve().parent.parent
+REPO = Path(os.environ.get("MATVEC_REPO_ROOT") or CODE_ROOT)
+sys.path.insert(0, str(CODE_ROOT))
+
+TABLE_START = "<!-- TPU_RESULTS_TABLE_START -->"
+TABLE_END = "<!-- TPU_RESULTS_TABLE_END -->"
+NORTH_STAR_KEY = "blockwise_65536_bf16_hbm_sweep"
+
+
+def _inventory(data_out: Path) -> tuple[list[str], list[str]]:
+    expected = {
+        "loop-protocol extended CSV": data_out / "results_extended.csv",
+        "VMEM roof": data_out / "vmem_roof.json",
+        "north-star artifact": REPO / "BASELINE_65536_bf16.json",
+        "TPU figures": REPO / "figures" / "tpu",
+    }
+    for strategy in ("rowwise", "colwise", "colwise_ring",
+                     "colwise_ring_overlap", "colwise_a2a", "blockwise"):
+        expected[f"{strategy} CSV"] = data_out / f"{strategy}.csv"
+    present, missing = [], []
+    for label, path in expected.items():
+        try:
+            shown = path.relative_to(REPO)
+        except ValueError:  # absolute --data-root outside the repo
+            shown = path
+        (present if path.exists() else missing).append(f"{label} ({shown})")
+    return present, missing
+
+
+def _gates() -> tuple[bool, str]:
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_data_quality.py",
+         "-q", "-rs"],
+        cwd=CODE_ROOT, capture_output=True, text=True,
+    )
+    out = r.stdout.strip().splitlines()
+    tail = "\n".join(out[-12:])
+    ok = r.returncode == 0 and "skipped" not in (out[-1] if out else "")
+    return ok, tail
+
+
+def _update_north_star(apply: bool) -> str:
+    artifact = REPO / "BASELINE_65536_bf16.json"
+    payload = json.loads(artifact.read_text())
+    if payload.get("unit") not in ("GB/s", "GBps", "gbps"):
+        return f"north star: unexpected unit {payload.get('unit')!r} — not applied"
+    gbps = float(payload["value"])
+    baseline_file = REPO / "BASELINE.json"
+    baseline = json.loads(baseline_file.read_text())
+    entry = baseline["published"][NORTH_STAR_KEY]
+    before = entry.get("status"), entry.get("best_measured_gbps")
+    if not apply:
+        return (f"north star: would set status=published, "
+                f"best_measured_gbps={gbps} (now {before[0]}, {before[1]})")
+    entry["status"] = "published"
+    entry["best_measured_gbps"] = gbps
+    entry["mapping_note"] = (
+        f"Measured by the landed capture (BASELINE_65536_bf16.json): "
+        f"{gbps} GB/s at 65536^2 bf16, blockwise, measure=loop. "
+        "Wedge/history notes prior to landing: see git history of this "
+        "entry."
+    )
+    baseline_file.write_text(json.dumps(baseline, indent=1) + "\n")
+    return (f"north star: status {before[0]} -> published, "
+            f"best_measured_gbps {before[1]} -> {gbps}")
+
+
+def _render_table(data_root: Path) -> str | None:
+    """The rendered per-size table, or None (with diagnostics printed)
+    when the renderer's filters match no rows — the caller must treat
+    that as a pre-write abort, never a post-write crash."""
+    r = subprocess.run(
+        [sys.executable, "scripts/results_table.py",
+         "--data-root", str(data_root)],
+        cwd=CODE_ROOT, capture_output=True, text=True,
+    )
+    if r.returncode != 0:
+        print("results_table.py failed — dataset present but its rows "
+              "don't match the renderer's filters:")
+        print((r.stdout + r.stderr).strip())
+        return None
+    return r.stdout.strip()
+
+
+def _splice_readme(table_md: str, apply: bool) -> str:
+    readme = REPO / "README.md"
+    text = readme.read_text()
+    if TABLE_START not in text or TABLE_END not in text:
+        return "README: table markers missing — not applied"
+    block = (
+        f"{TABLE_START}\n"
+        "Per-size amortized loop-protocol times on the one v5e chip "
+        "(fp32, square regime; rendered from the committed "
+        "`data/out/results_extended.csv` by `scripts/results_table.py`):\n\n"
+        f"{table_md}\n"
+        f"{TABLE_END}"
+    )
+    new = re.sub(
+        re.escape(TABLE_START) + r".*?" + re.escape(TABLE_END),
+        block.replace("\\", r"\\"), text, flags=re.S,
+    )
+    if not apply:
+        n_rows = table_md.count("\n") - 1
+        return f"README: would splice a {n_rows}-row table between markers"
+    readme.write_text(new)
+    return "README: per-size table spliced between markers"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data-root", default="data")
+    p.add_argument("--apply", action="store_true",
+                   help="write BASELINE.json and README.md (default: report)")
+    p.add_argument("--retire-superseded", action="store_true",
+                   help="delete data/out/superseded/ (the capture's dataset "
+                   "wholesale-replaces the quarantined rows)")
+    args = p.parse_args(argv)
+    data_out = REPO / args.data_root / "out"
+
+    present, missing = _inventory(data_out)
+    print(f"artifacts present ({len(present)}):")
+    for line in present:
+        print(f"  + {line}")
+    if missing:
+        print(f"artifacts MISSING ({len(missing)}):")
+        for line in missing:
+            print(f"  - {line}")
+
+    core_ready = (data_out / "results_extended.csv").exists()
+    if not core_ready:
+        print("\nno loop-protocol dataset at the top level — nothing to "
+              "land; the watcher/capture has not completed")
+        return 1
+
+    ok, tail = _gates()
+    print("\ndata-quality gates:", "PASS, zero skips" if ok else "NOT CLEAN")
+    if not ok:
+        print(tail)
+        print("\ngates must pass with zero skips before landing — aborting")
+        return 1
+
+    # Render BEFORE any write: a dataset whose rows miss the renderer's
+    # filters must abort with nothing half-landed, not crash after
+    # BASELINE.json was already rewritten.
+    table_md = _render_table(REPO / args.data_root)
+    if table_md is None:
+        print("aborting before any write — fix the dataset/filters first")
+        return 1
+
+    if (REPO / "BASELINE_65536_bf16.json").exists():
+        print("\n" + _update_north_star(args.apply))
+    else:
+        print("\nnorth star: BASELINE_65536_bf16.json absent (baseline "
+              "stage did not land) — BASELINE.json left untouched")
+
+    print(_splice_readme(table_md, args.apply))
+
+    superseded = data_out / "superseded"
+    if superseded.exists():
+        if args.retire_superseded and args.apply:
+            shutil.rmtree(superseded)
+            print("retired data/out/superseded/ (use `git rm -r` to stage "
+                  "the deletion)")
+        elif args.retire_superseded:
+            print("data/out/superseded/: would delete (needs --apply — "
+                  "report mode never writes)")
+        else:
+            print("data/out/superseded/ still present — retire with "
+                  "--apply --retire-superseded once the new dataset is "
+                  "committed")
+
+    if args.apply:
+        print("\nsuggested staging:")
+        print("  git add data/out/*.csv data/out/vmem_roof.json "
+              "figures/tpu docs README.md README_RU.md BASELINE.json "
+              "BASELINE_65536_bf16.json stats_visualization.ipynb")
+        print("then run `python bench.py` once for the round's headline "
+              "and sync README_RU's results section by hand")
+    else:
+        print("\n(report only — rerun with --apply to write)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
