@@ -183,10 +183,10 @@ def flash_path_available(
 
 
 def _partial_impl(q, k, v, q_pos, k_pos, causal, bq, bk):
+    # Callers reach this through flash_block_partial, which has already
+    # established via flash_path_available that the shape tiles.
     h, sq, d = q.shape
     sk = k.shape[1]
-    if not flash_path_available(sq, sk, d, bq=bq, bk=bk):
-        return _reference_partial(q, k, v, q_pos, k_pos, causal=causal)
     return _pallas_partial(
         q, k, v, q_pos, k_pos,
         causal=causal,

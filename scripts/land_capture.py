@@ -162,6 +162,17 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     data_out = REPO / args.data_root / "out"
 
+    if args.data_root != "data":
+        # The data-quality gates (tests/test_data_quality.py) read the
+        # committed data/ tree unconditionally — landing from another root
+        # would gate the WRONG dataset and let ungated rows reach
+        # BASELINE.json and the README. Non-default roots are for
+        # inspection only.
+        print(f"--data-root {args.data_root}: landing requires the default "
+              "root (the gates only gate data/out); move the capture there "
+              "first")
+        return 1
+
     present, missing = _inventory(data_out)
     print(f"artifacts present ({len(present)}):")
     for line in present:
@@ -184,15 +195,32 @@ def main(argv=None) -> int:
         print("\ngates must pass with zero skips before landing — aborting")
         return 1
 
-    # Render BEFORE any write: a dataset whose rows miss the renderer's
-    # filters must abort with nothing half-landed, not crash after
-    # BASELINE.json was already rewritten.
+    # EVERY validation runs before ANY write — a failure must leave
+    # nothing half-landed (north star published without its README table,
+    # or vice versa).
+    problems = []
     table_md = _render_table(REPO / args.data_root)
     if table_md is None:
-        print("aborting before any write — fix the dataset/filters first")
+        problems.append("dataset rows don't render (see above)")
+    readme_text = (REPO / "README.md").read_text()
+    if TABLE_START not in readme_text or TABLE_END not in readme_text:
+        problems.append("README.md TPU_RESULTS_TABLE markers missing")
+    have_north_star = (REPO / "BASELINE_65536_bf16.json").exists()
+    if have_north_star:
+        unit = json.loads(
+            (REPO / "BASELINE_65536_bf16.json").read_text()
+        ).get("unit")
+        if unit not in ("GB/s", "GBps", "gbps"):
+            problems.append(
+                f"BASELINE_65536_bf16.json has unexpected unit {unit!r}"
+            )
+    if problems:
+        for prob in problems:
+            print(f"pre-write check failed: {prob}")
+        print("aborting before any write")
         return 1
 
-    if (REPO / "BASELINE_65536_bf16.json").exists():
+    if have_north_star:
         print("\n" + _update_north_star(args.apply))
     else:
         print("\nnorth star: BASELINE_65536_bf16.json absent (baseline "

@@ -37,6 +37,11 @@ Stages:
                 (wedge-safe: the notebook reads CSVs, never the chip).
 
 Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
+
+Exit codes: 0 = every stage ok (soft sweep skips allowed); 1 = aborted
+mid-run (probe failed or a stage hit the wedge timeout — retryable); 4 =
+ran to completion but one or more stages hard-failed (deterministic —
+the watcher must NOT endlessly re-run the capture on it).
 """
 
 from __future__ import annotations
@@ -261,7 +266,13 @@ def main(argv=None) -> int:
         print(f"stage {stage}: rc={rc} {tag}", flush=True)
     print(f"capture complete — {len(hard)} hard-failed stage(s)"
           + (f": {', '.join(hard)}" if hard else ""), flush=True)
-    return 1 if hard else 0
+    # rc separates RETRYABLE aborts from COMPLETED runs so the watcher can
+    # tell them apart: 1 = aborted mid-run (probe failure / wedge timeout;
+    # a retry at the next healthy window can genuinely do better), 4 =
+    # every stage ran to completion but some failed (deterministic stage
+    # bugs don't heal on retry — an unlimited-retry watcher re-running the
+    # whole capture on them would burn the healthy window in a loop).
+    return 4 if hard else 0
 
 
 def _wipe_stale_csvs(out_dir: Path) -> None:

@@ -29,11 +29,21 @@ while [ "$MAX_ATTEMPTS" -eq 0 ] || [ "$attempt" -lt "$MAX_ATTEMPTS" ]; do
   if timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     attempt=$((attempt + 1))
     echo "$(date -u +%FT%TZ) probe OK — capture attempt $attempt/${MAX_ATTEMPTS/#0/inf}" >&2
-    if python scripts/tpu_measure_all.py "$@"; then
+    python scripts/tpu_measure_all.py "$@"
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
       echo "$(date -u +%FT%TZ) capture succeeded on attempt $attempt" >&2
       exit 0
+    elif [ "$rc" -ne 1 ]; then
+      # Anything but the explicit retryable abort (rc=1: probe failure /
+      # wedge timeout) is deterministic — completed-with-failed-stages
+      # (rc=4), argparse usage errors (rc=2, e.g. a typo'd flag passed
+      # through "$@"), crashes. Retrying the whole multi-hour capture
+      # cannot heal those and would burn the healthy window in a loop.
+      echo "$(date -u +%FT%TZ) capture attempt $attempt ended rc=$rc (deterministic; only rc=1 retries) — not retrying; see report above" >&2
+      exit 2
     fi
-    echo "$(date -u +%FT%TZ) capture attempt $attempt failed — back to probing" >&2
+    echo "$(date -u +%FT%TZ) capture attempt $attempt aborted (rc=1, wedge/probe) — back to probing" >&2
   else
     echo "$(date -u +%FT%TZ) probe failed/hung — retrying in ${INTERVAL}s" >&2
   fi

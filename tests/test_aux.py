@@ -187,18 +187,21 @@ def test_tpu_measure_all_soft_vs_hard_rc(monkeypatch, capsys):
     # argparse's usage-error exit (2) from a sweep stage must stay hard: a
     # broken sweep command line writes zero rows, and "capture succeeded"
     # over that would waste the healthy window without anyone noticing.
+    # Hard failures in a COMPLETED run exit 4 (deterministic — the watcher
+    # must not endlessly re-run the capture), distinct from the retryable
+    # wedge-abort rc 1.
     monkeypatch.setattr(
         tpu_measure_all, "run",
         lambda cmd: 2 if "--sweep" in " ".join(cmd) else 0,
     )
-    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+    assert tpu_measure_all.main(["--data-root", "x"]) == 4
 
-    # An overlap-stage crash (rc=1) is a hard failure worth retrying...
+    # An overlap-stage crash (rc=1) is a hard failure too...
     monkeypatch.setattr(
         tpu_measure_all, "run",
         lambda cmd: 1 if "overlap_study" in " ".join(cmd) else 0,
     )
-    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+    assert tpu_measure_all.main(["--data-root", "x"]) == 4
     assert "overlap" in capsys.readouterr().out
 
     # ...and so is rc=2 from a non-sweep stage (argparse usage error: a
@@ -207,6 +210,13 @@ def test_tpu_measure_all_soft_vs_hard_rc(monkeypatch, capsys):
         tpu_measure_all, "run",
         lambda cmd: 2 if "hostlink_study" in " ".join(cmd) else 0,
     )
+    assert tpu_measure_all.main(["--data-root", "x"]) == 4
+
+    # A mid-run WEDGE (stage timeout) stays rc 1 — the retryable class.
+    def wedge(cmd):
+        raise tpu_measure_all.StageWedged("stage exceeded budget")
+
+    monkeypatch.setattr(tpu_measure_all, "run", wedge)
     assert tpu_measure_all.main(["--data-root", "x"]) == 1
 
 
@@ -528,3 +538,25 @@ def test_land_capture_rehearsal(monkeypatch, tmp_path):
     assert rc == 0
     readme2 = (tmp_path / "README.md").read_text()
     assert readme2.count("TPU_RESULTS_TABLE_START") == 1
+
+
+def test_watcher_stops_on_completed_capture_with_failed_stages(tmp_path):
+    """Capture rc=4 means every stage RAN but some hard-failed —
+    deterministic, so an unlimited-retry watcher must stop instead of
+    re-running the whole multi-hour capture in a loop through the healthy
+    window. Retryable aborts (rc=1) before it still retry."""
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).parents[1]
+    env = _watcher_env(tmp_path, probe_failures=0, capture_rcs=[1, 4, 0])
+    env.pop("WATCH_MAX_ATTEMPTS", None)
+    r = subprocess.run(
+        ["bash", str(repo / "scripts" / "watch_and_capture.sh")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    # rc=1 retried; rc=4 stopped the loop — the queued rc=0 never ran.
+    assert r.returncode == 2, r.stderr
+    assert "aborted (rc=1, wedge/probe)" in r.stderr
+    assert "attempt 2 ended rc=4 (deterministic" in r.stderr
+    assert "attempt 3" not in r.stderr
