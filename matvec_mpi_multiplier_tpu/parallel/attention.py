@@ -27,7 +27,12 @@ one iota per block.
 
 Accumulation runs in fp32 regardless of storage dtype (bf16 Q/K/V is the
 TPU-native input; softmax statistics in bf16 would destroy long-context
-tails) — the same accumulator contract as the kernel registry.
+tails) — the same accumulator contract as the kernel registry. The WIRE is
+the exception by design: KV blocks (and their backward cotangents)
+traverse the collectives at storage width, so bf16 inputs pay half the
+ICI bytes of fp32; forward numerics are unchanged (the per-tile upcast is
+exact), while KV gradients accept per-hop bf16 rounding — pass fp32
+inputs where fp32-precise gradients matter more than wire bytes.
 """
 
 from __future__ import annotations
@@ -102,7 +107,20 @@ def ring_attention(
     blk, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     qf = q.astype(jnp.float32) * scale
-    kv = (k.astype(jnp.float32), v.astype(jnp.float32))
+    # KV circulates at its STORAGE dtype: bf16 blocks ride the ring at
+    # half the ICI bytes of fp32 (TPU collectives carry bf16 natively),
+    # and the per-tile upcast is exact, so the FORWARD numbers are
+    # bit-identical to upcasting before the hops. The backward follows
+    # the same wire: per-hop dK/dV cotangents round to bf16 and sum
+    # across the reversed ring in bf16 — the standard bf16
+    # gradient-communication trade (p-1 roundings instead of the one a
+    # pre-loop upcast would give). Callers needing fp32-precise KV
+    # gradients pass fp32 K/V and pay the 2x wire. The CPU test backend
+    # legalizes bf16 collectives to f32 (its collective runtime is
+    # f32-only), so HLO inspected there shows f32 permutes; that is the
+    # emulation, not this schedule. Q is local (never on the wire), so
+    # pre-scaling it in fp32 costs nothing.
+    kv = (k, v)
 
     m = jnp.full((h, blk), -jnp.inf, jnp.float32)
     l = jnp.zeros((h, blk), jnp.float32)
@@ -131,14 +149,18 @@ def ring_attention(
             )
             acc, m, l = merge_partials((acc, m, l), part)
             continue
-        scores = jnp.einsum("qhd,khd->hqk", qf, k_blk)  # (h, blk, blk)
+        scores = jnp.einsum(
+            "qhd,khd->hqk", qf, k_blk.astype(jnp.float32)
+        )  # (h, blk, blk)
         if causal:
             q_pos = idx * blk + rows[:, None]
             k_pos = src * blk + rows[None, :]
             scores = jnp.where(
                 (k_pos <= q_pos)[None, :, :], scores, -jnp.inf
             )
-        m, l, acc = _online_update(m, l, acc, scores, v_blk)
+        m, l, acc = _online_update(
+            m, l, acc, scores, v_blk.astype(jnp.float32)
+        )
 
     # Fully-masked rows (can't happen causally: position t attends itself)
     # would have l == 0; guard the division anyway.
@@ -163,8 +185,13 @@ def _dense_block_attention(q, k, v, *, causal: bool) -> Array:
 
 
 def _local_heads_attention(q, k, v, *, causal: bool, kernel: str) -> Array:
-    """Full local attention over (s, h, d_head) fp32 arrays — the per-head
-    step both Ulysses branches share, in the requested kernel tier."""
+    """Full local attention over (s, h, d_head) arrays — the per-head
+    step both Ulysses branches share, in the requested kernel tier.
+    Accepts storage dtype (the exchanges deliver it un-upcast) and runs
+    the math in fp32 per the accumulator contract."""
+    q = q.astype(jnp.float32)
+    k = k.astype(jnp.float32)
+    v = v.astype(jnp.float32)
     if kernel == "flash":
         s, _, dh = q.shape
         pos = jax.lax.iota(jnp.int32, s)
@@ -205,19 +232,17 @@ def ulysses_attention(
     p = jax.lax.axis_size(axis_name)
     blk, h, dh = q.shape
     if p == 1:
-        return _local_heads_attention(
-            q.astype(jnp.float32), k.astype(jnp.float32),
-            v.astype(jnp.float32), causal=causal, kernel=kernel,
-        )
+        return _local_heads_attention(q, k, v, causal=causal, kernel=kernel)
     if h % p != 0:
         raise ValueError(f"ulysses_attention: {h} heads not divisible by {p}")
 
     def to_heads(x):
         # (s/p, h, dh) -> (s, h/p, dh): split heads across devices, gather
-        # the sequence — one balanced exchange.
+        # the sequence — one balanced exchange, in STORAGE dtype (bf16
+        # rides the fabric at half the fp32 bytes; the local step upcasts
+        # after, which is exact).
         return jax.lax.all_to_all(
-            x.astype(jnp.float32), axis_name, split_axis=1, concat_axis=0,
-            tiled=True,
+            x, axis_name, split_axis=1, concat_axis=0, tiled=True
         )
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)

@@ -189,7 +189,11 @@ def main(argv=None) -> int:
         "`ring` (`parallel/attention.py::ring_attention`) circulates KV "
         "blocks over p−1 single-neighbor ppermute hops with a "
         "flash-attention online softmax — O(s/p·d) per-device memory, the "
-        "s×s score matrix never exists. `ulysses` reshards to a "
+        "s×s score matrix never exists. KV rides the wire at its storage "
+        "dtype (bf16 = half the ICI bytes of fp32; the per-tile upcast is "
+        "exact), as does the forward Ulysses reshard — Ulysses' return "
+        "leg carries the fp32 output at full width per the accumulator "
+        "contract. `ulysses` reshards to a "
         "head-parallel layout with ONE balanced all_to_all each way and "
         "runs dense per-head attention — one low-latency exchange against "
         "O(s²/p) per-device scores. The dense column is the "

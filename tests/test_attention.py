@@ -413,3 +413,43 @@ def test_flash_tier_gradients_match_xla_tier(devices, rng):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-4
         )
+
+
+def test_ring_kv_circulates_in_storage_dtype(devices):
+    """bf16 KV must ride the ring at storage width — the traced program's
+    ppermute operands are bf16 (half the ICI bytes of fp32; the upcast
+    happens per tile, which is exact). Checked on the jaxpr, BEFORE any
+    backend legalization: the CPU runtime widens bf16 collectives to f32
+    in its own lowering, which is an emulation property this test must
+    not confuse with the schedule's."""
+    import jax
+
+    mesh = make_mesh(8)
+    attn = build_ring_attention(mesh, causal=True)
+    q = jnp.zeros((256, 8, 16), jnp.bfloat16)
+
+    def collect(jaxpr, name, out):
+        def descend(sub):
+            if hasattr(sub, "eqns"):          # a raw Jaxpr (shard_map)
+                collect(sub, name, out)
+            elif hasattr(sub, "jaxpr"):       # a ClosedJaxpr (pjit etc.)
+                collect(sub.jaxpr, name, out)
+            elif isinstance(sub, (list, tuple)):
+                for s in sub:
+                    descend(s)
+
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == name:
+                out.append(eqn)
+            for sub in eqn.params.values():
+                descend(sub)
+        return out
+
+    jaxpr = jax.make_jaxpr(lambda a, b, c: attn(a, b, c))(q, q, q)
+    perms = collect(jaxpr.jaxpr, "ppermute", [])
+    assert perms, "no ppermute found in the traced ring"
+    for eqn in perms:
+        for var in eqn.invars:
+            assert var.aval.dtype == jnp.bfloat16, (
+                f"KV widened to {var.aval.dtype} before the wire"
+            )
