@@ -415,6 +415,27 @@ def test_flash_tier_gradients_match_xla_tier(devices, rng):
         )
 
 
+def _collect_eqns(jaxpr, name, out):
+    """All eqns of primitive ``name`` anywhere in a (nested) jaxpr — the
+    shared traversal for the wire-dtype pins below (handles raw Jaxpr
+    params from shard_map and ClosedJaxpr params from pjit alike)."""
+    def descend(sub):
+        if hasattr(sub, "eqns"):          # a raw Jaxpr (shard_map)
+            _collect_eqns(sub, name, out)
+        elif hasattr(sub, "jaxpr"):       # a ClosedJaxpr (pjit etc.)
+            _collect_eqns(sub.jaxpr, name, out)
+        elif isinstance(sub, (list, tuple)):
+            for s in sub:
+                descend(s)
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == name:
+            out.append(eqn)
+        for sub in eqn.params.values():
+            descend(sub)
+    return out
+
+
 def test_ring_kv_circulates_in_storage_dtype(devices):
     """bf16 KV must ride the ring at storage width — the traced program's
     ppermute operands are bf16 (half the ICI bytes of fp32; the upcast
@@ -428,28 +449,37 @@ def test_ring_kv_circulates_in_storage_dtype(devices):
     attn = build_ring_attention(mesh, causal=True)
     q = jnp.zeros((256, 8, 16), jnp.bfloat16)
 
-    def collect(jaxpr, name, out):
-        def descend(sub):
-            if hasattr(sub, "eqns"):          # a raw Jaxpr (shard_map)
-                collect(sub, name, out)
-            elif hasattr(sub, "jaxpr"):       # a ClosedJaxpr (pjit etc.)
-                collect(sub.jaxpr, name, out)
-            elif isinstance(sub, (list, tuple)):
-                for s in sub:
-                    descend(s)
-
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name == name:
-                out.append(eqn)
-            for sub in eqn.params.values():
-                descend(sub)
-        return out
-
     jaxpr = jax.make_jaxpr(lambda a, b, c: attn(a, b, c))(q, q, q)
-    perms = collect(jaxpr.jaxpr, "ppermute", [])
+    perms = _collect_eqns(jaxpr.jaxpr, "ppermute", [])
     assert perms, "no ppermute found in the traced ring"
     for eqn in perms:
         for var in eqn.invars:
             assert var.aval.dtype == jnp.bfloat16, (
                 f"KV widened to {var.aval.dtype} before the wire"
             )
+
+
+def test_ulysses_forward_exchange_in_storage_dtype(devices):
+    """Ulysses' forward q/k/v reshards must carry storage dtype (bf16);
+    the return leg carries the fp32 output per the accumulator contract —
+    3 of 4 exchanges at half width. Same jaxpr-level check (and same CPU
+    legalization caveat) as the ring test above."""
+    import jax
+
+    from matvec_mpi_multiplier_tpu.parallel.attention import (
+        build_ulysses_attention,
+    )
+
+    mesh = make_mesh(8)
+    attn = build_ulysses_attention(mesh, causal=True)
+    q = jnp.zeros((256, 8, 16), jnp.bfloat16)
+
+    jaxpr = jax.make_jaxpr(lambda a, b, c: attn(a, b, c))(q, q, q)
+    a2a = _collect_eqns(jaxpr.jaxpr, "all_to_all", [])
+    assert len(a2a) == 4, f"expected 4 exchanges, found {len(a2a)}"
+    # Positional, not sorted: eqn order is deterministic (q, k, v in, then
+    # the output out), and WHICH leg carries which dtype is the contract —
+    # a bf16 return leg would break the fp32 accumulator contract even
+    # with the same dtype multiset.
+    dtypes = [str(eqn.invars[0].aval.dtype) for eqn in a2a]
+    assert dtypes == ["bfloat16", "bfloat16", "bfloat16", "float32"], dtypes
