@@ -560,3 +560,26 @@ def test_watcher_stops_on_completed_capture_with_failed_stages(tmp_path):
     assert "aborted (rc=1, wedge/probe)" in r.stderr
     assert "attempt 2 ended rc=4 (deterministic" in r.stderr
     assert "attempt 3" not in r.stderr
+
+
+def test_attention_study_isolates_variant_failures(monkeypatch, tmp_path):
+    """A variant that cannot run (here: Ulysses with h=2 on an 8-device
+    mesh) must cost only its own columns — the report still lands with the
+    healthy variants' numbers, and the stage exits nonzero so the capture
+    records the finding. The capture gets one shot per healthy window; a
+    Mosaic lowering quirk in one tier must not void the others' evidence."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import attention_study
+
+    report = tmp_path / "ATTENTION.md"
+    rc = attention_study.main([
+        "--platform", "cpu", "--seqs", "64", "--heads", "2", "--d-head", "8",
+        "--n-reps", "2", "--report", str(report),
+    ])
+    assert rc == 1
+    text = report.read_text()
+    assert "FAILED" in text            # the broken variant is named, not
+    assert "| 64 |" in text            # silently absent — and the healthy
+    assert text.count("FAILED") == 2   # rows landed (ring + dense timed)
