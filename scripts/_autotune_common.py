@@ -1,10 +1,11 @@
 """Shared skeleton for the Pallas tile autotuners.
 
-Both autotuners (scripts/autotune_pallas.py — HBM-bound GEMV tiles;
-scripts/autotune_pallas_gemm.py — MXU-bound GEMM tiles) share their CLI,
-platform guard, candidate timing, and report-writing logic; this module
-holds it once so a fix to one face (e.g. the platform override or the
-TimingError path) cannot silently drift from the other.
+The three autotuners (scripts/autotune_pallas.py — HBM-bound GEMV tiles;
+scripts/autotune_pallas_gemm.py — MXU-bound GEMM tiles;
+scripts/autotune_pallas_attention.py — the fused attention tile) share
+their CLI, platform guard, candidate timing, and report-writing logic;
+this module holds it once so a fix to one face (e.g. the platform
+override or the TimingError path) cannot silently drift from the others.
 """
 
 from __future__ import annotations
@@ -14,6 +15,10 @@ import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+
+# v5e per-chip bf16 MXU peak, the denominator of every %-of-peak/MFU line
+# (same convention as scripts/stats_visualization.py --mxu-peak).
+MXU_PEAK_TFLOPS = 197.0
 
 
 def build_parser(doc: str, *, default_size: int, default_report: str

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Autotune the Pallas flash-attention tile (bq, bk) on the real chip.
+
+Third face of the autotune family (GEMV tiles: HBM-bound; GEMM tiles:
+MXU-bound): the fused attention tile (``ops/pallas_attention.py``) sits
+between — an MXU contraction pair around a VPU softmax, where the (bq, bk)
+score-tile shape sets the MXU/VPU interleave and the VMEM working set.
+Sweeps a (bq, bk) grid at the p=1 full-attention shape (the single-chip
+case the capture's attention stage measures), times each tile against the
+score-materializing XLA tier, and reports the table + winner
+(docs/AUTOTUNE_ATTENTION.md). Tile configs that fail to compile are
+recorded and skipped.
+
+TPU-only by default: off-TPU pallas runs in interpret mode (pass
+--allow-interpret --platform cpu --size 256 to smoke-test the plumbing).
+
+Usage::
+
+    python scripts/autotune_pallas_attention.py            # on the chip
+    python scripts/autotune_pallas_attention.py --size 4096 --causal
+"""
+
+from __future__ import annotations
+
+import itertools
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from _autotune_common import (  # noqa: E402
+    MXU_PEAK_TFLOPS,
+    build_parser,
+    measure_median,
+    setup_backend,
+    write_report,
+)
+
+BQS = (256, 512, 1024)
+BKS = (256, 512, 1024)
+
+
+def main(argv=None) -> int:
+    p = build_parser(
+        __doc__, default_size=8192, default_report="AUTOTUNE_ATTENTION.md"
+    )
+    p.add_argument("--heads", type=int, default=8)
+    # 128 = the lane width; other values run the tier's fallback, which
+    # there is no point tuning.
+    p.add_argument("--d-head", type=int, default=128)
+    p.add_argument("--causal", action="store_true")
+    args = p.parse_args(argv)
+    if args.d_head % 128:
+        print("--d-head must be a 128-lane multiple (the kernel's tiling "
+              "requirement; other head sizes use the untiled fallback)",
+              file=sys.stderr)
+        return 2
+    on_tpu = setup_backend(args)
+    if on_tpu is None:
+        return 1
+
+    import jax
+    import jax.numpy as jnp
+
+    from matvec_mpi_multiplier_tpu.ops.pallas_attention import (
+        _pallas_partial,
+        _reference_partial,
+    )
+    from matvec_mpi_multiplier_tpu.utils.errors import TimingError
+
+    s, h, d = args.size, args.heads, args.d_head
+    dtype = args.dtype
+    scale = 1.0 / (d ** 0.5)
+
+    # Head-major operands generated on device (bench.py's fill pattern),
+    # Q pre-scaled as the schedules do; K and V stacked into one array so
+    # the two-operand timing harness (time_fn_looped) carries them.
+    @jax.jit
+    def gen():
+        i1 = jax.lax.broadcasted_iota(jnp.int32, (h, s, d), 1)
+        i2 = jax.lax.broadcasted_iota(jnp.int32, (h, s, d), 2)
+        base = ((i1 + i2) % 1024).astype(dtype) * (10.0 / 1024.0)
+        q = (base * jnp.asarray(scale, dtype)).astype(dtype)
+        kv = jnp.stack([base, base * jnp.asarray(0.5, dtype)])
+        return q, kv
+
+    q, kv = gen()
+    jax.block_until_ready((q, kv))
+    pos = jnp.arange(s, dtype=jnp.int32)
+
+    flops = 4.0 * s * s * h * d * (0.5 if args.causal else 1.0)
+
+    def gflops(t: float) -> float:
+        return flops / t / 1e9
+
+    # Baseline: the xla tier's computation, from the tier's own tested
+    # oracle (_reference_partial) rather than a re-implementation that
+    # could drift from the kernel's masking/statistics conventions.
+    @jax.jit
+    def xla_attention(q_, kv_):
+        o, _, l = _reference_partial(
+            q_, kv_[0], kv_[1], pos, pos, causal=args.causal
+        )
+        return o / jnp.maximum(l, 1e-30)[..., None]
+
+    rows = []
+    try:
+        t_xla = measure_median(xla_attention, (q, kv), args)
+    except TimingError as e:
+        t_xla = None
+        rows.append(("xla tier", None, None, "unmeasurable"))
+        print(f"xla: UNMEASURABLE ({e})", flush=True)
+    else:
+        rows.append(("xla tier", t_xla, gflops(t_xla), "ok"))
+        print(f"xla: {t_xla*1e3:.3f} ms  {gflops(t_xla):.1f} GFLOP/s",
+              flush=True)
+
+    best = None
+    for bq, bk in itertools.product(BQS, BKS):
+        label = f"flash {bq}x{bk}"
+        if s % bq or s % bk:
+            rows.append((label, None, None, "indivisible"))
+            continue
+
+        def flash(q_, kv_, bq=bq, bk=bk):
+            o, _, l = _pallas_partial(
+                q_, kv_[0], kv_[1], pos, pos,
+                causal=args.causal, bq=bq, bk=bk, interpret=not on_tpu,
+            )
+            return o / jnp.maximum(l, 1e-30)[..., None]
+
+        try:
+            t = measure_median(flash, (q, kv), args)
+        except TimingError as e:
+            rows.append((label, None, None, "unmeasurable"))
+            print(f"{label}: UNMEASURABLE ({e})", flush=True)
+            continue
+        except Exception as e:  # compile failure — record and move on
+            rows.append((label, None, None, f"{type(e).__name__}"))
+            print(f"{label}: FAILED {type(e).__name__}", flush=True)
+            continue
+        rows.append((label, t, gflops(t), "ok"))
+        print(f"{label}: {t*1e3:.3f} ms  {gflops(t):.1f} GFLOP/s",
+              flush=True)
+        if best is None or t < best[1]:
+            best = (label, t)
+
+    report = [
+        "# Pallas flash-attention tile autotune",
+        "",
+        f"s={s}, h={h}, d_head={d}, {dtype} storage / fp32 statistics, "
+        f"causal={args.causal}; device-looped measure ({args.n_reps} reps "
+        f"× {args.samples} samples, median), backend="
+        f"{'tpu' if on_tpu else 'interpret (smoke only)'} "
+        "(generated by `scripts/autotune_pallas_attention.py`).",
+        "",
+        "| config | time (ms) | GFLOP/s | status |",
+        "|---|---|---|---|",
+    ]
+    for label, t, gf, status in rows:
+        report.append(
+            f"| {label} | {t*1e3:.3f} | {gf:.1f} | {status} |"
+            if t is not None else f"| {label} | — | — | {status} |"
+        )
+    if best is not None:
+        baseline = (
+            f"xla-tier baseline {gflops(t_xla):.1f} GFLOP/s"
+            if t_xla is not None else "xla-tier baseline unmeasurable"
+        )
+        report += [
+            "",
+            f"Best tile: **{best[0]}** at {gflops(best[1]):.1f} GFLOP/s "
+            f"({100*gflops(best[1])/(MXU_PEAK_TFLOPS*1e3):.2f}% of the "
+            f"{MXU_PEAK_TFLOPS:.0f} TFLOP/s v5e "
+            f"bf16 MXU peak); {baseline}. If the winner differs from the "
+            "committed DEFAULT_BQ/DEFAULT_BK "
+            "(`ops/pallas_attention.py`), update them and re-run the "
+            "attention stage.",
+        ]
+    write_report("\n".join(report) + "\n", args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,7 +107,8 @@ def main(argv=None) -> int:
         "--skip", nargs="*", default=[],
         choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
                  "compensated", "refine", "attention", "autotune",
-                 "autotune_gemm", "baseline", "figures", "notebook"],
+                 "autotune_gemm", "autotune_attention", "baseline",
+                 "figures", "notebook"],
     )
     p.add_argument(
         "--wipe-stale-csvs", action="store_true",
@@ -231,6 +232,12 @@ def main(argv=None) -> int:
         if "autotune_gemm" not in args.skip:
             # MXU tile search: the MFU face of the autotune story.
             step("autotune_gemm", [py, "scripts/autotune_pallas_gemm.py"])
+        if "autotune_attention" not in args.skip:
+            # Flash-attention tile search: the fused tier's (bq, bk) grid
+            # vs the score-materializing xla tier at the p=1 shape the
+            # attention stage measures (docs/AUTOTUNE_ATTENTION.md).
+            step("autotune_attention",
+                 [py, "scripts/autotune_pallas_attention.py"])
         if "figures" not in args.skip:
             step("figures", [py, "scripts/stats_visualization.py",
                              "--data-out", str(Path(args.data_root) / "out"),

@@ -586,3 +586,29 @@ def test_attention_study_isolates_variant_failures(monkeypatch, tmp_path):
     row = next(l for l in text.splitlines() if l.startswith("| 64 |"))
     assert row.count("FAILED") == 2
     assert row.count("ms") == 0 and "|" in row
+
+
+def test_autotune_attention_cli_smoke(monkeypatch, tmp_path):
+    """Same plumbing smoke for the flash-attention tile autotuner."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import autotune_pallas_attention
+
+    monkeypatch.setattr(autotune_pallas_attention, "BQS", (128,))
+    monkeypatch.setattr(autotune_pallas_attention, "BKS", (128,))
+    report = tmp_path / "AUTOTUNE_ATTENTION.md"
+    rc = autotune_pallas_attention.main([
+        "--platform", "cpu", "--allow-interpret", "--size", "128",
+        "--heads", "2", "--n-reps", "1", "--samples", "1",
+        "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    assert "flash 128x128" in text
+    assert "xla tier" in text
+    assert "Best tile" in text
+    # A non-lane-multiple head size has no kernel to tune: usage error.
+    assert autotune_pallas_attention.main(
+        ["--platform", "cpu", "--allow-interpret", "--d-head", "64"]
+    ) == 2
