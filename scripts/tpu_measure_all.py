@@ -234,10 +234,13 @@ def main(argv=None) -> int:
             step("autotune_gemm", [py, "scripts/autotune_pallas_gemm.py"])
         if "autotune_attention" not in args.skip:
             # Flash-attention tile search: the fused tier's (bq, bk) grid
-            # vs the score-materializing xla tier at the p=1 shape the
-            # attention stage measures (docs/AUTOTUNE_ATTENTION.md).
+            # vs the score-materializing xla tier at the p=1 shape AND
+            # masking the attention stage measures (--causal, matching the
+            # attention step above — causal masking shifts the tile's
+            # MXU/VPU balance, so tuning non-causal could crown the wrong
+            # winner). docs/AUTOTUNE_ATTENTION.md.
             step("autotune_attention",
-                 [py, "scripts/autotune_pallas_attention.py"])
+                 [py, "scripts/autotune_pallas_attention.py", "--causal"])
         if "figures" not in args.skip:
             step("figures", [py, "scripts/stats_visualization.py",
                              "--data-out", str(Path(args.data_root) / "out"),

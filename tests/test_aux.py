@@ -612,3 +612,52 @@ def test_autotune_attention_cli_smoke(monkeypatch, tmp_path):
     assert autotune_pallas_attention.main(
         ["--platform", "cpu", "--allow-interpret", "--d-head", "64"]
     ) == 2
+
+
+def test_land_capture_aborts_before_any_write_on_unrenderable_dataset(
+    monkeypatch, tmp_path
+):
+    """The nothing-half-landed invariant: a dataset whose rows miss the
+    renderer's filters (here: sync-measure rows only, no loop rows) must
+    abort BEFORE BASELINE.json or README.md are touched — a north star
+    published without its README table would be a half-landed capture."""
+    from pathlib import Path
+
+    repo = Path(__file__).parents[1]
+    monkeypatch.syspath_prepend(str(repo / "scripts"))
+    out = tmp_path / "data" / "out"
+    out.mkdir(parents=True)
+    header = ("n_rows, n_cols, n_devices, time, strategy, dtype, mode, "
+              "measure, gflops, gbps, n_rhs\n")
+    (out / "results_extended.csv").write_text(
+        header
+        + "600, 600, 1, 0.001, rowwise, float32, amortized, sync, "
+        "0.72, 2.88, 1\n"
+    )
+    (out / "vmem_roof.json").write_text('{"ceiling_per_chip_gbps": 1000}')
+    baseline_before = (
+        '{"published": {"blockwise_65536_bf16_hbm_sweep": '
+        '{"status": "blocked_tunnel", "best_measured_gbps": null}}}'
+    )
+    (tmp_path / "BASELINE.json").write_text(baseline_before)
+    (tmp_path / "BASELINE_65536_bf16.json").write_text(
+        '{"metric": "m", "value": 777.5, "unit": "GB/s"}'
+    )
+    readme_before = (
+        "# x\n\n<!-- TPU_RESULTS_TABLE_START -->\npending\n"
+        "<!-- TPU_RESULTS_TABLE_END -->\n"
+    )
+    (tmp_path / "README.md").write_text(readme_before)
+
+    import importlib
+
+    import land_capture
+
+    importlib.reload(land_capture)
+    monkeypatch.setattr(land_capture, "REPO", tmp_path)
+    monkeypatch.setattr(land_capture, "_gates", lambda: (True, "stubbed"))
+    rc = land_capture.main(["--apply"])
+    assert rc == 1
+    # Nothing was written: both files byte-identical to before.
+    assert (tmp_path / "BASELINE.json").read_text() == baseline_before
+    assert (tmp_path / "README.md").read_text() == readme_before
