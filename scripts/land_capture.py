@@ -110,45 +110,62 @@ def _update_north_star(apply: bool) -> str:
             f"best_measured_gbps {before[1]} -> {gbps}")
 
 
-def _render_table(data_root: Path) -> str | None:
-    """The rendered per-size table, or None (with diagnostics printed)
-    when the renderer's filters match no rows — the caller must treat
-    that as a pre-write abort, never a post-write crash."""
+def _render_table(
+    data_root: Path, shape: str = "square", *, required: bool = True
+) -> str | None:
+    """The rendered per-size table for one regime, or None when the
+    renderer's filters match no rows. ``required`` tables print the
+    renderer's diagnostics and the caller treats None as a pre-write
+    abort; optional ones (the asymmetric regime — legitimately absent
+    when a capture wedged after the square sweep) report the absence
+    calmly and the landing proceeds without them."""
     r = subprocess.run(
         [sys.executable, "scripts/results_table.py",
-         "--data-root", str(data_root)],
+         "--data-root", str(data_root), "--shape", shape],
         cwd=CODE_ROOT, capture_output=True, text=True,
     )
     if r.returncode != 0:
-        print("results_table.py failed — dataset present but its rows "
-              "don't match the renderer's filters:")
-        print((r.stdout + r.stderr).strip())
+        if required:
+            print(f"results_table.py ({shape}) failed — dataset present "
+                  "but its rows don't match the renderer's filters:")
+            print((r.stdout + r.stderr).strip())
+        else:
+            print(f"no {shape}-regime rows — landing without that table")
         return None
     return r.stdout.strip()
 
 
-def _splice_readme(table_md: str, apply: bool) -> str:
+def _splice_readme(square_md: str, asym_md: str | None, apply: bool) -> str:
     readme = REPO / "README.md"
     text = readme.read_text()
     if TABLE_START not in text or TABLE_END not in text:
         return "README: table markers missing — not applied"
-    block = (
-        f"{TABLE_START}\n"
+    parts = [
+        TABLE_START,
         "Per-size amortized loop-protocol times on the one v5e chip "
-        "(fp32, square regime; rendered from the committed "
-        "`data/out/results_extended.csv` by `scripts/results_table.py`):\n\n"
-        f"{table_md}\n"
-        f"{TABLE_END}"
-    )
+        "(fp32; rendered from the committed "
+        "`data/out/results_extended.csv` by `scripts/results_table.py`)."
+        " Square regime:",
+        "",
+        square_md,
+    ]
+    if asym_md is not None:
+        # The asymmetric regime is a first-class reference deliverable
+        # (its asymmetric_*.csv files, quirk Q10). Caption stays generic:
+        # the renderer's asym filter is "non-square", and each table row
+        # labels its own m×n.
+        parts += ["", "Asymmetric regime (non-square sizes):", "", asym_md]
+    parts.append(TABLE_END)
+    block = "\n".join(parts)
     new = re.sub(
         re.escape(TABLE_START) + r".*?" + re.escape(TABLE_END),
         block.replace("\\", r"\\"), text, flags=re.S,
     )
     if not apply:
-        n_rows = table_md.count("\n") - 1
-        return f"README: would splice a {n_rows}-row table between markers"
+        n_rows = block.count("\n|") - 2 * (2 if asym_md is not None else 1)
+        return f"README: would splice {n_rows} table rows between markers"
     readme.write_text(new)
-    return "README: per-size table spliced between markers"
+    return "README: per-size tables spliced between markers"
 
 
 def main(argv=None) -> int:
@@ -199,9 +216,13 @@ def main(argv=None) -> int:
     # nothing half-landed (north star published without its README table,
     # or vice versa).
     problems = []
-    table_md = _render_table(REPO / args.data_root)
+    table_md = _render_table(REPO / args.data_root, "square")
     if table_md is None:
         problems.append("dataset rows don't render (see above)")
+    # The asymmetric table is included when its rows exist; a capture that
+    # wedged after the square sweep still lands with the square table
+    # alone (per-stage flushing means partial datasets are expected).
+    asym_md = _render_table(REPO / args.data_root, "asym", required=False)
     readme_text = (REPO / "README.md").read_text()
     if TABLE_START not in readme_text or TABLE_END not in readme_text:
         problems.append("README.md TPU_RESULTS_TABLE markers missing")
@@ -226,7 +247,7 @@ def main(argv=None) -> int:
         print("\nnorth star: BASELINE_65536_bf16.json absent (baseline "
               "stage did not land) — BASELINE.json left untouched")
 
-    print(_splice_readme(table_md, args.apply))
+    print(_splice_readme(table_md, asym_md, args.apply))
 
     superseded = data_out / "superseded"
     if superseded.exists():

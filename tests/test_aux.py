@@ -491,6 +491,12 @@ def test_land_capture_rehearsal(monkeypatch, tmp_path):
             f"600, 600, 1, 0.001, {s}, float32, amortized, loop, "
             "0.72, 2.88, 1\n"
         )
+        # One asymmetric-regime row per strategy: the splice must render
+        # BOTH regime tables (the reference's asymmetric_*.csv face).
+        ext_rows.append(
+            f"120, 60000, 1, 0.002, {s}, float32, amortized, loop, "
+            "7.2, 28.8, 1\n"
+        )
     (out / "results_extended.csv").write_text(header + "".join(ext_rows))
     (out / "vmem_roof.json").write_text('{"ceiling_per_chip_gbps": 1000}')
     (out / "superseded").mkdir()
@@ -531,6 +537,7 @@ def test_land_capture_rehearsal(monkeypatch, tmp_path):
     assert entry["best_measured_gbps"] == 777.5
     readme = (tmp_path / "README.md").read_text()
     assert "| 600² |" in readme and "pending" not in readme
+    assert "| 120×60000 |" in readme  # the asymmetric table landed too
     assert not (out / "superseded").exists()
 
     # Idempotence: a second --apply re-splices cleanly between markers.
