@@ -142,6 +142,12 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
     )
     # The fp64-parity GEMM tier's on-chip cost lands with the capture.
     assert any("--kernel ozaki" in c for c in joined)
+    # The attention tile autotune runs after the GEMM one, on the SAME
+    # causal workload the attention stage measures (a non-causal tune
+    # could crown the wrong tile for the workload actually reported).
+    att_tune = stage("autotune_pallas_attention.py")
+    assert stage("autotune_pallas_gemm.py") < att_tune
+    assert "--causal" in joined[att_tune]
 
     # The notebook re-execution is LAST (it renders whatever dataset the
     # earlier stages finished writing)...
