@@ -332,3 +332,58 @@ def test_two_process_attention_schedules(tmp_path):
     for o in by_idx.values():
         assert o["ring"] < 5e-6
         assert o["ulysses"] < 5e-6
+
+
+FLASH_WORKER = """
+import json, os, sys
+
+idx = int(sys.argv[1])
+port = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = ""  # 1 local CPU device per process -> 2 global
+
+import jax
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=idx
+)
+assert jax.device_count() == 2 and jax.local_device_count() == 1
+
+from matvec_mpi_multiplier_tpu.parallel.attention import build_ring_attention
+from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+
+# s=256 on p=2 gives (128, 128) per-hop blocks at d_head=128 — shapes the
+# Pallas tile ACCEPTS (flash_path_available), so the fused tier itself
+# (interpret mode) runs across the process boundary, not its fallback.
+# Single head keeps per-device interpret work far below the CPU
+# collective-rendezvous termination timeout.
+mesh = make_mesh(2)
+s, d = 256, 128
+rng = np.random.default_rng(17)
+q = rng.standard_normal((s, d)).astype(np.float32)
+k = rng.standard_normal((s, d)).astype(np.float32)
+v = rng.standard_normal((s, d)).astype(np.float32)
+
+import jax.numpy as jnp
+
+o_xla = np.asarray(build_ring_attention(mesh, causal=True, gather_output=True)(
+    jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+o_flash = np.asarray(build_ring_attention(
+    mesh, causal=True, gather_output=True, kernel="flash")(
+    jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+err = float(np.max(np.abs(o_flash - o_xla)))
+print(json.dumps({"idx": idx, "err": err}))
+"""
+
+
+def test_two_process_flash_tier(tmp_path):
+    """The fused Pallas tile inside the ring, executed across a REAL
+    process boundary at shapes the kernel accepts (not its fallback):
+    cross-process ppermute hops feeding interpret-mode pallas_call, flash
+    agreeing with the xla tier on both processes."""
+    by_idx = _run_workers(tmp_path, FLASH_WORKER)
+    for o in by_idx.values():
+        assert o["err"] < 5e-6
