@@ -15,9 +15,11 @@ zero forgotten steps:
 3. **North star** — ``BASELINE.json``'s ``blockwise_65536_bf16_hbm_sweep``
    entry is updated from the capture's ``BASELINE_65536_bf16.json``
    (status → published, measured GB/s filled in).
-4. **README table** — the per-size results table is rendered from the
-   committed rows (``scripts/results_table.py``) and spliced between the
-   ``TPU_RESULTS_TABLE`` markers in ``README.md``.
+4. **README tables** — the per-size results tables (square + asymmetric
+   regimes) are rendered from the committed rows
+   (``scripts/results_table.py``) and spliced between the
+   ``TPU_RESULTS_TABLE`` markers in BOTH ``README.md`` and its RU mirror
+   ``README_RU.md`` (tables are language-neutral; captions translate).
 5. **Summary** — what changed, what to `git add`, and what (if anything)
    still needs a human: retiring ``data/out/superseded/`` is offered via
    ``--retire-superseded`` because PARITY.md promises wholesale
@@ -135,26 +137,41 @@ def _render_table(
     return r.stdout.strip()
 
 
-def _splice_readme(square_md: str, asym_md: str | None, apply: bool) -> str:
-    readme = REPO / "README.md"
-    text = readme.read_text()
-    if TABLE_START not in text or TABLE_END not in text:
-        return "README: table markers missing — not applied"
-    parts = [
-        TABLE_START,
+_CAPTIONS = {
+    "README.md": (
         "Per-size amortized loop-protocol times on the one v5e chip "
         "(fp32; rendered from the committed "
         "`data/out/results_extended.csv` by `scripts/results_table.py`)."
         " Square regime:",
-        "",
-        square_md,
-    ]
+        "Asymmetric regime (non-square sizes):",
+    ),
+    "README_RU.md": (
+        "По-размерные времена amortized-протокола loop на одном чипе v5e "
+        "(fp32; отрендерено из зафиксированного "
+        "`data/out/results_extended.csv` скриптом "
+        "`scripts/results_table.py`). Квадратный режим:",
+        "Асимметричный режим (неквадратные размеры):",
+    ),
+}
+
+
+def _splice_readme(
+    square_md: str, asym_md: str | None, apply: bool,
+    readme_name: str = "README.md",
+) -> str:
+    readme = REPO / readme_name
+    text = readme.read_text()
+    if TABLE_START not in text or TABLE_END not in text:
+        return f"{readme_name}: table markers missing — not applied"
+    square_caption, asym_caption = _CAPTIONS[readme_name]
+    parts = [TABLE_START, square_caption, "", square_md]
     if asym_md is not None:
         # The asymmetric regime is a first-class reference deliverable
         # (its asymmetric_*.csv files, quirk Q10). Caption stays generic:
         # the renderer's asym filter is "non-square", and each table row
-        # labels its own m×n.
-        parts += ["", "Asymmetric regime (non-square sizes):", "", asym_md]
+        # labels its own m×n. Tables are language-neutral, so the RU
+        # mirror splices the same markdown under a translated caption.
+        parts += ["", asym_caption, "", asym_md]
     parts.append(TABLE_END)
     block = "\n".join(parts)
     new = re.sub(
@@ -163,16 +180,18 @@ def _splice_readme(square_md: str, asym_md: str | None, apply: bool) -> str:
     )
     if not apply:
         n_rows = block.count("\n|") - 2 * (2 if asym_md is not None else 1)
-        return f"README: would splice {n_rows} table rows between markers"
+        return (f"{readme_name}: would splice {n_rows} table rows "
+                "between markers")
     readme.write_text(new)
-    return "README: per-size tables spliced between markers"
+    return f"{readme_name}: per-size tables spliced between markers"
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--data-root", default="data")
     p.add_argument("--apply", action="store_true",
-                   help="write BASELINE.json and README.md (default: report)")
+                   help="write BASELINE.json, README.md and README_RU.md "
+                   "(default: report)")
     p.add_argument("--retire-superseded", action="store_true",
                    help="delete data/out/superseded/ (the capture's dataset "
                    "wholesale-replaces the quarantined rows)")
@@ -223,9 +242,16 @@ def main(argv=None) -> int:
     # wedged after the square sweep still lands with the square table
     # alone (per-stage flushing means partial datasets are expected).
     asym_md = _render_table(REPO / args.data_root, "asym", required=False)
-    readme_text = (REPO / "README.md").read_text()
-    if TABLE_START not in readme_text or TABLE_END not in readme_text:
-        problems.append("README.md TPU_RESULTS_TABLE markers missing")
+    # _CAPTIONS is the single list of localized READMEs: the pre-check,
+    # the splice loop below, and the caption table cannot drift apart.
+    for name in _CAPTIONS:
+        readme_path = REPO / name
+        if not readme_path.exists():
+            problems.append(f"{name} missing")
+            continue
+        readme_text = readme_path.read_text()
+        if TABLE_START not in readme_text or TABLE_END not in readme_text:
+            problems.append(f"{name} TPU_RESULTS_TABLE markers missing")
     have_north_star = (REPO / "BASELINE_65536_bf16.json").exists()
     if have_north_star:
         unit = json.loads(
@@ -247,7 +273,8 @@ def main(argv=None) -> int:
         print("\nnorth star: BASELINE_65536_bf16.json absent (baseline "
               "stage did not land) — BASELINE.json left untouched")
 
-    print(_splice_readme(table_md, asym_md, args.apply))
+    for name in _CAPTIONS:
+        print(_splice_readme(table_md, asym_md, args.apply, name))
 
     superseded = data_out / "superseded"
     if superseded.exists():
@@ -268,8 +295,9 @@ def main(argv=None) -> int:
         print("  git add data/out/*.csv data/out/vmem_roof.json "
               "figures/tpu docs README.md README_RU.md BASELINE.json "
               "BASELINE_65536_bf16.json stats_visualization.ipynb")
-        print("then run `python bench.py` once for the round's headline "
-              "and sync README_RU's results section by hand")
+        print("then run `python bench.py` once for the round's headline; "
+              "both READMEs' tables are already spliced — check the "
+              "surrounding RU prose still reads correctly")
     else:
         print("\n(report only — rerun with --apply to write)")
     return 0

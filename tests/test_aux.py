@@ -519,6 +519,10 @@ def test_land_capture_rehearsal(monkeypatch, tmp_path):
         "# x\n\n<!-- TPU_RESULTS_TABLE_START -->\npending\n"
         "<!-- TPU_RESULTS_TABLE_END -->\n"
     )
+    (tmp_path / "README_RU.md").write_text(
+        "# y\n\n<!-- TPU_RESULTS_TABLE_START -->\npending-ru\n"
+        "<!-- TPU_RESULTS_TABLE_END -->\n"
+    )
 
     # Gates would run against the REAL repo's committed data (still
     # pre-capture), so rehearse via the module with _gates stubbed and
@@ -544,6 +548,9 @@ def test_land_capture_rehearsal(monkeypatch, tmp_path):
     readme = (tmp_path / "README.md").read_text()
     assert "| 600² |" in readme and "pending" not in readme
     assert "| 120×60000 |" in readme  # the asymmetric table landed too
+    readme_ru = (tmp_path / "README_RU.md").read_text()
+    assert "| 600² |" in readme_ru and "pending-ru" not in readme_ru
+    assert "Квадратный режим" in readme_ru  # RU caption, same tables
     assert not (out / "superseded").exists()
 
     # Idempotence: a second --apply re-splices cleanly between markers.
@@ -661,6 +668,7 @@ def test_land_capture_aborts_before_any_write_on_unrenderable_dataset(
         "<!-- TPU_RESULTS_TABLE_END -->\n"
     )
     (tmp_path / "README.md").write_text(readme_before)
+    (tmp_path / "README_RU.md").write_text(readme_before)
 
     import importlib
 
