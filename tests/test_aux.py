@@ -360,6 +360,68 @@ def test_refine_study_cli_smoke(monkeypatch, tmp_path):
     assert "refined" in text
 
 
+def test_refine_study_marks_capped_cg_control(monkeypatch, tmp_path):
+    """When a control solver reports non-convergence by exhausting the
+    iteration budget, its error and iteration cells are starred — for
+    plain CG AND the PCG control — and the report says the control is
+    truncated, not converged: the gain claim must never silently compare
+    against a truncated run. The mark keys on CGResult.converged (the
+    true-residual check), not on the iteration count alone (n_iters ==
+    max_iters can coincide with convergence on the final step); a
+    non-converged control that stopped BELOW the budget gets the
+    distinct floor mark instead (more iterations would not have
+    helped)."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import refine_study
+
+    monkeypatch.setattr(refine_study, "CONDS", (1e4,))
+    report = tmp_path / "REFINEMENT.md"
+    rc = refine_study.main([
+        "--platform", "cpu", "--size", "64", "--max-iters", "5",
+        "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    assert "| 5\\* |" in text
+    assert "truncated run, not a converged one" in text
+    # Both control columns carry the star: CG err, PCG err, then an
+    # unstarred refined err — three starred cells per capped row in
+    # total (cg err, pcg err, cg iters).
+    row = next(line for line in text.splitlines() if "| 5\\* |" in line)
+    assert row.count("\\*") == 3
+
+
+def test_refine_study_floor_mark_distinct_from_budget_mark(
+    monkeypatch, tmp_path
+):
+    """A control that stops short of tol with budget to spare (fp32 CG's
+    attainable floor — tol=1e-7 is below what fp32 arithmetic can reach)
+    is marked with the floor dagger, not the truncation star, and the
+    floor footnote explains that more iterations would not have
+    helped."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import refine_study
+
+    monkeypatch.setattr(refine_study, "CONDS", (1e2,))
+    report = tmp_path / "REFINEMENT.md"
+    rc = refine_study.main([
+        "--platform", "cpu", "--size", "256", "--max-iters", "20000",
+        "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    # At cond 1e2 with an effectively unlimited budget, CG exits on its
+    # recurrence stagnation well under the cap but the true residual
+    # stays above tol*||b||: the dagger sub-case.
+    assert "†" in text
+    assert "stopped short of `tol` with budget to spare" in text
+    assert "truncated run" not in text
+
+
 def test_attention_study_cli_smoke(monkeypatch, tmp_path):
     """End-to-end plumbing of the attention study on the CPU backend:
     tiny ladder, correctness asserts, report generation."""
