@@ -188,6 +188,16 @@ def build_parser() -> argparse.ArgumentParser:
         "SpeedUp/Efficiency averaging",
     )
     p.add_argument(
+        "--skip-measured",
+        action="store_true",
+        help="skip any config whose row already exists in the extended CSV "
+        "(same strategy label, shape, device count, dtype, mode, measure "
+        "and n_rhs) — lets a capture that died mid-sweep (tunnel wedge) "
+        "resume at the next healthy window instead of redoing every "
+        "config; requires an explicit --measure (an 'auto' sweep cannot "
+        "know which method an existing row used)",
+    )
+    p.add_argument(
         "--keep-going",
         action="store_true",
         help="on a runtime/backend error in one config (e.g. a transient "
@@ -267,6 +277,17 @@ def run_sweep(args: argparse.Namespace) -> int:
             "convention has no rank-2 right-hand side); gemm operands are "
             "generated in memory"
         )
+    if args.skip_measured and args.measure == "auto":
+        raise SystemExit(
+            "--skip-measured needs an explicit --measure: existing rows are "
+            "matched by their measure column, and 'auto' resolves per "
+            "config AFTER the skip decision would have to be made"
+        )
+    if args.skip_measured and args.no_csv:
+        raise SystemExit(
+            "--skip-measured with --no-csv would re-skip forever (new "
+            "results are never written back) — drop one of the two"
+        )
     # Fail fast on an unknown kernel: get_*_kernel raises the same KeyError,
     # but only deep inside the loop, after earlier configs already ran.
     from ..ops import available_gemm_kernels, available_kernels
@@ -315,9 +336,7 @@ def run_sweep(args: argparse.Namespace) -> int:
     n_ok, n_skip, n_unmeasurable, n_failed = counters
     if not args.no_csv:
         for name in strategies:
-            csv_name = f"gemm_{name}" if args.op == "gemm" else name
-            if args.label_suffix:
-                csv_name = f"{csv_name}_{args.label_suffix}"
+            csv_name = csv_label(name, args.op, args.label_suffix)
             for mode in modes:
                 print(f"CSV: {csv_path(csv_name, args.data_root, mode=mode)}")
     if args.profile_dir is not None:
@@ -333,16 +352,60 @@ def run_sweep(args: argparse.Namespace) -> int:
     return 3 if n_unmeasurable else 0
 
 
+def csv_label(name: str, op: str, label_suffix: str | None) -> str:
+    """The strategy label exactly as CSV rows record it: gemm rows land as
+    ``gemm_<name>`` (timing.py::benchmark_gemm sets ``strategy_name``) and
+    ``--label-suffix`` appends after that. Single source for the CSV-path
+    printout AND the ``--skip-measured`` row matching — if these drifted
+    apart, resumed sweeps would silently re-run (and duplicate) every
+    config."""
+    label = f"gemm_{name}" if op == "gemm" else name
+    return f"{label}_{label_suffix}" if label_suffix else label
+
+
+def _measured_keys(args) -> set[tuple]:
+    """Identity keys of rows already in the extended CSV, for
+    ``--skip-measured``: strategy label as written (``--label-suffix``
+    included), shape, device count, dtype, mode, measure, n_rhs.
+
+    Rows missing any key column are dropped, not fatal: the extended CSV
+    can hold old-schema rows (pre-``measure`` files rotate on first
+    append, ``metrics._append_row``) or a final line truncated by the
+    wedge-timeout kill — the very crash this resume path recovers from.
+    An unmatchable row simply re-measures."""
+    from .metrics import extended_csv_path, read_csv
+
+    path = extended_csv_path(args.data_root)
+    if not path.exists():
+        return set()
+    keys = set()
+    for row in read_csv(path):
+        try:
+            keys.add((
+                str(row["strategy"]), int(row["n_rows"]),
+                int(row["n_cols"]), int(row["n_devices"]),
+                str(row["dtype"]), str(row["mode"]), str(row["measure"]),
+                int(row.get("n_rhs", 1)),
+            ))
+        except (KeyError, TypeError, ValueError):
+            continue
+    return keys
+
+
 def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
     # Sizes on the outer loop: operands depend only on the size (and seed),
     # so each (n_rows, n_cols) pair is generated/loaded exactly once and
-    # shared across every strategy x device-count combination.
+    # shared across every strategy x device-count combination — and only
+    # when at least one of its configs actually runs (a fully
+    # skip-measured size never generates operands at all).
     gemm = args.op == "gemm"
+    measured = _measured_keys(args) if args.skip_measured else set()
     for n_rows, n_cols in sizes:
         n_rhs = (args.n_rhs or n_cols) if gemm else 1
         a = x = None
         for name in strategies:
             strat = None if gemm else get_strategy(name)
+            label_name = csv_label(name, args.op, args.label_suffix)
             for n_dev in counts:
                 mesh = meshes[n_dev]
                 try:
@@ -354,13 +417,25 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                     print(f"skip {name} {n_rows}x{n_cols} p={n_dev}: {e}")
                     counters[1] += 1
                     continue
-                if a is None:
-                    if gemm:
-                        a = io.generate_matrix(n_rows, n_cols, seed=args.seed)
-                        x = io.generate_matrix(n_cols, n_rhs, seed=args.seed + 1)
-                    else:
-                        a, x = operands(n_rows, n_cols, args)
                 for mode in modes:
+                    if (label_name, n_rows, n_cols, n_dev, args.dtype,
+                            mode, args.measure, n_rhs) in measured:
+                        print(
+                            f"skip {label_name} {n_rows}x{n_cols} p={n_dev} "
+                            f"[{mode}]: already measured (--skip-measured)"
+                        )
+                        counters[1] += 1
+                        continue
+                    if a is None:
+                        if gemm:
+                            a = io.generate_matrix(
+                                n_rows, n_cols, seed=args.seed
+                            )
+                            x = io.generate_matrix(
+                                n_cols, n_rhs, seed=args.seed + 1
+                            )
+                        else:
+                            a, x = operands(n_rows, n_cols, args)
                     label = f"{args.op}_{name}_{n_rows}x{n_cols}_p{n_dev}_{mode}"
                     bench_kwargs = dict(
                         dtype=args.dtype,

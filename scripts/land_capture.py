@@ -291,6 +291,15 @@ def main(argv=None) -> int:
                   "committed")
 
     if args.apply:
+        # Landing completes the round: re-arm the capture's once-per-round
+        # stale-CSV wipe (tpu_measure_all.py::_wipe_stale_csvs) so the NEXT
+        # round's capture retires this round's rows instead of resuming
+        # over a landed dataset under a possibly-changed protocol.
+        sentinel = data_out / ".stale_wiped"
+        if sentinel.exists():
+            sentinel.unlink()
+            print("cleared data/out/.stale_wiped — stale-CSV wipe re-armed "
+                  "for the next round")
         print("\nsuggested staging:")
         print("  git add data/out/*.csv data/out/vmem_roof.json "
               "figures/tpu docs README.md README_RU.md BASELINE.json "

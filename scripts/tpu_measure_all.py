@@ -148,8 +148,15 @@ def main(argv=None) -> int:
             # North-star first (after the cheap headline): the one artifact
             # a mid-capture wedge must never cost again.
             statuses.append(("baseline", _baseline_stage(py), False))
+        # --skip-measured: every sweep-family stage resumes over whatever
+        # rows an earlier (wedge-killed) attempt already flushed — a
+        # healthy window only ever pays for configs not yet measured.
+        # Safe because each attempt runs the same protocol on the same
+        # chip; --wipe-stale-csvs (dropped by the watcher after the first
+        # started attempt) is what retires rows from OLDER protocols.
         sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
-                 "--data-root", args.data_root, "--keep-going"]
+                 "--data-root", args.data_root, "--keep-going",
+                 "--skip-measured"]
         if "sweeps" not in args.skip:
             if args.wipe_stale_csvs:
                 _wipe_stale_csvs(Path(args.data_root) / "out")
@@ -288,9 +295,22 @@ def main(argv=None) -> int:
 def _wipe_stale_csvs(out_dir: Path) -> None:
     """Move pre-existing top-level CSVs aside (never touches cpu_mesh/).
 
+    Once per round: the first wipe writes a ``.stale_wiped`` sentinel and
+    later runs return without touching anything — a watcher retry after a
+    mid-capture wedge must resume over the rows the earlier attempt
+    flushed (sweep stages pass ``--skip-measured``), not set its own
+    round's partial dataset aside. ``land_capture.py --apply`` clears the
+    sentinel when the round's dataset lands, re-arming the wipe for the
+    next round's protocol.
+
     Backups are never overwritten: a second capture run must not clobber the
     first run's set-aside data with its own (possibly wedge-truncated) CSVs.
     """
+    sentinel = out_dir / ".stale_wiped"
+    if sentinel.exists():
+        print(f"stale-CSV wipe already done this round ({sentinel} exists) "
+              "— resuming over the current dataset", flush=True)
+        return
     for csv in sorted(out_dir.glob("*.csv")):
         stale = csv.with_suffix(".csv.stale")
         n = 2
@@ -299,6 +319,11 @@ def _wipe_stale_csvs(out_dir: Path) -> None:
             n += 1
         print(f"moving stale {csv} -> {stale}", flush=True)
         csv.replace(stale)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    sentinel.write_text(
+        "stale CSVs wiped this round; land_capture.py --apply removes this "
+        "sentinel\n"
+    )
 
 
 def _baseline_stage(py: str) -> int:

@@ -29,6 +29,11 @@ while [ "$MAX_ATTEMPTS" -eq 0 ] || [ "$attempt" -lt "$MAX_ATTEMPTS" ]; do
   if timeout "$PROBE_TIMEOUT" python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     attempt=$((attempt + 1))
     echo "$(date -u +%FT%TZ) probe OK — capture attempt $attempt/${MAX_ATTEMPTS/#0/inf}" >&2
+    # --wipe-stale-csvs (if given) passes through on EVERY attempt: the
+    # capture's wipe is once-per-round via a sentinel (.stale_wiped, see
+    # tpu_measure_all.py), so a retry resumes over the partial dataset an
+    # earlier attempt flushed (sweep stages pass --skip-measured) instead
+    # of setting it aside and redoing every config.
     python scripts/tpu_measure_all.py "$@"
     rc=$?
     if [ "$rc" -eq 0 ]; then

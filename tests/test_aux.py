@@ -142,6 +142,12 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
     )
     # The fp64-parity GEMM tier's on-chip cost lands with the capture.
     assert any("--kernel ozaki" in c for c in joined)
+    # Every sweep-family stage resumes over rows an earlier wedge-killed
+    # attempt already flushed (the once-per-round wipe sentinel guarantees
+    # surviving rows are this round's own).
+    for c in joined:
+        if "bench.sweep" in c:
+            assert "--skip-measured" in c, c
     # The attention tile autotune runs after the GEMM one, on the SAME
     # causal workload the attention stage measures (a non-causal tune
     # could crown the wrong tile for the workload actually reported).
@@ -461,6 +467,7 @@ def _watcher_env(tmp_path, probe_failures: int, capture_rcs: list[int]) -> dict:
 state={tmp_path}
 case "$*" in
   *tpu_measure_all.py*)
+    echo "$*" >> "$state/capture_argvs"
     rcs=$(cat "$state/capture_rcs")
     rc=${{rcs%%$'\\n'*}}; [ -z "$rc" ] && rc=1
     rest=${{rcs#*$'\\n'}}; [ "$rest" = "$rcs" ] && rest=""
@@ -534,6 +541,58 @@ def test_watcher_default_budget_is_unlimited(tmp_path):
     assert "attempt 8/inf" in r.stderr
 
 
+def test_watcher_passes_args_through_on_every_attempt(tmp_path):
+    """The watcher passes its args (incl. --wipe-stale-csvs) through
+    unchanged on every attempt: once-per-round wipe semantics live in the
+    capture's sentinel (test below), NOT in fragile argv filtering — a
+    prefix-abbreviated flag (argparse accepts those) would dodge any
+    string filter."""
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).parents[1]
+    env = _watcher_env(tmp_path, probe_failures=0, capture_rcs=[1, 0])
+    r = subprocess.run(
+        ["bash", str(repo / "scripts" / "watch_and_capture.sh"),
+         "--wipe-stale-csvs", "--data-root", "data"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    argvs = (tmp_path / "capture_argvs").read_text().splitlines()
+    assert len(argvs) == 2
+    for argv in argvs:
+        assert "--wipe-stale-csvs" in argv
+        assert "--data-root data" in argv
+
+
+def test_wipe_stale_csvs_is_once_per_round(monkeypatch, tmp_path):
+    """--wipe-stale-csvs retires rows from OLDER protocols exactly once
+    per round: the first wipe moves CSVs aside and writes the
+    .stale_wiped sentinel; under the sentinel a retrying capture leaves
+    the partial dataset its own earlier attempt flushed (sweeps resume
+    via --skip-measured). A landed round re-arms the wipe (the landing
+    test covers sentinel removal)."""
+    from pathlib import Path
+
+    monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
+    import tpu_measure_all
+
+    out = tmp_path / "out"
+    out.mkdir(parents=True)
+    (out / "rowwise.csv").write_text("old protocol rows\n")
+    tpu_measure_all._wipe_stale_csvs(out)
+    assert not (out / "rowwise.csv").exists()
+    assert (out / "rowwise.csv.stale").exists()
+    assert (out / ".stale_wiped").exists()
+
+    # Attempt 2 of the same round: the partial dataset survives.
+    (out / "rowwise.csv").write_text("this round's partial rows\n")
+    tpu_measure_all._wipe_stale_csvs(out)
+    assert (out / "rowwise.csv").read_text() == (
+        "this round's partial rows\n"
+    )
+
+
 def test_land_capture_rehearsal(monkeypatch, tmp_path):
     """Full rehearsal of the capture-landing script against a synthetic
     repo tree: inventory, north-star update, README table splice — so
@@ -598,8 +657,12 @@ def test_land_capture_rehearsal(monkeypatch, tmp_path):
     monkeypatch.setattr(
         land_capture, "_gates", lambda: (True, "stubbed green")
     )
+    # A capture ran this round: its once-per-round wipe sentinel is
+    # present and landing must clear it (re-arming the next round's wipe).
+    (out / ".stale_wiped").write_text("wiped\n")
     rc = land_capture.main(["--apply", "--retire-superseded"])
     assert rc == 0
+    assert not (out / ".stale_wiped").exists()
 
     import json
 

@@ -403,6 +403,71 @@ def test_sweep_cli_keep_going_skips_unmeasurable(
         sweep_main(args)
 
 
+def test_sweep_cli_skip_measured_resumes(devices, tmp_path, monkeypatch, capsys):
+    """--skip-measured: configs whose rows already sit in the extended CSV
+    are skipped (the capture-retry resume path after a tunnel wedge), new
+    configs still run, and no row is ever duplicated."""
+    monkeypatch.setenv("MATVEC_DATA_DIR", str(tmp_path))
+    base = ["--strategy", "rowwise", "--devices", "2", "--n-reps", "2",
+            "--dtype", "float64", "--measure", "sync"]
+    assert sweep_main(base + ["--sizes", "16"]) == 0
+    rows1 = read_csv(extended_csv_path(tmp_path))
+    assert len(rows1) == 1
+
+    # Identical re-run with --skip-measured: nothing timed, nothing added.
+    assert sweep_main(base + ["--sizes", "16", "--skip-measured"]) == 0
+    out = capsys.readouterr().out
+    assert "already measured" in out
+    assert "0 configs timed" in out
+    assert read_csv(extended_csv_path(tmp_path)) == rows1
+
+    # A widened sweep resumes: only the new size runs.
+    assert sweep_main(base + ["--sizes", "16", "32", "--skip-measured"]) == 0
+    out = capsys.readouterr().out
+    assert "1 configs timed" in out
+    rows3 = read_csv(extended_csv_path(tmp_path))
+    assert len(rows3) == 2
+    assert sorted(r["n_rows"] for r in rows3) == [16, 32]
+
+
+def test_sweep_cli_skip_measured_distinguishes_label_and_dtype(
+    devices, tmp_path, monkeypatch, capsys
+):
+    """The skip key includes the strategy label as written (suffix and
+    all) and the dtype: a measured plain row must not suppress a
+    suffixed-kernel or different-dtype run of the same shape."""
+    monkeypatch.setenv("MATVEC_DATA_DIR", str(tmp_path))
+    base = ["--strategy", "rowwise", "--devices", "2", "--sizes", "16",
+            "--n-reps", "2", "--measure", "sync"]
+    assert sweep_main(base + ["--dtype", "float64"]) == 0
+    # Same shape, different dtype: runs.
+    assert sweep_main(base + ["--dtype", "float32", "--skip-measured"]) == 0
+    assert "1 configs timed" in capsys.readouterr().out
+    # Same shape/dtype under a label suffix: runs (separate CSV identity).
+    assert sweep_main(
+        base + ["--dtype", "float64", "--label-suffix", "alt",
+                "--skip-measured"]
+    ) == 0
+    assert "1 configs timed" in capsys.readouterr().out
+    # And now all three identities are present exactly once.
+    rows = read_csv(extended_csv_path(tmp_path))
+    assert sorted((r["strategy"], r["dtype"]) for r in rows) == [
+        ("rowwise", "float32"), ("rowwise", "float64"),
+        ("rowwise_alt", "float64"),
+    ]
+
+
+def test_sweep_cli_skip_measured_guards():
+    """--skip-measured with auto measure (ambiguous row matching) or
+    --no-csv (would re-skip forever) is a usage error."""
+    with pytest.raises(SystemExit):
+        sweep_main(["--strategy", "rowwise", "--sizes", "16",
+                    "--skip-measured"])
+    with pytest.raises(SystemExit):
+        sweep_main(["--strategy", "rowwise", "--sizes", "16",
+                    "--measure", "sync", "--no-csv", "--skip-measured"])
+
+
 def test_sweep_cli_label_suffix(devices, tmp_path, monkeypatch):
     """Kernel-variant rows land under a suffixed strategy name so they never
     blend into the plain per-strategy SpeedUp/Efficiency averaging."""
