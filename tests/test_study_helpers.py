@@ -33,11 +33,15 @@ def test_ulp_error_zero_iff_exact(rng):
 
 
 def test_wipe_stale_csvs_never_clobbers_backups(tmp_path):
+    """Across ROUNDS (the sentinel is cleared at landing), a later wipe
+    must never overwrite an earlier round's set-aside backups."""
     out = tmp_path / "out"
     out.mkdir()
     (out / "rowwise.csv").write_text("first capture\n")
     _wipe_stale_csvs(out)
     assert (out / "rowwise.csv.stale").read_text() == "first capture\n"
+    # Round boundary: landing clears the once-per-round sentinel.
+    (out / ".stale_wiped").unlink()
     (out / "rowwise.csv").write_text("second capture\n")
     _wipe_stale_csvs(out)
     # The first backup survives; the second goes to a counter suffix.
