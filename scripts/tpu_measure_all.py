@@ -15,24 +15,34 @@ Stages:
                 after the headline: it is the single highest-leverage
                 artifact, and a capture that wedges mid-sweep must not
                 lose it again (that is how round 3's first attempt died);
-4. sweeps     — square + asymmetric fp32 sweeps, median-of-5 device-looped
+4. sweep_square — the square fp32 sweep, median-of-5 device-looped
                 slopes (--measure loop: the rep loop is a fori_loop on
                 device with a jitter-calibrated spread, so per-dispatch
                 tunnel overhead never touches the number), replacing the
-                round-1 noise-dominated rows;
-5. hostlink   — link model + derived reference-mode rows (the wedge-safe
-                Q5 substitute; never does per-rep transfers);
-6. gemm       — MXU-bound GEMM numbers (8192^2 bf16 xla + pallas tiers);
-7. overlap    — scripts/overlap_study.py on the real backend (async
-                collective-permute pair evidence; self-skips at p=1);
-8. compensated— scripts/compensated_study.py on the chip (accuracy vs the
+                round-1 noise-dominated rows; then the derived sub-VMEM
+                roof (wedge-safe, reads the CSVs just written);
+5. gemm       — MXU-bound GEMM numbers (8192^2 bf16 xla + pallas tiers,
+                plus the fp64-parity ozaki tier);
+6. compensated— scripts/compensated_study.py on the chip (accuracy vs the
                 fp64 oracle + bandwidth rows);
-9. autotune   — scripts/autotune_pallas.py (bm, bk) tile search at the
+7. autotune   — scripts/autotune_pallas.py (bm, bk) tile search at the
                 headline size vs the committed defaults;
-10. autotune_gemm — scripts/autotune_pallas_gemm.py (bm, bn, bk) search at
+8. autotune_gemm — scripts/autotune_pallas_gemm.py (bm, bn, bk) search at
                 8192^2 bf16, reported as MFU vs the 197 TFLOP/s MXU peak;
-11. figures   — regenerate figures/tpu with HBM-roofline and MFU columns;
-12. notebook  — re-execute stats_visualization.ipynb in place so its
+   (5-8 are cheap one-shot stages that each close an evidence gap on
+   their own, so they run BEFORE the long asymmetric sweep: observed
+   healthy windows can be minutes, and --skip-measured resume means the
+   sweeps lose nothing by going later)
+9. sweep_asymmetric — the asymmetric fp32 sweep + a re-derived roof;
+10. hostlink  — link model + derived reference-mode rows (the wedge-safe
+                Q5 substitute; never does per-rep transfers);
+11. overlap   — scripts/overlap_study.py on the real backend (async
+                collective-permute pair evidence; self-skips at p=1);
+12. refine / attention / autotune_attention — solver-accuracy and
+                long-context evidence on the chip, then the causal
+                flash-tile autotune matching the attention workload;
+13. figures   — regenerate figures/tpu with HBM-roofline and MFU columns;
+14. notebook  — re-execute stats_visualization.ipynb in place so its
                 committed outputs match the dataset the capture just wrote
                 (wedge-safe: the notebook reads CSVs, never the chip).
 
@@ -157,29 +167,40 @@ def main(argv=None) -> int:
         sweep = [py, "-m", "matvec_mpi_multiplier_tpu.bench.sweep",
                  "--data-root", args.data_root, "--keep-going",
                  "--skip-measured"]
-        if "sweeps" not in args.skip:
-            if args.wipe_stale_csvs:
-                _wipe_stale_csvs(Path(args.data_root) / "out")
-            # One invocation per sweep kind, each with its own stage budget:
-            # the jitter-calibrated spreads make a combined square+asymmetric
-            # run (~114 configs incl. compiles) brush the per-stage timeout,
-            # and a timeout would abort every later stage.
-            for sweep_kind in ("square", "asymmetric"):
-                step(f"sweep_{sweep_kind}",
-                     sweep + ["--strategy", "all",
-                              "--sweep", sweep_kind,
-                              "--dtype", "float32", "--measure", "loop",
-                              "--chain-samples", "5", "--n-reps", "50"],
-                     sweep_stage=True)
+        def sweep_stage(kind: str) -> None:
+            step(f"sweep_{kind}",
+                 sweep + ["--strategy", "all",
+                          "--sweep", kind,
+                          "--dtype", "float32", "--measure", "loop",
+                          "--chain-samples", "5", "--n-reps", "50"],
+                 sweep_stage=True)
+
+        def vmem_roof_stage(tag: str = "vmem_roof") -> None:
             # Wedge-safe (reads the CSVs just written): derive the
             # measurement-based sub-VMEM sanity ceiling so the data-quality
             # gate tightens from the flat pre-measurement bound the moment
             # loop rows exist (tests/test_data_quality.py reads the JSON).
-            step("vmem_roof", [py, "scripts/derive_vmem_roof.py",
-                               "--data-root", args.data_root])
-        if "hostlink" not in args.skip:
-            step("hostlink", [py, "scripts/hostlink_study.py",
-                              "--data-root", args.data_root, "--max-mb", "256"])
+            step(tag, [py, "scripts/derive_vmem_roof.py",
+                       "--data-root", args.data_root])
+
+        # Stage order is tuned for SHORT healthy windows (the observed
+        # 2026-07-31 window lasted ~12 minutes): after the square sweep —
+        # the core dataset deliverable — the cheap one-shot stages that
+        # each close an evidence gap on their own (GEMM/MFU tiers,
+        # fp64-parity tiers on the MXU, the two tile autotunes; ~45 min
+        # total) run BEFORE the long asymmetric sweep (~2 h). Per-stage
+        # flushing + --skip-measured resume make the order safe: a wedge
+        # anywhere loses only the stages after it, and a sweep interrupted
+        # mid-run continues from its first unmeasured config next window.
+        # Each sweep kind gets its own invocation and stage budget: the
+        # jitter-calibrated spreads make a combined square+asymmetric run
+        # (~114 configs incl. compiles) brush the per-stage timeout, and a
+        # timeout would abort every later stage.
+        if "sweeps" not in args.skip:
+            if args.wipe_stale_csvs:
+                _wipe_stale_csvs(Path(args.data_root) / "out")
+            sweep_stage("square")
+            vmem_roof_stage()
         if "gemm" not in args.skip:
             step("gemm_xla",
                  sweep + ["--op", "gemm", "--strategy", "all",
@@ -205,17 +226,33 @@ def main(argv=None) -> int:
                           "--n-reps", "10",
                           "--label-suffix", "ozaki"],
                  sweep_stage=True)
-        if "overlap" not in args.skip:
-            # Real-backend overlap evidence: async collective-permute
-            # start/done pairs in the compiled module + TPU timings
-            # (docs/OVERLAP.md regenerated with backend=tpu).
-            step("overlap", [py, "scripts/overlap_study.py", "--size", "8192"])
         if "compensated" not in args.skip:
             # fp64-parity evidence on the chip: accuracy vs the fp64 oracle
             # + bandwidth rows (docs/COMPENSATED.md, backend=tpu).
             step("compensated",
                  [py, "scripts/compensated_study.py", "--size", "8192",
                   "--data-root", args.data_root])
+        if "autotune" not in args.skip:
+            # Pallas tile search at the headline size: if a tile beats the
+            # committed (512, 4096) defaults the report says which.
+            step("autotune", [py, "scripts/autotune_pallas.py"])
+        if "autotune_gemm" not in args.skip:
+            # MXU tile search: the MFU face of the autotune story.
+            step("autotune_gemm", [py, "scripts/autotune_pallas_gemm.py"])
+        if "sweeps" not in args.skip:
+            sweep_stage("asymmetric")
+            # Re-derive the sub-VMEM ceiling over the full dataset: the
+            # asymmetric regime's small operands are sub-VMEM too and may
+            # move the fastest-row basis.
+            vmem_roof_stage("vmem_roof_asym")
+        if "hostlink" not in args.skip:
+            step("hostlink", [py, "scripts/hostlink_study.py",
+                              "--data-root", args.data_root, "--max-mb", "256"])
+        if "overlap" not in args.skip:
+            # Real-backend overlap evidence: async collective-permute
+            # start/done pairs in the compiled module + TPU timings
+            # (docs/OVERLAP.md regenerated with backend=tpu).
+            step("overlap", [py, "scripts/overlap_study.py", "--size", "8192"])
         if "refine" not in args.skip:
             # Solver-level accuracy evidence on the chip: iterative
             # refinement's forward-error ladder (docs/REFINEMENT.md,
@@ -232,13 +269,6 @@ def main(argv=None) -> int:
             # tiers alone would fit, but the stage times all of them).
             step("attention", [py, "scripts/attention_study.py",
                                "--seqs", "4096", "8192", "--causal"])
-        if "autotune" not in args.skip:
-            # Pallas tile search at the headline size: if a tile beats the
-            # committed (512, 4096) defaults the report says which.
-            step("autotune", [py, "scripts/autotune_pallas.py"])
-        if "autotune_gemm" not in args.skip:
-            # MXU tile search: the MFU face of the autotune story.
-            step("autotune_gemm", [py, "scripts/autotune_pallas_gemm.py"])
         if "autotune_attention" not in args.skip:
             # Flash-attention tile search: the fused tier's (bq, bk) grid
             # vs the score-materializing xla tier at the p=1 shape AND
