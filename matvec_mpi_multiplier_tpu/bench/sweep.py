@@ -202,12 +202,14 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="on a runtime/backend error in one config (e.g. a transient "
         "tunnel failure), record it and continue with the next config "
-        "instead of aborting the whole sweep; exit code 1 = some config "
-        "hard-failed (backend fault — worth retrying the capture), "
+        "instead of aborting the whole sweep; exit code 5 = some config "
+        "failed but the sweep COMPLETED (backend fault — retry-worthy, "
+        "and with --skip-measured a retry redoes only the failures), "
         "3 = completed with only unmeasurable (TimingError) skips — a "
         "re-run would re-hit the same noise floor, so callers should "
-        "treat 3 as a soft success (3, not 2: argparse exits 2 on usage "
-        "errors, which must never read as soft)",
+        "treat 3 as a soft success. Distinct codes on purpose: crashes "
+        "exit 1 and argparse usage errors exit 2, and neither of those "
+        "deterministic classes may ever read as retry-worthy",
     )
     p.add_argument(
         "--profile-dir",
@@ -346,7 +348,12 @@ def run_sweep(args: argparse.Namespace) -> int:
         f"{n_unmeasurable} unmeasurable, {n_failed} failed"
     )
     if n_failed:
-        return 1
+        # 5, not 1: a COMPLETED sweep with recorded config failures is the
+        # transient-backend class (worth retrying; --skip-measured makes
+        # the retry redo only the failures), while a crash — config bug,
+        # re-raised MatvecError — exits 1 via the interpreter. A capture
+        # orchestrator keys retry-vs-stop off exactly this distinction.
+        return 5
     # 3, not 2: argparse's usage-error convention is exit 2, and a capture
     # orchestrator must never read a broken command line as a soft skip.
     return 3 if n_unmeasurable else 0

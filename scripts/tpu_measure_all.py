@@ -48,10 +48,13 @@ Stages:
 
 Usage: python scripts/tpu_measure_all.py [--skip STAGE ...] [--data-root data]
 
-Exit codes: 0 = every stage ok (soft sweep skips allowed); 1 = aborted
-mid-run (probe failed or a stage hit the wedge timeout — retryable); 4 =
-ran to completion but one or more stages hard-failed (deterministic —
-the watcher must NOT endlessly re-run the capture on it).
+Exit codes: 0 = every stage ok (soft sweep skips allowed); 1 = retryable
+(probe failed, a stage hit the wedge timeout, a sweep completed with
+transient config failures [sweep rc 5], or the baseline degraded to the
+cpu fallback — the resume redoes only what failed); 4 = ran to
+completion and the failures are deterministic-class (stage crashes,
+usage errors — the watcher must NOT endlessly re-run the capture on
+those).
 """
 
 from __future__ import annotations
@@ -139,17 +142,24 @@ def main(argv=None) -> int:
         return 1
     print("probe OK — capturing all stages", flush=True)
 
-    # Per-stage (name, rc, soft) record. A sweep under --keep-going exits 3
-    # when it completed with only UNMEASURABLE (TimingError) skips — noise
-    # floor, not backend fault; re-running the capture over it would burn
-    # the healthy window for rows a retry cannot improve. Only sweep stages
-    # get that dispensation, and the code is 3 (not 2) so an argparse
-    # usage error — exit 2 by convention — can never read as soft.
-    statuses: list[tuple[str, int, bool]] = []
+    # Per-stage (name, rc, soft, retryable) record. A sweep under
+    # --keep-going exits 3 when it completed with only UNMEASURABLE
+    # (TimingError) skips — noise floor, not backend fault; re-running the
+    # capture over it would burn the healthy window for rows a retry
+    # cannot improve. Only sweep stages get that dispensation, and the
+    # code is 3 (not 2) so an argparse usage error — exit 2 by
+    # convention — can never read as soft. Sweep exit 5 = completed with
+    # transient config failures (crashes exit 1; the sweep reserves 5 for
+    # exactly this) — the RETRYABLE class, as is a baseline stage that
+    # degraded to the cpu fallback (rc 1 there means the tunnel wedged
+    # between the probe and the stage, and the north star must never be
+    # forfeited over a transient).
+    statuses: list[tuple[str, int, bool, bool]] = []
 
     def step(stage: str, cmd: list[str], sweep_stage: bool = False) -> None:
         rc = run(cmd)
-        statuses.append((stage, rc, sweep_stage and rc == 3))
+        statuses.append((stage, rc, sweep_stage and rc == 3,
+                         sweep_stage and rc == 5))
 
     try:
         if "headline" not in args.skip:
@@ -157,7 +167,8 @@ def main(argv=None) -> int:
         if "baseline" not in args.skip:
             # North-star first (after the cheap headline): the one artifact
             # a mid-capture wedge must never cost again.
-            statuses.append(("baseline", _baseline_stage(py), False))
+            rc_b = _baseline_stage(py)
+            statuses.append(("baseline", rc_b, False, rc_b == 1))
         # --skip-measured: every sweep-family stage resumes over whatever
         # rows an earlier (wedge-killed) attempt already flushed — a
         # healthy window only ever pays for configs not yet measured.
@@ -307,18 +318,36 @@ def main(argv=None) -> int:
     except StageWedged as e:
         print(f"ABORT: {e}", flush=True)
         return 1
-    hard = [s for s, rc, soft in statuses if rc != 0 and not soft]
-    for stage, rc, soft in statuses:
-        tag = "ok" if rc == 0 else ("soft-skip" if soft else "FAILED")
+    hard = [s for s, rc, soft, retry in statuses
+            if rc != 0 and not soft and not retry]
+    retryable = [s for s, _, _, retry in statuses if retry]
+    for stage, rc, soft, retry in statuses:
+        tag = ("ok" if rc == 0
+               else "soft-skip" if soft
+               else "RETRY" if retry
+               else "FAILED")
         print(f"stage {stage}: rc={rc} {tag}", flush=True)
     print(f"capture complete — {len(hard)} hard-failed stage(s)"
-          + (f": {', '.join(hard)}" if hard else ""), flush=True)
+          + (f": {', '.join(hard)}" if hard else "")
+          + (f"; {len(retryable)} retryable: {', '.join(retryable)}"
+             if retryable else ""), flush=True)
     # rc separates RETRYABLE aborts from COMPLETED runs so the watcher can
-    # tell them apart: 1 = aborted mid-run (probe failure / wedge timeout;
-    # a retry at the next healthy window can genuinely do better), 4 =
-    # every stage ran to completion but some failed (deterministic stage
-    # bugs don't heal on retry — an unlimited-retry watcher re-running the
-    # whole capture on them would burn the healthy window in a loop).
+    # tell them apart: 1 = retryable (probe failure / wedge timeout / a
+    # sweep that completed with transient config failures / the baseline
+    # degrading to the cpu fallback — and --skip-measured makes a sweep
+    # retry redo only the failures), 4 = every stage ran to completion and
+    # the failures are deterministic-class (stage crashes, usage errors) —
+    # an unlimited-retry watcher re-running the whole capture on those
+    # would burn the healthy window in a loop. A retryable failure
+    # outranks a coexisting deterministic one: the retry re-fails the
+    # deterministic stage cheaply, and once the retryable stages complete
+    # the deterministic failure alone yields 4 and stops the loop.
+    if retryable:
+        print(f"retryable stage failure(s): {', '.join(retryable)} — "
+              "exiting 1 so the watcher tries again at the next healthy "
+              "window (sweep retries redo only the failed configs)",
+              flush=True)
+        return 1
     return 4 if hard else 0
 
 

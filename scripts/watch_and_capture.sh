@@ -40,11 +40,14 @@ while [ "$MAX_ATTEMPTS" -eq 0 ] || [ "$attempt" -lt "$MAX_ATTEMPTS" ]; do
       echo "$(date -u +%FT%TZ) capture succeeded on attempt $attempt" >&2
       exit 0
     elif [ "$rc" -ne 1 ]; then
-      # Anything but the explicit retryable abort (rc=1: probe failure /
-      # wedge timeout) is deterministic — completed-with-failed-stages
-      # (rc=4), argparse usage errors (rc=2, e.g. a typo'd flag passed
-      # through "$@"), crashes. Retrying the whole multi-hour capture
-      # cannot heal those and would burn the healthy window in a loop.
+      # Anything but the explicit retryable abort (rc=1: probe failure,
+      # wedge timeout, a sweep that completed with transient config
+      # failures, or the baseline degrading to the cpu fallback) is
+      # deterministic — completed-with-hard-failed-stages (rc=4),
+      # argparse usage errors (rc=2, e.g. a typo'd flag passed through
+      # "$@"), crashes. Retrying the whole capture cannot heal those and
+      # would burn the healthy window in a loop (retries of the
+      # retryable class are cheap: sweeps resume via --skip-measured).
       echo "$(date -u +%FT%TZ) capture attempt $attempt ended rc=$rc (deterministic; only rc=1 retries) — not retrying; see report above" >&2
       exit 2
     fi

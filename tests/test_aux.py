@@ -192,8 +192,12 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
 def test_tpu_measure_all_soft_vs_hard_rc(monkeypatch, capsys):
     """Sweep rc=3 (completed, only unmeasurable skips) must NOT fail the
     capture — the watcher would otherwise re-run the whole thing over rows a
-    retry cannot improve. rc=2 from ANY stage (argparse usage-error
-    convention, even a sweep) and rc=1 from anywhere stay hard failures."""
+    retry cannot improve. Sweep rc=1 (completed with transient config
+    failures) makes the CAPTURE retryable: --skip-measured means the retry
+    redoes only the failed configs, so stopping the watcher over a tunnel
+    hiccup would forfeit every later window. rc=2 from ANY stage (argparse
+    usage-error convention, even a sweep) and rc=1 from non-sweep stages
+    stay deterministic-hard."""
     from pathlib import Path
 
     monkeypatch.syspath_prepend(str(Path(__file__).parents[1] / "scripts"))
@@ -210,6 +214,47 @@ def test_tpu_measure_all_soft_vs_hard_rc(monkeypatch, capsys):
     assert tpu_measure_all.main(["--data-root", "x"]) == 0
     out = capsys.readouterr().out
     assert "soft-skip" in out and "0 hard-failed" in out
+
+    # A sweep that completed but hard-failed some configs (transient
+    # tunnel faults under --keep-going; sweep exit 5) is the RETRYABLE
+    # class: the capture exits 1 so the watcher tries the next window.
+    monkeypatch.setattr(
+        tpu_measure_all, "run",
+        lambda cmd: 5 if "--sweep asymmetric" in " ".join(cmd) else 0,
+    )
+    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+    out = capsys.readouterr().out
+    assert "retryable" in out
+    # Consistent report: a retryable stage is tagged RETRY, never FAILED,
+    # and never counted in the hard-failed summary.
+    assert "RETRY" in out and "FAILED" not in out
+    assert "0 hard-failed" in out
+
+    # ...even when a deterministic stage failure coexists: the retry
+    # re-fails that stage cheaply, and once the sweeps complete the
+    # deterministic failure alone stops the loop.
+    monkeypatch.setattr(
+        tpu_measure_all, "run",
+        lambda cmd: 5 if "--sweep asymmetric" in " ".join(cmd)
+        else (1 if "overlap_study" in " ".join(cmd) else 0),
+    )
+    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+
+    # A sweep CRASH (exit 1 — config bug, re-raised MatvecError) is NOT
+    # the retryable class: deterministic, capture exits 4.
+    monkeypatch.setattr(
+        tpu_measure_all, "run",
+        lambda cmd: 1 if "--sweep asymmetric" in " ".join(cmd) else 0,
+    )
+    assert tpu_measure_all.main(["--data-root", "x"]) == 4
+
+    # The baseline stage's rc=1 (cpu-fallback / no JSON — the tunnel
+    # wedging between probe and stage) is retryable: the north star must
+    # never be forfeited over a transient.
+    monkeypatch.setattr(tpu_measure_all, "run", lambda cmd: 0)
+    monkeypatch.setattr(tpu_measure_all, "_baseline_stage", lambda py: 1)
+    assert tpu_measure_all.main(["--data-root", "x"]) == 1
+    monkeypatch.setattr(tpu_measure_all, "_baseline_stage", lambda py: 0)
 
     # argparse's usage-error exit (2) from a sweep stage must stay hard: a
     # broken sweep command line writes zero rows, and "capture succeeded"

@@ -357,7 +357,10 @@ def test_sweep_cli_keep_going_survives_backend_errors(
     args = ["--strategy", "rowwise", "--devices", "2", "--sizes", "16", "32",
             "--n-reps", "2", "--dtype", "float64"]
     rc = sweep_main(args + ["--keep-going"])
-    assert rc == 1  # a failure happened and is reported in the exit code
+    # 5, not 1: a COMPLETED sweep with recorded config failures is the
+    # retry-worthy class (crashes exit 1, usage errors 2) — the capture
+    # orchestrator keys retry-vs-stop off exactly this code.
+    assert rc == 5
     assert "FAILED" in capsys.readouterr().err
     rows = read_csv(csv_path("rowwise", tmp_path))
     assert len(rows) == 1 and rows[0]["n_rows"] == 32  # later config landed
