@@ -1,0 +1,149 @@
+#!/bin/bash
+# Kill and relaunch a WEDGED capture instead of waiting out its stage budget.
+#
+# The watcher (scripts/watch_and_capture.sh) already survives tunnel wedges:
+# tpu_measure_all.py's per-stage timeout (90 min) kills a blocked stage and
+# the watcher goes back to probing. But on days when healthy windows last
+# ~12 minutes and wedges strike mid-stage, 90 minutes of waiting per wedge
+# forfeits several windows. This nanny closes that gap with the one signal
+# that separates a wedge from slow-but-healthy work: a wedged tunnel client
+# blocks forever in C++ with ZERO host CPU advance, while every real stage
+# (XLA compiles, jitter calibration, CSV flushes, figure rendering) burns
+# host CPU at least every few minutes. block_until_ready waits are also
+# near-zero-CPU, but no single on-device dispatch in any stage runs longer
+# than ~1 min on this chip — far under the trip threshold.
+#
+# Mechanics: the watcher runs as the nanny's own child, and the monitored
+# family is the watcher's /proc-walked descendant tree — never a global
+# cmdline match, so hand-run studies or editors can neither be killed nor
+# mask a wedge by burning CPU. The aggregate includes each process's
+# reaped-children CPU (cutime/cstime), so a completed stage's ticks persist
+# in the orchestrator's counters and the aggregate only ever grows while
+# work is happening; a drop (pid set change mid-sample) resets the stall
+# window rather than aging it. If the aggregate advances less than
+# $MIN_TICKS over $STALL_S while a capture stage is up, the family is
+# SIGKILLed (watcher first, so it cannot race a retry) and the watcher is
+# relaunched; sweep stages resume over flushed rows (--skip-measured), so
+# a kill costs at most the one in-flight config. Between captures (probe
+# phase, no stage child alive) nothing is ever killed. When the watcher
+# exits on its own, its real exit code (via wait) decides: rc 0 = capture
+# complete, rc 1 = the watcher's own attempt budget ran out, rc 2 =
+# deterministic failure — all three are voluntary and stop the nanny;
+# anything else (OOM kill, stray signal) is involuntary and the watcher
+# restarts.
+#
+# Usage: nohup bash scripts/capture_nanny.sh [watcher args...] > nanny.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+STALL_S="${NANNY_STALL_S:-600}"
+POLL_S="${NANNY_POLL_S:-60}"
+MIN_TICKS="${NANNY_MIN_TICKS:-200}"   # 2 s of CPU @ 100 Hz
+MAX_RESTARTS="${NANNY_MAX_RESTARTS:-500}"
+LOG="${NANNY_CAPTURE_LOG:-capture_r5.log}"
+
+say() { echo "$(date -u +%FT%TZ) nanny: $*"; }
+
+descendants() {  # pids of the tree rooted at $1 (including $1), via ppid walk
+  local roots="$1" out="" pid ppid
+  local -A child_of=()
+  while read -r pid ppid; do
+    child_of[$ppid]="${child_of[$ppid]:-} $pid"
+  done < <(ps -e -o pid=,ppid=)
+  while [ -n "$roots" ]; do
+    set -- $roots; roots=""
+    for pid in "$@"; do
+      out="$out $pid"
+      roots="$roots ${child_of[$pid]:-}"
+    done
+  done
+  echo "$out"
+}
+
+ticks_of() {  # sum utime+stime+cutime+cstime over pids; vanished pids count 0
+  local total=0 pid t
+  for pid in "$@"; do
+    if [ -r "/proc/$pid/stat" ]; then
+      # fields 14-17; comm (field 2) may contain spaces, so cut from the
+      # closing paren onward before counting fields
+      t=$(awk '{n=index($0,")"); split(substr($0,n+2),f," ");
+                print f[12]+f[13]+f[14]+f[15]}' "/proc/$pid/stat" 2>/dev/null) || t=0
+      total=$((total + ${t:-0}))
+    fi
+  done
+  echo "$total"
+}
+
+capture_up() {  # a capture (not just the probing watcher) is running?
+  local pid
+  for pid in "$@"; do
+    if [ -r "/proc/$pid/cmdline" ] &&
+       tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null |
+         grep -q 'tpu_measure_all\.py'; then
+      return 0
+    fi
+  done
+  return 1
+}
+
+wpid=""
+start_watcher() {
+  bash scripts/watch_and_capture.sh "$@" >> "$LOG" 2>&1 &
+  wpid=$!
+  say "watcher started (pid $wpid)"
+}
+
+start_watcher "$@"
+
+restarts=0
+stall_ticks=-1   # aggregate at the start of the current stall window
+stall_since=0
+while :; do
+  sleep "$POLL_S"
+  if ! kill -0 "$wpid" 2>/dev/null; then
+    wait "$wpid"; rc=$?
+    if [ "$rc" -le 2 ]; then
+      # All three voluntary watcher exits: 0 = capture complete, 1 = its
+      # attempt budget ran out, 2 = deterministic capture failure.
+      # Restarting on any of them would defeat the watcher's own policy.
+      say "watcher exited rc=$rc (0=complete, 1=attempt budget, 2=deterministic failure) — nanny done"
+      exit "$rc"
+    fi
+    say "watcher died involuntarily (rc=$rc) — restarting"
+    restarts=$((restarts + 1))
+    [ "$restarts" -ge "$MAX_RESTARTS" ] && { say "restart budget exhausted"; exit 1; }
+    start_watcher "$@"
+    stall_ticks=-1
+    continue
+  fi
+  pids=$(descendants "$wpid")
+  # shellcheck disable=SC2086
+  if ! capture_up $pids; then
+    stall_ticks=-1   # between captures (probe phase): reset the window
+    continue
+  fi
+  # shellcheck disable=SC2086
+  now_ticks=$(ticks_of $pids)
+  now_s=$(date +%s)
+  if [ "$stall_ticks" -lt 0 ] || [ "$now_ticks" -lt "$stall_ticks" ] ||
+     [ $((now_ticks - stall_ticks)) -ge "$MIN_TICKS" ]; then
+    stall_ticks="$now_ticks"
+    stall_since="$now_s"
+    continue
+  fi
+  if [ $((now_s - stall_since)) -lt "$STALL_S" ]; then
+    continue
+  fi
+  restarts=$((restarts + 1))
+  say "WEDGE: capture CPU advanced $((now_ticks - stall_ticks)) ticks in $((now_s - stall_since))s — killing family (restart $restarts/$MAX_RESTARTS)"
+  kill -9 "$wpid" 2>/dev/null
+  # shellcheck disable=SC2086
+  kill -9 $pids 2>/dev/null
+  wait "$wpid" 2>/dev/null
+  sleep 2
+  if [ "$restarts" -ge "$MAX_RESTARTS" ]; then
+    say "restart budget exhausted — stopping"
+    exit 1
+  fi
+  start_watcher "$@"
+  stall_ticks=-1
+done
