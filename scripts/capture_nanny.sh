@@ -21,8 +21,8 @@
 # in the orchestrator's counters and the aggregate only ever grows while
 # work is happening; a drop (pid set change mid-sample) resets the stall
 # window rather than aging it. If the aggregate advances less than
-# $MIN_TICKS over $STALL_S while a capture stage is up, the family is
-# SIGKILLed (watcher first, so it cannot race a retry) and the watcher is
+# $MIN_TICKS over $STALL_S while a capture stage is up, the watcher's
+# process group is SIGKILLed atomically and the watcher is
 # relaunched; sweep stages resume over flushed rows (--skip-measured), so
 # a kill costs at most the one in-flight config. Between captures (probe
 # phase, no stage child alive) nothing is ever killed. When the watcher
@@ -63,9 +63,9 @@ ticks_of() {  # sum utime+stime+cutime+cstime over pids; vanished pids count 0
   local total=0 pid t
   for pid in "$@"; do
     if [ -r "/proc/$pid/stat" ]; then
-      # fields 14-17; comm (field 2) may contain spaces, so cut from the
-      # closing paren onward before counting fields
-      t=$(awk '{n=index($0,")"); split(substr($0,n+2),f," ");
+      # fields 14-17; comm (field 2) may contain spaces or ')' itself, so
+      # cut from the LAST closing paren onward before counting fields
+      t=$(awk '{n=match($0, /\)[^)]*$/); split(substr($0,n+2),f," ");
                 print f[12]+f[13]+f[14]+f[15]}' "/proc/$pid/stat" 2>/dev/null) || t=0
       total=$((total + ${t:-0}))
     fi
@@ -87,9 +87,57 @@ capture_up() {  # a capture (not just the probing watcher) is running?
 
 wpid=""
 start_watcher() {
+  # Job control (set -m) gives the watcher its OWN process group with
+  # pgid == $!: the family then stays findable by pgid even after the
+  # leader dies (children reparent to init but keep the pgid), with no
+  # pid snapshot to go stale between sample and kill.
+  set -m
   bash scripts/watch_and_capture.sh "$@" >> "$LOG" 2>&1 &
   wpid=$!
+  set +m
   say "watcher started (pid $wpid)"
+}
+
+group_members() {  # pids currently in the watcher's process group
+  ps -e -o pid=,pgid= | awk -v g="$wpid" '$2 == g {print $1}'
+}
+
+family_pids() {  # group members + ALL their descendants: catches children
+                 # that left the group or session (GNU timeout runs its
+                 # command in its own group; jupyter kernels setsid) but
+                 # still hang off a group member by ppid.
+  local roots
+  roots=$(group_members | tr '\n' ' ')
+  case "$roots" in
+    *[0-9]*) descendants "$roots" | tr ' ' '\n' | sort -un | tr '\n' ' ';;
+    *) echo "";;
+  esac
+}
+
+kill_family() {
+  local fam pid matched=""
+  fam=$(family_pids)
+  case "$fam" in *[0-9]*) ;; *)
+    say "no surviving processes in pgid $wpid — nothing to kill"
+    return;;
+  esac
+  # Never strike a RECYCLED pgid: after the whole group is gone, $wpid can
+  # be reassigned to an unrelated job within one poll interval. Require
+  # the capture's own fingerprint among the members before killing.
+  for pid in $fam; do
+    if [ -r "/proc/$pid/cmdline" ] &&
+       tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null |
+         grep -Eq 'watch_and_capture|tpu_measure_all|bench\.sweep|_study\.py|autotune_pallas|derive_vmem_roof|stats_visualization|nbconvert|jupyter'; then
+      matched=1; break
+    fi
+  done
+  if [ -z "$matched" ]; then
+    say "pgid $wpid holds no capture-family cmdline (recycled pid?) — not killing"
+    return
+  fi
+  kill -9 -- "-$wpid" 2>/dev/null
+  # shellcheck disable=SC2086
+  kill -9 $fam 2>/dev/null
 }
 
 start_watcher "$@"
@@ -108,9 +156,14 @@ while :; do
       say "watcher exited rc=$rc (0=complete, 1=attempt budget, 2=deterministic failure) — nanny done"
       exit "$rc"
     fi
-    say "watcher died involuntarily (rc=$rc) — restarting"
+    # The dead watcher's capture children reparent to init but keep its
+    # pgid — group-kill them, or the relaunched watcher starts a SECOND
+    # capture contending for the chip and the CSVs.
+    kill_family
+    say "watcher died involuntarily (rc=$rc) — killed orphans, restarting"
     restarts=$((restarts + 1))
     [ "$restarts" -ge "$MAX_RESTARTS" ] && { say "restart budget exhausted"; exit 1; }
+    sleep 2   # let dying processes release the chip and close CSVs
     start_watcher "$@"
     stall_ticks=-1
     continue
@@ -135,9 +188,7 @@ while :; do
   fi
   restarts=$((restarts + 1))
   say "WEDGE: capture CPU advanced $((now_ticks - stall_ticks)) ticks in $((now_s - stall_since))s — killing family (restart $restarts/$MAX_RESTARTS)"
-  kill -9 "$wpid" 2>/dev/null
-  # shellcheck disable=SC2086
-  kill -9 $pids 2>/dev/null
+  kill_family
   wait "$wpid" 2>/dev/null
   sleep 2
   if [ "$restarts" -ge "$MAX_RESTARTS" ]; then

@@ -1,0 +1,157 @@
+"""Unit tests for scripts/capture_nanny.sh's decision helpers.
+
+The nanny is the last link in the capture chain (nanny -> watcher ->
+tpu_measure_all.py -> stages): it SIGKILLs and relaunches a capture whose
+process tree stops advancing CPU (the tunnel-wedge signature, see the
+script header). A wrong pid walk or tick sum kills healthy captures, so
+the helpers get the same unit treatment as the Python plumbing
+(tests/test_aux.py::test_tpu_measure_all_stage_plumbing).
+
+Each test sources just the function under test out of the script with sed
+and drives it against this test's own live process tree — real /proc, no
+mocks of the kernel interface.
+"""
+
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+NANNY = REPO / "scripts" / "capture_nanny.sh"
+
+
+def _run_with_helpers(body: str) -> subprocess.CompletedProcess:
+    """Run a bash snippet with the nanny's helper functions in scope."""
+    script = (
+        f'source <(sed -n "/^descendants()/,/^}}/p; /^ticks_of()/,/^}}/p; '
+        f'/^capture_up()/,/^}}/p" {NANNY})\n' + body
+    )
+    return subprocess.run(
+        ["bash", "-c", script], capture_output=True, text=True, timeout=60
+    )
+
+
+def test_descendants_walks_grandchildren():
+    # bash parent -> bash child -> sleep grandchild: the walk must find all
+    # three levels, since sweep stages are grandchildren of the watcher.
+    r = _run_with_helpers(
+        "gcf=$(mktemp)\n"
+        'bash -c "sleep 30 & echo \\$! > $gcf; wait" & c=$!\n'
+        "sleep 0.5\n"
+        "d=$(descendants $$)\n"
+        'gc=$(cat "$gcf"); rm -f "$gcf"\n'
+        "kill $c $gc 2>/dev/null\n"
+        'case " $d " in *" $c "*) ;; *) echo MISSING-CHILD; exit 1;; esac\n'
+        'case " $d " in *" $gc "*) ;; *) echo MISSING-GRANDCHILD; exit 1;; esac\n'
+        "echo OK"
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_descendants_includes_root_and_ignores_strangers():
+    r = _run_with_helpers(
+        "d=$(descendants $$)\n"
+        'case " $d " in *" $$ "*) ;; *) echo MISSING-ROOT; exit 1;; esac\n'
+        # pid 1 is never in this shell's subtree
+        'case " $d " in *" 1 "*) echo STRANGER; exit 1;; *) ;; esac\n'
+        "echo OK"
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_ticks_monotone_across_child_exit():
+    # The wedge detector's core invariant: when a CPU-burning child exits,
+    # its ticks must persist in the parent's cutime (summed by ticks_of),
+    # so the aggregate cannot collapse and fake a stall-window reset/trip.
+    r = _run_with_helpers(
+        # burn ~0.3s CPU in a child, measure while alive
+        "bash -c 'i=0; while [ $i -lt 300000 ]; do i=$((i+1)); done' & c=$!\n"
+        "wait $c\n"
+        "after=$(ticks_of $(descendants $$))\n"
+        'echo "after=$after"\n'
+        "[ \"$after\" -ge 10 ] || { echo LOST-CHILD-TICKS; exit 1; }\n"
+        "echo OK"
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_ticks_of_survives_vanished_pid():
+    r = _run_with_helpers("ticks_of 999999 $$ >/dev/null && echo OK")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_capture_up_detects_orchestrator_cmdline():
+    # capture_up keys on the orchestrator script name in /proc cmdline;
+    # a probing watcher (no orchestrator child) must read as "down".
+    # The orchestrator name is spelled split so THIS test shell's own
+    # cmdline (which embeds this script text) can't satisfy capture_up —
+    # the same self-match trap the nanny avoids by walking descendants.
+    r = _run_with_helpers(
+        'name="tpu_measure_""all.py"\n'
+        "sleep 30 & plain=$!\n"
+        "if capture_up $plain; then echo FALSE-POSITIVE; "
+        "kill $plain; exit 1; fi\n"
+        'python3 -c "import time; time.sleep(30)" "$name" & cap=$!\n'
+        "sleep 0.5\n"
+        "capture_up $plain $cap; rc=$?\n"
+        "kill $plain $cap 2>/dev/null\n"
+        "[ $rc -eq 0 ] || { echo MISSED-CAPTURE; exit 1; }\n"
+        "echo OK"
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _run_nanny_with_stub_watcher(tmp_path, stub_body: str, timeout=45):
+    """Run the real nanny against a stub watch_and_capture.sh in an
+    isolated tree (the nanny cd's to its script's parent dir)."""
+    import os
+    import shutil
+
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    shutil.copy(NANNY, scripts / "capture_nanny.sh")
+    stub = scripts / "watch_and_capture.sh"
+    stub.write_text("#!/bin/bash\n" + stub_body)
+    env = dict(
+        os.environ,
+        NANNY_POLL_S="1",
+        NANNY_MAX_RESTARTS="2",
+        NANNY_CAPTURE_LOG=str(tmp_path / "cap.log"),
+    )
+    return subprocess.run(
+        ["bash", str(scripts / "capture_nanny.sh")],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.parametrize("rc", [0, 1, 2])
+def test_voluntary_watcher_exit_stops_nanny(tmp_path, rc):
+    # rc 0/1/2 are the watcher's three voluntary exits (complete / attempt
+    # budget / deterministic failure): the nanny must stop, forward the
+    # code, and never restart.
+    r = _run_nanny_with_stub_watcher(tmp_path, f"exit {rc}\n")
+    assert r.returncode == rc, r.stdout + r.stderr
+    assert "nanny done" in r.stdout
+    assert "restarting" not in r.stdout
+
+
+def test_involuntary_watcher_death_restarts(tmp_path):
+    # A signal death (rc 128+9) is involuntary: the nanny restarts the
+    # watcher until its own budget (2 here) runs out, then exits 1.
+    r = _run_nanny_with_stub_watcher(tmp_path, "kill -9 $$\n")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "died involuntarily" in r.stdout
+    assert "restart budget exhausted" in r.stdout
+
+
+def test_nanny_script_has_no_global_cmdline_kill():
+    """Regression guard: the nanny must scope kills to the watcher's
+    descendant tree, never pkill/pgrep by global cmdline pattern (which
+    once matched the operator's own shell and unrelated editors)."""
+    text = NANNY.read_text()
+    assert "pkill" not in text
+    assert "pgrep" not in text
