@@ -52,6 +52,11 @@ MESH_AXIS_COLS: str = "cols"
 TPU_HBM_PEAK_GBPS: float = 819.0
 VMEM_BYTES: int = 128 * 1024 * 1024
 
+# TPU v5e per-chip MXU peak, bf16 (datasheet ~197 TFLOP/s). With
+# TPU_HBM_PEAK_GBPS this fixes the roofline ridge intensity
+# (~240 FLOP/byte) used by the crossover study and the MFU columns.
+MXU_PEAK_BF16_GFLOPS: float = 197_000.0
+
 # Bytes per element by dtype name (CSV rows carry dtype as a string).
 DTYPE_ITEMSIZE: dict[str, int] = {
     "float64": 8, "float32": 4, "bfloat16": 2, "float16": 2,

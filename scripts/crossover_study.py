@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Roofline-crossover study: where GEMV becomes GEMM on the MXU.
+
+The reference's entire scope is ``n_rhs = 1`` (``y = A·x``,
+``src/matr_utils.c:86-96``) — the memory-bound corner of the roofline,
+where the committed sweeps show this framework at ~92% of HBM peak. This
+study measures what the reference never could: the transition from the
+HBM-bound GEMV regime to the MXU-bound GEMM regime as right-hand sides
+are added, on the same blockwise strategy and the same chip.
+
+Model: for C = A·B with A (n×n) and B (n×r), bf16, arithmetic intensity
+is I(r) = 2n²r / 2(n² + 2nr) ≈ r FLOP/byte for r ≪ n. The v5e ridge
+point sits at I* = MXU_PEAK / HBM_PEAK ≈ 197e3/819 ≈ 240 FLOP/byte, so
+the knee should appear near r ≈ 240 — the study sweeps r over powers of
+two and reports, per r: measured time, effective GB/s (HBM axis),
+achieved GFLOP/s and MFU (MXU axis), and which roofline bound is closer.
+The measured knee pins the chip's actual ridge against the datasheet
+one; everything is appended to the extended CSV (strategy label
+``gemm_blockwise_xover``, one row per r, distinguished by the schema's
+``n_rhs`` column) so the data-quality gates cover it.
+
+Usage::
+
+    python scripts/crossover_study.py                      # real chip
+    python scripts/crossover_study.py --platform cpu --host-devices 8 \
+        --size 512 --n-rhs 1 8 64                          # plumbing test
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DEFAULT_RHS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--host-devices", type=int, default=None)
+    p.add_argument("--size", type=int, default=8192)
+    p.add_argument("--n-rhs", type=int, nargs="*", default=list(DEFAULT_RHS))
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--n-reps", type=int, default=20)
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--no-csv", action="store_true")
+    p.add_argument("--hbm-peak-gbps", type=float, default=None,
+                   help="HBM roofline (default: utils.constants for TPU)")
+    p.add_argument("--mxu-peak-gflops", type=float, default=None,
+                   help="MXU roofline (default: utils.constants for TPU)")
+    p.add_argument("--report", default=str(REPO / "docs" / "CROSSOVER.md"))
+    p.add_argument("--no-report", action="store_true")
+    args = p.parse_args(argv)
+
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+
+    configure_platform(args.platform, args.host_devices)
+
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from matvec_mpi_multiplier_tpu.bench.metrics import append_result
+    from matvec_mpi_multiplier_tpu.bench.timing import benchmark_gemm
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.utils import constants
+    from matvec_mpi_multiplier_tpu.utils.errors import TimingError
+
+    platform = jax.devices()[0].platform
+    n_dev = args.devices or len(jax.devices())
+    mesh = make_mesh(n_dev)
+    hbm = args.hbm_peak_gbps or constants.TPU_HBM_PEAK_GBPS * n_dev
+    # The MXU peak (and hence the ridge and MFU columns) is the bf16 one;
+    # for other dtypes the bound is annotated as nominal in the report.
+    mxu = args.mxu_peak_gflops or constants.MXU_PEAK_BF16_GFLOPS * n_dev
+    ridge = mxu / hbm
+    itemsize = constants.DTYPE_ITEMSIZE[args.dtype]
+    n = args.size
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((n, n)).astype(np.float32)
+
+    rows = []
+    for r in sorted(set(args.n_rhs)):
+        b = rng.standard_normal((n, r)).astype(np.float32)
+        res = None
+        for attempt in (1, 2):
+            try:
+                res = benchmark_gemm(
+                    "blockwise", mesh, a, b, dtype=args.dtype,
+                    n_reps=args.n_reps, measure="loop",
+                )
+                break
+            except TimingError as e:
+                print(f"n_rhs={r} attempt {attempt}: UNMEASURABLE ({e})",
+                      file=sys.stderr)
+        if res is None:
+            rows.append((r, None))
+            continue
+        if not args.no_csv:
+            # Own label PER r: downstream per-strategy-CSV consumers
+            # (analysis/stats.py) average rows sharing (strategy, m, n, p)
+            # — a shared xover label would blend every r into one
+            # nonsense series. results_extended keeps n_rhs either way.
+            append_result(
+                dataclasses.replace(
+                    res, strategy=f"gemm_blockwise_xover_r{r}"
+                ),
+                args.data_root,
+            )
+        intensity = 2.0 * res.n_rows * res.n_cols * res.n_rhs / (
+            itemsize * (res.n_rows * res.n_cols
+                        + res.n_cols * res.n_rhs
+                        + res.n_rows * res.n_rhs)
+        )  # FLOP per byte: 2mkr / itemsize·(mk + kr + mr)
+        mfu = res.gflops / mxu
+        rows.append((r, dict(
+            time_ms=res.mean_time_s * 1e3, gbps=res.gbps,
+            gflops=res.gflops, mfu=mfu, intensity=intensity,
+            hbm_frac=res.gbps / hbm,
+        )))
+        print(f"n_rhs={r:5d}: {res.mean_time_s*1e3:9.3f} ms  "
+              f"{res.gbps:8.2f} GB/s ({res.gbps/hbm:5.1%} HBM)  "
+              f"{res.gflops/1e3:9.2f} TFLOP/s (MFU {mfu:6.2%})")
+
+    measured = [(r, m) for r, m in rows if m is not None]
+    knee = None
+    for r, m in measured:
+        # The empirical knee: first r where the compute axis dominates the
+        # bandwidth axis (MFU fraction exceeds HBM fraction).
+        if m["mfu"] >= m["hbm_frac"]:
+            knee = r
+            break
+
+    report = [
+        "# GEMV→GEMM roofline crossover (measured)",
+        "",
+        f"Backend: **{platform}**, {n_dev}-device mesh, blockwise strategy, "
+        f"A {n}×{n} {args.dtype}, B {n}×r, measure=loop, {args.n_reps} reps "
+        "(generated by `scripts/crossover_study.py`).",
+        "",
+        f"Rooflines used: HBM {hbm:.0f} GB/s, MXU {mxu/1e3:.0f} TFLOP/s"
+        + (" (bf16 peak — nominal for this dtype)"
+           if args.dtype != "bfloat16" else "")
+        + f" → ridge intensity {ridge:.0f} FLOP/byte; model "
+        f"I(r) ≈ 2r/{itemsize} for r ≪ n predicts the knee near "
+        f"r ≈ {ridge * itemsize / 2:.0f}.",
+        "",
+        "| n_rhs | I(r) FLOP/B | time (ms) | GB/s | %HBM | TFLOP/s | MFU |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r, m in rows:
+        if m is None:
+            report.append(f"| {r} | — | unmeasurable | — | — | — | — |")
+        else:
+            report.append(
+                f"| {r} | {m['intensity']:.1f} | {m['time_ms']:.3f} | "
+                f"{m['gbps']:.1f} | {m['hbm_frac']:.1%} | "
+                f"{m['gflops']/1e3:.2f} | {m['mfu']:.2%} |"
+            )
+    report += [
+        "",
+        (f"Measured knee (first r where MFU ≥ %HBM): **r = {knee}** vs the "
+         f"datasheet ridge r ≈ {ridge * itemsize / 2:.0f}."
+         if knee is not None else
+         "No measured knee inside the swept range — every row is still "
+         "bandwidth-bound (or unmeasurable this window)."),
+        "",
+        "Reading: at r = 1 this is the reference's workload — pure HBM "
+        "streaming, the MXU nearly idle. Each doubling of r doubles "
+        "arithmetic intensity at almost constant traffic, so time stays "
+        "flat and TFLOP/s doubles until the MXU saturates; past the knee, "
+        "time scales with r and %HBM falls. The same A·x engine the "
+        "reference benchmarks is, on this hardware, one axis of a GEMM "
+        "whose other axis is free until r ≈ the ridge — the quantitative "
+        "case for batching right-hand sides on TPU.",
+    ]
+    text = "\n".join(report) + "\n"
+    print("\n" + text)
+    if not args.no_report:
+        out = Path(args.report)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(text)
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

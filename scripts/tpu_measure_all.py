@@ -25,6 +25,9 @@ Stages:
                 plus the fp64-parity ozaki tier);
 6. compensated— scripts/compensated_study.py on the chip (accuracy vs the
                 fp64 oracle + bandwidth rows);
+6b. crossover — scripts/crossover_study.py: the GEMV→GEMM roofline knee
+                (n_rhs sweep at 8192, bf16 — where the HBM-bound regime
+                hands over to the MXU-bound one);
 7. autotune   — scripts/autotune_pallas.py (bm, bk) tile search at the
                 headline size vs the committed defaults;
 8. autotune_gemm — scripts/autotune_pallas_gemm.py (bm, bn, bk) search at
@@ -119,9 +122,9 @@ def main(argv=None) -> int:
     p.add_argument(
         "--skip", nargs="*", default=[],
         choices=["headline", "sweeps", "hostlink", "gemm", "overlap",
-                 "compensated", "refine", "attention", "autotune",
-                 "autotune_gemm", "autotune_attention", "baseline",
-                 "figures", "notebook"],
+                 "compensated", "crossover", "refine", "attention",
+                 "autotune", "autotune_gemm", "autotune_attention",
+                 "baseline", "figures", "notebook"],
     )
     p.add_argument(
         "--wipe-stale-csvs", action="store_true",
@@ -242,6 +245,12 @@ def main(argv=None) -> int:
             # + bandwidth rows (docs/COMPENSATED.md, backend=tpu).
             step("compensated",
                  [py, "scripts/compensated_study.py", "--size", "8192",
+                  "--data-root", args.data_root])
+        if "crossover" not in args.skip:
+            # The roofline-knee study: same blockwise engine, n_rhs swept
+            # from the reference's r=1 regime into MXU saturation.
+            step("crossover",
+                 [py, "scripts/crossover_study.py",
                   "--data-root", args.data_root])
         if "autotune" not in args.skip:
             # Pallas tile search at the headline size: if a tile beats the

@@ -144,6 +144,9 @@ def test_tpu_measure_all_stage_plumbing(monkeypatch):
         # written, so its stage must directly follow the square sweep.
         < stage("derive_vmem_roof")
         < stage("--op gemm") < stage("compensated_study")
+        # The roofline-knee study rides the same warm MXU window as the
+        # GEMM/compensated tiers it contextualizes.
+        < stage("crossover_study")
         < stage("autotune_pallas.py") < stage("autotune_pallas_gemm.py")
         < stage("--sweep asymmetric") < stage("hostlink_study")
         < stage("overlap_study")

@@ -32,6 +32,36 @@ def test_ulp_error_zero_iff_exact(rng):
     assert ulp_error(off, exact) >= 1.0
 
 
+def test_crossover_study_end_to_end(tmp_path):
+    """The roofline-knee study runs the full CLI path on the virtual mesh:
+    one extended-CSV row per n_rhs under its own strategy label (so the
+    plain gemm_blockwise series is never contaminated), report written
+    with the model's ridge intensity and one table row per r."""
+    import csv
+
+    import crossover_study
+
+    report = tmp_path / "CROSSOVER.md"
+    rc = crossover_study.main([
+        "--size", "256", "--n-rhs", "1", "8",
+        "--n-reps", "3", "--data-root", str(tmp_path / "data"),
+        "--report", str(report),
+    ])
+    assert rc == 0
+    text = report.read_text()
+    assert "ridge intensity" in text
+    assert "| 1 |" in text and "| 8 |" in text
+    rows = list(csv.DictReader(
+        (tmp_path / "data" / "out" / "results_extended.csv").open(),
+        skipinitialspace=True,
+    ))
+    xover = [r for r in rows if r["strategy"].startswith("gemm_blockwise_xover")]
+    assert sorted(int(r["n_rhs"]) for r in xover) == [1, 8]
+    # Per-r labels: per-strategy-CSV consumers average rows sharing
+    # (strategy, m, n, p), so every r must land in its own series.
+    assert len({r["strategy"] for r in xover}) == 2
+
+
 def test_wipe_stale_csvs_never_clobbers_backups(tmp_path):
     """Across ROUNDS (the sentinel is cleared at landing), a later wipe
     must never overwrite an earlier round's set-aside backups."""
