@@ -115,18 +115,33 @@ def _cpu_fallback(dtype: str, probe_error: str) -> int:
     times = time_fn_chained(fn, (a, x), n_reps=10, warmup=2)
     t = float(np.median(times))
     gbps = jnp.dtype(dtype).itemsize * (size * size + 2 * size) / t / 1e9
-    print(
-        json.dumps(
-            {
-                "metric": f"blockwise_{size}x{size}_{dtype}_matvec_bandwidth_cpu_fallback",
-                "value": round(gbps, 3),
-                "unit": "GB/s",
-                "vs_baseline": round(gbps / REFERENCE_BEST_GBPS, 2),
-                "backend": "cpu-fallback",
-                "error": f"accelerator backend unreachable: {probe_error}",
-            }
-        )
-    )
+    payload = {
+        "metric": f"blockwise_{size}x{size}_{dtype}_matvec_bandwidth_cpu_fallback",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / REFERENCE_BEST_GBPS, 2),
+        "backend": "cpu-fallback",
+        "error": f"accelerator backend unreachable: {probe_error}",
+    }
+    # The fallback must stay an honest CPU measurement of THIS run — but a
+    # wedged round end should not erase the round's real TPU evidence from
+    # the headline record, so point at the committed north-star artifact
+    # (written only by a successful on-chip baseline stage, never by a
+    # fallback) with explicit provenance.
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BASELINE_65536_bf16.json")
+    try:
+        with open(artifact) as f:
+            committed = json.load(f)
+        payload["committed_tpu_evidence"] = {
+            **{k: committed[k] for k in ("metric", "value", "unit",
+                                         "vs_baseline") if k in committed},
+            "source": "BASELINE_65536_bf16.json — measured on the TPU in "
+            "an earlier healthy tunnel window, NOT by this run",
+        }
+    except (OSError, ValueError):
+        pass
+    print(json.dumps(payload))
     return 0
 
 
