@@ -207,3 +207,56 @@ def plot_roofline(
     fig.savefig(out_path, dpi=120)
     plt.close(fig)
     return out_path
+
+
+def plot_crossover_roofline(
+    points: list[tuple[int, float, float]],
+    out_path: str | os.PathLike,
+    *,
+    hbm_peak_gbps: float,
+    mxu_peak_gflops: float,
+) -> Path | None:
+    """The classic roofline diagram for the GEMV→GEMM crossover study.
+
+    ``points`` are ``(n_rhs, intensity FLOP/byte, achieved GFLOP/s)`` from
+    one n_rhs sweep at a fixed matrix (scripts/crossover_study.py). Axes
+    are log-log: the bandwidth roof is the slope ``hbm · I``, the compute
+    roof the flat ``mxu`` line, their intersection the ridge. Measured
+    points hug the slope while HBM-bound and peel onto the flat roof past
+    the knee — the figure form of the study's t/t_bw column. Returns None
+    on no points (every row unmeasurable).
+    """
+    if not points:
+        return None
+    plt = _mpl()
+    fig, ax = plt.subplots(figsize=(6.5, 4.5))
+    pts = sorted(points)
+    xs = [i for _, i, _ in pts]
+    ys = [g for _, _, g in pts]
+    lo, hi = min(xs) / 2, max(xs) * 2
+    grid = [lo * (hi / lo) ** (k / 200) for k in range(201)]
+    ax.plot(grid, [min(hbm_peak_gbps * i, mxu_peak_gflops) for i in grid],
+            color="k", ls="--", lw=1,
+            label=f"roofline (HBM {hbm_peak_gbps:.0f} GB/s, "
+                  f"MXU {mxu_peak_gflops / 1e3:.0f} TFLOP/s)")
+    ridge = mxu_peak_gflops / hbm_peak_gbps
+    ax.axvline(ridge, color="gray", ls=":", lw=1,
+               label=f"ridge ({ridge:.0f} FLOP/byte)")
+    ax.plot(xs, ys, marker="o", ms=4, color="C0", label="measured")
+    for (r, i, g) in pts:
+        ax.annotate(f"r={r}", (i, g), textcoords="offset points",
+                    xytext=(4, -9), fontsize=7)
+    ax.set_xscale("log")
+    ax.set_yscale("log")
+    ax.set_xlabel("arithmetic intensity (FLOP/byte)")
+    ax.set_ylabel("achieved GFLOP/s")
+    ax.grid(True, alpha=0.3, which="both")
+    ax.legend(fontsize=7, loc="lower right")
+    ax.set_title("GEMV→GEMM crossover on the roofline (r = n_rhs)",
+                 fontsize=10)
+    fig.tight_layout()
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path

@@ -59,6 +59,9 @@ def main(argv=None) -> int:
                    "like the default (utils.constants for TPU)")
     p.add_argument("--report", default=str(REPO / "docs" / "CROSSOVER.md"))
     p.add_argument("--no-report", action="store_true")
+    p.add_argument("--fig",
+                   default=str(REPO / "figures" / "tpu" / "crossover.png"))
+    p.add_argument("--no-fig", action="store_true")
     args = p.parse_args(argv)
 
     from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
@@ -214,6 +217,29 @@ def main(argv=None) -> int:
         "whose other axis is free until r ≈ the ridge — the quantitative "
         "case for batching right-hand sides on TPU.",
     ]
+    if not args.no_fig:
+        # matplotlib lives in the [analysis] extra: its absence must cost
+        # the figure, never the sweep's report (the measurements above may
+        # have taken a whole healthy tunnel window).
+        try:
+            from matvec_mpi_multiplier_tpu.analysis.plots import (
+                plot_crossover_roofline,
+            )
+
+            fig_path = plot_crossover_roofline(
+                [(r, m["intensity"], m["gflops"]) for r, m in measured],
+                args.fig, hbm_peak_gbps=hbm, mxu_peak_gflops=mxu,
+            )
+        except ImportError as e:
+            print(f"figure skipped: {e}", file=sys.stderr)
+            fig_path = None
+        if fig_path is not None:
+            try:
+                shown = fig_path.relative_to(REPO)
+            except ValueError:  # user-supplied --fig outside the repo
+                shown = fig_path
+            report += ["", f"Figure: `{shown}`."]
+            print(f"figure: {fig_path}")
     text = "\n".join(report) + "\n"
     print("\n" + text)
     if not args.no_report:
