@@ -105,7 +105,9 @@ def test_capture_up_detects_orchestrator_cmdline():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
-def _run_nanny_with_stub_watcher(tmp_path, stub_body: str, timeout=45):
+def _run_nanny_with_stub_watcher(
+    tmp_path, stub_body: str, timeout=45, extra_env=None
+):
     """Run the real nanny against a stub watch_and_capture.sh in an
     isolated tree (the nanny cd's to its script's parent dir)."""
     import os
@@ -121,6 +123,7 @@ def _run_nanny_with_stub_watcher(tmp_path, stub_body: str, timeout=45):
         NANNY_POLL_S="1",
         NANNY_MAX_RESTARTS="2",
         NANNY_CAPTURE_LOG=str(tmp_path / "cap.log"),
+        **(extra_env or {}),
     )
     return subprocess.run(
         ["bash", str(scripts / "capture_nanny.sh")],
@@ -137,6 +140,24 @@ def test_voluntary_watcher_exit_stops_nanny(tmp_path, rc):
     assert r.returncode == rc, r.stdout + r.stderr
     assert "nanny done" in r.stdout
     assert "restarting" not in r.stdout
+
+
+def test_wedge_detection_kills_and_restarts(tmp_path):
+    # Full-loop wedge drill: a stub watcher whose "orchestrator" child
+    # (cmdline carries tpu_measure_all.py, so capture_up sees a capture)
+    # blocks at zero CPU — the wedge signature. With a 3s stall window the
+    # nanny must detect it, SIGKILL the family, relaunch, re-detect, and
+    # exit 1 when its 2-restart budget runs out.
+    r = _run_nanny_with_stub_watcher(
+        tmp_path,
+        'python3 -c "import time; time.sleep(300)" tpu_measure_all.py &\n'
+        "wait\n",
+        timeout=120,
+        extra_env={"NANNY_STALL_S": "3"},
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert r.stdout.count("WEDGE") == 2, r.stdout
+    assert "restart budget exhausted" in r.stdout
 
 
 def test_involuntary_watcher_death_restarts(tmp_path):
