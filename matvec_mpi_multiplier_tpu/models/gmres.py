@@ -1,0 +1,169 @@
+"""Distributed restarted GMRES on the strategy shardings.
+
+``models/cg.py`` closes the solver story for SPD systems; GMRES(m) is its
+general-matrix sibling — the standard Krylov solver when A is
+nonsymmetric (flow problems, signed couplings, anything the reference's
+plain GEMV (`src/matr_utils.c:86-96`) would feed a real application).
+Same composition contract as CG: A stays sharded by the chosen strategy,
+one strategy matvec per Arnoldi step is the only O(n²) work, vectors ride
+replicated, and the whole solve is ONE compiled program.
+
+TPU-first choices, where a textbook port would go scalar:
+
+* **Arnoldi by CGS2, not modified Gram-Schmidt.** MGS orthogonalizes
+  against one basis vector at a time — m sequential length-n dots, a
+  VPU-latency chain. Classical Gram-Schmidt turns the whole projection
+  into ``V @ w`` — one (m+1)×n matvec on the MXU — and applying it twice
+  ("CGS2") restores MGS-grade orthogonality (the standard fix, loss
+  bounded by O(u·cond) after the second pass). Basis maintenance is then
+  two small matvecs per step instead of 2(k+1) scalar-chained dots.
+* **Fixed shapes everywhere.** The basis V is a preallocated (m+1, n)
+  array and H is (m+1, m); step k masks the not-yet-built rows instead of
+  growing arrays (XLA recompiles on shape change; masking compiles once).
+  A lucky breakdown (h_{k+1,k} = 0: the Krylov space already contains the
+  solution) simply zeros the remaining columns — the small least-squares
+  solve below is rank-revealing and ignores them.
+* **The (m+1)×m least-squares solve stays on device.** Per restart cycle
+  one ``jnp.linalg.lstsq`` on the tiny Hessenberg system replaces the
+  classical running Givens rotations — a sequential scalar recurrence
+  with no data to amortize it — at O(m³) ≪ one matvec for any practical
+  m.
+* **Restarts are a ``lax.while_loop`` on the TRUE residual** (recomputed
+  ``b - A x`` each cycle through the strategy matvec), so the data-
+  dependent outer iteration is compiler-visible control flow, and the
+  convergence decision never trusts the in-cycle recurrence.
+
+The ``kernel`` knob accepts the accuracy tiers (``ozaki``,
+``compensated``) exactly as CG does, for fp64-parity iterations on
+fp64-less hardware.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .base import MatvecStrategy
+from .cg import CGResult  # shared result contract; n_iters = restart CYCLES
+
+
+def build_gmres(
+    strategy: MatvecStrategy,
+    mesh: Mesh,
+    *,
+    kernel: str | Callable = "xla",
+    restart: int = 40,
+    tol: float = 1e-6,
+    max_restarts: int = 50,
+) -> Callable[[Array, Array], CGResult]:
+    """Return jitted ``gmres(a, b) -> CGResult`` solving ``A x = b`` for
+    general square A (no symmetry or definiteness assumed).
+
+    ``restart`` is the Arnoldi basis size m of GMRES(m); ``max_restarts``
+    bounds the outer cycles, so the worst-case matvec count is
+    ``max_restarts * (restart + 1)``. Shapes are validated through the
+    strategy's own guards (same typed ShardingError as the benchmark
+    entry points).
+    """
+    if restart < 1:
+        raise ValueError(f"restart must be >= 1, got {restart}")
+    matvec = strategy.build(mesh, kernel=kernel, gather_output=True)
+    replicated = NamedSharding(mesh, P())
+    m = restart
+
+    @jax.jit
+    def gmres(a: Array, b: Array) -> CGResult:
+        strategy.validate(a.shape[0], a.shape[1], mesh)
+        if a.shape[0] != a.shape[1]:
+            raise ValueError(
+                f"gmres needs a square matrix, got {a.shape[0]}x{a.shape[1]}"
+            )
+        n = a.shape[0]
+        acc = jnp.promote_types(a.dtype, jnp.float32)
+        b_acc = jax.lax.with_sharding_constraint(b.astype(acc), replicated)
+        b_norm = jnp.sqrt(jnp.sum(b_acc * b_acc))
+        threshold = tol * b_norm
+
+        def mv(v: Array) -> Array:
+            y = matvec(a, v.astype(a.dtype)).astype(acc)
+            return jax.lax.with_sharding_constraint(y, replicated)
+
+        def cycle(x: Array, r: Array, rnorm: Array):
+            """One GMRES(m) cycle from iterate x with residual r."""
+            # V rows are the Krylov basis; row 0 = r/||r||. A zero
+            # residual can't reach here (the outer cond stops first), but
+            # guard the division anyway for the pathological b = 0 call.
+            safe = rnorm > 0
+            v0 = jnp.where(safe, r / jnp.where(safe, rnorm, 1.0), 0.0)
+            V0 = jnp.zeros((m + 1, n), acc).at[0].set(v0)
+            H0 = jnp.zeros((m + 1, m), acc)
+
+            def arnoldi_step(k, carry):
+                V, H = carry
+                w = mv(V[k])
+                # CGS2: project out the whole built basis twice via MXU
+                # matvecs; rows > k of V are zero so their coefficients
+                # vanish — masking is implicit in the preallocation.
+                h1 = V @ w
+                w = w - h1 @ V
+                h2 = V @ w
+                w = w - h2 @ V
+                h = h1 + h2
+                wnorm = jnp.sqrt(jnp.sum(w * w))
+                ok = wnorm > 0  # 0 = (lucky) breakdown: basis is invariant
+                vk1 = jnp.where(ok, w / jnp.where(ok, wnorm, 1.0), 0.0)
+                V = V.at[k + 1].set(vk1)
+                H = H.at[:, k].set(h.at[k + 1].set(wnorm))
+                return (V, H)
+
+            V, H = jax.lax.fori_loop(0, m, arnoldi_step, (V0, H0))
+            # min_y || beta e1 - H y ||: a tiny (m+1)x(m) dense solve.
+            # rcond=None (machine-eps scaled) makes it rank-revealing, so
+            # post-breakdown zero columns drop out of the solution.
+            e1 = jnp.zeros((m + 1,), acc).at[0].set(rnorm)
+            y, *_ = jnp.linalg.lstsq(H, e1)
+            x_new = x + y @ V[:m]
+            # The convergence decision uses the TRUE residual — one extra
+            # matvec per cycle buys immunity to basis-loss drift.
+            r_new = b_acc - mv(x_new)
+            return x_new, r_new, jnp.sqrt(jnp.sum(r_new * r_new))
+
+        x0 = jnp.zeros_like(b_acc)
+        state0 = (x0, b_acc, b_norm, jnp.asarray(0, jnp.int32),
+                  x0, b_norm)  # best-so-far (x, ||r||)
+
+        def cond(state):
+            _, _, rnorm, k, _, _ = state
+            return (rnorm > threshold) & (k < max_restarts)
+
+        def body(state):
+            x, r, rnorm, k, x_best, rn_best = state
+            x, r, rnorm = cycle(x, r, rnorm)
+            # Restarted GMRES can stagnate (restart loses the minimization
+            # history); like CG, return the best visited iterate so an
+            # unreachable tolerance costs wall-time, never the answer.
+            better = rnorm < rn_best
+            x_best = jnp.where(better, x, x_best)
+            rn_best = jnp.where(better, rnorm, rn_best)
+            return (x, r, rnorm, k + 1, x_best, rn_best)
+
+        _, _, _, k, x_best, rn_best = jax.lax.while_loop(cond, body, state0)
+        return CGResult(
+            x=x_best,
+            n_iters=k,
+            residual_norm=rn_best,
+            converged=rn_best <= threshold,
+        )
+
+    return gmres
+
+
+def solve_gmres(
+    strategy: MatvecStrategy, mesh: Mesh, a: Array, b: Array, **kwargs
+) -> CGResult:
+    """Convenience one-shot (kwargs go to :func:`build_gmres`)."""
+    return build_gmres(strategy, mesh, **kwargs)(a, b)

@@ -1,14 +1,17 @@
 #!/usr/bin/env python
-"""Distributed conjugate-gradient solver CLI (models/cg.py).
+"""Distributed Krylov solver CLI (models/cg.py, models/gmres.py).
 
-Solves ``A x = b`` for SPD ``A`` with the matrix sharded by any strategy
-(never replicated) and one compiled ``lax.while_loop`` driving the
-iteration — the framework's distributed matvec running inside a real
-Krylov solver instead of a benchmark harness.
+Solves ``A x = b`` with the matrix sharded by any strategy (never
+replicated) and one compiled ``lax.while_loop`` driving the iteration —
+the framework's distributed matvec running inside a real Krylov solver
+instead of a benchmark harness. ``--method cg`` (default) assumes SPD A;
+``--method gmres`` runs restarted GMRES on a deliberately NONSYMMETRIC
+system, the general-matrix case CG cannot touch.
 
 Examples::
 
     python scripts/solve_cg.py --size 1024 --strategy blockwise
+    python scripts/solve_cg.py --size 1024 --method gmres --restart 40
     python scripts/solve_cg.py --size 1024 --kernel ozaki --tol 1e-10 \
         --platform cpu --host-devices 8
 """
@@ -25,15 +28,25 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--size", type=int, default=1024, help="n for the n x n SPD system")
+    p.add_argument("--size", type=int, default=1024,
+                   help="n for the n x n system")
     p.add_argument("--strategy", default="blockwise")
+    p.add_argument("--method", choices=["cg", "gmres"], default="cg",
+                   help="cg: SPD systems; gmres: general (nonsymmetric) "
+                   "systems via restarted CGS2-Arnoldi")
+    p.add_argument("--restart", type=int, default=40,
+                   help="GMRES(m) basis size (ignored for cg)")
     p.add_argument("--kernel", default="xla",
                    help="local GEMV tier (xla | pallas | compensated | "
                    "ozaki | ... — the fp64-parity tiers matter for "
                    "ill-conditioned systems)")
     p.add_argument("--tol", type=float, default=1e-6,
                    help="relative tolerance: stop at ||r|| <= tol * ||b||")
-    p.add_argument("--max-iters", type=int, default=1000)
+    p.add_argument("--max-iters", type=int, default=None,
+                   help="cg iteration cap (default 1000; cg-only — gmres "
+                   "is bounded by --max-restarts)")
+    p.add_argument("--max-restarts", type=int, default=50,
+                   help="GMRES outer-cycle cap (ignored for cg)")
     p.add_argument("--precondition", choices=["none", "jacobi"],
                    default="none",
                    help="jacobi: diag(A) preconditioner — the cheap win "
@@ -63,34 +76,55 @@ def main(argv=None) -> int:
 
     from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
     from matvec_mpi_multiplier_tpu.models.cg import build_cg, build_refined
+    from matvec_mpi_multiplier_tpu.models.gmres import build_gmres
     from matvec_mpi_multiplier_tpu.parallel import distributed
 
     distributed.initialize()
     mesh = make_mesh(args.devices)
     n = args.size
     rng = np.random.default_rng(args.seed)
-    # SPD by construction: G'G/n + I (well-conditioned; --kernel's accuracy
-    # tiers earn their keep as conditioning worsens, not here).
     g = rng.standard_normal((n, n)).astype(np.float32)
-    a_host = (g.T @ g / n + np.eye(n, dtype=np.float32)).astype(np.float32)
+    if args.method == "gmres":
+        if args.refine or args.precondition != "none" \
+                or args.max_iters is not None:
+            p.error("--refine/--precondition/--max-iters are cg-only "
+                    "options (gmres is bounded by --max-restarts)")
+        # Deliberately nonsymmetric, spectrum shifted off the origin —
+        # the system class GMRES exists for and CG would diverge on.
+        a_host = (g / np.sqrt(n) + 2.0 * np.eye(n, dtype=np.float32))
+        a_host = a_host.astype(np.float32)
+    else:
+        # SPD by construction: G'G/n + I (well-conditioned; --kernel's
+        # accuracy tiers earn their keep as conditioning worsens, not
+        # here).
+        a_host = (g.T @ g / n + np.eye(n, dtype=np.float32)).astype(
+            np.float32
+        )
     x_true = rng.standard_normal(n).astype(np.float32)
     b_host = a_host @ x_true
 
     strategy = get_strategy(args.strategy)
     precondition = False if args.precondition == "none" else args.precondition
-    if args.refine:
+    max_iters = 1000 if args.max_iters is None else args.max_iters
+    if args.method == "gmres":
+        run = build_gmres(
+            strategy, mesh, kernel=args.kernel, tol=args.tol,
+            restart=args.restart, max_restarts=args.max_restarts,
+        )
+        label = f"{args.kernel}/gmres({args.restart})"
+    elif args.refine:
         # Built ONCE: the compiled inner-CG and residual programs are
         # reused by the timed second call (--kernel drives the inner CG;
         # the residual always runs the fp64-parity ozaki tier).
         run = build_refined(
             strategy, mesh, kernel=args.kernel, tol=args.tol,
-            max_iters=args.max_iters, precondition=precondition,
+            max_iters=max_iters, precondition=precondition,
         )
         label = f"{args.kernel}+refine(ozaki)"
     else:
         run = build_cg(
             strategy, mesh, kernel=args.kernel, tol=args.tol,
-            max_iters=args.max_iters, precondition=precondition,
+            max_iters=max_iters, precondition=precondition,
         )
         label = args.kernel
     # Device-resident operands OUTSIDE the timed region: the reported ms
@@ -108,7 +142,8 @@ def main(argv=None) -> int:
     err = float(np.max(np.abs(np.asarray(res.x) - x_true)))
     if distributed.is_main_process():
         print(
-            f"cg[{args.strategy}/{label}] n={n} p={mesh.devices.size}: "
+            f"{args.method}[{args.strategy}/{label}] n={n} "
+            f"p={mesh.devices.size}: "
             f"converged={bool(res.converged)} iters={int(res.n_iters)} "
             f"||r||={float(res.residual_norm):.3e} max|x-x_true|={err:.3e} "
             f"{dt * 1e3:.1f} ms"
