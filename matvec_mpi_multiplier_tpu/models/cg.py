@@ -214,17 +214,18 @@ def build_refined(
     strategy: MatvecStrategy,
     mesh: Mesh,
     *,
+    inner: str = "cg",
     residual_kernel: str | Callable = "ozaki",
     inner_tol: float = 1e-2,
     tol: float = 5e-7,
     max_refinements: int = 10,
-    **cg_kwargs,
+    **inner_kwargs,
 ) -> Callable[[Array, Array], CGResult]:
-    """Mixed-precision iterative refinement: fp32 CG speed, fp64-parity
-    residuals — the textbook application of the accuracy kernel tiers.
-    Returns ``refined(a, b) -> CGResult``; the compiled inner-CG and
-    residual programs are built once and reused across calls (per operand
-    shape), so a warm second call pays no retracing.
+    """Mixed-precision iterative refinement: fp32 Krylov speed,
+    fp64-parity residuals — the textbook application of the accuracy
+    kernel tiers. Returns ``refined(a, b) -> CGResult``; the compiled
+    inner-solver and residual programs are built once and reused across
+    calls (per operand shape), so a warm second call pays no retracing.
 
     Plain fp32 CG's forward error grows as ``cond(A) * u_fp32``: at
     condition 10^5 half the digits are gone. Wilkinson-style refinement
@@ -252,18 +253,39 @@ def build_refined(
       costs one extra accurate matvec per trip (``A @ x_lo``).
 
     The outer loop is host-driven (a handful of trips, each launching the
-    compiled CG and residual programs); ``tol``/``max_refinements`` bound
-    it, ``inner_tol`` is the per-correction CG tolerance (loose on
-    purpose: refinement only needs a few digits per trip). Returns a
-    :func:`CGResult` whose ``n_iters`` counts refinement trips and whose
-    ``residual_norm`` is the high-precision ``||b - A x||``.
+    compiled inner-solver and residual programs); ``tol``/
+    ``max_refinements`` bound it, ``inner_tol`` is the per-correction
+    tolerance (loose on purpose: refinement only needs a few digits per
+    trip). Returns a :func:`CGResult` whose ``n_iters`` counts refinement
+    trips and whose ``residual_norm`` is the high-precision
+    ``||b - A x||``.
+
+    Wilkinson refinement never needed symmetry — only a correction solver
+    — so ``inner="gmres"`` swaps the fp32 correction solves to restarted
+    GMRES (``models/gmres.py``; ``inner_kwargs`` then take its
+    ``restart``/``max_restarts``), giving fp64-parity refinement on
+    NONSYMMETRIC systems. Restarted GMRES already self-refines (each
+    restart re-solves the residual system), but only down to the fp32
+    residual-EVALUATION floor ``~u·||A||·||x||``; the accurate-residual
+    trips here cross that floor — the gap CG-based refinement (SPD-only)
+    and plain GMRES each leave open (measured in
+    ``tests/test_gmres.py``).
     """
     from ..ops.compensated import df_add
     from ..parallel.mesh import make_mesh
     from ..utils.errors import ShardingError
     from .rowwise import RowwiseStrategy
 
-    inner = build_cg(strategy, mesh, tol=inner_tol, **cg_kwargs)
+    if inner == "cg":
+        inner_solve = build_cg(strategy, mesh, tol=inner_tol, **inner_kwargs)
+    elif inner == "gmres":
+        from .gmres import build_gmres  # deferred: gmres imports CGResult
+
+        inner_solve = build_gmres(
+            strategy, mesh, tol=inner_tol, **inner_kwargs
+        )
+    else:
+        raise ValueError(f"inner must be 'cg' or 'gmres', got {inner!r}")
     # The augmented residual matvec: k+1 columns can break the strategy's
     # divisibility guards, so it runs on a rowwise sharding regardless of
     # the inner strategy; whether n+1 rows/cols divide THIS mesh is a
@@ -315,7 +337,7 @@ def build_refined(
         # when one fails to halve it. ``tol`` remains the
         # reported-convergence criterion.
         while trips < max_refinements and rnorm > 0.0:
-            d = inner(a, r.astype(a.dtype)).x.astype(acc)
+            d = inner_solve(a, r.astype(a.dtype)).x.astype(acc)
             nh, nl = df_add(x_hi, x_lo, d, jnp.zeros_like(d))
             r_new = res(nh, nl)
             new_norm = _host_norm(r_new)

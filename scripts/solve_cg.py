@@ -52,10 +52,12 @@ def main(argv=None) -> int:
                    help="jacobi: diag(A) preconditioner — the cheap win "
                    "when rows live on very different scales")
     p.add_argument("--refine", action="store_true",
-                   help="mixed-precision iterative refinement: fp32 CG "
-                   "corrections + fp64-parity (ozaki) residuals + "
-                   "double-float x — ~fp32-ulp solutions where plain fp32 "
-                   "CG floors at cond(A)*eps")
+                   help="mixed-precision iterative refinement: fp32 "
+                   "corrections by the chosen --method (CG or GMRES) + "
+                   "fp64-parity (ozaki) residuals + double-float x — "
+                   "~fp32-ulp solutions where plain fp32 CG floors at "
+                   "cond(A)*eps, and past the fp32 residual-evaluation "
+                   "floor for GMRES")
     p.add_argument("--devices", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
@@ -85,10 +87,9 @@ def main(argv=None) -> int:
     rng = np.random.default_rng(args.seed)
     g = rng.standard_normal((n, n)).astype(np.float32)
     if args.method == "gmres":
-        if args.refine or args.precondition != "none" \
-                or args.max_iters is not None:
-            p.error("--refine/--precondition/--max-iters are cg-only "
-                    "options (gmres is bounded by --max-restarts)")
+        if args.precondition != "none" or args.max_iters is not None:
+            p.error("--precondition/--max-iters are cg-only options "
+                    "(gmres is bounded by --max-restarts)")
         # Deliberately nonsymmetric, spectrum shifted off the origin —
         # the system class GMRES exists for and CG would diverge on.
         a_host = (g / np.sqrt(n) + 2.0 * np.eye(n, dtype=np.float32))
@@ -106,7 +107,15 @@ def main(argv=None) -> int:
     strategy = get_strategy(args.strategy)
     precondition = False if args.precondition == "none" else args.precondition
     max_iters = 1000 if args.max_iters is None else args.max_iters
-    if args.method == "gmres":
+    if args.method == "gmres" and args.refine:
+        # Nonsymmetric mixed-precision refinement: fp32 GMRES corrections,
+        # fp64-parity residuals, double-float x (build_refined inner=gmres).
+        run = build_refined(
+            strategy, mesh, inner="gmres", kernel=args.kernel, tol=args.tol,
+            restart=args.restart, max_restarts=args.max_restarts,
+        )
+        label = f"{args.kernel}/gmres({args.restart})+refine(ozaki)"
+    elif args.method == "gmres":
         run = build_gmres(
             strategy, mesh, kernel=args.kernel, tol=args.tol,
             restart=args.restart, max_restarts=args.max_restarts,
