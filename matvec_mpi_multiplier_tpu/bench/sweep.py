@@ -132,7 +132,40 @@ def build_parser() -> argparse.ArgumentParser:
         help="'amortized': operands HBM-resident (honest TPU number); "
         "'reference': host->device transfer timed every rep (quirk Q5 parity)",
     )
-    p.add_argument("--kernel", default="xla", help="local GEMV kernel name")
+    p.add_argument(
+        "--kernel",
+        default="xla",
+        help="local GEMV kernel name; 'auto' consults the tuning cache "
+        "(tuning/ — populate with --tune or the tuning CLI) and falls back "
+        "to the static default on a miss",
+    )
+    p.add_argument(
+        "--combine",
+        default=None,
+        choices=[
+            "auto", "psum", "psum_scatter", "ring", "ring_overlap", "a2a",
+            "gather",
+        ],
+        help="combine-schedule override (matvec only): a concrete schedule "
+        "name, or 'auto' for the tuning-cache winner per config (static "
+        "default on a miss) — see MatvecStrategy.build",
+    )
+    p.add_argument(
+        "--tune",
+        action="store_true",
+        help="pre-pass: measure kernel/tile/combine candidates for every "
+        "config in this sweep (under this sweep's --measure/--kernel) and "
+        "persist winners to the tuning cache before sweeping (the inline "
+        "form of `python -m matvec_mpi_multiplier_tpu.tuning`)",
+    )
+    p.add_argument(
+        "--min-gain",
+        type=float,
+        default=None,
+        help="with --tune: hysteresis margin — a non-default candidate must "
+        "beat the static default by this relative fraction to be recorded "
+        "(default 0.05; raise on noisy shared hosts)",
+    )
     p.add_argument(
         "--measure",
         choices=list(MEASURE_METHODS),
@@ -321,8 +354,37 @@ def run_sweep(args: argparse.Namespace) -> int:
     else:
         sizes = [(s, s) for s in SQUARE_SIZES] + list(ASYMMETRIC_SIZES)
     modes = list(TIMING_MODES) if args.mode == "both" else [args.mode]
+    if args.combine is not None and args.op == "gemm":
+        raise SystemExit(
+            "--combine is matvec-only: gemm strategies bind their combine "
+            "schedule by name (colwise_ring / colwise_a2a / ...)"
+        )
 
     meshes = {n_dev: make_mesh(n_dev) for n_dev in counts}
+    if args.tune:
+        from ..tuning import TuningCache, reset_cache
+        from ..tuning.search import TUNE_MIN_GAIN, tune_sweep
+
+        cache = TuningCache.load()
+        print(f"tuning pre-pass -> {cache.path}")
+        tune_sweep(
+            strategies, sizes, [meshes[n] for n in counts], args.dtype,
+            cache, op=args.op, n_rhs=args.n_rhs, seed=args.seed,
+            # Tune under the sweep's own conditions — a combine crossover
+            # measured under a different kernel/protocol need not hold in
+            # the sweep it feeds. kernel='auto' would consult the very
+            # cache being built, so the pre-pass measures its candidates
+            # under the static default instead.
+            kernel="xla" if args.kernel == "auto" else args.kernel,
+            measure=args.measure,
+            min_gain=(
+                args.min_gain if args.min_gain is not None else TUNE_MIN_GAIN
+            ),
+        )
+        cache.save()
+        # The sweep's auto lookups must see the fresh decisions, not a
+        # singleton loaded before the pre-pass ran.
+        reset_cache()
     # [timed, skipped, unmeasurable, failed] — the last two only fill under
     # --keep-going. Unmeasurable (TimingError) is separated from hard
     # failures because the two demand opposite reactions from a capture
@@ -412,6 +474,17 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
         a = x = None
         for name in strategies:
             strat = None if gemm else get_strategy(name)
+            if (strat is not None and args.combine is not None
+                    and not strat.supports_combine(args.combine)):
+                # e.g. --combine psum_scatter under --strategy all: rowwise
+                # has no such schedule. A skip, not a crash — the flag is
+                # meaningful for the strategies that do support it.
+                print(
+                    f"skip {name} {n_rows}x{n_cols}: no combine schedule "
+                    f"{args.combine!r} for this strategy"
+                )
+                counters[1] += 1
+                continue
             label_name = csv_label(name, args.op, args.label_suffix)
             for n_dev in counts:
                 mesh = meshes[n_dev]
@@ -451,6 +524,8 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                         measure=args.measure,
                         kernel=args.kernel,
                     )
+                    if not gemm and args.combine is not None:
+                        bench_kwargs["combine"] = args.combine
                     if args.chain_samples is not None:
                         bench_kwargs["chain_samples"] = args.chain_samples
                     try:

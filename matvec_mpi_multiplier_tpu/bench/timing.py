@@ -572,14 +572,20 @@ def benchmark_strategy(
     kernel: str | Callable = "xla",
     gather_output: bool = True,
     chain_samples: int = DEFAULT_CHAIN_SAMPLES,
+    combine: str | None = None,
 ) -> TimingResult:
     """Benchmark one (strategy, mesh, size) configuration — the body of the
     reference's per-config run (``src/multiplier_rowwise.c:54-176``) minus the
-    CSV write (see bench.metrics)."""
+    CSV write (see bench.metrics).
+
+    ``combine`` selects the combine schedule by name (``"auto"`` consults
+    the tuning cache) — see ``MatvecStrategy.build``."""
     measure = resolve_measure(mode, measure)
     a, x = _prepare_operands(a, x, dtype)
     strategy.validate(a.shape[0], a.shape[1], mesh)
-    fn = strategy.build(mesh, kernel=kernel, gather_output=gather_output)
+    fn = strategy.build(
+        mesh, kernel=kernel, gather_output=gather_output, combine=combine
+    )
     return _run_benchmark(
         fn=fn, a=a, rhs=x, shardings=strategy.shardings(mesh), mesh=mesh,
         strategy_name=strategy.name, n_rhs=1, n_reps=n_reps, mode=mode,

@@ -27,12 +27,18 @@ from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.gemv import get_kernel
+from ..utils.compat import shard_map
 
 
 class MatvecStrategy(abc.ABC):
     """One named partitioning strategy for ``y = A @ x``."""
 
     name: str = "abstract"
+
+    # Set by constructors that accept ``combine="auto"`` (e.g.
+    # ``get_strategy("colwise", combine="auto")``): ``build()`` picks it up
+    # when no explicit ``combine`` argument is passed.
+    requested_combine: str | None = None
 
     @abc.abstractmethod
     def specs(self, mesh: Mesh) -> tuple[P, P, P]:
@@ -60,6 +66,92 @@ class MatvecStrategy(abc.ABC):
         spec_a, spec_x, _ = self.specs(mesh)
         return NamedSharding(mesh, spec_a), NamedSharding(mesh, spec_x)
 
+    # ---- combine-schedule machinery (the autotuner's third axis) ----
+
+    def with_combine(self, combine: str):
+        """Return a rebound strategy instance implementing ``combine`` as an
+        in-body schedule, or None when this strategy has no in-body combine
+        (the base: rowwise/blockwise, whose combine IS the output gather,
+        handled by :meth:`build`)."""
+        return None
+
+    def combine_candidates(self, mesh: Mesh) -> tuple[str, ...]:
+        """Combine schedules the autotuner may measure/select for this
+        strategy. The base family is the output-gather pair; strategies
+        owning an in-body combine (colwise) override."""
+        if self.specs(mesh)[2] == P():
+            return ()
+        return ("gather", "ring")
+
+    def default_combine(self, mesh: Mesh) -> str:
+        """The static default the ``auto`` tier falls back to on a tuning-
+        cache miss — must always be valid wherever ``self.validate`` is."""
+        return "gather"
+
+    def _build_combine(
+        self, mesh: Mesh, combine: str, **build_kwargs
+    ) -> Callable[[Array, Array], Array]:
+        """Build the concrete matvec for one resolved combine schedule."""
+        bound = self.with_combine(combine)
+        if bound is not None:
+            return bound.build(mesh, **build_kwargs)
+        if combine == "ring":
+            # Gather-schedule knob: only meaningful when the output is being
+            # gathered. gather_output=False keeps the caller's sharded y —
+            # a cache-chosen schedule must never override that contract.
+            if build_kwargs.get("gather_output", True):
+                build_kwargs["gather_output"] = "ring"
+        elif combine != "gather":
+            raise ValueError(
+                f"strategy {self.name!r} has no combine schedule "
+                f"{combine!r}; candidates: {self.combine_candidates(mesh)}"
+            )
+        return self._build_plain(mesh, **build_kwargs)
+
+    def supports_combine(self, combine: str | None) -> bool:
+        """True when :meth:`build` accepts this ``combine`` value — the
+        sweep driver's skip predicate for (strategy, --combine) pairs."""
+        if combine in (None, "auto"):
+            return True
+        try:
+            bound = self.with_combine(combine)
+        except ValueError:
+            return False
+        return bound is not None or combine in ("gather", "ring")
+
+    def _build_auto_combine(
+        self, mesh: Mesh, **build_kwargs
+    ) -> Callable[[Array, Array], Array]:
+        """``combine="auto"``: consult the tuning cache per operand shape at
+        trace time and dispatch to the measured winner, falling back to the
+        static default on a miss. Each resolved schedule is built (and
+        compiled) lazily, at most once."""
+        from ..tuning import lookup_combine
+
+        candidates = self.combine_candidates(mesh)
+        built: dict[str, Callable] = {}
+
+        @jax.jit
+        def matvec(a: Array, x: Array) -> Array:
+            self.validate(a.shape[0], a.shape[1], mesh)
+            choice = lookup_combine(
+                op="matvec",
+                strategy=self.name,
+                m=a.shape[0],
+                k=a.shape[1],
+                p=mesh_size(mesh),
+                dtype=str(a.dtype),
+            )
+            if choice not in candidates:
+                choice = self.default_combine(mesh)
+            if choice not in built:
+                built[choice] = self._build_combine(
+                    mesh, choice, **build_kwargs
+                )
+            return built[choice](a, x)
+
+        return matvec
+
     def build(
         self,
         mesh: Mesh,
@@ -67,6 +159,7 @@ class MatvecStrategy(abc.ABC):
         kernel: str | Callable = "xla",
         gather_output: bool | str = True,
         check_vma: bool | None = None,
+        combine: str | None = None,
     ) -> Callable[[Array, Array], Array]:
         """Return jitted ``matvec(a, x) -> y`` for this strategy on ``mesh``.
 
@@ -82,7 +175,42 @@ class MatvecStrategy(abc.ABC):
         one XLA-scheduled all-gather); for a strategy whose native output is
         already replicated (plain colwise) there is nothing to gather and it
         behaves like ``True``.
+
+        ``combine`` selects the combine schedule by name instead of by
+        strategy subclass: for the colwise family a reduction schedule
+        (``"psum"`` / ``"psum_scatter"`` / ``"ring"`` / ``"ring_overlap"`` /
+        ``"a2a"``), for sharded-output strategies a gather schedule
+        (``"gather"`` / ``"ring"``). ``combine="auto"`` consults the tuning
+        cache (``tuning/``) per operand shape at trace time and falls back
+        to the strategy's static default on a miss — the measured-selection
+        tier the autotuner populates.
         """
+        if combine is None:
+            combine = self.requested_combine
+        if combine == "auto":
+            return self._build_auto_combine(
+                mesh, kernel=kernel, gather_output=gather_output,
+                check_vma=check_vma,
+            )
+        if combine is not None:
+            return self._build_combine(
+                mesh, combine, kernel=kernel, gather_output=gather_output,
+                check_vma=check_vma,
+            )
+        return self._build_plain(
+            mesh, kernel=kernel, gather_output=gather_output,
+            check_vma=check_vma,
+        )
+
+    def _build_plain(
+        self,
+        mesh: Mesh,
+        *,
+        kernel: str | Callable = "xla",
+        gather_output: bool | str = True,
+        check_vma: bool | None = None,
+    ) -> Callable[[Array, Array], Array]:
+        """The concrete (combine-resolved) builder behind :meth:`build`."""
         if not isinstance(gather_output, bool) and gather_output != "ring":
             # Fail at build: any other string is truthy and would silently
             # run the plain gather — a benchmark comparing "ring" vs a typo
@@ -102,7 +230,7 @@ class MatvecStrategy(abc.ABC):
             check_vma = not getattr(kern, "relax_vma_check", False)
 
         body = self.local_body(mesh, kern)
-        mapped = jax.shard_map(
+        mapped = shard_map(
             body, mesh=mesh, in_specs=(spec_a, spec_x), out_specs=spec_y,
             check_vma=check_vma,
         )
@@ -121,7 +249,7 @@ class MatvecStrategy(abc.ABC):
             # with check_vma=False would also waive the psum/out_specs
             # checks on the compute body, which this way stay enforced.
             y_axes = spec_y[0]
-            ring_gather = jax.shard_map(
+            ring_gather = shard_map(
                 lambda y_blk: ring_all_gather(y_blk, y_axes),
                 mesh=mesh, in_specs=(spec_y,), out_specs=P(),
                 check_vma=False,

@@ -281,6 +281,14 @@ def build_refined(
     elif inner == "gmres":
         from .gmres import build_gmres  # deferred: gmres imports CGResult
 
+        # GMRES(m) has no in-cycle convergence exit (fixed-shape Arnoldi,
+        # models/gmres.py), so every inner trip pays the full m matvecs even
+        # when the loose inner_tol is crossed at step 1. At inner_tol=1e-2 a
+        # few digits per trip is all refinement needs: default to a small
+        # restart (ADVICE round 5) instead of gmres' standalone 40 —
+        # max_restarts still bounds total work, and callers tuning restart
+        # explicitly keep their value.
+        inner_kwargs.setdefault("restart", 10)
         inner_solve = build_gmres(
             strategy, mesh, tol=inner_tol, **inner_kwargs
         )

@@ -10,13 +10,32 @@ allreduce-bearing strategy, and the reference's only analog of
 sequence/context parallelism (sharding the reduced dimension, SURVEY.md §5.7).
 
 TPU-native formulation: shard A's column axis and x over the whole mesh;
-local partial GEMV; combine with ``lax.psum`` (replicated y, the
-``MPI_Reduce``-to-root analog) or ``lax.psum_scatter``
-(y row-sharded — the efficient form that never materializes p full-length
-partials). The reference's explicit strided-panel staging is free here: XLA
+local partial GEMV; combine with one of the **combine schedules** — the
+family the autotuner (``tuning/``) selects over:
+
+* ``"psum"``          — ``lax.psum``: replicated y, the ``MPI_Reduce``-to-root
+  analog (the plain-colwise default);
+* ``"psum_scatter"``  — ``lax.psum_scatter``: y row-sharded, never
+  materializing p full-length partials (the scatter default);
+* ``"ring"``          — explicit neighbor-ring reduce-scatter
+  (``parallel.ring.ring_psum_scatter``: p−1 single-link hops);
+* ``"ring_overlap"``  — the GEMV rides the ring (``ring_matvec``): each step
+  computes only the tile feeding the chunk in flight, overlapping compute
+  with the previous hop's ppermute — the ring-attention schedule shape;
+* ``"a2a"``           — one balanced ``lax.all_to_all`` + local reduce (the
+  Ulysses-style face of sequence parallelism).
+
+The named registry strategies ``colwise_ring`` / ``colwise_ring_overlap`` /
+``colwise_a2a`` are thin bindings of these schedules, kept for CSV-label and
+CLI compatibility; ``ColwiseStrategy(combine=...)`` is the single
+implementation, and ``combine="auto"`` defers the choice to the tuning cache
+per operand shape (``models/base.py::MatvecStrategy.build``).
+
+The reference's explicit strided-panel staging is free here: XLA
 layouts/resharding do it (SURVEY.md §5.8). Constraint preserved:
 ``n_cols % p == 0`` (``src/multiplier_colwise.c:151-154``; error message fixed
-per quirk Q2 — the C code printed "n_rows" for a check on n_cols).
+per quirk Q2 — the C code printed "n_rows" for a check on n_cols). The
+scatter-family schedules additionally require ``n_rows % p == 0``.
 """
 
 from __future__ import annotations
@@ -29,14 +48,48 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .base import MatvecStrategy, flat_axes, mesh_size
 from ..utils.errors import check_divisible
 
+# Schedules whose output is row-sharded (the scatter family). "psum" is the
+# only replicated-output schedule.
+SCATTER_COMBINES = ("psum_scatter", "ring", "ring_overlap", "a2a")
+COLWISE_COMBINES = ("psum",) + SCATTER_COMBINES
+
 
 class ColwiseStrategy(MatvecStrategy):
     name = "colwise"
 
-    def __init__(self, scatter_output: bool = False):
-        # scatter_output=True uses psum_scatter: y comes out row-sharded over
-        # the mesh instead of replicated. Requires n_rows % p == 0 as well.
-        self.scatter_output = scatter_output
+    def __init__(
+        self, scatter_output: bool = False, combine: str | None = None
+    ):
+        # scatter_output=True selects the scatter family: y comes out
+        # row-sharded over the mesh instead of replicated (requires
+        # n_rows % p == 0 as well). ``combine`` names the schedule directly
+        # (COLWISE_COMBINES) or defers to the tuning cache with "auto";
+        # None keeps the static default for the output form.
+        if combine == "auto":
+            self.requested_combine = "auto"
+            combine = None
+        elif combine is not None and combine not in COLWISE_COMBINES:
+            raise ValueError(
+                f"combine must be one of {COLWISE_COMBINES} or 'auto'; "
+                f"got {combine!r}"
+            )
+        if combine is None:
+            combine = "psum_scatter" if scatter_output else "psum"
+        self.combine = combine
+        self.scatter_output = combine in SCATTER_COMBINES
+
+    def with_combine(self, combine: str) -> "ColwiseStrategy":
+        bound = ColwiseStrategy(combine=combine)
+        bound.name = self.name  # keep the registry/CSV label stable
+        return bound
+
+    def combine_candidates(self, mesh: Mesh) -> tuple[str, ...]:
+        return COLWISE_COMBINES
+
+    def default_combine(self, mesh: Mesh) -> str:
+        # The static default for this instance's output form — always valid
+        # wherever this instance's validate() passes.
+        return self.combine
 
     def specs(self, mesh: Mesh) -> tuple[P, P, P]:
         axes = flat_axes(mesh)
@@ -44,20 +97,34 @@ class ColwiseStrategy(MatvecStrategy):
         return P(None, axes), P(axes), spec_y
 
     def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
+        from ..parallel.ring import (
+            a2a_psum_scatter,
+            ring_matvec,
+            ring_psum_scatter,
+        )
+
         axes = flat_axes(mesh)
-        scatter = self.scatter_output
+        combine = self.combine
 
         def body(a_panel, x_seg):
             # Full-length partial y from this device's column panel — the
             # moral equivalent of multiply_colwise's scale+row-sum
-            # (src/multiplier_colwise.c:107-122), fused by XLA into one dot.
-            # The cross-device sum runs on the kernel's accumulator dtype
-            # (fp32 for bf16 storage) and casts back only afterwards.
-            partial = kernel(a_panel, x_seg)
-            if scatter:
-                y = jax.lax.psum_scatter(partial, axes, tiled=True)
-            else:
-                y = jax.lax.psum(partial, axes)
+            # (src/multiplier_colwise.c:107-122), fused by XLA into one dot
+            # — combined across devices by the selected schedule. The
+            # cross-device sum runs on the kernel's accumulator dtype (fp32
+            # for bf16 storage) and casts back only afterwards.
+            if combine == "ring_overlap":
+                y = ring_matvec(a_panel, x_seg, axes, kernel)
+            elif combine == "ring":
+                y = ring_psum_scatter(kernel(a_panel, x_seg), axes)
+            elif combine == "a2a":
+                y = a2a_psum_scatter(kernel(a_panel, x_seg), axes)
+            elif combine == "psum_scatter":
+                y = jax.lax.psum_scatter(
+                    kernel(a_panel, x_seg), axes, tiled=True
+                )
+            else:  # "psum"
+                y = jax.lax.psum(kernel(a_panel, x_seg), axes)
             return y.astype(a_panel.dtype)
 
         return body
@@ -70,37 +137,19 @@ class ColwiseStrategy(MatvecStrategy):
 
 
 class ColwiseRingStrategy(ColwiseStrategy):
-    """Colwise with the combine expressed as an explicit neighbor-ring
-    reduce-scatter (parallel/ring.py) instead of one ``lax.psum_scatter`` —
-    the long-context / sequence-parallel schedule (each hop rides a single
-    ICI neighbor link, adds overlap hops). Output is always row-sharded.
+    """Colwise with the combine bound to the explicit neighbor-ring
+    reduce-scatter (``combine="ring"``) — the long-context /
+    sequence-parallel schedule. Output is always row-sharded.
 
-    ``overlap=True`` moves the GEMV itself into the ring (ring_matvec): each
-    step computes only the (m/p, k/p) tile feeding the chunk in flight, so
-    per-step compute overlaps the previous hop's ppermute — the
-    ring-attention schedule shape, vs. compute-then-reduce.
+    ``overlap=True`` binds ``"ring_overlap"``: the GEMV itself rides the
+    ring (``parallel.ring.ring_matvec``), overlapping each step's tile
+    compute with the previous hop's ppermute.
     """
 
     name = "colwise_ring"
 
     def __init__(self, overlap: bool = False):
-        super().__init__(scatter_output=True)
-        self.overlap = overlap
-
-    def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
-        from ..parallel.ring import ring_matvec, ring_psum_scatter
-
-        axes = flat_axes(mesh)
-        overlap = self.overlap
-
-        def body(a_panel, x_seg):
-            if overlap:
-                y = ring_matvec(a_panel, x_seg, axes, kernel)
-            else:
-                y = ring_psum_scatter(kernel(a_panel, x_seg), axes)
-            return y.astype(a_panel.dtype)
-
-        return body
+        super().__init__(combine="ring_overlap" if overlap else "ring")
 
 
 class ColwiseRingOverlapStrategy(ColwiseRingStrategy):
@@ -113,34 +162,12 @@ class ColwiseRingOverlapStrategy(ColwiseRingStrategy):
 
 
 class ColwiseAllToAllStrategy(ColwiseStrategy):
-    """Colwise with the combine as an explicit all-to-all + local reduce —
-    the Ulysses-style face of sequence parallelism, completing the combine
-    family (one-shot ``psum_scatter`` / neighbor ``ring`` / balanced
-    ``all_to_all``).
-
-    Reference analog: the same ``MPI_Reduce(SUM)`` combine
-    (``src/multiplier_colwise.c:124``), decomposed the way all-to-all
-    sequence-parallel schemes reshard between sequence- and head-parallel
-    layouts: each device splits its full-length partial y into p row
-    chunks, one ``lax.all_to_all`` delivers chunk j to device j (a single
-    balanced exchange using every ICI link at once, where the ring takes
-    p−1 neighbor hops), and a local sum over the p received contributions
-    completes the reduce-scatter. Output is always row-sharded; matches
-    ``psum_scatter`` up to reduction order.
-    """
+    """Colwise with the combine bound to the balanced all-to-all + local
+    reduce schedule (``combine="a2a"`` — the Ulysses-style face of sequence
+    parallelism). Output is always row-sharded; matches ``psum_scatter`` up
+    to reduction order."""
 
     name = "colwise_a2a"
 
     def __init__(self):
-        super().__init__(scatter_output=True)
-
-    def local_body(self, mesh: Mesh, kernel: Callable) -> Callable:
-        from ..parallel.ring import a2a_psum_scatter
-
-        axes = flat_axes(mesh)
-
-        def body(a_panel, x_seg):
-            partial = kernel(a_panel, x_seg)  # (m,), accumulator dtype
-            return a2a_psum_scatter(partial, axes).astype(a_panel.dtype)
-
-        return body
+        super().__init__(combine="a2a")

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.gemm_kernels import get_gemm_kernel
 from ..parallel.mesh import mesh_grid_shape
+from ..utils.compat import shard_map
 from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
 from ..utils.errors import ShardingError, check_divisible
 from .base import flat_axes, mesh_size
@@ -204,7 +205,7 @@ def build_gemm(
                 partial = jax.lax.psum(partial, reduce_axis)
             return partial.astype(a_blk.dtype)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body, mesh=mesh, in_specs=(spec_a, spec_b), out_specs=spec_c,
         check_vma=check_vma,
     )

@@ -31,7 +31,31 @@ def matmul_xla(a: Array, b: Array) -> Array:
     return jnp.matmul(a, b, preferred_element_type=acc)
 
 
-_GEMM_KERNELS: dict[str, GemmKernel] = {"xla": matmul_xla}
+def matmul_auto(a: Array, b: Array) -> Array:
+    """Measured-selection tier for GEMM — the rank-2 face of
+    ``ops.gemv.gemv_auto``: tuning-cache lookup on the local
+    (m, k, n, dtype), static XLA default on a miss or unregistered winner."""
+    from ..tuning import lookup_gemm
+
+    decision = lookup_gemm(
+        a.shape[0], a.shape[1], b.shape[1], str(a.dtype)
+    )
+    if decision is None:
+        return matmul_xla(a, b)
+    fn = _GEMM_KERNELS.get(decision.get("kernel"))
+    if fn is None or fn is matmul_auto:
+        return matmul_xla(a, b)
+    return fn(a, b)
+
+
+# Same build-time vma relaxation as gemv_auto: pallas is reachable.
+matmul_auto.relax_vma_check = True  # type: ignore[attr-defined]
+
+
+_GEMM_KERNELS: dict[str, GemmKernel] = {
+    "xla": matmul_xla,
+    "auto": matmul_auto,
+}
 
 
 def register_gemm_kernel(name: str, fn: GemmKernel) -> None:

@@ -58,9 +58,46 @@ def gemv_colwise_xla(a: Array, x: Array) -> Array:
     return jnp.sum(a.astype(acc) * x.astype(acc)[None, :], axis=1)
 
 
+def gemv_auto(a: Array, x: Array) -> Array:
+    """Measured-selection tier: consult the tuning cache (``tuning/``) for
+    this (local shape, dtype) on this platform and dispatch to the recorded
+    winner — kernel choice AND, for the pallas tier, the measured (bm, bk)
+    tile sizes. A cold cache (or a winner whose tier isn't registered, e.g.
+    ``native`` without the .so) falls back to the static default, the XLA
+    kernel — ``kernel="auto"`` is never worse-informed than ``kernel="xla"``.
+
+    The lookup key is the LOCAL (per-device) shape: under shard_map each
+    device runs this kernel on its own block, which is exactly the
+    granularity the tuner measures (``tuning/search.py``).
+    """
+    from ..tuning import lookup_gemv
+
+    decision = lookup_gemv(a.shape[0], a.shape[1], str(a.dtype))
+    if decision is None:
+        return gemv_xla(a, x)
+    kernel = decision.get("kernel")
+    if kernel == "pallas":
+        from .pallas_gemv import gemv_pallas
+
+        return gemv_pallas(a, x, bm=decision.get("bm"), bk=decision.get("bk"))
+    fn = _KERNELS.get(kernel)
+    if fn is None or fn is gemv_auto:
+        # Unregistered winner (e.g. 'native' tuned where the .so existed)
+        # or a pathological self-reference in the cache: static default.
+        return gemv_xla(a, x)
+    return fn(a, x)
+
+
+# The auto tier may resolve to pallas at trace time, whose interpret mode
+# defeats the shard_map vma checker (see pallas_gemv.py) — the check is a
+# build-time decision, so it must be relaxed whenever pallas is reachable.
+gemv_auto.relax_vma_check = True  # type: ignore[attr-defined]
+
+
 _KERNELS: dict[str, GemvKernel] = {
     "xla": gemv_xla,
     "xla_colwise": gemv_colwise_xla,
+    "auto": gemv_auto,
 }
 
 

@@ -115,7 +115,9 @@ def gemm_native(a: Array, b: Array) -> Array:
         target = "matvec_gemm_f64_ffi"
     else:
         raise TypeError(f"native gemm supports float32/float64, got {a.dtype}")
-    call = jax.ffi.ffi_call(
+    from ..utils.compat import ffi
+
+    call = ffi.ffi_call(
         target, jax.ShapeDtypeStruct((a.shape[0], b.shape[1]), a.dtype)
     )
     return call(a, b)

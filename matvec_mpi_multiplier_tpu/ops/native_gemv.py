@@ -117,7 +117,9 @@ def gemv_native(a: Array, x: Array) -> Array:
         target = "matvec_gemv_f64_ffi"
     else:
         raise TypeError(f"native gemv supports float32/float64, got {a.dtype}")
-    call = jax.ffi.ffi_call(
+    from ..utils.compat import ffi
+
+    call = ffi.ffi_call(
         target, jax.ShapeDtypeStruct((a.shape[0],), a.dtype)
     )
     return call(a, x)

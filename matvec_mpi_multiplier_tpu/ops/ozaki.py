@@ -74,6 +74,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from ..utils.compat import ldexp
 from .compensated import _df_reduce_lastaxis
 from .gemv import register_kernel
 
@@ -179,8 +180,10 @@ def _gemv_ozaki(a: Array, x: Array, n_slices: int) -> Array:
     s2 = partials.reshape(m, nb, n_slices * n_slices)
     hi_b, lo_b = _df_reduce_lastaxis(s2, jnp.zeros_like(s2))  # (m, nb)
     total_shift = a_shift[:, :, 0] + x_shift[:, 0][None, :]  # (m, nb)
-    hi_b = jnp.ldexp(hi_b, -total_shift)
-    lo_b = jnp.ldexp(lo_b, -total_shift)
+    # compat.ldexp: an exact two-step rescale — naive ldexp's 2^e factor
+    # flushes to zero below 2^-126 on old JAX, zeroing subnormal results.
+    hi_b = ldexp(hi_b, -total_shift)
+    lo_b = ldexp(lo_b, -total_shift)
     # Then across blocks (shifts undone, so magnitudes are commensurable).
     hi, lo = _df_reduce_lastaxis(hi_b, lo_b)
     return (hi + lo).astype(acc)

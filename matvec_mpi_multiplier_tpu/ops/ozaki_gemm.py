@@ -47,6 +47,7 @@ from functools import partial
 import jax.numpy as jnp
 from jax import Array
 
+from ..utils.compat import ldexp
 from .compensated import df_add
 from .gemm_kernels import register_gemm_kernel
 from .gemv import register_kernel
@@ -150,9 +151,12 @@ def _matmul_ozaki_i8(a: Array, b: Array, n_slices: int) -> Array:
                 )
                 p_hi, p_lo = _int32_halves(p)
                 hi_p, lo_p = df_add(hi_p, lo_p, p_hi, p_lo)
+            # compat.ldexp: e_pair reaches below -126 for deeply subnormal
+            # lines (ea near the fp32 floor), where a naive ldexp's 2^e
+            # factor flushes to zero (JAX 0.4.x) and zeros the pair.
             hi_acc, lo_acc = df_add(
                 hi_acc, lo_acc,
-                jnp.ldexp(hi_p, e_pair), jnp.ldexp(lo_p, e_pair),
+                ldexp(hi_p, e_pair), ldexp(lo_p, e_pair),
             )
     c = (hi_acc + lo_acc).astype(acc)
     return c[:, 0] if x_vector else c

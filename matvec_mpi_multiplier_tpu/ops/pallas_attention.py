@@ -42,6 +42,7 @@ from jax import Array
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.compat import align_vma, shape_dtype_struct, vma_of
 from .pallas_gemv import _largest_divisor_leq, _on_tpu
 
 # (bq, bk) score tiles: 512x512 fp32 = 1 MiB in VMEM, comfortably
@@ -113,15 +114,12 @@ def _pallas_partial(
     sk = k.shape[1]
     grid = (h, sq // bq, sk // bk)
     # Same vma alignment dance as _pallas_gemv: under shard_map the output
-    # avals must declare the union of the inputs' varying mesh axes.
+    # avals must declare the union of the inputs' varying mesh axes
+    # (utils.compat: a no-op on pre-vma JAX).
     vma = frozenset()
     for x in (q, k, v, q_pos, k_pos):
-        vma |= frozenset(jax.typeof(x).vma)
-    aligned = []
-    for x in (q, k, v, q_pos, k_pos):
-        missing = tuple(vma - frozenset(jax.typeof(x).vma))
-        aligned.append(jax.lax.pcast(x, missing, to="varying"))
-    q, k, v, q_pos, k_pos = aligned
+        vma |= vma_of(x)
+    q, k, v, q_pos, k_pos = align_vma(q, k, v, q_pos, k_pos)
     o, m, l = pl.pallas_call(
         functools.partial(_flash_kernel, causal=causal),
         grid=grid,
@@ -138,9 +136,9 @@ def _pallas_partial(
             pl.BlockSpec((1, bq), lambda hi, qi, ki: (hi, qi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((h, sq, d), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((h, sq), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((h, sq), jnp.float32, vma=vma),
+            shape_dtype_struct((h, sq, d), jnp.float32, vma=vma),
+            shape_dtype_struct((h, sq), jnp.float32, vma=vma),
+            shape_dtype_struct((h, sq), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import Array
 from jax.experimental import pallas as pl
 
+from ..utils.compat import align_vma, shape_dtype_struct, vma_of
 from .gemm_kernels import matmul_xla, register_gemm_kernel
 from .pallas_gemv import _largest_divisor_leq, _on_tpu
 
@@ -66,9 +67,8 @@ def _pallas_matmul(
     # Align varying-mesh-axis sets across inputs (see pallas_gemv.py): under
     # shard_map one operand may be device-varying while the other is
     # replicated, and the kernel-level ops need matching vma sets.
-    vma = frozenset(jax.typeof(a).vma) | frozenset(jax.typeof(b).vma)
-    a = jax.lax.pcast(a, tuple(vma - frozenset(jax.typeof(a).vma)), to="varying")
-    b = jax.lax.pcast(b, tuple(vma - frozenset(jax.typeof(b).vma)), to="varying")
+    vma = vma_of(a) | vma_of(b)
+    a, b = align_vma(a, b)
     acc = jnp.promote_types(a.dtype, jnp.float32)
     return pl.pallas_call(
         _mm_kernel,
@@ -78,7 +78,7 @@ def _pallas_matmul(
             pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), acc, vma=vma),
+        out_shape=shape_dtype_struct((m, n), acc, vma=vma),
         interpret=interpret,
     )(a, b)
 

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import Array
 from jax.experimental import pallas as pl
 
+from ..utils.compat import align_vma, shape_dtype_struct, vma_of
 from .gemv import gemv_xla, register_kernel
 
 # Default tile sizes: bm rows of A per grid step, bk contraction elements.
@@ -84,9 +85,9 @@ def _pallas_gemv(
     # axes it varies over: the union of the inputs' varying axes. Align both
     # inputs to that union (e.g. rowwise passes a replicated x alongside a
     # device-varying A) so every kernel-level op sees matching vma sets.
-    vma = frozenset(jax.typeof(a).vma) | frozenset(jax.typeof(x).vma)
-    a = jax.lax.pcast(a, tuple(vma - frozenset(jax.typeof(a).vma)), to="varying")
-    x = jax.lax.pcast(x, tuple(vma - frozenset(jax.typeof(x).vma)), to="varying")
+    # (utils.compat: the whole dance is a no-op on pre-vma JAX.)
+    vma = vma_of(a) | vma_of(x)
+    a, x = align_vma(a, x)
     # Kernel contract (ops/gemv.py): accumulate and return the accumulator
     # dtype (fp32 for bf16/fp32, fp64 for fp64); the strategy casts back to
     # storage dtype after its cross-device reduce.
@@ -99,7 +100,7 @@ def _pallas_gemv(
             pl.BlockSpec((1, bk), lambda i, j: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((m, 1), acc, vma=vma),
+        out_shape=shape_dtype_struct((m, 1), acc, vma=vma),
         interpret=interpret,
     )(a, x[None, :])
     return out[:, 0]
@@ -119,25 +120,83 @@ def _on_tpu() -> bool:
     ).lower()
 
 
-def gemv_pallas(a: Array, x: Array) -> Array:
-    """Pallas tiled GEMV with automatic tile-size selection.
-
-    Shapes whose dimensions don't admit aligned tiles (e.g. the 4×8
-    correctness fixture) fall back to the XLA kernel — the contract is the
-    kernel registry's ``gemv(a, x) -> y``, not a shape restriction.
-    """
-    m, k = a.shape
+def default_tiles(m: int, k: int, itemsize: int) -> tuple[int, int] | None:
+    """The static default tile choice: largest aligned (bm, bk) under the
+    VMEM byte budget — the pre-autotuner heuristic, and the fallback the
+    ``auto`` tier keeps on a tuning-cache miss. None when the shape admits
+    no aligned tiling (the kernel then falls back to XLA)."""
     # fp32 min sublane is 8; bf16 is 16. Use 16 to cover both.
     bm = _largest_divisor_leq(m, DEFAULT_BM, 16)
     if bm is None:
-        return gemv_xla(a, x)
+        return None
     # Fixed tile *byte* budget: bk shrinks for wider dtypes (bf16 keeps the
     # tuned 4096; fp32 caps at 2048, fp64 at 1024 for the full-size bm).
-    bk_cap = min(DEFAULT_BK, TILE_BYTE_BUDGET // (bm * jnp.dtype(a.dtype).itemsize))
+    bk_cap = min(DEFAULT_BK, TILE_BYTE_BUDGET // (bm * itemsize))
     bk = _largest_divisor_leq(k, bk_cap, 128)
     if bk is None:
+        return None
+    return bm, bk
+
+
+def tile_ladder(m: int, k: int, itemsize: int) -> list[tuple[int, int]]:
+    """Candidate (bm, bk) pairs for the autotuner: the bm halving ladder
+    crossed with the bk halving ladder, keeping only aligned divisors of the
+    shape whose A-tile fits the VMEM byte budget. Ordered largest-first so
+    the static default (``default_tiles``) is always the first entry when
+    it exists."""
+    ladder = []
+    bm_cap = DEFAULT_BM
+    while bm_cap >= 16:
+        bm = _largest_divisor_leq(m, bm_cap, 16)
+        if bm is not None:
+            bk_cap = min(DEFAULT_BK, TILE_BYTE_BUDGET // (bm * itemsize))
+            while bk_cap >= 128:
+                bk = _largest_divisor_leq(k, bk_cap, 128)
+                if bk is not None and (bm, bk) not in ladder:
+                    ladder.append((bm, bk))
+                    bk_cap = bk // 2
+                else:
+                    bk_cap //= 2
+            bm_cap = bm // 2
+        else:
+            bm_cap //= 2
+    return ladder
+
+
+def gemv_pallas(
+    a: Array, x: Array, *, bm: int | None = None, bk: int | None = None
+) -> Array:
+    """Pallas tiled GEMV with automatic tile-size selection.
+
+    ``bm``/``bk`` override the tile sizes (the autotuner's measured winners
+    ride in through here); overrides that don't evenly tile the shape are
+    ignored in favor of the static default. Shapes whose dimensions don't
+    admit aligned tiles at all (e.g. the 4×8 correctness fixture) fall back
+    to the XLA kernel — the contract is the kernel registry's
+    ``gemv(a, x) -> y``, not a shape restriction.
+    """
+    m, k = a.shape
+    tiles = None
+    if bm is not None and bk is not None:
+        if m % bm == 0 and k % bk == 0 and bm % 8 == 0 and bk % 128 == 0:
+            tiles = (bm, bk)
+    if tiles is None:
+        tiles = default_tiles(m, k, jnp.dtype(a.dtype).itemsize)
+    if tiles is None:
         return gemv_xla(a, x)
-    return _pallas_gemv(a, x, bm=bm, bk=bk, interpret=not _on_tpu())
+    return _pallas_gemv(a, x, bm=tiles[0], bk=tiles[1], interpret=not _on_tpu())
+
+
+def make_pallas_gemv(bm: int, bk: int):
+    """A registry-shaped kernel pinned to one (bm, bk) tile choice — the
+    form the autotuner measures tile candidates through, and the form the
+    ``auto`` tier dispatches to on a cache hit."""
+
+    def kern(a: Array, x: Array) -> Array:
+        return gemv_pallas(a, x, bm=bm, bk=bk)
+
+    kern.relax_vma_check = True  # type: ignore[attr-defined]
+    return kern
 
 
 # Marks this kernel for the shard_map vma-check relaxation (models/base.py):

@@ -45,6 +45,7 @@ from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.pallas_attention import flash_block_partial, merge_partials
+from ..utils.compat import axis_size, shard_map
 from .ring import _ring_perm
 
 # Local-block attention tiers, mirroring the GEMV/GEMM kernel registries:
@@ -99,7 +100,7 @@ def ring_attention(
     so they agree to fp32 rounding.
     """
     _check_kernel(kernel)
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     single_head = q.ndim == 2
     if single_head:
@@ -229,7 +230,7 @@ def ulysses_attention(
     Returns the local ``(s/p, h, d_head)`` output block (fp32).
     """
     _check_kernel(kernel)
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     blk, h, dh = q.shape
     if p == 1:
         return _local_heads_attention(q, k, v, causal=causal, kernel=kernel)
@@ -270,7 +271,7 @@ def build_ring_attention(
     axes = tuple(mesh.axis_names)
     spec = P(axes)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         partial(ring_attention, axis_name=axes, causal=causal, kernel=kernel),
         mesh=mesh,
         in_specs=(spec, spec, spec),
@@ -312,7 +313,7 @@ def build_ulysses_attention(
     axes = tuple(mesh.axis_names)
     spec = P(axes)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         partial(ulysses_attention, axis_name=axes, causal=causal,
                 kernel=kernel),
         mesh=mesh,

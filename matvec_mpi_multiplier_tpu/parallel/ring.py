@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from ..utils.compat import axis_size
+
 
 def _ring_perm(p: int) -> list[tuple[int, int]]:
     """Right-neighbor ring permutation on a size-p axis."""
@@ -36,7 +38,7 @@ def _ring_reduce(chunk_fn, axis_name: str):
     ``i`` (``i`` is a traced, possibly negative index — implementations
     wrap with ``jnp.mod``). Callers handle ``p == 1`` themselves.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     perm = _ring_perm(p)
     acc = chunk_fn(idx - 1)
@@ -58,7 +60,7 @@ def ring_psum_scatter(x: Array, axis_name: str) -> Array:
     Requires ``x.shape[0] % p == 0`` (same constraint psum_scatter imposes
     tiled).
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     if p == 1:
         return x
     n = x.shape[0]
@@ -90,7 +92,7 @@ def ring_matvec(a_panel: Array, x_seg: Array, axis_name: str, kernel) -> Array:
 
     Requires ``m % p == 0``.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     if p == 1:
         return kernel(a_panel, x_seg)
     m = a_panel.shape[0]
@@ -132,7 +134,7 @@ def a2a_psum_scatter(x: Array, axis_name: str) -> Array:
     (m, n) partials for GEMM); same contract and constraint
     (``x.shape[0] % p == 0``) as :func:`ring_psum_scatter`.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     if p == 1:
         return x
     n = x.shape[0]
@@ -163,7 +165,7 @@ def ring_all_gather(x: Array, axis_name: str) -> Array:
     returning it through ``out_specs=P()`` must build their shard_map with
     ``check_vma=False`` — ``build`` scopes that to the gather stage only.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     if p == 1:
         return x
     idx = jax.lax.axis_index(axis_name)

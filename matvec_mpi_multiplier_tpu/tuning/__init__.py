@@ -1,0 +1,95 @@
+"""Dispatch-time autotuner: measured kernel/tile/schedule selection.
+
+The repo's performance-critical choices — local kernel tier (``xla`` /
+``pallas`` / ``native``), Pallas tile sizes, combine schedule
+(``psum_scatter`` / ``ring`` / ``a2a`` / gather variants) — were originally
+static: flags and constants tuned once on one platform. The paper's central
+finding (and GSPMD's, arxiv 2105.04663) is that the best choice depends on
+shape, process count and regime; this package turns each choice into a
+*measured, cached decision*:
+
+* ``tuning.search`` measures candidates under the existing ``bench.timing``
+  protocol and records winners;
+* ``tuning.cache`` persists them to a versioned JSON file keyed by config +
+  platform fingerprint;
+* the dispatch tiers — ``kernel="auto"`` (ops/gemv.py, ops/gemm_kernels.py)
+  and ``combine="auto"`` (models/base.py) — consult the cache through the
+  module-level singleton here, falling back to the static defaults on any
+  miss, so ``auto`` is always safe to request.
+
+Offline population: ``python -m matvec_mpi_multiplier_tpu.tuning`` (see
+``__main__.py``) or ``bench.sweep --tune``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .cache import (
+    CACHE_ENV,
+    CACHE_VERSION,
+    TuningCache,
+    combine_key,
+    default_cache_path,
+    gemm_key,
+    gemv_key,
+    platform_fingerprint,
+)
+
+__all__ = [
+    "CACHE_ENV",
+    "CACHE_VERSION",
+    "TuningCache",
+    "combine_key",
+    "default_cache_path",
+    "gemm_key",
+    "gemv_key",
+    "platform_fingerprint",
+    "get_cache",
+    "reset_cache",
+    "lookup_gemv",
+    "lookup_gemm",
+    "lookup_combine",
+]
+
+# The dispatch-side singleton: loaded lazily on first lookup so importing
+# the package costs nothing, and invalidated when the resolved path changes
+# (tests and CLIs redirect via MATVEC_TUNING_CACHE).
+_cache: TuningCache | None = None
+
+
+def get_cache() -> TuningCache:
+    global _cache
+    path = default_cache_path()
+    if _cache is None or _cache.path != path:
+        _cache = TuningCache.load(path)
+    return _cache
+
+
+def reset_cache() -> None:
+    """Drop the in-memory singleton so the next lookup re-reads the file
+    (used after a tuning run writes new decisions, and by tests)."""
+    global _cache
+    _cache = None
+
+
+def lookup_gemv(m: int, k: int, dtype: str) -> dict[str, Any] | None:
+    """The recorded local-GEMV kernel decision for this (LOCAL shape, dtype)
+    on this platform, or None — the ``kernel="auto"`` tier's question."""
+    return get_cache().lookup(gemv_key(m, k, dtype))
+
+
+def lookup_gemm(m: int, k: int, n: int, dtype: str) -> dict[str, Any] | None:
+    """The recorded local-GEMM kernel decision, or None."""
+    return get_cache().lookup(gemm_key(m, k, n, dtype))
+
+
+def lookup_combine(
+    *, op: str, strategy: str, m: int, k: int, p: int, dtype: str
+) -> str | None:
+    """The recorded combine schedule for this (GLOBAL shape, mesh size), or
+    None — the ``combine="auto"`` tier's question (models/base.py)."""
+    decision = get_cache().lookup(combine_key(op, strategy, m, k, p, dtype))
+    if decision is None:
+        return None
+    return decision.get("combine")

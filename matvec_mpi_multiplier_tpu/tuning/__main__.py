@@ -1,0 +1,123 @@
+"""Offline tuning-cache population CLI.
+
+Usage::
+
+    python -m matvec_mpi_multiplier_tpu.tuning \
+        --strategy all --devices 1 2 4 8 --sweep square --dtype float32
+
+    # CPU smoke (the test environment's virtual mesh):
+    python -m matvec_mpi_multiplier_tpu.tuning --platform cpu \
+        --host-devices 8 --sizes 1024 --strategy colwise rowwise
+
+Measures the kernel/tile/combine candidates for every config in the grid
+(the same grid ``bench.sweep`` runs) and persists the winners to the JSON
+cache (``tuning/cache.py``; ``--cache`` / ``MATVEC_TUNING_CACHE`` override
+the path). A subsequent ``bench.sweep --kernel auto`` / ``--combine auto``
+run consults the cache without re-measuring; ``bench.sweep --tune`` runs
+this same population pass inline before sweeping.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m matvec_mpi_multiplier_tpu.tuning",
+        description="Populate the autotuner cache: measure kernel/tile/"
+        "combine candidates for a sweep grid and persist the winners.",
+    )
+    p.add_argument("--strategy", nargs="+", default=["all"])
+    p.add_argument("--op", choices=["matvec", "gemm"], default="matvec")
+    p.add_argument("--n-rhs", type=int, default=None)
+    p.add_argument("--devices", nargs="+", type=int, default=None)
+    p.add_argument(
+        "--sweep", choices=["square", "asymmetric", "both"], default="square"
+    )
+    p.add_argument("--sizes", nargs="+", type=int, default=None)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--n-reps", type=int, default=None)
+    p.add_argument("--samples", type=int, default=None)
+    p.add_argument(
+        "--measure",
+        choices=["auto", "loop", "chain", "sync"],
+        default="auto",
+        help="timing method for combine-schedule measurement (bench/timing.py)",
+    )
+    p.add_argument(
+        "--force",
+        action="store_true",
+        help="re-measure configs already in the cache",
+    )
+    p.add_argument(
+        "--min-gain",
+        type=float,
+        default=None,
+        help="hysteresis margin: a non-default candidate must beat the "
+        "static default by this relative fraction to be recorded as the "
+        "winner (default 0.05; raise it on noisy shared hosts so "
+        "measurement noise can't unseat the default)",
+    )
+    p.add_argument("--cache", default=None, help="cache file path override")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--host-devices", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cache is not None:
+        # Through the env var so the dispatch-side singleton (lookup_gemv &
+        # co.) resolves the same file in this process and its children.
+        os.environ["MATVEC_TUNING_CACHE"] = args.cache
+
+    from ..bench.sweep import (
+        ASYMMETRIC_SIZES,
+        SQUARE_SIZES,
+        configure_platform,
+        device_counts_available,
+        resolve_strategies,
+    )
+
+    configure_platform(args.platform, args.host_devices)
+
+    from ..parallel.mesh import make_mesh
+    from . import reset_cache
+    from .cache import TuningCache, platform_fingerprint
+    from .search import TUNE_MIN_GAIN, TUNE_N_REPS, TUNE_SAMPLES, tune_sweep
+
+    strategies = resolve_strategies(args.strategy, args.op)
+    counts = args.devices or device_counts_available()
+    if args.sizes:
+        sizes = [(s, s) for s in args.sizes]
+    elif args.sweep == "square":
+        sizes = [(s, s) for s in SQUARE_SIZES]
+    elif args.sweep == "asymmetric":
+        sizes = list(ASYMMETRIC_SIZES)
+    else:
+        sizes = [(s, s) for s in SQUARE_SIZES] + list(ASYMMETRIC_SIZES)
+    meshes = [make_mesh(n) for n in counts]
+
+    cache = TuningCache.load(args.cache)
+    print(f"tuning cache: {cache.path} ({len(cache)} entries)")
+    print(f"platform fingerprint: {platform_fingerprint()}")
+    tune_sweep(
+        strategies, sizes, meshes, args.dtype, cache,
+        op=args.op, n_rhs=args.n_rhs, measure=args.measure,
+        n_reps=args.n_reps or TUNE_N_REPS,
+        samples=args.samples or TUNE_SAMPLES,
+        force=args.force, seed=args.seed,
+        min_gain=args.min_gain if args.min_gain is not None else TUNE_MIN_GAIN,
+    )
+    path = cache.save()
+    reset_cache()  # same-process callers re-read the fresh decisions
+    print(f"saved {len(cache)} entries to {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,161 @@
+"""Persistent tuning cache: measured perf decisions, keyed by configuration
+and platform fingerprint.
+
+The cache is one versioned JSON file (default
+``<data_dir>/out/tuning_cache.json``, overridable via the
+``MATVEC_TUNING_CACHE`` env var or an explicit path). Every entry records
+one *decision* — the measured winner for one (op, shape, dtype, mesh size)
+configuration — under a key that embeds the **platform fingerprint**
+(platform, device kind, JAX version): a cache tuned on one machine is
+harmless on another (its entries simply never match, so dispatch falls back
+to the static defaults and a ``--tune`` run re-measures), and a single file
+can carry tunings for several platforms side by side.
+
+Schema (version 1)::
+
+    {
+      "version": 1,
+      "entries": {
+        "<fingerprint>|gemv|<m>x<k>|<dtype>":
+            {"kernel": "pallas", "bm": 512, "bk": 2048,
+             "time_s": 1.2e-4, "candidates": {"xla": 1.5e-4, ...}},
+        "<fingerprint>|combine|matvec|<strategy>|<m>x<k>|p<p>|<dtype>":
+            {"combine": "psum_scatter", "time_s": ..., "candidates": {...}}
+      }
+    }
+
+``gemv`` keys use the LOCAL (per-device) shape — the granularity the kernel
+registry's ``auto`` tier dispatches on under shard_map; ``combine`` keys use
+the GLOBAL shape plus the mesh size. A file with an unknown ``version`` is
+ignored wholesale (treated as empty) rather than half-parsed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from pathlib import Path
+from typing import Any
+
+CACHE_VERSION = 1
+CACHE_ENV = "MATVEC_TUNING_CACHE"
+CACHE_FILENAME = "tuning_cache.json"
+
+
+def default_cache_path(root: str | os.PathLike | None = None) -> Path:
+    """Resolve the cache file path: explicit ``root``/env override, else the
+    benchmark output directory (so tuned decisions travel with the CSVs they
+    explain)."""
+    env = os.environ.get(CACHE_ENV)
+    if root is None and env:
+        return Path(env)
+    from ..utils.constants import OUT_SUBDIR
+    from ..utils.io import data_dir
+
+    return data_dir(root) / OUT_SUBDIR / CACHE_FILENAME
+
+
+def platform_fingerprint() -> str:
+    """The identity the cache keys decisions under: platform + device kind +
+    JAX version. Measured winners do not transfer across any of the three
+    (a v5e tiling is wrong on v4; an XLA upgrade can flip a crossover), so
+    a mismatch on any component must read as a cache miss."""
+    import jax
+
+    devs = jax.devices()
+    if devs:
+        platform = getattr(devs[0], "platform", "unknown") or "unknown"
+        kind = getattr(devs[0], "device_kind", "unknown") or "unknown"
+    else:  # pragma: no cover - no-device backends
+        platform = kind = "unknown"
+    kind = kind.replace(" ", "_")
+    return f"{platform}:{kind}:jax-{jax.__version__}"
+
+
+def gemv_key(m: int, k: int, dtype: str, fingerprint: str | None = None) -> str:
+    """Key for a local-GEMV kernel decision (LOCAL per-device shape)."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|gemv|{m}x{k}|{dtype}"
+
+
+def gemm_key(
+    m: int, k: int, n: int, dtype: str, fingerprint: str | None = None
+) -> str:
+    """Key for a local-GEMM kernel decision (LOCAL per-device shape)."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|gemm|{m}x{k}x{n}|{dtype}"
+
+
+def combine_key(
+    op: str,
+    strategy: str,
+    m: int,
+    k: int,
+    p: int,
+    dtype: str,
+    fingerprint: str | None = None,
+) -> str:
+    """Key for a combine-schedule decision (GLOBAL shape + mesh size)."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|combine|{op}|{strategy}|{m}x{k}|p{p}|{dtype}"
+
+
+class TuningCache:
+    """In-memory view of the JSON cache file, with atomic persistence."""
+
+    def __init__(self, path: str | os.PathLike | None = None):
+        self.path = Path(path) if path is not None else default_cache_path()
+        self.entries: dict[str, dict[str, Any]] = {}
+
+    @classmethod
+    def load(cls, path: str | os.PathLike | None = None) -> "TuningCache":
+        """Read the cache file; a missing, unreadable, unparseable or
+        wrong-version file loads as empty (dispatch then falls back to the
+        static defaults — a corrupt cache must never break a sweep)."""
+        cache = cls(path)
+        try:
+            raw = json.loads(Path(cache.path).read_text())
+        except (OSError, json.JSONDecodeError):
+            return cache
+        if (
+            not isinstance(raw, dict)
+            or raw.get("version") != CACHE_VERSION
+            or not isinstance(raw.get("entries"), dict)
+        ):
+            return cache
+        cache.entries = {
+            str(k): v for k, v in raw["entries"].items() if isinstance(v, dict)
+        }
+        return cache
+
+    def lookup(self, key: str) -> dict[str, Any] | None:
+        """The decision recorded under ``key``, or None (a miss — including
+        every fingerprint mismatch, since the fingerprint is part of the
+        key)."""
+        return self.entries.get(key)
+
+    def record(self, key: str, decision: dict[str, Any]) -> None:
+        self.entries[key] = decision
+
+    def save(self) -> Path:
+        """Atomically persist (write-to-temp + rename): a sweep killed
+        mid-save must never leave a truncated JSON behind — load() would
+        silently treat it as empty and a long tuning run would be lost."""
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        payload = {"version": CACHE_VERSION, "entries": self.entries}
+        fd, tmp = tempfile.mkstemp(
+            dir=self.path.parent, prefix=self.path.name, suffix=".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, self.path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return self.path
+
+    def __len__(self) -> int:
+        return len(self.entries)

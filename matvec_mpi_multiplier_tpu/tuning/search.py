@@ -1,0 +1,490 @@
+"""Candidate enumeration + measurement for the autotuner.
+
+Three measured axes, mirroring the repo's three static perf choices:
+
+* **local kernel** — ``xla`` / ``pallas`` / ``native`` (when its .so is
+  built), measured as the bare per-device kernel on one device;
+* **Pallas tile sizes** — the (bm, bk) halving ladder inside the VMEM byte
+  budget (``ops.pallas_gemv.tile_ladder``), measured as distinct candidates
+  of the kernel axis so a tile choice only wins by beating every tier;
+* **combine schedule** — the strategy-level combine family
+  (``psum_scatter`` / ``ring`` / ``ring_overlap`` / ``a2a`` for colwise,
+  ``gather`` / ``ring`` for sharded-output strategies), measured as the
+  full distributed matvec on the target mesh.
+
+All measurements ride the existing benchmark protocol (``bench.timing``):
+device-looped slope timing with median-of-samples, the same numbers the
+sweep CSVs record — so a tuned winner is by construction the candidate the
+benchmark would have ranked first.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..bench.timing import benchmark_strategy, time_fn_looped
+from ..models import get_strategy
+from ..parallel.mesh import mesh_grid_shape
+from ..utils.errors import MatvecError, TimingError
+from .cache import TuningCache, combine_key, gemm_key, gemv_key
+
+# Tuning measures many candidates per config; the full 100-rep protocol
+# would make a --tune pre-pass cost more than the sweep it feeds. The slope
+# method self-widens its rep spread until the signal beats dispatch jitter
+# (bench/timing.py::_grow_spread), so a smaller request loses no validity.
+TUNE_N_REPS = 30
+TUNE_SAMPLES = 3
+
+# Hysteresis: a non-default candidate must beat the static default's time by
+# this relative margin to be recorded as the winner. Near-ties are decided
+# by measurement noise, and a noise-picked "winner" breaks the auto tier's
+# contract of never being slower than the default — when the race is inside
+# the margin, the default keeps the seat. Ranking uses each candidate's
+# MINIMUM observed time (sync reps) / median slope (loop), the statistics
+# least distorted by contention spikes on shared hosts.
+TUNE_MIN_GAIN = 0.05
+
+
+def _measure_fn(
+    fn: Callable, args: tuple, *, n_reps: int, samples: int
+) -> float | None:
+    """Median per-execution time of a bare device function, or None when the
+    backend is too noisy for this candidate (an unmeasurable candidate can
+    never become a recorded winner)."""
+    try:
+        times = time_fn_looped(fn, args, n_reps=n_reps, samples=samples)
+    except TimingError:
+        return None
+    return float(np.median(times))
+
+
+def _pick_winner(
+    measured: dict[str, float], default: str, min_gain: float = TUNE_MIN_GAIN
+) -> str | None:
+    """The fastest measured candidate — unless the static default is within
+    ``min_gain`` of it, in which case the default keeps the seat (see
+    TUNE_MIN_GAIN). None when nothing was measurable."""
+    if not measured:
+        return None
+    winner = min(measured, key=measured.get)
+    if (
+        winner != default
+        and default in measured
+        and measured[winner] > (1.0 - min_gain) * measured[default]
+    ):
+        return default
+    return winner
+
+
+# ---------------------------------------------------------------- kernels
+
+
+def gemv_candidates(m: int, k: int, dtype: str) -> list[dict[str, Any]]:
+    """Kernel-axis candidates for one local (m, k, dtype): every registered
+    tier, with the pallas tier expanded over its tile ladder.
+
+    The pallas ladder is only offered on a real TPU: everywhere else the
+    kernel runs in interpret mode — orders of magnitude slower than any
+    production tier (it can never win) and slow enough that measuring it
+    would dominate a --tune pass. Set ``MATVEC_TUNE_PALLAS=1`` to force it
+    in (used to exercise the ladder path off-TPU)."""
+    import os
+
+    from ..ops.gemv import available_kernels
+    from ..ops.pallas_gemv import _on_tpu, tile_ladder
+
+    cands: list[dict[str, Any]] = [{"kernel": "xla"}]
+    if _on_tpu() or os.environ.get("MATVEC_TUNE_PALLAS") == "1":
+        itemsize = jnp.dtype(dtype).itemsize
+        for bm, bk in tile_ladder(m, k, itemsize):
+            cands.append({"kernel": "pallas", "bm": bm, "bk": bk})
+    if "native" in available_kernels():
+        cands.append({"kernel": "native"})
+    return cands
+
+
+def _candidate_label(cand: dict[str, Any]) -> str:
+    if cand["kernel"] == "pallas" and "bm" in cand:
+        return f"pallas[{cand['bm']}x{cand['bk']}]"
+    return cand["kernel"]
+
+
+def _candidate_gemv_fn(cand: dict[str, Any]) -> Callable:
+    from ..ops.gemv import get_kernel
+    from ..ops.pallas_gemv import make_pallas_gemv
+
+    if cand["kernel"] == "pallas" and "bm" in cand:
+        return make_pallas_gemv(cand["bm"], cand["bk"])
+    return get_kernel(cand["kernel"])
+
+
+def tune_gemv(
+    m: int,
+    k: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """Measure the kernel/tile candidates for one LOCAL (m, k, dtype) on one
+    device and record the winner. Returns the decision (cached or fresh),
+    None when nothing was measurable."""
+    key = gemv_key(m, k, dtype)
+    existing = cache.lookup(key)
+    if existing is not None and not force:
+        return existing
+    rng = np.random.default_rng(seed)
+    a = jnp.asarray(rng.uniform(0, 10, (m, k)), dtype=dtype)
+    x = jnp.asarray(rng.uniform(0, 10, (k,)), dtype=dtype)
+    cands = gemv_candidates(m, k, dtype)
+    # Discarded warmup of the first candidate: the first measurement in a
+    # cold process absorbs one-time costs (thread-pool spin-up, allocator
+    # growth) that would bias the ranking against whichever candidate runs
+    # first — the default, by construction.
+    _measure_fn(
+        _candidate_gemv_fn(cands[0]), (a, x), n_reps=max(1, n_reps // 4),
+        samples=1,
+    )
+    measured: dict[str, float] = {}
+    by_label: dict[str, dict[str, Any]] = {}
+    for cand in cands:
+        label = _candidate_label(cand)
+        t = _measure_fn(
+            _candidate_gemv_fn(cand), (a, x), n_reps=n_reps, samples=samples
+        )
+        if t is None:
+            log(f"  gemv {m}x{k} {dtype} {label}: unmeasurable")
+            continue
+        measured[label] = t
+        by_label[label] = cand
+        log(f"  gemv {m}x{k} {dtype} {label}: {t * 1e6:.1f} us")
+    winner = _pick_winner(measured, default="xla", min_gain=min_gain)
+    if winner is None:
+        return None
+    if winner != "xla" and "xla" in measured:
+        # Confirmation pass: re-measure the default and the apparent winner
+        # back-to-back, both fully warm. The first sweep's ranking can still
+        # carry cold-process ramp (the default is always measured first);
+        # the adjacent pair is free of order bias, so the final hysteresis
+        # decision uses it.
+        for label in ("xla", winner):
+            t = _measure_fn(
+                _candidate_gemv_fn(by_label[label]), (a, x),
+                n_reps=n_reps, samples=samples,
+            )
+            if t is not None:
+                measured[label] = t
+        winner = _pick_winner(measured, default="xla", min_gain=min_gain)
+        log(f"  gemv {m}x{k} {dtype} confirm -> {winner}")
+    best = dict(by_label[winner], time_s=measured[winner], candidates=measured)
+    cache.record(key, best)
+    return best
+
+
+def gemm_candidates(dtype: str) -> list[dict[str, Any]]:
+    """Perf-tier GEMM candidates. Same pallas gating as
+    :func:`gemv_candidates` (interpret mode off-TPU can never win and would
+    dominate the tune pass), and the accuracy tiers (ozaki*, compensated)
+    are excluded outright — they trade speed for precision by design, so
+    measuring them buys nothing a perf tuner can record."""
+    import os
+
+    from ..ops.gemm_kernels import available_gemm_kernels
+    from ..ops.pallas_gemv import _on_tpu
+
+    cands: list[dict[str, Any]] = [{"kernel": "xla"}]
+    if _on_tpu() or os.environ.get("MATVEC_TUNE_PALLAS") == "1":
+        cands.append({"kernel": "pallas"})
+    if "native" in available_gemm_kernels():
+        cands.append({"kernel": "native"})
+    return cands
+
+
+def tune_gemm(
+    m: int,
+    k: int,
+    n: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """GEMM face of :func:`tune_gemv` (kernel tier axis only — the pallas
+    GEMM tile ladder is a ROADMAP follow-on)."""
+    from ..ops.gemm_kernels import get_gemm_kernel
+
+    key = gemm_key(m, k, n, dtype)
+    existing = cache.lookup(key)
+    if existing is not None and not force:
+        return existing
+    rng = np.random.default_rng(seed)
+    a = jnp.asarray(rng.uniform(0, 10, (m, k)), dtype=dtype)
+    b = jnp.asarray(rng.uniform(0, 10, (k, n)), dtype=dtype)
+    measured: dict[str, float] = {}
+    for cand in gemm_candidates(dtype):
+        label = cand["kernel"]
+        t = _measure_fn(
+            get_gemm_kernel(label), (a, b), n_reps=n_reps, samples=samples
+        )
+        if t is None:
+            log(f"  gemm {m}x{k}x{n} {dtype} {label}: unmeasurable")
+            continue
+        measured[label] = t
+        log(f"  gemm {m}x{k}x{n} {dtype} {label}: {t * 1e6:.1f} us")
+    winner = _pick_winner(measured, default="xla", min_gain=min_gain)
+    if winner is None:
+        return None
+    best = {"kernel": winner, "time_s": measured[winner],
+            "candidates": measured}
+    cache.record(key, best)
+    return best
+
+
+# ---------------------------------------------------------------- combine
+
+
+def tune_combine(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    kernel: str = "xla",
+    measure: str = "auto",
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    memo: dict | None = None,
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """Measure the combine-schedule candidates for one GLOBAL
+    (strategy, m, k, mesh, dtype) config as full distributed matvecs and
+    record the winner. Candidates whose divisibility guards reject the shape
+    are skipped (they could never run at dispatch time either).
+
+    ``memo`` (optional, shared across one tune_sweep run) caches candidate
+    measurements by program identity: the colwise registry variants
+    (colwise / colwise_ring / ... ) bind the SAME parameterized strategy, so
+    under --strategy all their identical candidate programs are measured
+    once, not once per registry name (only the hysteresis default differs
+    per name)."""
+    from ..utils.io import generate_matrix, generate_vector
+
+    p = int(mesh.devices.size)
+    key = combine_key("matvec", strategy_name, m, k, p, dtype)
+    existing = cache.lookup(key)
+    if existing is not None and not force:
+        return existing
+    strat = get_strategy(strategy_name)
+    try:
+        candidates = strat.combine_candidates(mesh)
+    except MatvecError:
+        # e.g. blockwise on a mesh without its 2-D axes: nothing to tune.
+        return None
+    if not candidates:
+        return None
+    a = generate_matrix(m, k, seed=seed)
+    x = generate_vector(k, seed=seed + 1)
+    # Discarded warmup (same cold-process rationale as tune_gemv): without
+    # it the first-measured candidate — the default — looks slower than it
+    # is and noise-picked winners slip past the hysteresis.
+    try:
+        benchmark_strategy(
+            strat, mesh, a, x, dtype=dtype, n_reps=1, measure=measure,
+            kernel=kernel, combine=candidates[0], chain_samples=1,
+        )
+    except (MatvecError, TimingError):
+        pass
+    family = "colwise" if strategy_name.startswith("colwise") else strategy_name
+    measured: dict[str, float] = {}
+    for cand in candidates:
+        memo_key = (family, cand, m, k, p, dtype, kernel, measure)
+        if memo is not None and memo_key in memo:
+            measured[cand] = memo[memo_key]
+            continue
+        bound = strat.with_combine(cand) or strat
+        try:
+            bound.validate(m, k, mesh)
+        except MatvecError as e:
+            log(f"  combine {strategy_name} {m}x{k} p={p} {cand}: skip ({e})")
+            continue
+        try:
+            result = benchmark_strategy(
+                strat, mesh, a, x, dtype=dtype, n_reps=n_reps,
+                measure=measure, kernel=kernel, combine=cand,
+                chain_samples=samples,
+            )
+        except TimingError:
+            log(f"  combine {strategy_name} {m}x{k} p={p} {cand}: unmeasurable")
+            continue
+        # Rank on the MINIMUM rep time: on shared hosts the mean absorbs
+        # contention spikes that have nothing to do with the schedule.
+        t = float(result.min_time_s)
+        measured[cand] = t
+        if memo is not None:
+            memo[memo_key] = t
+        log(f"  combine {strategy_name} {m}x{k} p={p} {cand}: {t * 1e6:.1f} us")
+    default = strat.default_combine(mesh)
+    winner = _pick_winner(measured, default=default, min_gain=min_gain)
+    if winner is None:
+        return None
+    if winner != default and default in measured:
+        # Confirmation pass (same rationale as tune_gemv): the default is
+        # always measured first and can absorb cold-process ramp; decide on
+        # an adjacent, fully-warm re-measurement of the contending pair.
+        for cand in (default, winner):
+            try:
+                result = benchmark_strategy(
+                    strat, mesh, a, x, dtype=dtype, n_reps=n_reps,
+                    measure=measure, kernel=kernel, combine=cand,
+                    chain_samples=samples,
+                )
+            except TimingError:
+                continue
+            measured[cand] = float(result.min_time_s)
+        winner = _pick_winner(measured, default=default, min_gain=min_gain)
+        log(f"  combine {strategy_name} {m}x{k} p={p} confirm -> {winner}")
+    best = {"combine": winner, "time_s": measured[winner],
+            "candidates": measured}
+    cache.record(key, best)
+    return best
+
+
+# ------------------------------------------------------------ sweep-level
+
+
+def local_gemv_shapes(
+    strategy_name: str, m: int, k: int, mesh
+) -> set[tuple[int, int]]:
+    """The LOCAL per-device GEMV shapes a strategy presents to its kernel
+    for a GLOBAL (m, k) on ``mesh`` — the shapes the ``auto`` kernel tier
+    will look up at dispatch time, hence the shapes worth tuning."""
+    p = int(mesh.devices.size)
+    shapes: set[tuple[int, int]] = set()
+    if strategy_name == "rowwise":
+        if m % p == 0:
+            shapes.add((m // p, k))
+    elif strategy_name == "blockwise":
+        try:
+            r, c = mesh_grid_shape(mesh)
+        except Exception:
+            return shapes
+        if m % r == 0 and k % c == 0:
+            shapes.add((m // r, k // c))
+    elif strategy_name.startswith("colwise"):
+        if k % p == 0:
+            shapes.add((m, k // p))
+            # The overlapped ring calls the kernel on (m/p, k/p) tiles; an
+            # auto-combine strategy can resolve to it, so tune that shape too.
+            if m % p == 0:
+                shapes.add((m // p, k // p))
+    return shapes
+
+
+def tune_config(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    op: str = "matvec",
+    n_rhs: int | None = None,
+    kernel: str = "xla",
+    measure: str = "auto",
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    memo: dict | None = None,
+    log: Callable[[str], None] = print,
+) -> None:
+    """Tune everything one sweep config consults at dispatch time: the
+    local-kernel keys for each per-device shape, plus (matvec only) the
+    combine-schedule key for the global config."""
+    if op == "gemm":
+        n = n_rhs or k
+        p = int(mesh.devices.size)
+        local: set[tuple[int, int, int]] = set()
+        if strategy_name == "rowwise" and m % p == 0:
+            local.add((m // p, k, n))
+        elif strategy_name.startswith("colwise") and k % p == 0:
+            local.add((m, k // p, n))
+        elif strategy_name == "blockwise":
+            try:
+                r, c = mesh_grid_shape(mesh)
+            except Exception:
+                r = c = None
+            if r and m % r == 0 and k % c == 0:
+                local.add((m // r, k // c, n))
+        for lm, lk, ln in sorted(local):
+            tune_gemm(
+                lm, lk, ln, dtype, cache, n_reps=n_reps, samples=samples,
+                force=force, seed=seed, min_gain=min_gain, log=log,
+            )
+        return
+    for lm, lk in sorted(local_gemv_shapes(strategy_name, m, k, mesh)):
+        tune_gemv(
+            lm, lk, dtype, cache, n_reps=n_reps, samples=samples,
+            force=force, seed=seed, min_gain=min_gain, log=log,
+        )
+    tune_combine(
+        strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
+        measure=measure, n_reps=n_reps, samples=samples, force=force,
+        seed=seed, min_gain=min_gain, memo=memo, log=log,
+    )
+
+
+def tune_sweep(
+    strategies: Iterable[str],
+    sizes: Iterable[tuple[int, int]],
+    meshes: Iterable,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    op: str = "matvec",
+    n_rhs: int | None = None,
+    kernel: str = "xla",
+    measure: str = "auto",
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    log: Callable[[str], None] = print,
+) -> TuningCache:
+    """Populate the cache for a whole sweep grid, saving incrementally after
+    each (size, mesh) cell so an interrupted tuning run keeps its progress."""
+    strategies = list(strategies)
+    memo: dict = {}  # shared candidate measurements (see tune_combine)
+    for m, k in sizes:
+        for mesh in meshes:
+            for name in strategies:
+                tune_config(
+                    name, mesh, m, k, dtype, cache, op=op, n_rhs=n_rhs,
+                    kernel=kernel, measure=measure, n_reps=n_reps,
+                    samples=samples, force=force, seed=seed,
+                    min_gain=min_gain, memo=memo, log=log,
+                )
+            cache.save()
+    return cache

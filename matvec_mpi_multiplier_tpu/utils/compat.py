@@ -1,0 +1,155 @@
+"""JAX cross-version compatibility shim.
+
+The codebase targets the current JAX API generation (``jax.shard_map`` with
+``check_vma``, the varying-manual-axes ("vma") system reached through
+``jax.typeof(...).vma`` / ``jax.lax.pcast``, and ``jax.ShapeDtypeStruct``'s
+``vma=`` keyword). Older installs (JAX <= 0.5.x, e.g. the 0.4.37 this
+container ships) predate all three: ``shard_map`` lives in
+``jax.experimental.shard_map`` with a ``check_rep`` flag (the vma checker's
+predecessor — same replication contract, coarser tracking), arrays carry no
+vma set, and there is no ``pcast``.
+
+Every module that touches one of these APIs goes through THIS shim and
+nothing else — a grep-based lint (``scripts/tier1.sh`` and
+``tests/test_lint.py``) forbids direct ``jax.shard_map`` /
+``jax.experimental.shard_map`` references anywhere else, so the next JAX
+bump is a one-file change.
+
+On the old generation:
+
+* :func:`shard_map` maps ``check_vma`` onto ``check_rep`` — both gate the
+  same "does the body's output replication match out_specs" contract, so
+  call sites keep one spelling;
+* :func:`vma_of` returns the empty frozenset (no axis is ever marked
+  varying) and :func:`pcast_to_varying` is the identity — the vma alignment
+  dance the pallas wrappers do becomes a no-op, which is exactly right:
+  without a vma checker there is nothing to align for;
+* :func:`shape_dtype_struct` drops the ``vma=`` keyword;
+* :func:`axis_size` falls back to ``lax.psum(1, axis)``, which constant-folds
+  to a static int inside shard_map on every JAX generation.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+import jax
+
+# Capability probes, not version probes — the APIs did not all move in one
+# release (jax.shard_map was promoted to the top level before check_rep was
+# renamed check_vma), so each surface is probed for what it actually does:
+#
+# * HAS_VMA gates the varying-manual-axes system itself (jax.typeof(...).vma,
+#   lax.pcast, ShapeDtypeStruct(vma=...), which DID ship together);
+# * the shard_map implementation and its check-kwarg spelling are resolved
+#   independently, from wherever shard_map lives and from its signature.
+HAS_VMA: bool = hasattr(jax, "typeof") and hasattr(jax.lax, "pcast")
+
+if hasattr(jax, "shard_map"):
+    _shard_map_impl = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+try:
+    _CHECK_KW = (
+        "check_vma"
+        if "check_vma" in inspect.signature(_shard_map_impl).parameters
+        else "check_rep"
+    )
+except (TypeError, ValueError):  # pragma: no cover - unsignaturable impl
+    _CHECK_KW = "check_vma" if HAS_VMA else "check_rep"
+
+# The FFI registration surface moved from jax.extend.ffi to jax.ffi; both
+# expose the same names (include_dir, pycapsule, register_ffi_target).
+if hasattr(jax, "ffi"):
+    ffi = jax.ffi
+else:  # pragma: no cover - exercised only on old installs
+    import jax.extend.ffi as ffi  # type: ignore[no-redef]
+
+
+def shard_map(
+    f: Callable,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    check_vma: bool = True,
+) -> Callable:
+    """``jax.shard_map`` on new JAX; ``jax.experimental.shard_map.shard_map``
+    with ``check_rep=check_vma`` on old (the kwarg spelling is read off the
+    implementation's own signature). Keyword-only by design so call sites
+    cannot drift between the two positional conventions."""
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )
+
+
+def vma_of(x: Any) -> frozenset:
+    """The set of mesh axes ``x`` varies over (``jax.typeof(x).vma``);
+    empty on JAX generations without the vma system."""
+    if HAS_VMA:
+        return frozenset(jax.typeof(x).vma)
+    return frozenset()
+
+
+def pcast_to_varying(x: Any, axes) -> Any:
+    """``jax.lax.pcast(x, axes, to="varying")``, identity when ``axes`` is
+    empty or the install has no vma system."""
+    axes = tuple(axes)
+    if not axes or not HAS_VMA:
+        return x
+    return jax.lax.pcast(x, axes, to="varying")
+
+
+def align_vma(*xs: Any) -> tuple:
+    """Broadcast every array up to the union of the group's varying axes —
+    the alignment the pallas wrappers need so all kernel-level operands
+    carry matching vma sets. No-op (returns inputs) on old JAX."""
+    if not HAS_VMA:
+        return xs
+    union = frozenset()
+    for x in xs:
+        union |= vma_of(x)
+    return tuple(pcast_to_varying(x, union - vma_of(x)) for x in xs)
+
+
+def shape_dtype_struct(shape, dtype, vma: frozenset = frozenset()):
+    """``jax.ShapeDtypeStruct`` carrying ``vma`` where the install supports
+    it (pallas_call out_shape under shard_map needs the declared set there;
+    old JAX has no such concept to declare)."""
+    if HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def ldexp(x, e):
+    """``x * 2**e``, exact even when ``2**e`` itself underflows fp32.
+
+    Old jnp.ldexp materializes ``2**e`` in the operand dtype before
+    multiplying, so a scale below 2^-126 flushes to zero and takes the
+    (representable, possibly subnormal) product with it — e.g.
+    ``ldexp(4096f, -132)`` returned 0 instead of 2^-120 on JAX 0.4.x. The
+    two-step form keeps each factor a normal number: the first shift is
+    clamped to the normal exponent range, the remainder applied second, so
+    the only rounding is the final (power-of-two, hence exact-or-subnormal)
+    multiply — the same contract as a correct ldexp.
+    """
+    import jax.numpy as jnp
+
+    e = jnp.asarray(e)
+    e1 = jnp.clip(e, -126, 127)
+    first = jnp.ldexp(x, e1)
+    return jnp.where(e == e1, first, jnp.ldexp(first, e - e1))
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mesh axis (or tuple of axes) inside shard_map.
+
+    ``jax.lax.axis_size`` where it exists; otherwise ``lax.psum(1, axis)``,
+    which constant-folds to a Python int for a non-tracer operand on every
+    JAX generation."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

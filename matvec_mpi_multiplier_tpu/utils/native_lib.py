@@ -58,13 +58,15 @@ def declare_ctypes_sig(
 
 def register_ffi_targets(lib: ctypes.CDLL, pairs) -> None:
     """Register ``(target_name, exported_symbol)`` pairs as CPU XLA FFI
-    custom-call targets. jax is imported lazily so this module stays
-    jax-free at import time (utils/io.py depends on that)."""
-    import jax
+    custom-call targets. jax is imported lazily (through the cross-version
+    shim — the FFI surface moved between jax.extend.ffi and jax.ffi) so
+    this module stays jax-free at import time (utils/io.py depends on
+    that)."""
+    from .compat import ffi
 
     for target, symbol in pairs:
-        jax.ffi.register_ffi_target(
-            target, jax.ffi.pycapsule(getattr(lib, symbol)), platform="cpu"
+        ffi.register_ffi_target(
+            target, ffi.pycapsule(getattr(lib, symbol)), platform="cpu"
         )
 
 

@@ -156,6 +156,14 @@ while :; do
       say "watcher exited rc=$rc (0=complete, 1=attempt budget, 2=deterministic failure) — nanny done"
       exit "$rc"
     fi
+    if [ "$rc" -eq 126 ] || [ "$rc" -eq 127 ]; then
+      # Shell exec failures: 126 = watcher script not executable, 127 = not
+      # found. Deterministic — relaunching the same command line MAX_RESTARTS
+      # times (~8h of one-per-poll retries) cannot fix a missing/chmod-less
+      # script, so treat as fatal instead of involuntary death.
+      say "watcher launch failed rc=$rc (126=not executable, 127=not found) — deterministic exec failure, not retrying"
+      exit "$rc"
+    fi
     # The dead watcher's capture children reparent to init but keep its
     # pgid — group-kill them, or the relaunched watcher starts a SECOND
     # capture contending for the chip and the CSVs.

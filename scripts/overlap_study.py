@@ -52,6 +52,9 @@ def _flatten(jaxpr, eqns: list, alias: dict) -> None:
         sub = None
         for val in eqn.params.values():
             inner = val if hasattr(val, "eqns") else getattr(val, "jaxpr", None)
+            # A ClosedJaxpr (old-JAX shard_map carries one in its params)
+            # exposes .eqns but not .invars — unwrap to the raw Jaxpr.
+            inner = getattr(inner, "jaxpr", inner)
             if hasattr(inner, "eqns"):
                 sub = inner
                 break

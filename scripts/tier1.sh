@@ -1,0 +1,45 @@
+#!/bin/sh
+# Tier-1 verify wrapper: the ROADMAP.md tier-1 command plus the repo lint
+# gate, as one entry point for CI and local runs.
+#
+#   ./scripts/tier1.sh            # lint + tier-1 test suite
+#   ./scripts/tier1.sh --lint-only
+#
+# Lint: direct `jax.shard_map` / `jax.experimental.shard_map` references are
+# forbidden outside utils/compat.py — every module goes through the
+# cross-version shim so a JAX API bump is a one-file change. (The same rule
+# is enforced in-suite by tests/test_lint.py; this wrapper lets CI fail fast
+# before spending the full suite's runtime.)
+
+set -eu
+cd "$(dirname "$0")/.."
+
+lint() {
+  # --include limits the sweep to Python sources; compat.py is the one
+  # allowed importer. Matches attribute use AND both import spellings.
+  bad=$(grep -rnE \
+      'jax\.shard_map|jax\.experimental\.shard_map|from jax\.experimental import shard_map' \
+      --include='*.py' \
+      matvec_mpi_multiplier_tpu tests scripts bench.py __graft_entry__.py \
+      2>/dev/null | grep -v 'matvec_mpi_multiplier_tpu/utils/compat\.py' || true)
+  if [ -n "$bad" ]; then
+    echo "LINT: direct shard_map references outside utils/compat.py:" >&2
+    echo "$bad" >&2
+    echo "Route them through matvec_mpi_multiplier_tpu.utils.compat." >&2
+    return 1
+  fi
+  echo "lint: ok (no direct shard_map references outside utils/compat.py)"
+}
+
+lint
+[ "${1:-}" = "--lint-only" ] && exit 0
+
+# ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
+set -o pipefail 2>/dev/null || true
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+  -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=$?
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit $rc

@@ -12,6 +12,7 @@ import pytest
 
 from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
 from matvec_mpi_multiplier_tpu.models.gemm import build_gemm, validate_gemm
+from matvec_mpi_multiplier_tpu.utils.compat import shard_map
 from matvec_mpi_multiplier_tpu.utils.errors import ShardingError
 
 
@@ -29,7 +30,7 @@ def test_a2a_psum_scatter_matches_lax(devices, rng, p):
     partials = rng.standard_normal((p, 16 * p))
 
     def run(body):
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             body, mesh=mesh, in_specs=(P("r"),), out_specs=P("r")
         ))(jnp.asarray(partials))
 

@@ -13,7 +13,15 @@ from matvec_mpi_multiplier_tpu.analysis.stats import (
 
 REF_OUT = "/root/reference/data/out"
 
+# The reference checkout is an environment fixture, not part of this repo —
+# gate the tests that read it rather than fail where it isn't mounted.
+needs_reference = pytest.mark.skipif(
+    not __import__("pathlib").Path(REF_OUT).exists(),
+    reason="reference checkout not present in this environment",
+)
 
+
+@needs_reference
 def test_reference_rowwise_speedup():
     """BASELINE.md: rowwise 10200², p=6 → S=1.45, E=0.242."""
     points = load_strategy_csv(f"{REF_OUT}/rowwise.csv")
@@ -27,6 +35,7 @@ def test_reference_rowwise_speedup():
     assert p6.gflops() == pytest.approx(1.00, abs=0.02)
 
 
+@needs_reference
 def test_reference_colwise_best_speedup():
     """BASELINE.md: colwise has the best curves — S=2.13 at 10200² p=6."""
     points = load_strategy_csv(f"{REF_OUT}/colwise.csv")
@@ -37,6 +46,7 @@ def test_reference_colwise_best_speedup():
     assert p6.speedup == pytest.approx(2.13, abs=0.01)
 
 
+@needs_reference
 def test_reference_blockwise_best_time():
     """BASELINE.md headline: best absolute time at 10200² is blockwise p=12
     (0.2017 s), and p=24 collapses."""
@@ -48,6 +58,7 @@ def test_reference_blockwise_best_time():
     assert p24.speedup < 0.2  # oversubscription collapse (README.md:74)
 
 
+@needs_reference
 def test_reference_asymmetric_parses():
     """Quirk Q10: asymmetric CSVs have a no-space header; must still parse."""
     points = load_strategy_csv(f"{REF_OUT}/asymmetric_rowwise.csv")
@@ -130,6 +141,7 @@ def test_viz_script_separates_gemm_comparison(tmp_path):
     assert run["gemm_rowwise_reference"][0].n_rhs == 8
 
 
+@needs_reference
 def test_format_table():
     points = load_strategy_csv(f"{REF_OUT}/rowwise.csv")
     md = format_table(points[:3])
@@ -137,6 +149,7 @@ def test_format_table():
     assert "rowwise" in md
 
 
+@needs_reference
 def test_plots_render(tmp_path):
     from matvec_mpi_multiplier_tpu.analysis.plots import (
         plot_comparison,
@@ -154,6 +167,7 @@ def test_plots_render(tmp_path):
     assert f2.exists() and f2.stat().st_size > 1000
 
 
+@needs_reference
 def test_plot_roofline(tmp_path):
     from matvec_mpi_multiplier_tpu.analysis.plots import plot_roofline
 

@@ -304,6 +304,10 @@ def test_csv_stale_header_rotated(devices, tmp_path):
 def test_read_csv_reference_files():
     """Our parser must read the reference's own committed CSVs, including the
     no-space asymmetric header (quirk Q10)."""
+    from pathlib import Path
+
+    if not Path("/root/reference/data/out/rowwise.csv").exists():
+        pytest.skip("reference checkout not present in this environment")
     rows = read_csv("/root/reference/data/out/rowwise.csv")
     assert rows[0] == {"n_rows": 600, "n_cols": 600, "n_processes": 1,
                        "time": pytest.approx(0.00101, abs=1e-4)}

@@ -16,6 +16,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 WORKER = """
@@ -162,6 +164,15 @@ def _run_workers(tmp_path, worker_src: str, *extra_argv: str) -> dict:
     try:
         for p in procs:
             out, err = p.communicate(timeout=300)
+            if p.returncode != 0 and (
+                "Multiprocess computations aren't implemented" in err
+            ):
+                # Old jaxlib CPU backends (e.g. 0.4.x here) have no cross-
+                # process CPU collectives at all — an install capability
+                # gap, not a defect in the SPMD programs under test.
+                pytest.skip(
+                    "this jaxlib's CPU backend has no multiprocess support"
+                )
             assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
             outs.append(json.loads(out.strip().splitlines()[-1]))
     finally:

@@ -161,6 +161,30 @@ def test_refined_gmres_beats_plain_fp32_on_nonsym_illconditioned(devices):
     assert rel(refined.x) * 4 < rel(plain.x)  # measured ~10x at seed 8
 
 
+def test_refined_gmres_defaults_to_small_inner_restart(devices, monkeypatch):
+    """The loose inner solves (inner_tol=1e-2) need a few digits per trip,
+    and GMRES(m) has no in-cycle exit — every trip pays all m matvecs. The
+    refinement default must therefore be a small restart (ADVICE round 5),
+    while an explicit restart= passes through untouched."""
+    import matvec_mpi_multiplier_tpu.models.gmres as gmres_mod
+    from matvec_mpi_multiplier_tpu.models.cg import build_refined
+
+    seen = []
+    real = gmres_mod.build_gmres
+
+    def spy(strategy, mesh, **kw):
+        seen.append(kw)
+        return real(strategy, mesh, **kw)
+
+    monkeypatch.setattr(gmres_mod, "build_gmres", spy)
+    mesh = make_mesh(8)
+    strat = get_strategy("rowwise")
+    build_refined(strat, mesh, inner="gmres")
+    assert seen[-1]["restart"] == 10
+    build_refined(strat, mesh, inner="gmres", restart=64)
+    assert seen[-1]["restart"] == 64
+
+
 def test_refined_rejects_unknown_inner(devices):
     from matvec_mpi_multiplier_tpu.models.cg import build_refined
 

@@ -1,0 +1,49 @@
+"""Repo lint gates that must ride the tier-1 suite.
+
+The JAX cross-version shim (``utils/compat.py``) only works if it is the
+single chokepoint: one stray direct shard_map reference re-breaks every
+test on an older install the moment that module is imported. The grep here
+mirrors ``scripts/tier1.sh``'s fail-fast lint so the rule is enforced even
+when the suite is invoked directly (the ROADMAP tier-1 command).
+"""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SHIM = REPO / "matvec_mpi_multiplier_tpu" / "utils" / "compat.py"
+
+_PATTERN = re.compile(
+    r"jax\.shard_map"
+    r"|jax\.experimental\.shard_map"
+    r"|from jax\.experimental import shard_map"
+)
+
+_SCAN_ROOTS = ("matvec_mpi_multiplier_tpu", "tests", "scripts")
+_SCAN_FILES = ("bench.py", "__graft_entry__.py")
+
+
+def _python_sources():
+    for root in _SCAN_ROOTS:
+        yield from sorted((REPO / root).rglob("*.py"))
+    for name in _SCAN_FILES:
+        p = REPO / name
+        if p.exists():
+            yield p
+
+
+def test_no_direct_shard_map_outside_compat():
+    offenders = []
+    for path in _python_sources():
+        if path == SHIM:
+            continue
+        for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1
+        ):
+            if _PATTERN.search(line):
+                offenders.append(f"{path.relative_to(REPO)}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "direct shard_map references outside utils/compat.py (route them "
+        "through matvec_mpi_multiplier_tpu.utils.compat):\n"
+        + "\n".join(offenders)
+    )

@@ -142,6 +142,18 @@ def test_voluntary_watcher_exit_stops_nanny(tmp_path, rc):
     assert "restarting" not in r.stdout
 
 
+@pytest.mark.parametrize("rc", [126, 127])
+def test_exec_failure_is_fatal_not_retried(tmp_path, rc):
+    # rc 126 (not executable) / 127 (not found) are deterministic launch
+    # failures: retrying the identical command line MAX_RESTARTS times
+    # cannot fix a missing or chmod-less script, so the nanny must forward
+    # the code immediately instead of burning its restart budget.
+    r = _run_nanny_with_stub_watcher(tmp_path, f"exit {rc}\n")
+    assert r.returncode == rc, r.stdout + r.stderr
+    assert "deterministic exec failure" in r.stdout
+    assert "restarting" not in r.stdout
+
+
 def test_wedge_detection_kills_and_restarts(tmp_path):
     # Full-loop wedge drill: a stub watcher whose "orchestrator" child
     # (cmdline carries tpu_measure_all.py, so capture_up sees a capture)

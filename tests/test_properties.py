@@ -8,6 +8,10 @@ counterexamples and shrinks failures to minimal cases.
 
 import numpy as np
 import pytest
+
+# Gate, don't fail collection: hypothesis is an optional dev dependency and
+# some environments (the pinned-JAX CI image) don't ship it.
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp

@@ -15,11 +15,12 @@ from matvec_mpi_multiplier_tpu.parallel.ring import (
     ring_all_gather,
     ring_psum_scatter,
 )
+from matvec_mpi_multiplier_tpu.utils.compat import shard_map
 
 
 def _shard_map_1d(body, mesh, in_spec, out_spec, check_vma=True):
     return jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+        shard_map(body, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
                       check_vma=check_vma)
     )
 
@@ -120,13 +121,13 @@ def test_ring_matvec_matches_psum_scatter(devices):
         return jax.lax.psum_scatter(y, "d", tiled=True)
 
     run_o = jax.jit(
-        jax.shard_map(
+        shard_map(
             overlapped, mesh=mesh, in_specs=(P(None, "d"), P("d")),
             out_specs=P("d"),
         )
     )
     run_r = jax.jit(
-        jax.shard_map(
+        shard_map(
             reference, mesh=mesh, in_specs=(P(None, "d"), P("d")),
             out_specs=P("d"),
         )
@@ -148,7 +149,7 @@ def test_ring_matvec_rejects_indivisible_rows(devices):
     mesh = make_1d_mesh(8, axis_name="d")
     with pytest.raises(ValueError, match="not divisible"):
         jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda a, x: ring_matvec(a, x, "d", gemv_xla),
                 mesh=mesh, in_specs=(P(None, "d"), P("d")), out_specs=P("d"),
             )

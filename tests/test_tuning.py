@@ -1,0 +1,391 @@
+"""Autotuner tests: cache round-trip, fingerprint gating, and the ``auto``
+dispatch tiers' fall-back and cache-hit behavior.
+
+The measurement layer itself (tuning/search.py) is exercised with a faked
+timer — the selection/recording logic is what needs pinning; real slope
+measurement is bench/timing.py's own, already-tested machinery.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.tuning import (
+    TuningCache,
+    combine_key,
+    gemv_key,
+    lookup_combine,
+    lookup_gemv,
+    platform_fingerprint,
+    reset_cache,
+)
+from matvec_mpi_multiplier_tpu.tuning.cache import CACHE_VERSION
+
+
+@pytest.fixture()
+def cache_path(tmp_path, monkeypatch):
+    """Redirect the cache (dispatch singleton included) to a temp file."""
+    path = tmp_path / "tuning_cache.json"
+    monkeypatch.setenv("MATVEC_TUNING_CACHE", str(path))
+    reset_cache()
+    yield path
+    reset_cache()
+
+
+@pytest.fixture()
+def operands(rng):
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    return a, x
+
+
+# ------------------------------------------------------------------ cache
+
+
+def test_cache_round_trip(cache_path):
+    cache = TuningCache.load(cache_path)
+    key = gemv_key(512, 4096, "float32")
+    decision = {"kernel": "pallas", "bm": 512, "bk": 2048, "time_s": 1e-4}
+    cache.record(key, decision)
+    assert cache.save() == cache_path
+
+    reloaded = TuningCache.load(cache_path)
+    assert reloaded.lookup(key) == decision
+    assert len(reloaded) == 1
+    # The file is the documented versioned schema.
+    raw = json.loads(cache_path.read_text())
+    assert raw["version"] == CACHE_VERSION
+    assert key in raw["entries"]
+
+
+def test_fingerprint_mismatch_is_a_miss(cache_path):
+    """A decision tuned on another platform/JAX must never be served: its
+    fingerprint is baked into the key, so the lookup misses and dispatch
+    falls back to the static default (re-tune territory)."""
+    cache = TuningCache.load(cache_path)
+    foreign = gemv_key(64, 64, "float32", fingerprint="tpu:v5e:jax-9.9.9")
+    cache.record(foreign, {"kernel": "pallas", "bm": 8, "bk": 128})
+    cache.save()
+    reset_cache()
+
+    assert "tpu:v5e" not in platform_fingerprint()
+    assert lookup_gemv(64, 64, "float32") is None
+    # The foreign entry itself survives the round-trip untouched.
+    assert TuningCache.load(cache_path).lookup(foreign) is not None
+
+
+def test_wrong_version_file_loads_empty(cache_path):
+    cache_path.write_text(json.dumps({
+        "version": CACHE_VERSION + 1,
+        "entries": {gemv_key(8, 8, "float32"): {"kernel": "xla"}},
+    }))
+    assert len(TuningCache.load(cache_path)) == 0
+
+
+def test_corrupt_file_loads_empty(cache_path):
+    cache_path.write_text("{ this is not json")
+    assert len(TuningCache.load(cache_path)) == 0
+
+
+def test_save_is_atomic_overwrite(cache_path):
+    c1 = TuningCache.load(cache_path)
+    c1.record(gemv_key(8, 8, "float32"), {"kernel": "xla"})
+    c1.save()
+    c2 = TuningCache.load(cache_path)
+    c2.record(gemv_key(16, 16, "float32"), {"kernel": "xla"})
+    c2.save()
+    assert len(TuningCache.load(cache_path)) == 2
+
+
+# ------------------------------------------------------- kernel="auto"
+
+
+def test_kernel_auto_cold_cache_matches_xla(devices, cache_path, operands):
+    """On a cold cache the auto tier must be exactly the static default."""
+    a, x = operands
+    mesh = make_mesh(8)
+    strat = get_strategy("rowwise")
+    y_auto = strat.build(mesh, kernel="auto")(a, x)
+    y_xla = strat.build(mesh, kernel="xla")(a, x)
+    np.testing.assert_array_equal(np.asarray(y_auto), np.asarray(y_xla))
+
+
+def test_kernel_auto_dispatches_cached_winner(
+    devices, cache_path, operands, monkeypatch
+):
+    """A recorded pallas winner for the LOCAL shape must actually route
+    dispatch through the pallas tier (and still be correct)."""
+    import matvec_mpi_multiplier_tpu.ops.pallas_gemv as pg
+
+    a, x = operands
+    mesh = make_mesh(8)
+    # rowwise on p=8: local blocks are (8, 64).
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        gemv_key(8, 64, "float32"),
+        {"kernel": "pallas", "bm": 8, "bk": 128},
+    )
+    cache.save()
+    reset_cache()
+
+    calls = []
+    real = pg.gemv_pallas
+
+    def spy(a_, x_, **kw):
+        calls.append(kw)
+        return real(a_, x_, **kw)
+
+    monkeypatch.setattr(pg, "gemv_pallas", spy)
+    y = get_strategy("rowwise").build(mesh, kernel="auto")(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-5)
+    assert calls and calls[0] == {"bm": 8, "bk": 128}
+
+
+def test_kernel_auto_unregistered_winner_falls_back(
+    devices, cache_path, operands
+):
+    """A cached winner whose tier isn't registered here (e.g. 'native'
+    tuned where the .so existed) must fall back to XLA, not crash."""
+    a, x = operands
+    mesh = make_mesh(8)
+    cache = TuningCache.load(cache_path)
+    cache.record(gemv_key(8, 64, "float32"), {"kernel": "no_such_tier"})
+    cache.save()
+    reset_cache()
+    y = get_strategy("rowwise").build(mesh, kernel="auto")(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-5)
+
+
+# ------------------------------------------------------ combine="auto"
+
+
+def test_combine_auto_cold_cache_matches_default(
+    devices, cache_path, operands
+):
+    a, x = operands
+    mesh = make_mesh(8)
+    strat = get_strategy("colwise")
+    y_auto = strat.build(mesh, combine="auto")(a, x)
+    y_def = strat.build(mesh)(a, x)
+    np.testing.assert_array_equal(np.asarray(y_auto), np.asarray(y_def))
+
+
+def test_combine_auto_dispatches_cached_winner(
+    devices, cache_path, operands, monkeypatch
+):
+    import matvec_mpi_multiplier_tpu.parallel.ring as ring
+
+    a, x = operands
+    mesh = make_mesh(8)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        combine_key("matvec", "colwise", 64, 64, 8, "float32"),
+        {"combine": "ring"},
+    )
+    cache.save()
+    reset_cache()
+    assert lookup_combine(
+        op="matvec", strategy="colwise", m=64, k=64, p=8, dtype="float32"
+    ) == "ring"
+
+    calls = []
+    real = ring.ring_psum_scatter
+
+    def spy(v, axes):
+        calls.append(axes)
+        return real(v, axes)
+
+    monkeypatch.setattr(ring, "ring_psum_scatter", spy)
+    y = get_strategy("colwise").build(mesh, combine="auto")(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-4)
+    assert calls, "cached 'ring' winner did not route through the ring"
+
+
+def test_combine_auto_invalid_winner_falls_back(
+    devices, cache_path, rng
+):
+    """A cached scatter-family winner for a shape whose rows don't divide
+    the mesh must fall back to the strategy default, not crash: the bound
+    candidate list is filtered against combine_candidates, and the default
+    (psum for plain colwise) is always valid where validate() passes."""
+    m, k = 60, 64  # 60 % 8 != 0: scatter family invalid, psum fine
+    a = rng.uniform(0, 10, (m, k)).astype(np.float32)
+    x = rng.uniform(0, 10, (k,)).astype(np.float32)
+    mesh = make_mesh(8)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        combine_key("matvec", "colwise", m, k, 8, "float32"),
+        {"combine": "definitely_not_a_schedule"},
+    )
+    cache.save()
+    reset_cache()
+    y = get_strategy("colwise").build(mesh, combine="auto")(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-4)
+
+
+def test_combine_constructor_auto(devices, cache_path, operands):
+    """get_strategy('colwise', combine='auto') defers like build(combine=)."""
+    a, x = operands
+    mesh = make_mesh(8)
+    y = get_strategy("colwise", combine="auto").build(mesh)(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-4)
+
+
+def test_combine_never_overrides_ungathered_output(devices, cache_path, rng):
+    """gather_output=False is a sharding contract: a gather-schedule combine
+    (explicit 'ring' or a cache-chosen one) must not replicate the output
+    the caller asked to keep sharded."""
+    from jax.sharding import PartitionSpec as P
+
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    mesh = make_mesh(8)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        combine_key("matvec", "rowwise", 64, 64, 8, "float32"),
+        {"combine": "ring"},
+    )
+    cache.save()
+    reset_cache()
+    for comb in ("ring", "auto"):
+        y = get_strategy("rowwise").build(
+            mesh, gather_output=False, combine=comb
+        )(a, x)
+        assert y.sharding.spec != P(), comb
+
+
+def test_supports_combine_predicate(devices):
+    assert get_strategy("rowwise").supports_combine("ring")
+    assert get_strategy("rowwise").supports_combine("auto")
+    assert not get_strategy("rowwise").supports_combine("psum_scatter")
+    assert get_strategy("colwise").supports_combine("a2a")
+    assert not get_strategy("colwise").supports_combine("gather")
+
+
+def test_combine_rejects_unknown_schedule(devices):
+    with pytest.raises(ValueError, match="combine"):
+        get_strategy("colwise", combine="nope")
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="combine schedule"):
+        get_strategy("rowwise").build(mesh, combine="a2a")
+
+
+# ------------------------------------------------------------- search
+
+
+def test_tune_gemv_records_fastest_candidate(cache_path, monkeypatch):
+    from matvec_mpi_multiplier_tpu.tuning import search
+
+    # Off-TPU the pallas ladder is gated out of the candidate list (interpret
+    # mode); force it in so the tile axis is part of what's being ranked.
+    monkeypatch.setenv("MATVEC_TUNE_PALLAS", "1")
+    cands = search.gemv_candidates(32, 128, "float32")
+    fast = search._candidate_label(cands[1])  # make the SECOND fastest
+
+    real_fn = search._candidate_gemv_fn
+
+    def tagged(cand):
+        fn = real_fn(cand)
+
+        def wrapper(*a, **kw):
+            return fn(*a, **kw)
+
+        wrapper.label = search._candidate_label(cand)
+        return wrapper
+
+    def fake_measure(fn, args, *, n_reps, samples):
+        label = getattr(fn, "label", None)
+        if label is None:
+            return 99.0  # the discarded cold-process warmup probe
+        return 1.0 if label == fast else 10.0
+
+    monkeypatch.setattr(search, "_candidate_gemv_fn", tagged)
+    monkeypatch.setattr(search, "_measure_fn", fake_measure)
+    cache = TuningCache.load(cache_path)
+    decision = search.tune_gemv(
+        32, 128, "float32", cache, log=lambda *_: None
+    )
+    assert decision is not None
+    for key, val in cands[1].items():
+        assert decision[key] == val
+    assert decision["time_s"] == 1.0
+    # Recorded under the right key, re-served without re-measuring.
+    assert cache.lookup(gemv_key(32, 128, "float32")) == decision
+    monkeypatch.setattr(
+        search, "_measure_fn",
+        lambda *a, **k: pytest.fail("cache hit must not re-measure"),
+    )
+    again = search.tune_gemv(32, 128, "float32", cache, log=lambda *_: None)
+    assert again == decision
+
+
+def test_pick_winner_hysteresis():
+    from matvec_mpi_multiplier_tpu.tuning.search import _pick_winner
+
+    # Within the margin the static default keeps the seat (noise guard)...
+    assert _pick_winner({"psum": 10.0, "ring": 9.8}, default="psum") == "psum"
+    # ...a real gain displaces it...
+    assert _pick_winner({"psum": 10.0, "ring": 9.0}, default="psum") == "ring"
+    # ...and an unmeasurable default can't block the only measured option.
+    assert _pick_winner({"ring": 5.0}, default="psum") == "ring"
+    assert _pick_winner({}, default="psum") is None
+
+
+def test_gemv_candidates_cover_ladder_and_tiers(monkeypatch):
+    monkeypatch.setenv("MATVEC_TUNE_PALLAS", "1")
+    from matvec_mpi_multiplier_tpu.ops.pallas_gemv import (
+        TILE_BYTE_BUDGET,
+        default_tiles,
+        tile_ladder,
+    )
+    from matvec_mpi_multiplier_tpu.tuning.search import gemv_candidates
+
+    cands = gemv_candidates(512, 4096, "float32")
+    assert cands[0] == {"kernel": "xla"}
+    pallas = [c for c in cands if c["kernel"] == "pallas"]
+    assert pallas, "pallas ladder missing"
+    ladder = tile_ladder(512, 4096, 4)
+    assert [(c["bm"], c["bk"]) for c in pallas] == ladder
+    # Ladder discipline: aligned divisors inside the byte budget, static
+    # default first.
+    assert ladder[0] == default_tiles(512, 4096, 4)
+    for bm, bk in ladder:
+        assert 512 % bm == 0 and 4096 % bk == 0
+        assert bm % 16 == 0 and bk % 128 == 0
+        assert bm * bk * 4 <= TILE_BYTE_BUDGET
+
+
+def test_local_gemv_shapes(devices):
+    from matvec_mpi_multiplier_tpu.tuning.search import local_gemv_shapes
+
+    mesh = make_mesh(8)
+    assert local_gemv_shapes("rowwise", 64, 48, mesh) == {(8, 48)}
+    assert local_gemv_shapes("colwise", 64, 48, mesh) == {(64, 6), (8, 6)}
+    assert local_gemv_shapes("rowwise", 60, 48, mesh) == set()
+
+
+def test_tune_combine_smoke(devices, cache_path):
+    """One real (tiny) combine tuning pass on the CPU mesh: records a valid
+    winner and every measured candidate, and the auto tier then serves it."""
+    from matvec_mpi_multiplier_tpu.tuning import search
+
+    mesh = make_mesh(2)
+    cache = TuningCache.load(cache_path)
+    decision = search.tune_combine(
+        "colwise", mesh, 16, 16, "float32", cache,
+        measure="sync", n_reps=2, samples=1, log=lambda *_: None,
+    )
+    assert decision is not None
+    assert decision["combine"] in (
+        "psum", "psum_scatter", "ring", "ring_overlap", "a2a"
+    )
+    assert set(decision["candidates"]) <= {
+        "psum", "psum_scatter", "ring", "ring_overlap", "a2a"
+    }
+    cache.save()
+    reset_cache()
+    assert lookup_combine(
+        op="matvec", strategy="colwise", m=16, k=16, p=2, dtype="float32"
+    ) == decision["combine"]
