@@ -21,6 +21,7 @@ from .models import (
     available_strategies,
     get_strategy,
 )
+from .engine import MatvecEngine
 from .models.gemm import available_gemm_strategies, build_gemm
 from .parallel.mesh import make_1d_mesh, make_mesh, mesh_grid_shape, most_square_factors
 from .utils import io
@@ -38,6 +39,7 @@ __all__ = [
     "available_strategies",
     "build_gemm",
     "available_gemm_strategies",
+    "MatvecEngine",
     "make_mesh",
     "make_1d_mesh",
     "mesh_grid_shape",

@@ -1,0 +1,449 @@
+"""Serve-throughput benchmark: the request-stream face of the suite.
+
+Where ``bench.sweep`` measures one (strategy, shape) matvec in isolation —
+the paper's protocol — this mode drives the serving engine (``engine/``)
+with a mixed-width stream of right-hand-side blocks against a resident
+sharded ``A`` and reports the numbers a serving system is judged on:
+
+* **requests/sec** and **columns/sec** over the steady phase;
+* **p50/p99 dispatch latency** — time from ``submit()`` entry to return,
+  i.e. the host cost of one request *excluding* device execution (dispatch
+  never host-syncs; the stream drains once at the end);
+* **compile counts** per phase — the zero-recompilation criterion: after
+  the warmup phase covers the bucket ladder, ``compiles_steady`` must be 0
+  across any mixed-shape replay;
+* the **GEMV→GEMM promotion check** — one engine-dispatched block of
+  ``b*`` columns vs ``b*`` sequential single-RHS dispatches, both through
+  the same engine under the same wall-clock protocol (the tuned crossover
+  must actually pay off in the serving loop, not just in the tuner).
+
+Rows land in ``data/out/serve_<strategy>.csv`` (``--data-root`` to
+redirect; the committed demo lives under ``data/engine_demo/``).
+
+Usage::
+
+    python -m matvec_mpi_multiplier_tpu.bench.serve \
+        --strategy rowwise colwise --sizes 1024 --platform cpu \
+        --host-devices 8 --tune
+
+    # or through the sweep driver:
+    python -m matvec_mpi_multiplier_tpu.bench.sweep --op serve ...
+
+This is timing/driver code: host syncs are deliberate protocol fences here
+(the engine's own dispatch path stays lint-enforced sync-free).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..engine import MatvecEngine, bucket_for, split_widths
+from ..models import available_strategies
+from ..utils.errors import MatvecError
+
+# Default request-width mix: single vectors through full buckets, with
+# off-bucket widths (3, 6, 12, 24) so the pad/unpad path is always
+# exercised. Clipped to --max-bucket.
+DEFAULT_WIDTH_MIX = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
+
+SERVE_CSV_HEADER = (
+    "n_rows, n_cols, n_devices, strategy, dtype, kernel, combine, "
+    "b_star, max_bucket, n_requests, total_cols, wall_s, rps, cols_per_s, "
+    "p50_dispatch_ms, p99_dispatch_ms, compiles_warmup, compiles_steady, "
+    "hits_steady, promo_b, promo_gemm_s, promo_seq_s, promo_speedup"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeResult:
+    """One serve-bench measurement (one CSV row)."""
+
+    n_rows: int
+    n_cols: int
+    n_devices: int
+    strategy: str
+    dtype: str
+    kernel: str
+    combine: str
+    b_star: int | None
+    max_bucket: int
+    n_requests: int
+    total_cols: int
+    wall_s: float
+    p50_dispatch_ms: float
+    p99_dispatch_ms: float
+    compiles_warmup: int
+    compiles_steady: int
+    hits_steady: int
+    # Promotion check: one b-wide GEMM dispatch vs b sequential single-RHS
+    # dispatches, per-request wall seconds (NaN when promotion is off).
+    promo_b: int
+    promo_gemm_s: float
+    promo_seq_s: float
+
+    @property
+    def rps(self) -> float:
+        return self.n_requests / self.wall_s
+
+    @property
+    def cols_per_s(self) -> float:
+        return self.total_cols / self.wall_s
+
+    @property
+    def promo_speedup(self) -> float:
+        """How many times faster the promoted block GEMM serves its batch
+        than sequential dispatch would (>1 = promotion pays)."""
+        if not (self.promo_gemm_s > 0):
+            return float("nan")
+        return self.promo_seq_s / self.promo_gemm_s
+
+
+def serve_csv_path(strategy: str, root=None):
+    from .metrics import out_dir
+
+    return out_dir(root) / f"serve_{strategy}.csv"
+
+
+def append_serve_result(result: ServeResult, root=None):
+    from ..parallel.distributed import is_main_process
+    from .metrics import _append_row
+
+    path = serve_csv_path(result.strategy, root)
+    if not is_main_process():
+        return path
+    row = (
+        f"{result.n_rows}, {result.n_cols}, {result.n_devices}, "
+        f"{result.strategy}, {result.dtype}, {result.kernel}, "
+        f"{result.combine}, "
+        f"{result.b_star if result.b_star is not None else -1}, "
+        f"{result.max_bucket}, {result.n_requests}, {result.total_cols}, "
+        f"{result.wall_s:.6f}, {result.rps:.2f}, {result.cols_per_s:.2f}, "
+        f"{result.p50_dispatch_ms:.4f}, {result.p99_dispatch_ms:.4f}, "
+        f"{result.compiles_warmup}, {result.compiles_steady}, "
+        f"{result.hits_steady}, {result.promo_b}, "
+        f"{result.promo_gemm_s:.6f}, {result.promo_seq_s:.6f}, "
+        f"{result.promo_speedup:.3f}"
+    )
+    _append_row(path, SERVE_CSV_HEADER, row)
+    return path
+
+
+def _request_pool(
+    k: int, widths: Sequence[int], dtype, seed: int
+) -> dict[int, np.ndarray]:
+    """One seeded host block per distinct width — generated once so the
+    timed loop measures dispatch, not numpy RNG."""
+    rng = np.random.default_rng(seed)
+    return {
+        w: rng.uniform(0, 10, (k, w)).astype(dtype) for w in set(widths)
+    }
+
+
+def _drain(futures) -> None:
+    """Protocol fence: materialize every outstanding result (timing code —
+    the one place the serve protocol host-syncs)."""
+    for fut in futures:
+        fut.result()
+
+
+def measure_promotion(
+    engine: MatvecEngine, pool: dict[int, np.ndarray], *, n_reps: int = 20
+) -> tuple[int, float, float]:
+    """One promoted block dispatch vs the same columns served one by one.
+
+    Both sides run through the SAME warm engine and the same wall-clock
+    protocol (submit everything, drain once), so the comparison isolates
+    exactly the promotion decision: one bucket-padded GEMM executable
+    versus ``b`` single-RHS executables. Returns per-request seconds
+    ``(b, t_gemm, t_seq)`` — or ``(0, nan, nan)`` when the engine has
+    promotion disabled: its block submits would take the per-column path
+    too, and recording that as a "promotion" row would pollute any
+    crossover analysis of the promo columns.
+    """
+    if engine.b_star is None:
+        return 0, float("nan"), float("nan")
+    b = max(2, min(engine.b_star, engine.max_bucket))
+    block = pool.get(b)
+    if block is None:
+        block = _request_pool(engine.k, [b], engine.dtype, seed=7)[b]
+    cols = [np.ascontiguousarray(block[:, j]) for j in range(b)]
+
+    # Warm both paths (compile + first-run costs out of the timed region).
+    _drain([engine.submit(block)])
+    _drain([engine.submit(c) for c in cols])
+
+    start = time.perf_counter()
+    futures = [engine.submit(block) for _ in range(n_reps)]
+    _drain(futures)
+    t_gemm = (time.perf_counter() - start) / n_reps
+
+    start = time.perf_counter()
+    futures = []
+    for _ in range(n_reps):
+        futures.extend(engine.submit(c) for c in cols)
+    _drain(futures)
+    t_seq = (time.perf_counter() - start) / n_reps
+    return b, t_gemm, t_seq
+
+
+def run_serve(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    *,
+    dtype: str = "float32",
+    kernel: str = "xla",
+    combine: str | None = None,
+    n_requests: int = 200,
+    max_bucket: int = 32,
+    widths: Sequence[int] | None = None,
+    promote: str | int | None = "auto",
+    donate: bool = True,
+    seed: int = 0,
+    promo_reps: int = 20,
+) -> ServeResult:
+    """Run the serve protocol for one (strategy, shape, mesh) config."""
+    from ..utils.io import generate_matrix
+
+    if widths is None:
+        widths = [w for w in DEFAULT_WIDTH_MIX if w <= max_bucket]
+    a = generate_matrix(m, k, seed=seed).astype(dtype)
+    engine = MatvecEngine(
+        a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
+        dtype=dtype, max_bucket=max_bucket, promote=promote, donate=donate,
+    )
+    pool = _request_pool(k, widths, engine.dtype, seed=seed + 1)
+
+    # ---- warmup: cover the executable set, then fence ----
+    engine.warmup(widths)
+    _drain([engine.submit(pool[w]) for w in sorted(set(widths))])
+    warm_stats = engine.stats
+    compiles_warmup = warm_stats.compiles
+
+    # ---- steady phase: mixed-width replay, drain once ----
+    rng = np.random.default_rng(seed + 2)
+    sequence = rng.choice(list(pool), size=n_requests)
+    latencies = np.empty(n_requests)
+    futures = []
+    start = time.perf_counter()
+    for i, w in enumerate(sequence):
+        t0 = time.perf_counter()
+        futures.append(engine.submit(pool[int(w)]))
+        latencies[i] = time.perf_counter() - t0
+    _drain(futures)
+    wall = time.perf_counter() - start
+
+    steady_stats = engine.stats
+    promo_b, promo_gemm, promo_seq = measure_promotion(
+        engine, pool, n_reps=promo_reps
+    )
+    return ServeResult(
+        n_rows=m,
+        n_cols=k,
+        n_devices=int(mesh.devices.size),
+        strategy=strategy_name,
+        dtype=str(engine.dtype),
+        kernel=kernel if isinstance(kernel, str) else "custom",
+        combine=combine or "default",
+        b_star=engine.b_star,
+        max_bucket=max_bucket,
+        n_requests=n_requests,
+        total_cols=int(sum(int(w) for w in sequence)),
+        wall_s=wall,
+        p50_dispatch_ms=float(np.percentile(latencies, 50) * 1e3),
+        p99_dispatch_ms=float(np.percentile(latencies, 99) * 1e3),
+        compiles_warmup=compiles_warmup,
+        compiles_steady=steady_stats.compiles - compiles_warmup,
+        hits_steady=steady_stats.hits - warm_stats.hits,
+        promo_b=promo_b,
+        promo_gemm_s=promo_gemm,
+        promo_seq_s=promo_seq,
+    )
+
+
+def tune_serve(
+    strategies: Sequence[str],
+    sizes: Sequence[tuple[int, int]],
+    meshes,
+    dtype: str,
+    *,
+    max_bucket: int = 32,
+    kernel: str = "xla",
+    measure: str = "auto",
+    min_gain: float | None = None,
+    seed: int = 0,
+    log=print,
+) -> None:
+    """Pre-pass for ``--tune``: populate every tuning-cache axis a serve
+    config consults — local kernels, combine schedules (matvec AND gemm,
+    engine construction reads both), and the promotion crossover ``b*``
+    over the bucket ladder."""
+    from ..engine.buckets import bucket_ladder
+    from ..tuning import TuningCache, reset_cache
+    from ..tuning.search import TUNE_MIN_GAIN, tune_config, tune_promotion
+
+    if min_gain is None:
+        min_gain = TUNE_MIN_GAIN
+    cache = TuningCache.load()
+    log(f"serve tuning pre-pass -> {cache.path}")
+    buckets = tuple(b for b in bucket_ladder(max_bucket) if b >= 2)
+    for m, k in sizes:
+        for mesh in meshes:
+            for name in strategies:
+                tune_config(
+                    name, mesh, m, k, dtype, cache, op="matvec",
+                    kernel=kernel, measure=measure, min_gain=min_gain,
+                    seed=seed, log=log,
+                )
+                tune_config(
+                    name, mesh, m, k, dtype, cache, op="gemm",
+                    n_rhs=max_bucket, kernel=kernel, measure=measure,
+                    min_gain=min_gain, seed=seed, log=log,
+                )
+                tune_promotion(
+                    name, mesh, m, k, dtype, cache, buckets=buckets,
+                    kernel=kernel, min_gain=min_gain, seed=seed, log=log,
+                )
+            cache.save()
+    cache.save()
+    reset_cache()  # serve engines must see the fresh decisions
+
+
+def run_serve_sweep(args: argparse.Namespace) -> int:
+    """The ``--op serve`` driver body shared by this module's CLI and
+    ``bench.sweep``."""
+    from ..parallel.mesh import make_mesh
+    from .sweep import (
+        SQUARE_SIZES,
+        configure_platform,
+        device_counts_available,
+        resolve_strategies,
+    )
+
+    configure_platform(args.platform, args.host_devices)
+    strategies = resolve_strategies(args.strategy, "matvec")
+    counts = args.devices or device_counts_available()
+    sizes = (
+        [(s, s) for s in args.sizes] if args.sizes
+        else [(s, s) for s in SQUARE_SIZES]
+    )
+    meshes = {n: make_mesh(n) for n in counts}
+    if getattr(args, "tune", False):
+        tune_serve(
+            strategies, sizes, [meshes[n] for n in counts], args.dtype,
+            max_bucket=args.max_bucket, kernel=args.kernel,
+            measure=getattr(args, "measure", "auto") or "auto",
+            min_gain=getattr(args, "min_gain", None), seed=args.seed,
+        )
+    promote = args.promote
+    if promote not in (None, "auto"):
+        promote = int(promote)
+    n_done = 0
+    for m, k in sizes:
+        for name in strategies:
+            for n_dev in counts:
+                mesh = meshes[n_dev]
+                try:
+                    result = run_serve(
+                        name, mesh, m, k, dtype=args.dtype,
+                        kernel=args.kernel, combine=args.combine,
+                        n_requests=args.n_requests,
+                        max_bucket=args.max_bucket, promote=promote,
+                        seed=args.seed,
+                    )
+                except MatvecError as e:
+                    print(f"skip {name} {m}x{k} p={n_dev}: {e}")
+                    continue
+                if not args.no_csv:
+                    path = append_serve_result(result, args.data_root)
+                else:
+                    path = None
+                print(
+                    f"serve {name} {m}x{k} p={n_dev} "
+                    f"b*={result.b_star} {result.rps:.1f} req/s "
+                    f"{result.cols_per_s:.1f} cols/s "
+                    f"p50={result.p50_dispatch_ms:.3f}ms "
+                    f"p99={result.p99_dispatch_ms:.3f}ms "
+                    f"compiles={result.compiles_warmup}+"
+                    f"{result.compiles_steady} "
+                    f"promo x{result.promo_speedup:.2f} @b={result.promo_b}"
+                )
+                if path is not None:
+                    print(f"CSV: {path}")
+                n_done += 1
+    print(f"{n_done} serve configs measured")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m matvec_mpi_multiplier_tpu.bench.serve",
+        description="Serve-throughput benchmark: mixed-width request "
+        "stream against a resident sharded A through the serving engine "
+        "(engine/).",
+    )
+    p.add_argument(
+        "--strategy", nargs="+", default=["all"],
+        help=f"strategies to serve: {available_strategies()} or 'all'",
+    )
+    p.add_argument("--devices", nargs="+", type=int, default=None)
+    p.add_argument("--sizes", nargs="+", type=int, default=None)
+    p.add_argument("--dtype", default="float32")
+    p.add_argument("--kernel", default="xla")
+    p.add_argument(
+        "--combine", default=None,
+        help="combine schedule (or 'auto' for the tuning-cache winner)",
+    )
+    p.add_argument(
+        "--n-requests", type=int, default=200,
+        help="steady-phase request count",
+    )
+    p.add_argument(
+        "--max-bucket", type=int, default=32,
+        help="widest batch bucket (power-of-two ladder below it)",
+    )
+    p.add_argument(
+        "--promote", default="auto",
+        help="GEMV->GEMM crossover b*: 'auto' (tuned), an int, or 'never'",
+    )
+    p.add_argument(
+        "--tune", action="store_true",
+        help="pre-pass: measure kernels, combines (matvec+gemm) and the "
+        "promotion crossover for every config, persisting to the tuning "
+        "cache",
+    )
+    p.add_argument(
+        "--min-gain", type=float, default=None,
+        help="with --tune: hysteresis margin (default 0.05; raise on "
+        "noisy shared hosts — see the sweep CLI's flag of the same name)",
+    )
+    p.add_argument(
+        "--measure", choices=["auto", "loop", "chain", "sync"],
+        default="auto",
+        help="with --tune: timing method for combine measurement "
+        "(bench/timing.py)",
+    )
+    p.add_argument("--data-root", default=None)
+    p.add_argument("--no-csv", action="store_true")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--host-devices", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.promote == "never":
+        args.promote = None
+    return run_serve_sweep(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

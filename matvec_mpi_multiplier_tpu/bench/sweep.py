@@ -85,11 +85,32 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--op",
-        choices=["matvec", "gemm"],
+        choices=["matvec", "gemm", "serve"],
         default="matvec",
-        help="operation to sweep: matvec (y = A·x, the reference's scope) or "
+        help="operation to sweep: matvec (y = A·x, the reference's scope), "
         "gemm (C = A @ B, the MXU-bound extension; rows land in "
-        "gemm_<strategy>.csv)",
+        "gemm_<strategy>.csv), or serve (mixed-width request stream "
+        "through the serving engine — requests/sec, p50/p99 dispatch "
+        "latency, compile counts; rows land in serve_<strategy>.csv — "
+        "bench/serve.py)",
+    )
+    p.add_argument(
+        "--n-requests",
+        type=int,
+        default=200,
+        help="with --op serve: steady-phase request count",
+    )
+    p.add_argument(
+        "--max-bucket",
+        type=int,
+        default=32,
+        help="with --op serve: widest batch bucket (power-of-two ladder)",
+    )
+    p.add_argument(
+        "--promote",
+        default="auto",
+        help="with --op serve: GEMV->GEMM crossover b* — 'auto' (tuned), "
+        "an int, or 'never'",
     )
     p.add_argument(
         "--n-rhs",
@@ -298,6 +319,14 @@ def configure_platform(platform: str | None, host_devices: int | None) -> None:
 
 
 def run_sweep(args: argparse.Namespace) -> int:
+    if args.op == "serve":
+        # The serve protocol has its own driver (warmup/steady phases,
+        # futures, promotion check) — bench/serve.py.
+        from .serve import run_serve_sweep
+
+        if args.promote == "never":
+            args.promote = None
+        return run_serve_sweep(args)
     if args.measure in ("chain", "loop") and args.mode in ("reference", "both"):
         # Reject up front: time_matvec raises the same ConfigError, but only
         # deep inside the loop, after earlier configs already burned minutes.
@@ -354,10 +383,12 @@ def run_sweep(args: argparse.Namespace) -> int:
     else:
         sizes = [(s, s) for s in SQUARE_SIZES] + list(ASYMMETRIC_SIZES)
     modes = list(TIMING_MODES) if args.mode == "both" else [args.mode]
-    if args.combine is not None and args.op == "gemm":
+    if args.op == "gemm" and args.combine == "gather":
+        # The reduction family transfers to gemm; the gather schedules are
+        # matvec-only (the batched output gather is XLA's to schedule).
         raise SystemExit(
-            "--combine is matvec-only: gemm strategies bind their combine "
-            "schedule by name (colwise_ring / colwise_a2a / ...)"
+            "--combine gather is matvec-only; gemm accepts "
+            "auto/psum/psum_scatter/ring/ring_overlap/a2a (see build_gemm)"
         )
 
     meshes = {n_dev: make_mesh(n_dev) for n_dev in counts}
@@ -474,8 +505,15 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
         a = x = None
         for name in strategies:
             strat = None if gemm else get_strategy(name)
-            if (strat is not None and args.combine is not None
-                    and not strat.supports_combine(args.combine)):
+            supports = True
+            if args.combine is not None:
+                if gemm:
+                    supports = get_strategy(name).supports_combine_batched(
+                        args.combine
+                    )
+                else:
+                    supports = strat.supports_combine(args.combine)
+            if not supports:
                 # e.g. --combine psum_scatter under --strategy all: rowwise
                 # has no such schedule. A skip, not a crash — the flag is
                 # meaningful for the strategies that do support it.
@@ -524,7 +562,7 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                         measure=args.measure,
                         kernel=args.kernel,
                     )
-                    if not gemm and args.combine is not None:
+                    if args.combine is not None:
                         bench_kwargs["combine"] = args.combine
                     if args.chain_samples is not None:
                         bench_kwargs["chain_samples"] = args.chain_samples

@@ -606,6 +606,7 @@ def benchmark_gemm(
     kernel: str | Callable = "xla",
     gather_output: bool = True,
     chain_samples: int = DEFAULT_CHAIN_SAMPLES,
+    combine: str | None = None,
 ) -> TimingResult:
     """Benchmark one GEMM (strategy, mesh, size) configuration.
 
@@ -613,13 +614,19 @@ def benchmark_gemm(
     side; the result's strategy is recorded as ``gemm_<name>`` so GEMM rows
     land in their own per-strategy CSVs (the reference schema has no op
     column to tell matvec and GEMM apart).
+
+    ``combine`` selects the combine schedule by name (``"auto"`` consults
+    the tuning cache under ``op="gemm"``) — see ``build_gemm``.
     """
     from ..models.gemm import build_gemm, gemm_shardings, validate_gemm
 
     measure = resolve_measure(mode, measure)
     a, b = _prepare_operands(a, b, dtype)
     validate_gemm(name, a.shape[0], a.shape[1], b.shape[1], mesh)
-    fn = build_gemm(name, mesh, kernel=kernel, gather_output=gather_output)
+    fn = build_gemm(
+        name, mesh, kernel=kernel, gather_output=gather_output,
+        combine=combine,
+    )
     return _run_benchmark(
         fn=fn, a=a, rhs=b, shardings=gemm_shardings(name, mesh), mesh=mesh,
         strategy_name=f"gemm_{name}", n_rhs=b.shape[1], n_reps=n_reps,

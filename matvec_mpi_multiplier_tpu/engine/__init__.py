@@ -1,0 +1,34 @@
+"""Serving engine: batched multi-RHS dispatch against a resident sharded A.
+
+The serving-shape subsystem (ROADMAP north star): where ``bench/`` measures
+one matvec at a time, this package serves a *stream* of right-hand sides —
+shape-bucketed, AOT-compiled, buffer-donating, GEMV→GEMM-promoting. See
+``core.py`` for the architecture, ``buckets.py`` for the shape ladder,
+``executables.py`` for the AOT cache, and the README's "Serving engine"
+section for usage. Benchmarked by ``bench/serve.py`` (``--op serve``).
+"""
+
+from .buckets import (
+    DEFAULT_MAX_BUCKET,
+    bucket_for,
+    bucket_ladder,
+    pad_columns,
+    split_widths,
+)
+from .core import DEFAULT_PROMOTE_B, EngineStats, MatvecEngine, MatvecFuture
+from .executables import ExecKey, ExecStats, ExecutableCache
+
+__all__ = [
+    "MatvecEngine",
+    "MatvecFuture",
+    "EngineStats",
+    "ExecutableCache",
+    "ExecKey",
+    "ExecStats",
+    "DEFAULT_MAX_BUCKET",
+    "DEFAULT_PROMOTE_B",
+    "bucket_ladder",
+    "bucket_for",
+    "split_widths",
+    "pad_columns",
+]

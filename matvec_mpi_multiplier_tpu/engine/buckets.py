@@ -1,0 +1,85 @@
+"""Bucket ladder: shape-canonicalization for the request stream.
+
+A serving workload presents right-hand-side blocks of arbitrary width; a
+compiled XLA executable serves exactly one shape. Left alone, a mixed-width
+stream would compile one program per distinct width — unbounded compile
+churn in the hot path (the GSPMD lesson, PAPERS.md: compile the sharded
+program once, reuse it across the request stream). The ladder quantizes
+widths to powers of two, so at most ``log2(max_bucket) + 1`` executables
+ever exist per (strategy, kernel, combine, dtype) and every request after
+warmup hits a cached one.
+
+Padding is host-side (the request is a host array on its way to the device
+anyway) and the pad columns are zeros; the matching unpad is a slice of the
+result columns at materialization time (``MatvecFuture.result``). Zero
+columns cannot perturb the real ones — each output element is a dot product
+over its own column only — so padded results are bitwise-identical to what
+the same executable computes with any other pad content.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.errors import ConfigError
+
+# Widest batch one executable serves (and the widest bucket the ladder
+# offers). Wider requests are split into max-bucket chunks — bounded VMEM
+# footprint per dispatch, and the chunks all hit the same hot executable.
+DEFAULT_MAX_BUCKET = 128
+
+
+def bucket_ladder(max_bucket: int = DEFAULT_MAX_BUCKET) -> tuple[int, ...]:
+    """The power-of-two bucket widths up to ``max_bucket`` inclusive
+    (``max_bucket`` itself is appended when it is not a power of two)."""
+    if max_bucket < 1:
+        raise ConfigError(f"max_bucket must be >= 1, got {max_bucket}")
+    ladder = []
+    b = 1
+    while b <= max_bucket:
+        ladder.append(b)
+        b *= 2
+    if ladder[-1] != max_bucket:
+        ladder.append(max_bucket)
+    return tuple(ladder)
+
+
+def bucket_for(width: int, max_bucket: int = DEFAULT_MAX_BUCKET) -> int:
+    """The bucket a request of ``width`` columns is padded to: the smallest
+    ladder entry >= width. Callers split requests wider than ``max_bucket``
+    into chunks first (``split_widths``)."""
+    if width < 1:
+        raise ConfigError(f"request width must be >= 1, got {width}")
+    if width > max_bucket:
+        raise ConfigError(
+            f"request width {width} exceeds max_bucket {max_bucket}; "
+            "split it first (split_widths)"
+        )
+    for b in bucket_ladder(max_bucket):
+        if b >= width:
+            return b
+    raise AssertionError("unreachable: ladder ends at max_bucket")
+
+
+def split_widths(width: int, max_bucket: int = DEFAULT_MAX_BUCKET) -> list[int]:
+    """Chunk widths for a request of ``width`` columns: full ``max_bucket``
+    chunks plus the remainder (which then pads to its own bucket)."""
+    if width < 1:
+        raise ConfigError(f"request width must be >= 1, got {width}")
+    chunks = [max_bucket] * (width // max_bucket)
+    if width % max_bucket:
+        chunks.append(width % max_bucket)
+    return chunks
+
+
+def pad_columns(block: np.ndarray, bucket: int) -> np.ndarray:
+    """Zero-pad a host (k, b) block to (k, bucket) columns (no-op copy-free
+    when already at bucket width)."""
+    k, b = block.shape
+    if b == bucket:
+        return block
+    if b > bucket:
+        raise ConfigError(f"block width {b} exceeds bucket {bucket}")
+    padded = np.zeros((k, bucket), dtype=block.dtype)
+    padded[:, :b] = block
+    return padded

@@ -1,0 +1,408 @@
+"""MatvecEngine: batched multi-RHS dispatch against a resident sharded A.
+
+The paper's benchmark shape is one ``y = A·x`` at a time; the serving shape
+(ROADMAP north star) is a *stream* of right-hand sides against a matrix
+that never moves. The engine holds ``A`` resident in its strategy sharding
+and serves requests through three mechanisms:
+
+* **shape buckets** (``buckets.py``) — request widths quantize to a
+  power-of-two ladder, so a mixed-width stream maps onto a bounded
+  executable set;
+* **AOT executable cache** (``executables.py``) — every (strategy × kernel
+  × combine × bucket × dtype) program is ``lower().compile()``d exactly
+  once, with the RHS buffer donated; after warmup the hot loop never
+  traces, never compiles, and never host-syncs;
+* **GEMV→GEMM promotion** — a batch of ``b ≥ b*`` right-hand sides rides
+  the strategy's sharded program as ONE block GEMM
+  (``MatvecStrategy.build_batched``; the MXU-bound formulation of "Large
+  Scale Distributed Linear Algebra With TPUs", PAPERS.md) instead of ``b``
+  GEMV dispatches; the crossover ``b*`` is the autotuner's fourth measured
+  axis (``tuning/search.py::tune_promotion``), consulted per (strategy,
+  shape, mesh, dtype) when ``promote="auto"``.
+
+``submit`` returns a :class:`MatvecFuture` immediately — dispatch is
+enqueue-only (JAX arrays are async by construction) and the host sync
+happens only when the caller materializes the result. The dispatch path is
+lint-enforced sync-free (``tests/test_lint.py``, ``scripts/tier1.sh``).
+
+Requests are HOST arrays (numpy): the engine owns host→device placement,
+including dtype normalization and bucket padding. Handing it a device
+array still works but the normalization copy becomes a device fetch —
+a caller-visible sync the serving contract does not make.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+from ..models import get_strategy
+from ..models.base import MatvecStrategy, mesh_size
+from ..utils.errors import ConfigError
+from .buckets import (
+    DEFAULT_MAX_BUCKET,
+    bucket_for,
+    bucket_ladder,
+    pad_columns,
+    split_widths,
+)
+from .executables import ExecKey, ExecStats, ExecutableCache
+
+# Static promotion default on a tuning-cache miss: one GEMM dispatch
+# replaces 4+ GEMV dispatches. Conservative on purpose — at b=4 the block
+# re-reads A once instead of 4 times, so even bandwidth-bound shapes win,
+# while b=2 can sit inside measurement noise on fast local backends.
+DEFAULT_PROMOTE_B = 4
+
+
+class MatvecFuture:
+    """Async handle to one request's result.
+
+    Holds the device arrays the dispatch produced (padded, when the GEMM
+    path ran) plus the real column counts; materialization slices the pad
+    columns away — the "masked-result unpad". ``result()`` host-syncs by
+    definition (that is what materializing means); everything up to it is
+    free of host round-trips.
+    """
+
+    def __init__(
+        self, parts: Sequence[tuple[jax.Array, int | None]], vector: bool
+    ):
+        # parts: (device_array, width) — width=None marks a rank-1 single
+        # column; an int marks a rank-2 block whose first `width` columns
+        # are real (the rest is bucket padding).
+        self._parts = list(parts)
+        self._vector = vector
+
+    def device_values(self) -> list[jax.Array]:
+        """The raw (still padded) device arrays — for callers chaining
+        device-side work without materializing."""
+        return [arr for arr, _ in self._parts]
+
+    def done(self) -> bool:
+        """True when every part's device computation has completed (never
+        blocks)."""
+        return all(
+            bool(arr.is_ready()) if hasattr(arr, "is_ready") else True
+            for arr, _ in self._parts
+        )
+
+    def result(self) -> np.ndarray:
+        """Materialize on host: ``(m,)`` for a vector request, ``(m, b)``
+        for a block request (pad columns sliced away)."""
+        if self._vector:
+            arr, _ = self._parts[0]
+            return np.asarray(arr)  # sync-ok: caller-requested materialization
+        cols = []
+        for arr, width in self._parts:
+            host = np.asarray(arr)  # sync-ok: caller-requested materialization
+            cols.append(host[:, None] if width is None else host[:, :width])
+        return cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
+
+
+class EngineStats(ExecStats):
+    """Executable-cache counters plus dispatch-level ones."""
+
+    def __init__(
+        self, compiles: int, hits: int, requests: int, dispatches: int,
+        cols: int,
+    ):
+        super().__init__(compiles=compiles, hits=hits)
+        self.requests = requests
+        self.dispatches = dispatches
+        self.cols = cols
+
+
+class MatvecEngine:
+    """Serve batches of right-hand sides against a resident sharded ``A``.
+
+    Parameters
+    ----------
+    a : host (m, k) array — placed once with the strategy's A-sharding.
+    mesh : target device mesh (default: all devices, ``make_mesh``).
+    strategy : strategy name or instance (``models``).
+    kernel : local kernel tier name (GEMV registry; the GEMM path maps it
+        through ``gemm_kernel_name_for``). ``"auto"`` consults the tuning
+        cache per local shape at trace time, as everywhere else.
+    combine : combine schedule name, ``"auto"`` (resolved ONCE at engine
+        construction from the tuning cache — per-dispatch resolution would
+        put a cache lookup in the hot loop), or None for the static
+        default.
+    dtype : operand dtype (default: ``a``'s).
+    max_bucket : widest bucket in the ladder; wider requests split.
+    promote : the GEMV→GEMM crossover ``b*``: ``"auto"`` (tuned decision,
+        static :data:`DEFAULT_PROMOTE_B` on a miss), an int (explicit),
+        or None (never promote — always the per-column path).
+    donate : donate the RHS buffer to each dispatch (HBM reuse; ignored by
+        backends that cannot donate, e.g. CPU).
+    gather_output : as in ``MatvecStrategy.build`` (bools only).
+    """
+
+    def __init__(
+        self,
+        a,
+        mesh=None,
+        *,
+        strategy: str | MatvecStrategy = "rowwise",
+        kernel: str | Callable = "xla",
+        combine: str | None = None,
+        dtype=None,
+        max_bucket: int = DEFAULT_MAX_BUCKET,
+        promote: str | int | None = "auto",
+        donate: bool = True,
+        gather_output: bool = True,
+    ):
+        if mesh is None:
+            from ..parallel.mesh import make_mesh
+
+            mesh = make_mesh(len(jax.devices()))
+        self.mesh = mesh
+        self.strategy = (
+            get_strategy(strategy) if isinstance(strategy, str) else strategy
+        )
+        a = np.asarray(a, dtype=dtype)  # sync-ok: one-time host staging of A
+        if a.ndim != 2:
+            raise ConfigError(f"A must be rank 2, got shape {a.shape}")
+        self.m, self.k = a.shape
+        self.dtype = a.dtype
+        self.strategy.validate(self.m, self.k, mesh)
+        if not isinstance(gather_output, bool):
+            raise ConfigError(
+                "engine gather_output must be True or False; got "
+                f"{gather_output!r}"
+            )
+        self.kernel = kernel
+        self.gather_output = gather_output
+        self.max_bucket = max_bucket
+        self._donate = (1,) if donate else ()
+        self._sh_a, self._sh_x = self.strategy.shardings(mesh)
+        _, self._sh_b = self.strategy.batched_shardings(mesh)
+        self._a = jax.device_put(a, self._sh_a)  # resident for engine life
+        self._matvec_combine, self._gemm_combine = self._resolve_combine(
+            combine
+        )
+        self.b_star = self._resolve_promotion(promote)
+        self._cache = ExecutableCache()
+        self._requests = 0
+        self._dispatches = 0
+        self._cols = 0
+
+    # ---- construction-time resolution ----
+
+    def _resolve_combine(
+        self, combine: str | None
+    ) -> tuple[str | None, str | None]:
+        """Pin the combine schedule for both paths at construction.
+
+        ``"auto"`` reads the tuning cache here, once — the engine's shapes
+        are fixed for its lifetime, so deferring to trace time (what
+        ``build(combine="auto")`` does) would only move a dict lookup into
+        the dispatch path. An explicit name binds the matvec path always,
+        and the batched path when the strategy has an in-body batched face
+        for it (the matvec-only ``"ring"`` output gather falls back to the
+        batched default: on that path the output gather is XLA's).
+        """
+        mesh = self.mesh
+        if combine not in (None, "auto") and not self.strategy.supports_combine(
+            combine
+        ):
+            # Fail at construction, not at first-dispatch compile: a serve
+            # loop must not discover a bad schedule name requests deep.
+            raise ConfigError(
+                f"strategy {self.strategy.name!r} has no combine schedule "
+                f"{combine!r}"
+            )
+        if combine == "auto":
+            from ..tuning import lookup_combine
+
+            common = dict(
+                strategy=self.strategy.name, m=self.m, k=self.k,
+                p=mesh_size(mesh), dtype=str(self.dtype),
+            )
+            mv = lookup_combine(op="matvec", **common)
+            if mv not in self.strategy.combine_candidates(mesh):
+                mv = None
+            gm = lookup_combine(op="gemm", **common)
+            if gm not in self.strategy.combine_candidates_batched(mesh):
+                gm = None
+            return mv, gm
+        if combine is None:
+            return None, None
+        batched_ok = combine in self.strategy.combine_candidates_batched(
+            mesh
+        )
+        return combine, (combine if batched_ok else None)
+
+    def _resolve_promotion(self, promote: str | int | None) -> int | None:
+        """The crossover ``b*``: requests of ``b >= b_star`` columns take
+        the single-GEMM path; below it, per-column GEMV dispatches. None
+        disables promotion entirely."""
+        if promote is None:
+            return None
+        if promote == "auto":
+            from ..tuning import lookup_promotion
+
+            decision = lookup_promotion(
+                strategy=self.strategy.name, m=self.m, k=self.k,
+                p=mesh_size(self.mesh), dtype=str(self.dtype),
+            )
+            if decision is None:
+                return DEFAULT_PROMOTE_B  # cache miss: static default
+            # Measured "promotion never won" is None here — honored, not
+            # treated as a miss.
+            return decision.get("b_star")
+        b_star = int(promote)
+        if b_star < 1:
+            raise ConfigError(f"promote must be >= 1, got {promote}")
+        return b_star
+
+    # ---- AOT builders ----
+
+    def _kernel_label(self) -> str:
+        return self.kernel if isinstance(self.kernel, str) else getattr(
+            self.kernel, "__name__", "custom"
+        )
+
+    def _matvec_key(self) -> ExecKey:
+        return ExecKey(
+            "matvec", self.strategy.name, self._kernel_label(),
+            self._matvec_combine, 1, str(self.dtype),
+        )
+
+    def _gemm_key(self, bucket: int) -> ExecKey:
+        return ExecKey(
+            "gemm", self.strategy.name, self._kernel_label(),
+            self._gemm_combine, bucket, str(self.dtype),
+        )
+
+    def _matvec_builder(self):
+        fn = self.strategy.build(
+            self.mesh, kernel=self.kernel,
+            gather_output=self.gather_output,
+            combine=self._matvec_combine,
+        )
+        structs = (
+            jax.ShapeDtypeStruct(
+                (self.m, self.k), self.dtype, sharding=self._sh_a
+            ),
+            jax.ShapeDtypeStruct((self.k,), self.dtype, sharding=self._sh_x),
+        )
+        return fn, structs, self._donate
+
+    def _gemm_builder(self, bucket: int):
+        def builder():
+            fn = self.strategy.build_batched(
+                self.mesh, kernel=self.kernel,
+                gather_output=self.gather_output,
+                combine=self._gemm_combine,
+            )
+            structs = (
+                jax.ShapeDtypeStruct(
+                    (self.m, self.k), self.dtype, sharding=self._sh_a
+                ),
+                jax.ShapeDtypeStruct(
+                    (self.k, bucket), self.dtype, sharding=self._sh_b
+                ),
+            )
+            return fn, structs, self._donate
+
+        return builder
+
+    # ---- dispatch (the hot path: enqueue-only, no host syncs) ----
+
+    def _dispatch_matvec(self, col: np.ndarray) -> jax.Array:
+        exe = self._cache.get(self._matvec_key(), self._matvec_builder)
+        self._dispatches += 1
+        return exe(self._a, jax.device_put(col, self._sh_x))
+
+    def _dispatch_gemm(self, padded: np.ndarray) -> jax.Array:
+        bucket = padded.shape[1]
+        exe = self._cache.get(self._gemm_key(bucket), self._gemm_builder(bucket))
+        self._dispatches += 1
+        return exe(self._a, jax.device_put(padded, self._sh_b))
+
+    def submit(self, x) -> MatvecFuture:
+        """Dispatch one request: a ``(k,)`` vector or a ``(k, b)`` block of
+        ``b`` right-hand sides (columns). Returns immediately; the result
+        future materializes (and unpads) on demand."""
+        x = np.asarray(x, dtype=self.dtype)  # sync-ok: requests are host arrays (see module docstring)
+        self._requests += 1
+        if x.ndim == 1:
+            if x.shape[0] != self.k:
+                raise ConfigError(
+                    f"request length {x.shape[0]} != A columns {self.k}"
+                )
+            self._cols += 1
+            return MatvecFuture(
+                [(self._dispatch_matvec(x), None)], vector=True
+            )
+        if x.ndim != 2 or x.shape[0] != self.k:
+            raise ConfigError(
+                f"request must be (k,) or (k, b) with k={self.k}; got "
+                f"shape {x.shape}"
+            )
+        b = x.shape[1]
+        if b == 0:
+            raise ConfigError("empty request (b=0)")
+        self._cols += b
+        parts: list[tuple[jax.Array, int | None]] = []
+        if self.b_star is not None and b >= self.b_star:
+            offset = 0
+            for width in split_widths(b, self.max_bucket):
+                chunk = x[:, offset:offset + width]
+                offset += width
+                padded = pad_columns(
+                    chunk, bucket_for(width, self.max_bucket)
+                )
+                parts.append((self._dispatch_gemm(padded), width))
+        else:
+            for j in range(b):
+                parts.append((self._dispatch_matvec(x[:, j]), None))
+        return MatvecFuture(parts, vector=False)
+
+    def __call__(self, x) -> np.ndarray:
+        """Synchronous convenience: ``submit(x).result()``."""
+        return self.submit(x).result()
+
+    # ---- warmup & introspection ----
+
+    def warmup(self, widths: Sequence[int] | None = None) -> int:
+        """Pre-compile the executable set a request stream will hit: the
+        single-RHS program plus (when promotion is on) every GEMM bucket —
+        by default the whole ladder (any split remainder can land on any
+        bucket), or exactly the buckets requests of ``widths`` columns
+        would dispatch to under :meth:`submit`'s routing (sub-``b*`` widths
+        take the per-column path, so they compile no GEMM bucket). Returns
+        the number of fresh compiles. After this, a stream confined to
+        those widths never compiles again — the serve bench's warm phase."""
+        before = self._cache.stats.compiles
+        self._cache.get(self._matvec_key(), self._matvec_builder)
+        if self.b_star is not None:
+            if widths is None:
+                buckets = set(bucket_ladder(self.max_bucket))
+            else:
+                buckets = set()
+                for w in widths:
+                    if w < self.b_star:
+                        continue  # submit() serves these per column
+                    for chunk in split_widths(w, self.max_bucket):
+                        buckets.add(bucket_for(chunk, self.max_bucket))
+            for bucket in sorted(buckets):
+                self._cache.get(
+                    self._gemm_key(bucket), self._gemm_builder(bucket)
+                )
+        return self._cache.stats.compiles - before
+
+    @property
+    def stats(self) -> EngineStats:
+        s = self._cache.stats
+        return EngineStats(
+            compiles=s.compiles, hits=s.hits, requests=self._requests,
+            dispatches=self._dispatches, cols=self._cols,
+        )
+
+    @property
+    def n_executables(self) -> int:
+        return len(self._cache)

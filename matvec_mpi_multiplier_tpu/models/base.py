@@ -66,6 +66,23 @@ class MatvecStrategy(abc.ABC):
         spec_a, spec_x, _ = self.specs(mesh)
         return NamedSharding(mesh, spec_a), NamedSharding(mesh, spec_x)
 
+    # ---- batched (multi-RHS) machinery ----
+
+    def batched_specs(self, mesh: Mesh) -> tuple[P, P, P]:
+        """PartitionSpecs for (A, B, C) of the batched ``C = A @ B`` — the
+        rank-2 extension of :meth:`specs`: A keeps its matvec sharding, the
+        RHS/output gain an unsharded trailing batch axis (each column of B
+        is one right-hand side, sharded exactly as x was)."""
+        spec_a, spec_x, spec_y = self.specs(mesh)
+        return spec_a, _append_batch_axis(spec_x), _append_batch_axis(spec_y)
+
+    def batched_shardings(
+        self, mesh: Mesh
+    ) -> tuple[NamedSharding, NamedSharding]:
+        """Device placements for (A, B) on the batched path."""
+        spec_a, spec_b, _ = self.batched_specs(mesh)
+        return NamedSharding(mesh, spec_a), NamedSharding(mesh, spec_b)
+
     # ---- combine-schedule machinery (the autotuner's third axis) ----
 
     def with_combine(self, combine: str):
@@ -89,12 +106,26 @@ class MatvecStrategy(abc.ABC):
         return "gather"
 
     def _build_combine(
-        self, mesh: Mesh, combine: str, **build_kwargs
+        self, mesh: Mesh, combine: str, *, batched: bool = False,
+        **build_kwargs
     ) -> Callable[[Array, Array], Array]:
-        """Build the concrete matvec for one resolved combine schedule."""
+        """Build the concrete matvec (or batched matmul) for one resolved
+        combine schedule."""
         bound = self.with_combine(combine)
         if bound is not None:
+            if batched:
+                return bound.build_batched(mesh, **build_kwargs)
             return bound.build(mesh, **build_kwargs)
+        if batched:
+            if combine != "gather":
+                # The gather-schedule pair only exists for the matvec path:
+                # ring_all_gather is rank-1 (parallel/ring.py), and the
+                # batched output gather is XLA's to schedule.
+                raise ValueError(
+                    f"strategy {self.name!r} has no batched combine "
+                    f"schedule {combine!r}"
+                )
+            return self._build_batched_plain(mesh, **build_kwargs)
         if combine == "ring":
             # Gather-schedule knob: only meaningful when the output is being
             # gathered. gather_output=False keeps the caller's sharded y —
@@ -119,23 +150,46 @@ class MatvecStrategy(abc.ABC):
             return False
         return bound is not None or combine in ("gather", "ring")
 
+    def supports_combine_batched(self, combine: str | None) -> bool:
+        """:meth:`supports_combine` for :meth:`build_batched`: the in-body
+        family only (the gather pair is matvec-only)."""
+        if combine in (None, "auto"):
+            return True
+        try:
+            return self.with_combine(combine) is not None
+        except ValueError:
+            return False
+
+    def combine_candidates_batched(self, mesh: Mesh) -> tuple[str, ...]:
+        """Combine schedules valid on the batched path: the in-body family
+        only (colwise); the base gather pair is matvec-only (see
+        :meth:`_build_combine`)."""
+        if self.with_combine(self.default_combine(mesh)) is None:
+            return ()
+        return self.combine_candidates(mesh)
+
     def _build_auto_combine(
-        self, mesh: Mesh, **build_kwargs
+        self, mesh: Mesh, *, batched: bool = False, **build_kwargs
     ) -> Callable[[Array, Array], Array]:
         """``combine="auto"``: consult the tuning cache per operand shape at
         trace time and dispatch to the measured winner, falling back to the
         static default on a miss. Each resolved schedule is built (and
-        compiled) lazily, at most once."""
+        compiled) lazily, at most once. The batched face keys its lookups
+        under ``op="gemm"`` — a matvec combine crossover need not hold for a
+        block of right-hand sides."""
         from ..tuning import lookup_combine
 
-        candidates = self.combine_candidates(mesh)
+        candidates = (
+            self.combine_candidates_batched(mesh) if batched
+            else self.combine_candidates(mesh)
+        )
         built: dict[str, Callable] = {}
 
         @jax.jit
         def matvec(a: Array, x: Array) -> Array:
             self.validate(a.shape[0], a.shape[1], mesh)
             choice = lookup_combine(
-                op="matvec",
+                op="gemm" if batched else "matvec",
                 strategy=self.name,
                 m=a.shape[0],
                 k=a.shape[1],
@@ -146,7 +200,7 @@ class MatvecStrategy(abc.ABC):
                 choice = self.default_combine(mesh)
             if choice not in built:
                 built[choice] = self._build_combine(
-                    mesh, choice, **build_kwargs
+                    mesh, choice, batched=batched, **build_kwargs
                 )
             return built[choice](a, x)
 
@@ -270,10 +324,100 @@ class MatvecStrategy(abc.ABC):
 
         return matvec
 
+    def build_batched(
+        self,
+        mesh: Mesh,
+        *,
+        kernel: str | Callable = "xla",
+        gather_output: bool = True,
+        check_vma: bool | None = None,
+        combine: str | None = None,
+    ) -> Callable[[Array, Array], Array]:
+        """Return jitted ``matmul(a, b) -> c`` for a BLOCK of right-hand
+        sides: ``b`` is ``(k, n_rhs)`` — one column per request — and the
+        whole block rides the strategy's sharded program as a single GEMM
+        (the MXU-bound promotion of n_rhs separate GEMVs; see
+        "Large Scale Distributed Linear Algebra With TPUs", PAPERS.md).
+
+        Reuses :meth:`specs` (rank-extended by :meth:`batched_specs`) and
+        :meth:`local_body` — the per-device collectives are rank-agnostic
+        (``parallel/ring.py``), so the matvec body serves unchanged with a
+        GEMM kernel from the rank-2 registry (``ops/gemm_kernels.py``).
+        ``kernel`` names a GEMM tier; GEMV-only tier names are mapped to
+        their rank-2 counterpart (``gemm_kernel_name_for``). ``combine``
+        follows :meth:`build` minus the matvec-only ``"ring"`` output
+        gather; ``combine="auto"`` consults the tuning cache under
+        ``op="gemm"``.
+        """
+        if combine is None:
+            combine = self.requested_combine
+        if combine == "auto":
+            return self._build_auto_combine(
+                mesh, batched=True, kernel=kernel,
+                gather_output=gather_output, check_vma=check_vma,
+            )
+        if combine is not None:
+            return self._build_combine(
+                mesh, combine, batched=True, kernel=kernel,
+                gather_output=gather_output, check_vma=check_vma,
+            )
+        return self._build_batched_plain(
+            mesh, kernel=kernel, gather_output=gather_output,
+            check_vma=check_vma,
+        )
+
+    def _build_batched_plain(
+        self,
+        mesh: Mesh,
+        *,
+        kernel: str | Callable = "xla",
+        gather_output: bool = True,
+        check_vma: bool | None = None,
+    ) -> Callable[[Array, Array], Array]:
+        """The concrete batched builder: :meth:`_build_plain` with the
+        rank-2 kernel registry and batch-extended specs."""
+        from ..ops.gemm_kernels import gemm_kernel_name_for, get_gemm_kernel
+
+        if not isinstance(gather_output, bool):
+            raise ValueError(
+                "batched gather_output must be True or False (the explicit "
+                f"ring gather is matvec-only); got {gather_output!r}"
+            )
+        if isinstance(kernel, str):
+            kernel = gemm_kernel_name_for(kernel)
+        kern = get_gemm_kernel(kernel)
+        spec_a, spec_b, spec_c = self.batched_specs(mesh)
+        if check_vma is None:
+            check_vma = not getattr(kern, "relax_vma_check", False)
+
+        body = self.local_body(mesh, kern)
+        mapped = shard_map(
+            body, mesh=mesh, in_specs=(spec_a, spec_b), out_specs=spec_c,
+            check_vma=check_vma,
+        )
+
+        @jax.jit
+        def matmul(a: Array, b: Array) -> Array:
+            self.validate(a.shape[0], a.shape[1], mesh)
+            c = mapped(a, b)
+            if gather_output:
+                c = jax.lax.with_sharding_constraint(c, NamedSharding(mesh, P()))
+            return c
+
+        return matmul
+
     def __call__(self, mesh: Mesh, a: Array, x: Array, **kwargs) -> Array:
         """Convenience one-shot: validate, build, run."""
         self.validate(a.shape[0], a.shape[1], mesh)
         return self.build(mesh, **kwargs)(a, x)
+
+
+def _append_batch_axis(spec: P) -> P:
+    """Extend a rank-1 spec with an unsharded trailing batch axis. ``P()``
+    (fully replicated) already covers any rank and stays as-is."""
+    if len(spec) == 0:
+        return spec
+    return P(*spec, None)
 
 
 def flat_axes(mesh: Mesh) -> tuple[str, ...]:

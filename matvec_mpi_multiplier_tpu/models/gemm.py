@@ -34,13 +34,10 @@ from __future__ import annotations
 
 from typing import Callable
 
-import jax
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.gemm_kernels import get_gemm_kernel
 from ..parallel.mesh import mesh_grid_shape
-from ..utils.compat import shard_map
 from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
 from ..utils.errors import ShardingError, check_divisible
 from .base import flat_axes, mesh_size
@@ -85,43 +82,6 @@ _GEMM_SPECS.update(
     # is one balanced all_to_all + local reduce instead of p-1 ring hops.
     colwise_a2a=_specs_colwise_ring,
 )
-
-
-def _ring_body(name: str, mesh: Mesh, kern: Callable) -> Callable:
-    """Combine via the explicit neighbor ring (parallel/ring.py) — the
-    long-context schedule applied to GEMM. ``colwise_ring`` computes the
-    full local partial then ring-reduce-scatters it; the ``_overlap``
-    variant moves the matmul into the ring (ring-SUMMA: each step's
-    (m/p, k/p) @ (k/p, n) tile overlaps the previous hop's ppermute)."""
-    from ..parallel.ring import ring_matmul, ring_psum_scatter
-
-    axes = flat_axes(mesh)
-    overlap = name.endswith("_overlap")
-
-    def body(a_blk: Array, b_blk: Array) -> Array:
-        if overlap:
-            c = ring_matmul(a_blk, b_blk, axes, kern)
-        else:
-            c = ring_psum_scatter(kern(a_blk, b_blk), axes)
-        return c.astype(a_blk.dtype)
-
-    return body
-
-
-def _a2a_body(mesh: Mesh, kern: Callable) -> Callable:
-    """Combine via one balanced all_to_all + local reduce (the Ulysses-style
-    face — parallel/ring.py::a2a_psum_scatter, the rank-agnostic helper
-    shared with the matvec ColwiseAllToAllStrategy), applied to GEMM: the
-    exchange delivers row-chunk j of each (m, n) partial C to device j."""
-    from ..parallel.ring import a2a_psum_scatter
-
-    axes = flat_axes(mesh)
-
-    def body(a_blk: Array, b_blk: Array) -> Array:
-        partial = kern(a_blk, b_blk)  # (m, n) accumulator dtype
-        return a2a_psum_scatter(partial, axes).astype(a_blk.dtype)
-
-    return body
 
 
 def available_gemm_strategies() -> list[str]:
@@ -175,47 +135,53 @@ def build_gemm(
     kernel: str | Callable = "xla",
     gather_output: bool = True,
     check_vma: bool | None = None,
+    combine: str | None = None,
 ) -> Callable[[Array, Array], Array]:
     """Return jitted ``matmul(a, b) -> c`` for one strategy on ``mesh``.
 
     ``kernel`` names a local-matmul tier from the GEMM kernel registry
-    (ops/gemm_kernels.py): ``"xla"`` (default) or ``"pallas"`` (the explicit
-    MXU tile, ops/pallas_gemm.py).
+    (ops/gemm_kernels.py): ``"xla"`` (default), ``"pallas"`` (the explicit
+    MXU tile, ops/pallas_gemm.py), or ``"native"`` when its .so is built.
+
+    ``combine`` selects the combine schedule by name instead of by registry
+    entry, exactly as ``MatvecStrategy.build`` does for matvec: for the
+    colwise family a reduction schedule (``"psum"`` / ``"psum_scatter"`` /
+    ``"ring"`` / ``"ring_overlap"`` / ``"a2a"``), and ``combine="auto"``
+    consults the tuning cache per operand shape under ``op="gemm"``
+    (static default on a miss). The registry names ``colwise_ring`` /
+    ``colwise_a2a`` / ... remain as thin bindings for CSV-label and CLI
+    compatibility.
+
+    Implementation: the matvec strategies' own ``build_batched``
+    (models/base.py) — the specs are rank-extended by ``batched_specs`` and
+    the shard_map bodies are rank-agnostic, so GEMM and matvec share one
+    compute/combine codepath per strategy.
     """
     if name not in _GEMM_SPECS:
         raise KeyError(
             f"unknown gemm strategy {name!r}; available: "
             f"{available_gemm_strategies()}"
         )
-    kern = get_gemm_kernel(kernel)
-    spec_a, spec_b, spec_c, reduce_axis = _GEMM_SPECS[name](mesh)
-    if check_vma is None:
-        # Same relaxation rule as MatvecStrategy.build (models/base.py):
-        # pallas interpret mode defeats the vma checker.
-        check_vma = not getattr(kern, "relax_vma_check", False)
+    from . import get_strategy
 
-    if name.startswith("colwise_ring"):
-        body = _ring_body(name, mesh, kern)
-    elif name == "colwise_a2a":
-        body = _a2a_body(mesh, kern)
-    else:
-        def body(a_blk: Array, b_blk: Array) -> Array:
-            partial = kern(a_blk, b_blk)
-            if reduce_axis is not None:
-                partial = jax.lax.psum(partial, reduce_axis)
-            return partial.astype(a_blk.dtype)
-
-    mapped = shard_map(
-        body, mesh=mesh, in_specs=(spec_a, spec_b), out_specs=spec_c,
-        check_vma=check_vma,
+    # The matvec registry carries the same six names with the same combine
+    # bindings (colwise_ring = ColwiseStrategy(combine="ring"), ...).
+    strat = get_strategy(name)
+    return strat.build_batched(
+        mesh, kernel=kernel, gather_output=gather_output,
+        check_vma=check_vma, combine=combine,
     )
 
-    @jax.jit
-    def matmul(a: Array, b: Array) -> Array:
-        validate_gemm(name, a.shape[0], a.shape[1], b.shape[1], mesh)
-        c = mapped(a, b)
-        if gather_output:
-            c = jax.lax.with_sharding_constraint(c, NamedSharding(mesh, P()))
-        return c
 
-    return matmul
+def gemm_combine_candidates(name: str, mesh: Mesh) -> tuple[str, ...]:
+    """Combine schedules the autotuner may measure for one GEMM strategy —
+    the in-body family only (``MatvecStrategy.combine_candidates_batched``);
+    empty for strategies whose combine is the output gather."""
+    from . import get_strategy
+
+    if name not in _GEMM_SPECS:
+        raise KeyError(
+            f"unknown gemm strategy {name!r}; available: "
+            f"{available_gemm_strategies()}"
+        )
+    return get_strategy(name).combine_candidates_batched(mesh)

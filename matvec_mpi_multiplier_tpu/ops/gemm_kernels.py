@@ -34,7 +34,9 @@ def matmul_xla(a: Array, b: Array) -> Array:
 def matmul_auto(a: Array, b: Array) -> Array:
     """Measured-selection tier for GEMM — the rank-2 face of
     ``ops.gemv.gemv_auto``: tuning-cache lookup on the local
-    (m, k, n, dtype), static XLA default on a miss or unregistered winner."""
+    (m, k, n, dtype), static XLA default on a miss or unregistered winner.
+    A pallas winner carries its measured (bm, bn, bk) tile sizes — the GEMM
+    tile ladder axis (``tuning/search.py::gemm_candidates``)."""
     from ..tuning import lookup_gemm
 
     decision = lookup_gemm(
@@ -42,7 +44,15 @@ def matmul_auto(a: Array, b: Array) -> Array:
     )
     if decision is None:
         return matmul_xla(a, b)
-    fn = _GEMM_KERNELS.get(decision.get("kernel"))
+    kernel = decision.get("kernel")
+    if kernel == "pallas":
+        from .pallas_gemm import matmul_pallas
+
+        return matmul_pallas(
+            a, b, bm=decision.get("bm"), bn=decision.get("bn"),
+            bk=decision.get("bk"),
+        )
+    fn = _GEMM_KERNELS.get(kernel)
     if fn is None or fn is matmul_auto:
         return matmul_xla(a, b)
     return fn(a, b)
@@ -60,6 +70,33 @@ _GEMM_KERNELS: dict[str, GemmKernel] = {
 
 def register_gemm_kernel(name: str, fn: GemmKernel) -> None:
     _GEMM_KERNELS[name] = fn
+
+
+# GEMV tier names with no literal GEMM registry entry, mapped to the tier
+# that implements the same choice for a rank-2 right-hand side. This is the
+# multi-RHS entry-point contract: any kernel name valid for a matvec build
+# is valid for the batched build of the same strategy.
+_GEMV_NAME_ALIASES = {
+    # The explicit scale-then-sum formulation has no rank-2 face; its GEMM
+    # promotion IS the plain matmul.
+    "xla_colwise": "xla",
+}
+
+
+def gemm_kernel_name_for(name: str) -> str:
+    """Resolve a (possibly GEMV-tier) kernel name to the GEMM registry name
+    implementing it for a rank-2 right-hand side. A registered GEMV tier
+    with no GEMM counterpart here (e.g. ``native`` tuned where only the
+    GEMV .so was built) falls back to ``xla`` — same doctrine as the
+    ``auto`` tiers: a batched promotion must never be *less* available than
+    the matvec path it replaces. Names unknown to BOTH registries pass
+    through so :func:`get_gemm_kernel` raises its usual KeyError."""
+    name = _GEMV_NAME_ALIASES.get(name, name)
+    if name in _GEMM_KERNELS:
+        return name
+    from .gemv import available_kernels
+
+    return "xla" if name in available_kernels() else name
 
 
 def get_gemm_kernel(name: str | Callable) -> GemmKernel:

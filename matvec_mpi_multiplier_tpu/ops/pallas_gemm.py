@@ -83,24 +83,108 @@ def _pallas_matmul(
     )(a, b)
 
 
-def matmul_pallas(a: Array, b: Array) -> Array:
-    """Pallas tiled matmul with automatic tile-size selection.
-
-    Shapes without aligned tiles fall back to the XLA kernel — the contract
-    is the registry's ``matmul(a, b) -> c``, not a shape restriction.
-    """
-    m, k = a.shape
-    _, n = b.shape
+def default_gemm_tiles(
+    m: int, n: int, k: int, itemsize: int
+) -> tuple[int, int, int] | None:
+    """The static default (bm, bn, bk) choice: largest aligned tiles under
+    the VMEM byte budget — the pre-autotuner heuristic, and the fallback the
+    ``auto`` tier keeps on a tuning-cache miss. None when the shape admits
+    no aligned tiling (the kernel then falls back to XLA)."""
     bm = _largest_divisor_leq(m, DEFAULT_BM, 16)
     bn = _largest_divisor_leq(n, DEFAULT_BN, 128)
     if bm is None or bn is None:
-        return matmul_xla(a, b)
-    itemsize = jnp.dtype(a.dtype).itemsize
+        return None
     bk_cap = min(DEFAULT_BK, TILE_BYTE_BUDGET // (max(bm, bn) * itemsize))
     bk = _largest_divisor_leq(k, bk_cap, 128)
     if bk is None:
+        return None
+    return bm, bn, bk
+
+
+def gemm_tile_ladder(
+    m: int, n: int, k: int, itemsize: int
+) -> list[tuple[int, int, int]]:
+    """Candidate (bm, bn, bk) triples for the autotuner — the GEMM face of
+    ``pallas_gemv.tile_ladder``: the bm and bn halving ladders crossed with
+    the bk halving ladder, keeping only aligned divisors of the shape whose
+    per-operand tile fits the VMEM byte budget. Ordered so the static
+    default (``default_gemm_tiles``) is always the first entry when it
+    exists. The cross product is pruned to the halving walk (each axis at
+    most ~log2 candidates) so a --tune pass stays tractable."""
+    ladder: list[tuple[int, int, int]] = []
+    bm_cap = DEFAULT_BM
+    while bm_cap >= 16:
+        bm = _largest_divisor_leq(m, bm_cap, 16)
+        if bm is None:
+            bm_cap //= 2
+            continue
+        bn_cap = DEFAULT_BN
+        while bn_cap >= 128:
+            bn = _largest_divisor_leq(n, bn_cap, 128)
+            if bn is None:
+                bn_cap //= 2
+                continue
+            bk_cap = min(
+                DEFAULT_BK, TILE_BYTE_BUDGET // (max(bm, bn) * itemsize)
+            )
+            while bk_cap >= 128:
+                bk = _largest_divisor_leq(k, bk_cap, 128)
+                if bk is not None and (bm, bn, bk) not in ladder:
+                    ladder.append((bm, bn, bk))
+                    bk_cap = bk // 2
+                else:
+                    bk_cap //= 2
+            bn_cap = bn // 2
+        bm_cap = bm // 2
+    return ladder
+
+
+def matmul_pallas(
+    a: Array,
+    b: Array,
+    *,
+    bm: int | None = None,
+    bn: int | None = None,
+    bk: int | None = None,
+) -> Array:
+    """Pallas tiled matmul with automatic tile-size selection.
+
+    ``bm``/``bn``/``bk`` override the tile sizes (the autotuner's measured
+    winners ride in through here — same contract as ``gemv_pallas``);
+    overrides that don't evenly tile the shape are ignored in favor of the
+    static default. Shapes without aligned tiles fall back to the XLA
+    kernel — the contract is the registry's ``matmul(a, b) -> c``, not a
+    shape restriction.
+    """
+    m, k = a.shape
+    _, n = b.shape
+    tiles = None
+    if bm is not None and bn is not None and bk is not None:
+        if (
+            m % bm == 0 and n % bn == 0 and k % bk == 0
+            and bm % 16 == 0 and bn % 128 == 0 and bk % 128 == 0
+        ):
+            tiles = (bm, bn, bk)
+    if tiles is None:
+        tiles = default_gemm_tiles(m, n, k, jnp.dtype(a.dtype).itemsize)
+    if tiles is None:
         return matmul_xla(a, b)
-    return _pallas_matmul(a, b, bm=bm, bn=bn, bk=bk, interpret=not _on_tpu())
+    return _pallas_matmul(
+        a, b, bm=tiles[0], bn=tiles[1], bk=tiles[2], interpret=not _on_tpu()
+    )
+
+
+def make_pallas_gemm(bm: int, bn: int, bk: int):
+    """A registry-shaped kernel pinned to one (bm, bn, bk) tile choice —
+    the form the autotuner measures GEMM tile candidates through, and the
+    form the ``auto`` tier dispatches to on a cache hit (the GEMM face of
+    ``pallas_gemv.make_pallas_gemv``)."""
+
+    def kern(a: Array, b: Array) -> Array:
+        return matmul_pallas(a, b, bm=bm, bn=bn, bk=bk)
+
+    kern.relax_vma_check = True  # type: ignore[attr-defined]
+    return kern
 
 
 # Same shard_map vma-check relaxation as the pallas GEMV (models/base.py).

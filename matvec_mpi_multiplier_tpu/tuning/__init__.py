@@ -29,27 +29,32 @@ from .cache import (
     CACHE_ENV,
     CACHE_VERSION,
     TuningCache,
+    broadcast_decisions,
     combine_key,
     default_cache_path,
     gemm_key,
     gemv_key,
     platform_fingerprint,
+    promote_key,
 )
 
 __all__ = [
     "CACHE_ENV",
     "CACHE_VERSION",
     "TuningCache",
+    "broadcast_decisions",
     "combine_key",
     "default_cache_path",
     "gemm_key",
     "gemv_key",
     "platform_fingerprint",
+    "promote_key",
     "get_cache",
     "reset_cache",
     "lookup_gemv",
     "lookup_gemm",
     "lookup_combine",
+    "lookup_promotion",
 ]
 
 # The dispatch-side singleton: loaded lazily on first lookup so importing
@@ -59,10 +64,30 @@ _cache: TuningCache | None = None
 
 
 def get_cache() -> TuningCache:
+    """The dispatch-side singleton view of the cache file.
+
+    Multi-host: only the coordinator (process 0) reads the file; its
+    decision table is broadcast to every process
+    (``cache.broadcast_decisions``) so all processes dispatch the identical
+    schedules — divergent per-process reads of a shared (or stale) cache
+    file could otherwise deadlock a sharded program in its first
+    collective. Single-process (the common case): plain file read.
+    """
     global _cache
+    import jax
+
     path = default_cache_path()
     if _cache is None or _cache.path != path:
-        _cache = TuningCache.load(path)
+        if jax.process_count() > 1:
+            from ..parallel.distributed import is_main_process
+
+            loaded = (
+                TuningCache.load(path) if is_main_process()
+                else TuningCache(path)
+            )
+            _cache = broadcast_decisions(loaded)
+        else:
+            _cache = TuningCache.load(path)
     return _cache
 
 
@@ -93,3 +118,14 @@ def lookup_combine(
     if decision is None:
         return None
     return decision.get("combine")
+
+
+def lookup_promotion(
+    *, strategy: str, m: int, k: int, p: int, dtype: str
+) -> dict[str, Any] | None:
+    """The recorded GEMV→GEMM batch-promotion decision for this (GLOBAL
+    shape, mesh size), or None — the serving engine's question
+    (``engine/core.py``). The decision's ``b_star`` is the smallest batch
+    width at which one sharded GEMM measured faster than ``b`` sequential
+    single-RHS dispatches (null when promotion never won)."""
+    return get_cache().lookup(promote_key(strategy, m, k, p, dtype))

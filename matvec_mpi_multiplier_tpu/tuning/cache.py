@@ -11,23 +11,34 @@ harmless on another (its entries simply never match, so dispatch falls back
 to the static defaults and a ``--tune`` run re-measures), and a single file
 can carry tunings for several platforms side by side.
 
-Schema (version 1)::
+Schema (version 2)::
 
     {
-      "version": 1,
+      "version": 2,
       "entries": {
         "<fingerprint>|gemv|<m>x<k>|<dtype>":
             {"kernel": "pallas", "bm": 512, "bk": 2048,
              "time_s": 1.2e-4, "candidates": {"xla": 1.5e-4, ...}},
-        "<fingerprint>|combine|matvec|<strategy>|<m>x<k>|p<p>|<dtype>":
-            {"combine": "psum_scatter", "time_s": ..., "candidates": {...}}
+        "<fingerprint>|gemm|<m>x<k>x<n>|<dtype>":
+            {"kernel": "pallas", "bm": 512, "bn": 512, "bk": 1024, ...},
+        "<fingerprint>|combine|<op>|<strategy>|<m>x<k>|p<p>|<dtype>":
+            {"combine": "psum_scatter", "time_s": ..., "candidates": {...}},
+        "<fingerprint>|promote|<strategy>|<m>x<k>|p<p>|<dtype>":
+            {"b_star": 4, "seq_time_s": ..., "gemm_times": {"4": ...}}
       }
     }
 
-``gemv`` keys use the LOCAL (per-device) shape — the granularity the kernel
-registry's ``auto`` tier dispatches on under shard_map; ``combine`` keys use
-the GLOBAL shape plus the mesh size. A file with an unknown ``version`` is
-ignored wholesale (treated as empty) rather than half-parsed.
+Version 2 over 1: GEMM decisions carry measured (bm, bn, bk) tile sizes,
+``combine`` keys exist for ``op="gemm"`` as well as ``"matvec"``, and the
+``promote`` kind records the GEMV→GEMM batch-promotion crossover ``b*``
+(the serving engine's fourth tuned axis — ``engine/``). Version-1 files are
+forward-compatible (their entries are a strict subset) and load as-is; a
+file with any other ``version`` is ignored wholesale (treated as empty)
+rather than half-parsed.
+
+``gemv``/``gemm`` keys use the LOCAL (per-device) shape — the granularity
+the kernel registry's ``auto`` tier dispatches on under shard_map;
+``combine`` and ``promote`` keys use the GLOBAL shape plus the mesh size.
 """
 
 from __future__ import annotations
@@ -38,7 +49,11 @@ import tempfile
 from pathlib import Path
 from typing import Any
 
-CACHE_VERSION = 1
+CACHE_VERSION = 2
+# Versions load() accepts: v1 entries are a strict subset of v2's (no
+# promote kind, no gemm tile fields), so an old cache keeps serving its
+# decisions after the upgrade instead of forcing a silent full re-tune.
+COMPATIBLE_VERSIONS = (1, CACHE_VERSION)
 CACHE_ENV = "MATVEC_TUNING_CACHE"
 CACHE_FILENAME = "tuning_cache.json"
 
@@ -101,6 +116,20 @@ def combine_key(
     return f"{fp}|combine|{op}|{strategy}|{m}x{k}|p{p}|{dtype}"
 
 
+def promote_key(
+    strategy: str,
+    m: int,
+    k: int,
+    p: int,
+    dtype: str,
+    fingerprint: str | None = None,
+) -> str:
+    """Key for a GEMV→GEMM batch-promotion crossover decision (GLOBAL shape
+    + mesh size — the serving engine's fourth tuned axis)."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|promote|{strategy}|{m}x{k}|p{p}|{dtype}"
+
+
 class TuningCache:
     """In-memory view of the JSON cache file, with atomic persistence."""
 
@@ -120,7 +149,7 @@ class TuningCache:
             return cache
         if (
             not isinstance(raw, dict)
-            or raw.get("version") != CACHE_VERSION
+            or raw.get("version") not in COMPATIBLE_VERSIONS
             or not isinstance(raw.get("entries"), dict)
         ):
             return cache
@@ -141,7 +170,16 @@ class TuningCache:
     def save(self) -> Path:
         """Atomically persist (write-to-temp + rename): a sweep killed
         mid-save must never leave a truncated JSON behind — load() would
-        silently treat it as empty and a long tuning run would be lost."""
+        silently treat it as empty and a long tuning run would be lost.
+
+        Multi-host: only the coordinator writes — on a shared filesystem p
+        processes renaming over the same path would race, and the
+        decisions are identical on every process anyway (measurement is
+        max-reduced across processes, bench/timing.py)."""
+        from ..parallel.distributed import is_main_process
+
+        if not is_main_process():
+            return self.path
         self.path.parent.mkdir(parents=True, exist_ok=True)
         payload = {"version": CACHE_VERSION, "entries": self.entries}
         fd, tmp = tempfile.mkstemp(
@@ -159,3 +197,41 @@ class TuningCache:
 
     def __len__(self) -> int:
         return len(self.entries)
+
+
+def broadcast_decisions(cache: TuningCache) -> TuningCache:
+    """Replace every process's entries with the coordinator's (process 0).
+
+    Multi-host doctrine (ROADMAP): the cache file is per-process-singleton
+    state, and letting each process re-read its own copy invites divergent
+    decisions — p processes dispatching *different* combine schedules of the
+    same sharded program would deadlock in the first collective. Only the
+    coordinator reads the file (see ``tuning.get_cache``); its entries are
+    serialized and broadcast through the device runtime
+    (``multihost_utils.broadcast_one_to_all``), so every process dispatches
+    from the identical decision table.
+
+    Single-process runs return ``cache`` untouched (no device traffic).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return cache
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = b"{}"
+    if jax.process_index() == 0:
+        payload = json.dumps(cache.entries).encode()
+    # Two-step broadcast: lengths first (broadcast needs equal shapes on
+    # every process), then the padded byte payload.
+    n = int(multihost_utils.broadcast_one_to_all(np.int64(len(payload))))
+    buf = np.zeros(n, np.uint8)
+    if jax.process_index() == 0:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    data = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    entries = json.loads(bytes(data).decode())
+    cache.entries = {
+        str(k): v for k, v in entries.items() if isinstance(v, dict)
+    }
+    return cache

@@ -26,11 +26,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..bench.timing import benchmark_strategy, time_fn_looped
+from ..bench.timing import benchmark_gemm, benchmark_strategy, time_fn_looped
 from ..models import get_strategy
 from ..parallel.mesh import mesh_grid_shape
 from ..utils.errors import MatvecError, TimingError
-from .cache import TuningCache, combine_key, gemm_key, gemv_key
+from .cache import TuningCache, combine_key, gemm_key, gemv_key, promote_key
 
 # Tuning measures many candidates per config; the full 100-rep protocol
 # would make a --tune pre-pass cost more than the sweep it feeds. The slope
@@ -190,23 +190,45 @@ def tune_gemv(
     return best
 
 
-def gemm_candidates(dtype: str) -> list[dict[str, Any]]:
-    """Perf-tier GEMM candidates. Same pallas gating as
-    :func:`gemv_candidates` (interpret mode off-TPU can never win and would
-    dominate the tune pass), and the accuracy tiers (ozaki*, compensated)
-    are excluded outright — they trade speed for precision by design, so
-    measuring them buys nothing a perf tuner can record."""
+def gemm_candidates(
+    m: int, k: int, n: int, dtype: str
+) -> list[dict[str, Any]]:
+    """Perf-tier GEMM candidates for one local (m, k, n, dtype): every
+    registered tier, with the pallas tier expanded over its (bm, bn, bk)
+    tile ladder — the GEMM face of :func:`gemv_candidates`. Same pallas
+    gating (interpret mode off-TPU can never win and would dominate the
+    tune pass), and the accuracy tiers (ozaki*, compensated) are excluded
+    outright — they trade speed for precision by design, so measuring them
+    buys nothing a perf tuner can record."""
     import os
 
     from ..ops.gemm_kernels import available_gemm_kernels
+    from ..ops.pallas_gemm import gemm_tile_ladder
     from ..ops.pallas_gemv import _on_tpu
 
     cands: list[dict[str, Any]] = [{"kernel": "xla"}]
     if _on_tpu() or os.environ.get("MATVEC_TUNE_PALLAS") == "1":
-        cands.append({"kernel": "pallas"})
+        itemsize = jnp.dtype(dtype).itemsize
+        for bm, bn, bk in gemm_tile_ladder(m, n, k, itemsize):
+            cands.append({"kernel": "pallas", "bm": bm, "bn": bn, "bk": bk})
     if "native" in available_gemm_kernels():
         cands.append({"kernel": "native"})
     return cands
+
+
+def _gemm_candidate_label(cand: dict[str, Any]) -> str:
+    if cand["kernel"] == "pallas" and "bm" in cand:
+        return f"pallas[{cand['bm']}x{cand['bn']}x{cand['bk']}]"
+    return cand["kernel"]
+
+
+def _candidate_gemm_fn(cand: dict[str, Any]) -> Callable:
+    from ..ops.gemm_kernels import get_gemm_kernel
+    from ..ops.pallas_gemm import make_pallas_gemm
+
+    if cand["kernel"] == "pallas" and "bm" in cand:
+        return make_pallas_gemm(cand["bm"], cand["bn"], cand["bk"])
+    return get_gemm_kernel(cand["kernel"])
 
 
 def tune_gemm(
@@ -223,10 +245,9 @@ def tune_gemm(
     min_gain: float = TUNE_MIN_GAIN,
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
-    """GEMM face of :func:`tune_gemv` (kernel tier axis only — the pallas
-    GEMM tile ladder is a ROADMAP follow-on)."""
-    from ..ops.gemm_kernels import get_gemm_kernel
-
+    """GEMM face of :func:`tune_gemv`: measure the kernel/tile candidates —
+    the pallas tier expanded over its (bm, bn, bk) ladder — for one LOCAL
+    (m, k, n, dtype) on one device and record the winner."""
     key = gemm_key(m, k, n, dtype)
     existing = cache.lookup(key)
     if existing is not None and not force:
@@ -234,22 +255,42 @@ def tune_gemm(
     rng = np.random.default_rng(seed)
     a = jnp.asarray(rng.uniform(0, 10, (m, k)), dtype=dtype)
     b = jnp.asarray(rng.uniform(0, 10, (k, n)), dtype=dtype)
+    cands = gemm_candidates(m, k, n, dtype)
+    # Discarded cold-process warmup (same rationale as tune_gemv).
+    _measure_fn(
+        _candidate_gemm_fn(cands[0]), (a, b), n_reps=max(1, n_reps // 4),
+        samples=1,
+    )
     measured: dict[str, float] = {}
-    for cand in gemm_candidates(dtype):
-        label = cand["kernel"]
+    by_label: dict[str, dict[str, Any]] = {}
+    for cand in cands:
+        label = _gemm_candidate_label(cand)
         t = _measure_fn(
-            get_gemm_kernel(label), (a, b), n_reps=n_reps, samples=samples
+            _candidate_gemm_fn(cand), (a, b), n_reps=n_reps, samples=samples
         )
         if t is None:
             log(f"  gemm {m}x{k}x{n} {dtype} {label}: unmeasurable")
             continue
         measured[label] = t
+        by_label[label] = cand
         log(f"  gemm {m}x{k}x{n} {dtype} {label}: {t * 1e6:.1f} us")
     winner = _pick_winner(measured, default="xla", min_gain=min_gain)
     if winner is None:
         return None
-    best = {"kernel": winner, "time_s": measured[winner],
-            "candidates": measured}
+    if winner != "xla" and "xla" in measured:
+        # Confirmation pass (same rationale as tune_gemv): the default is
+        # measured first and can absorb cold-process ramp; re-measure the
+        # contending pair adjacent and fully warm before deciding.
+        for label in ("xla", winner):
+            t = _measure_fn(
+                _candidate_gemm_fn(by_label[label]), (a, b),
+                n_reps=n_reps, samples=samples,
+            )
+            if t is not None:
+                measured[label] = t
+        winner = _pick_winner(measured, default="xla", min_gain=min_gain)
+        log(f"  gemm {m}x{k}x{n} {dtype} confirm -> {winner}")
+    best = dict(by_label[winner], time_s=measured[winner], candidates=measured)
     cache.record(key, best)
     return best
 
@@ -368,6 +409,194 @@ def tune_combine(
     return best
 
 
+def tune_gemm_combine(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    n: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    kernel: str = "xla",
+    measure: str = "auto",
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """GEMM face of :func:`tune_combine`: measure the in-body combine
+    schedules (``models.gemm.gemm_combine_candidates``) as full distributed
+    GEMMs on the target mesh and record the winner under
+    ``combine_key("gemm", ...)`` — the key ``build_gemm(combine="auto")``
+    consults. The combine key carries no n_rhs (a schedule crossover is a
+    property of the (m, k, p) communication shape, and the engine reuses
+    one decision across its whole bucket ladder), so the decision is
+    measured at the caller's representative ``n``."""
+    from ..models.gemm import gemm_combine_candidates, validate_gemm
+    from ..utils.io import generate_matrix
+
+    p = int(mesh.devices.size)
+    key = combine_key("gemm", strategy_name, m, k, p, dtype)
+    existing = cache.lookup(key)
+    if existing is not None and not force:
+        return existing
+    try:
+        candidates = gemm_combine_candidates(strategy_name, mesh)
+    except MatvecError:
+        return None
+    if not candidates:
+        return None
+    a = generate_matrix(m, k, seed=seed)
+    b = generate_matrix(k, n, seed=seed + 1)
+    strat = get_strategy(strategy_name)
+    # Discarded cold-process warmup (same rationale as tune_combine).
+    try:
+        benchmark_gemm(
+            strategy_name, mesh, a, b, dtype=dtype, n_reps=1,
+            measure=measure, kernel=kernel, combine=candidates[0],
+            chain_samples=1,
+        )
+    except (MatvecError, TimingError):
+        pass
+    measured: dict[str, float] = {}
+    for cand in candidates:
+        bound = strat.with_combine(cand) or strat
+        try:
+            bound.validate(m, k, mesh)
+            validate_gemm(strategy_name, m, k, n, mesh)
+        except MatvecError as e:
+            log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} "
+                f"{cand}: skip ({e})")
+            continue
+        try:
+            result = benchmark_gemm(
+                strategy_name, mesh, a, b, dtype=dtype, n_reps=n_reps,
+                measure=measure, kernel=kernel, combine=cand,
+                chain_samples=samples,
+            )
+        except TimingError:
+            log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} "
+                f"{cand}: unmeasurable")
+            continue
+        t = float(result.min_time_s)
+        measured[cand] = t
+        log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} {cand}: "
+            f"{t * 1e6:.1f} us")
+    default = strat.default_combine(mesh)
+    winner = _pick_winner(measured, default=default, min_gain=min_gain)
+    if winner is None:
+        return None
+    if winner != default and default in measured:
+        # Confirmation pass (same rationale as tune_combine).
+        for cand in (default, winner):
+            try:
+                result = benchmark_gemm(
+                    strategy_name, mesh, a, b, dtype=dtype, n_reps=n_reps,
+                    measure=measure, kernel=kernel, combine=cand,
+                    chain_samples=samples,
+                )
+            except TimingError:
+                continue
+            measured[cand] = float(result.min_time_s)
+        winner = _pick_winner(measured, default=default, min_gain=min_gain)
+        log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} "
+            f"confirm -> {winner}")
+    best = {"combine": winner, "time_s": measured[winner],
+            "candidates": measured, "n_rhs": n}
+    cache.record(key, best)
+    return best
+
+
+# ----------------------------------------------------------- promotion
+
+
+def tune_promotion(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    buckets: tuple[int, ...] = (2, 4, 8, 16, 32),
+    kernel: str = "xla",
+    combine: str | None = None,
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """The fourth autotuner axis: the GEMV→GEMM batch-promotion crossover.
+
+    For each bucket width ``b`` the serving question is: does ONE sharded
+    GEMM over a (k, b) block beat ``b`` sequential single-RHS dispatches of
+    the same strategy? Both sides are measured under the device-looped
+    slope protocol (``bench.timing``): ``t_seq(b) = b · t_matvec`` (the
+    per-dispatch device time of the built matvec) vs ``t_gemm(b)`` (one
+    batched dispatch via ``build_batched``). ``b*`` is recorded as the
+    smallest measured bucket where the GEMM wins by the hysteresis margin
+    — per-dispatch *host* overhead (tunnel transport, Python) only widens
+    the GEMM's real-world advantage, so the recorded crossover is
+    conservative. ``b_star: null`` records "promotion never won" (the
+    engine then keeps the per-column path; distinct from a cache miss,
+    which falls back to the static default).
+    """
+    p = int(mesh.devices.size)
+    key = promote_key(strategy_name, m, k, p, dtype)
+    existing = cache.lookup(key)
+    if existing is not None and not force:
+        return existing
+    strat = get_strategy(strategy_name)
+    try:
+        strat.validate(m, k, mesh)
+    except MatvecError:
+        return None
+    rng = np.random.default_rng(seed)
+    a = jnp.asarray(rng.uniform(0, 10, (m, k)), dtype=dtype)
+    x = jnp.asarray(rng.uniform(0, 10, (k,)), dtype=dtype)
+    sh_a, sh_x = strat.shardings(mesh)
+    a = jax.device_put(a, sh_a)
+    matvec = strat.build(mesh, kernel=kernel, combine=combine)
+    t_seq = _measure_fn(
+        matvec, (a, jax.device_put(x, sh_x)), n_reps=n_reps, samples=samples
+    )
+    if t_seq is None:
+        return None
+    log(f"  promote {strategy_name} {m}x{k} p={p} {dtype} "
+        f"matvec: {t_seq * 1e6:.1f} us")
+    gemm = strat.build_batched(mesh, kernel=kernel, combine=combine)
+    _, sh_b = strat.batched_shardings(mesh)
+    gemm_times: dict[str, float] = {}
+    b_star: int | None = None
+    for b in sorted(buckets):
+        rhs = jnp.asarray(rng.uniform(0, 10, (k, b)), dtype=dtype)
+        t_gemm = _measure_fn(
+            gemm, (a, jax.device_put(rhs, sh_b)), n_reps=n_reps,
+            samples=samples,
+        )
+        if t_gemm is None:
+            log(f"  promote {strategy_name} {m}x{k} p={p} b={b}: "
+                "unmeasurable")
+            continue
+        gemm_times[str(b)] = t_gemm
+        wins = t_gemm < (1.0 - min_gain) * b * t_seq
+        log(f"  promote {strategy_name} {m}x{k} p={p} b={b}: "
+            f"gemm {t_gemm * 1e6:.1f} us vs seq {b * t_seq * 1e6:.1f} us"
+            f"{'  <- wins' if wins else ''}")
+        if wins and b_star is None:
+            b_star = b
+    if not gemm_times:
+        return None
+    best = {"b_star": b_star, "seq_time_s": t_seq, "gemm_times": gemm_times}
+    cache.record(key, best)
+    return best
+
+
 # ------------------------------------------------------------ sweep-level
 
 
@@ -420,8 +649,8 @@ def tune_config(
     log: Callable[[str], None] = print,
 ) -> None:
     """Tune everything one sweep config consults at dispatch time: the
-    local-kernel keys for each per-device shape, plus (matvec only) the
-    combine-schedule key for the global config."""
+    local-kernel keys for each per-device shape, plus the combine-schedule
+    key for the global config (matvec and gemm both)."""
     if op == "gemm":
         n = n_rhs or k
         p = int(mesh.devices.size)
@@ -430,6 +659,10 @@ def tune_config(
             local.add((m // p, k, n))
         elif strategy_name.startswith("colwise") and k % p == 0:
             local.add((m, k // p, n))
+            # The overlapped ring calls the kernel on (m/p, k/p) tiles; an
+            # auto-combine build can resolve to it, so tune that shape too.
+            if m % p == 0:
+                local.add((m // p, k // p, n))
         elif strategy_name == "blockwise":
             try:
                 r, c = mesh_grid_shape(mesh)
@@ -442,6 +675,11 @@ def tune_config(
                 lm, lk, ln, dtype, cache, n_reps=n_reps, samples=samples,
                 force=force, seed=seed, min_gain=min_gain, log=log,
             )
+        tune_gemm_combine(
+            strategy_name, mesh, m, k, n, dtype, cache, kernel=kernel,
+            measure=measure, n_reps=n_reps, samples=samples, force=force,
+            seed=seed, min_gain=min_gain, log=log,
+        )
         return
     for lm, lk in sorted(local_gemv_shapes(strategy_name, m, k, mesh)):
         tune_gemv(

@@ -29,6 +29,25 @@ lint() {
     return 1
   fi
   echo "lint: ok (no direct shard_map references outside utils/compat.py)"
+
+  # Engine dispatch paths must never host-sync (the async submit contract):
+  # block_until_ready / device_get / materializing asarray are forbidden in
+  # engine/ except on lines whose `# sync-ok: <reason>` marker documents a
+  # deliberate materialization point (future.result, one-time host staging).
+  # Timing code is exempt by living in bench/serve.py. (Same rule in-suite:
+  # tests/test_lint.py::test_no_host_syncs_in_engine_dispatch.)
+  bad=$(grep -rnE \
+      'block_until_ready|device_get|np\.asarray|np\.array\(|jnp\.asarray' \
+      --include='*.py' matvec_mpi_multiplier_tpu/engine \
+      2>/dev/null | grep -v 'sync-ok:' || true)
+  if [ -n "$bad" ]; then
+    echo "LINT: host syncs in engine/ dispatch paths:" >&2
+    echo "$bad" >&2
+    echo "Mark deliberate materialization points with '# sync-ok: <reason>'" >&2
+    echo "or move timing code to bench/serve.py." >&2
+    return 1
+  fi
+  echo "lint: ok (no unmarked host syncs in engine/ dispatch paths)"
 }
 
 lint

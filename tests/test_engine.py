@@ -1,0 +1,387 @@
+"""Serving-engine tests (engine/): correctness across strategies/dtypes,
+bucket-padding isolation, executable-cache behavior, and promotion policy.
+
+Bitwise doctrine: below the promotion threshold the engine serves each
+column through the SAME single-RHS executable a direct ``strategy.build``
+call compiles, so those comparisons are exact. The promoted GEMM path runs
+a genuinely different local kernel (a width-b matmul), whose backend
+reduction order may differ from the width-1 case — there the contract is
+tight allclose against the matvec loop, plus bitwise agreement with the
+equivalent direct ``build_batched`` program (same executable shape).
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import available_strategies, get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.engine import (
+    DEFAULT_PROMOTE_B,
+    MatvecEngine,
+    bucket_for,
+    bucket_ladder,
+    pad_columns,
+    split_widths,
+)
+from matvec_mpi_multiplier_tpu.tuning import (
+    TuningCache,
+    combine_key,
+    promote_key,
+    reset_cache,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+RTOL = {"float32": 1e-5, "float64": 1e-12}
+
+
+@pytest.fixture()
+def cache_path(tmp_path, monkeypatch):
+    path = tmp_path / "tuning_cache.json"
+    monkeypatch.setenv("MATVEC_TUNING_CACHE", str(path))
+    reset_cache()
+    yield path
+    reset_cache()
+
+
+def make_operands(rng, m=64, k=64, dtype="float32"):
+    a = rng.uniform(0, 10, (m, k)).astype(dtype)
+    X = rng.uniform(0, 10, (k, 11)).astype(dtype)
+    return a, X
+
+
+# ---------------------------------------------------------------- buckets
+
+
+def test_bucket_ladder_and_quantization():
+    assert bucket_ladder(16) == (1, 2, 4, 8, 16)
+    assert bucket_ladder(24) == (1, 2, 4, 8, 16, 24)
+    assert bucket_for(1, 16) == 1
+    assert bucket_for(5, 16) == 8
+    assert bucket_for(16, 16) == 16
+    with pytest.raises(ConfigError):
+        bucket_for(17, 16)
+    with pytest.raises(ConfigError):
+        bucket_for(0, 16)
+    assert split_widths(40, 16) == [16, 16, 8]
+    assert split_widths(16, 16) == [16]
+    assert split_widths(3, 16) == [3]
+
+
+def test_pad_columns_zero_fills():
+    block = np.ones((4, 3), np.float32)
+    padded = pad_columns(block, 8)
+    assert padded.shape == (4, 8)
+    np.testing.assert_array_equal(padded[:, :3], block)
+    np.testing.assert_array_equal(padded[:, 3:], 0.0)
+    assert pad_columns(block, 3) is block  # already at width: no copy
+    with pytest.raises(ConfigError):
+        pad_columns(block, 2)
+
+
+# ----------------------------------------------------- correctness matrix
+
+
+@pytest.mark.parametrize("strategy", available_strategies())
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_engine_matches_single_matvec_loop(devices, rng, strategy, dtype):
+    """Batched submits — sequential AND promoted — reproduce a loop of
+    single matvecs for every strategy/dtype."""
+    mesh = make_mesh(8)
+    a, X = make_operands(rng, dtype=dtype)
+    engine = MatvecEngine(
+        a, mesh, strategy=strategy, promote=4, max_bucket=8
+    )
+    direct = get_strategy(strategy).build(mesh)
+    loop = np.stack(
+        [np.asarray(direct(a, X[:, j])) for j in range(X.shape[1])], axis=1
+    )
+
+    # Vector request: same executable class as the direct build — bitwise.
+    y = engine.submit(X[:, 0]).result()
+    np.testing.assert_array_equal(y, loop[:, 0])
+
+    # Sub-threshold block (b=3 < b*=4): per-column path, bitwise.
+    Y3 = engine.submit(X[:, :3]).result()
+    assert Y3.shape == (64, 3)
+    np.testing.assert_array_equal(Y3, loop[:, :3])
+
+    # Promoted block (b=11 >= b*): padded GEMMs (8 + pad, 3 -> bucket 4).
+    Y = engine.submit(X).result()
+    assert Y.shape == loop.shape
+    np.testing.assert_allclose(Y, loop, rtol=RTOL[dtype])
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_promoted_block_bitwise_matches_build_batched(devices, rng, dtype):
+    """The promoted path IS the strategy's batched program: same bucket
+    shape in, bitwise-equal columns out."""
+    mesh = make_mesh(8)
+    a, X = make_operands(rng, dtype=dtype)
+    block = X[:, :8]  # exactly one bucket: no padding in play
+    engine = MatvecEngine(a, mesh, strategy="colwise", promote=2, max_bucket=8)
+    got = engine.submit(block).result()
+    want = np.asarray(
+        get_strategy("colwise").build_batched(mesh)(a, block)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bfloat16_batches(devices, rng):
+    import jax.numpy as jnp
+
+    mesh = make_mesh(8)
+    a = rng.uniform(0, 1, (64, 64))
+    X = rng.uniform(0, 1, (64, 6))
+    engine = MatvecEngine(
+        a, mesh, strategy="rowwise", dtype=jnp.bfloat16, promote=2
+    )
+    Y = engine.submit(X).result()
+    assert Y.shape == (64, 6) and str(Y.dtype) == "bfloat16"
+    np.testing.assert_allclose(
+        Y.astype(np.float32),
+        (a.astype(np.float32) @ X.astype(np.float32)), rtol=0.05,
+    )
+
+
+# ------------------------------------------------------- padding isolation
+
+
+def test_bucket_padding_never_leaks(devices, rng):
+    """A width-5 request rides the bucket-8 executable; its 5 result
+    columns must be bitwise what the same executable computes for any
+    other request sharing those columns, and the pad columns must never
+    surface."""
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=2, max_bucket=8)
+    X5, X8 = X[:, :5], X[:, :8]
+    Y5 = engine.submit(X5).result()
+    Y8 = engine.submit(X8).result()
+    assert Y5.shape == (64, 5)
+    np.testing.assert_array_equal(Y5, Y8[:, :5])
+    # And the padded tail of the width-8 request is real data, not zeros.
+    assert np.abs(Y8[:, 5:]).min() > 0
+
+
+def test_split_request_spans_buckets(devices, rng):
+    """A request wider than max_bucket splits into chunks, each padded to
+    its own bucket, and reassembles in order."""
+    mesh = make_mesh(8)
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    X = rng.uniform(0, 10, (64, 21)).astype(np.float32)  # 8 + 8 + 5->8
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=2, max_bucket=8)
+    Y = engine.submit(X).result()
+    assert Y.shape == (64, 21)
+    np.testing.assert_allclose(Y, a @ X, rtol=1e-5)
+    assert engine.n_executables == 1  # every chunk hit the bucket-8 program
+
+
+# ------------------------------------------------- executable-cache state
+
+
+def test_compile_count_flat_across_mixed_replay(devices, rng):
+    """The acceptance criterion: after warmup covers the ladder, a
+    mixed-shape request stream never compiles again — only cache hits."""
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="colwise", promote=2, max_bucket=8)
+    warm_compiles = engine.warmup()
+    # matvec + buckets {1, 2, 4, 8}
+    assert warm_compiles == 1 + len(bucket_ladder(8))
+    assert engine.warmup() == 0  # idempotent
+
+    baseline = engine.stats.compiles
+    futures = [
+        engine.submit(X[:, :w]) for w in (1, 2, 3, 5, 8, 11, 7, 4, 6, 2)
+    ]
+    for f in futures:
+        f.result()
+    stats = engine.stats
+    assert stats.compiles == baseline, "steady-state stream compiled"
+    assert stats.hits > 0
+    assert stats.requests == 10
+
+
+def test_warmup_widths_subset(devices, rng):
+    """warmup(widths) compiles exactly the buckets those widths hit."""
+    mesh = make_mesh(8)
+    a, _ = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=2, max_bucket=16)
+    n = engine.warmup(widths=[3, 4])  # both quantize to bucket 4
+    assert n == 2  # matvec + bucket-4 gemm
+    assert engine.n_executables == 2
+
+
+def test_warmup_mirrors_submit_routing(devices, rng):
+    """Widths below b* take the per-column path, so warming them must not
+    compile GEMM buckets submit() would never dispatch."""
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=4, max_bucket=16)
+    n = engine.warmup(widths=[1, 2, 3, 5])  # only 5 promotes (bucket 8)
+    assert n == 2  # matvec + bucket-8 gemm; buckets 1/2 never compile
+    baseline = engine.stats.compiles
+    for w in (1, 2, 3, 5):
+        engine.submit(X[:, :w]).result()
+    assert engine.stats.compiles == baseline
+
+
+def test_unsupported_combine_fails_at_construction(devices, rng):
+    """A bad schedule name must fail when the engine is built, not
+    requests deep at first-dispatch compile (and as a MatvecError, so the
+    serve sweep's skip path catches it)."""
+    mesh = make_mesh(8)
+    a, _ = make_operands(rng)
+    with pytest.raises(ConfigError, match="combine schedule"):
+        MatvecEngine(a, mesh, strategy="rowwise", combine="psum_scatter")
+    with pytest.raises(ConfigError, match="combine schedule"):
+        MatvecEngine(a, mesh, strategy="blockwise", combine="nope")
+
+
+def test_no_promotion_uses_single_executable(devices, rng):
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=None)
+    Y = engine.submit(X[:, :6]).result()
+    np.testing.assert_allclose(Y, a @ X[:, :6], rtol=1e-5)
+    stats = engine.stats
+    assert engine.n_executables == 1  # only the matvec program exists
+    assert stats.dispatches == 6
+
+
+def test_donation_flag_off_still_correct(devices, rng):
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", donate=False, promote=2)
+    np.testing.assert_allclose(
+        engine.submit(X[:, :4]).result(), a @ X[:, :4], rtol=1e-5
+    )
+
+
+# -------------------------------------------------------- future semantics
+
+
+def test_future_is_async_then_done(devices, rng):
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=2)
+    fut = engine.submit(X[:, :4])
+    vals = fut.device_values()
+    assert vals and all(v.shape == (64, 4) for v in vals)  # padded view
+    fut.result()
+    assert fut.done()
+
+
+def test_request_validation(devices, rng):
+    mesh = make_mesh(8)
+    a, _ = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise")
+    with pytest.raises(ConfigError):
+        engine.submit(np.ones(32, np.float32))  # wrong k
+    with pytest.raises(ConfigError):
+        engine.submit(np.ones((32, 3), np.float32))
+    with pytest.raises(ConfigError):
+        engine.submit(np.ones((64, 0), np.float32))
+    with pytest.raises(ConfigError):
+        MatvecEngine(np.ones(8, np.float32), mesh)  # rank-1 A
+
+
+# ------------------------------------------------ tuned-decision plumbing
+
+
+def test_promote_auto_consults_tuning_cache(devices, rng, cache_path):
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        promote_key("rowwise", 64, 64, 8, "float32"),
+        {"b_star": 3, "seq_time_s": 1e-5, "gemm_times": {"3": 1e-5}},
+    )
+    cache.save()
+    reset_cache()
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote="auto")
+    assert engine.b_star == 3
+    # b=3 now promotes: one bucket-4 GEMM dispatch, not 3 matvecs.
+    engine.submit(X[:, :3]).result()
+    assert engine.stats.dispatches == 1
+
+
+def test_promote_auto_miss_uses_static_default(devices, rng, cache_path):
+    mesh = make_mesh(8)
+    a, _ = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote="auto")
+    assert engine.b_star == DEFAULT_PROMOTE_B
+
+
+def test_promote_measured_never_is_honored(devices, rng, cache_path):
+    """b_star=null in the cache means promotion measurably never won —
+    distinct from a miss: the engine must keep the per-column path."""
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        promote_key("rowwise", 64, 64, 8, "float32"),
+        {"b_star": None, "seq_time_s": 1e-5, "gemm_times": {"4": 9.0}},
+    )
+    cache.save()
+    reset_cache()
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote="auto")
+    assert engine.b_star is None
+    engine.submit(X[:, :6]).result()
+    assert engine.stats.dispatches == 6
+
+
+def test_engine_combine_auto_resolves_both_paths(
+    devices, rng, cache_path, monkeypatch
+):
+    """combine='auto' pins the matvec winner AND the gemm winner at
+    construction; the promoted path must actually run the gemm one."""
+    import matvec_mpi_multiplier_tpu.parallel.ring as ring
+
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        combine_key("matvec", "colwise", 64, 64, 8, "float32"),
+        {"combine": "psum"},
+    )
+    cache.record(
+        combine_key("gemm", "colwise", 64, 64, 8, "float32"),
+        {"combine": "ring"},
+    )
+    cache.save()
+    reset_cache()
+
+    calls = []
+    real = ring.ring_psum_scatter
+
+    def spy(v, axes):
+        calls.append(getattr(v, "ndim", None))
+        return real(v, axes)
+
+    monkeypatch.setattr(ring, "ring_psum_scatter", spy)
+    engine = MatvecEngine(
+        a, mesh, strategy="colwise", combine="auto", promote=4
+    )
+    assert engine._matvec_combine == "psum"
+    assert engine._gemm_combine == "ring"
+    Y = engine.submit(X[:, :8]).result()
+    np.testing.assert_allclose(Y, a @ X[:, :8], rtol=1e-4)
+    assert 2 in calls, "gemm dispatch did not route through the ring"
+    calls.clear()
+    y = engine.submit(X[:, 0]).result()
+    np.testing.assert_allclose(y, a @ X[:, 0], rtol=1e-4)
+    assert not calls, "matvec path must use its own (psum) winner"
+
+
+def test_matvec_only_combine_falls_back_on_batched_path(devices, rng):
+    """combine='ring' on rowwise is the matvec output gather; the batched
+    path has no such schedule and must fall back to its default rather
+    than refuse to build."""
+    mesh = make_mesh(8)
+    a, X = make_operands(rng)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", combine="ring", promote=2)
+    assert engine._matvec_combine == "ring"
+    assert engine._gemm_combine is None
+    np.testing.assert_allclose(
+        engine.submit(X[:, :4]).result(), a @ X[:, :4], rtol=1e-5
+    )

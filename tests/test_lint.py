@@ -47,3 +47,50 @@ def test_no_direct_shard_map_outside_compat():
         "through matvec_mpi_multiplier_tpu.utils.compat):\n"
         + "\n".join(offenders)
     )
+
+
+# The serving engine's dispatch path must never host-sync: a single
+# block_until_ready (or materializing np.asarray) in the hot loop turns the
+# async submit contract into a per-request device round-trip. Timing/driver
+# code (bench/serve.py) is exempt by living outside engine/; the engine's
+# own deliberate sync points (future materialization, one-time host
+# staging) carry a `sync-ok:` marker with a reason. Mirrored fail-fast in
+# scripts/tier1.sh.
+ENGINE = REPO / "matvec_mpi_multiplier_tpu" / "engine"
+
+_SYNC_PATTERN = re.compile(
+    r"block_until_ready|device_get|np\.asarray|np\.array\(|jnp\.asarray"
+)
+_SYNC_EXEMPT = "sync-ok:"
+
+
+def test_no_host_syncs_in_engine_dispatch():
+    offenders = []
+    for path in sorted(ENGINE.rglob("*.py")):
+        for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1
+        ):
+            if _SYNC_PATTERN.search(line) and _SYNC_EXEMPT not in line:
+                offenders.append(
+                    f"{path.relative_to(REPO)}:{lineno}: {line.strip()}"
+                )
+    assert not offenders, (
+        "host syncs in engine/ dispatch paths (mark deliberate "
+        "materialization points with `# sync-ok: <reason>`; timing code "
+        "belongs in bench/serve.py):\n" + "\n".join(offenders)
+    )
+
+
+def test_engine_sync_markers_carry_reasons():
+    """The exemption marker is a justification, not an escape hatch: every
+    `sync-ok:` must be a comment with a non-empty reason."""
+    bad = []
+    for path in sorted(ENGINE.rglob("*.py")):
+        for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1
+        ):
+            if _SYNC_EXEMPT in line:
+                tail = line.split(_SYNC_EXEMPT, 1)[1].strip()
+                if "#" not in line.split(_SYNC_EXEMPT)[0] or not tail:
+                    bad.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not bad, f"sync-ok markers without comment+reason: {bad}"

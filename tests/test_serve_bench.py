@@ -1,0 +1,150 @@
+"""Serve-bench tests (bench/serve.py): protocol invariants on the CPU mesh.
+
+The acceptance pair rides here: a mixed-batch request stream shows ZERO
+recompilations after warmup (compile count flat in the emitted CSV), and
+the promoted block GEMM beats sequential single-RHS dispatch under the
+same protocol. Long-running throughput runs are marked ``slow`` (excluded
+from tier-1; ``pytest -m slow`` opts in).
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
+from matvec_mpi_multiplier_tpu.bench.serve import (
+    SERVE_CSV_HEADER,
+    append_serve_result,
+    measure_promotion,
+    run_serve,
+    serve_csv_path,
+)
+from matvec_mpi_multiplier_tpu.engine import MatvecEngine
+
+
+@pytest.fixture()
+def result(devices):
+    mesh = make_mesh(8)
+    return run_serve(
+        "rowwise", mesh, 64, 64, n_requests=30, max_bucket=8,
+        promote=4, seed=0, promo_reps=5,
+    )
+
+
+def test_serve_zero_recompiles_after_warmup(result):
+    assert result.compiles_steady == 0
+    assert result.compiles_warmup > 0
+    assert result.hits_steady >= result.n_requests
+
+
+def test_serve_reports_throughput_and_latency(result):
+    assert result.n_requests == 30
+    assert result.wall_s > 0 and result.rps > 0
+    assert result.cols_per_s >= result.rps  # every request has >= 1 column
+    assert 0 < result.p50_dispatch_ms <= result.p99_dispatch_ms
+    assert result.total_cols >= result.n_requests
+
+
+def test_serve_promotion_fields(result):
+    assert result.promo_b == result.b_star == 4
+    assert result.promo_gemm_s > 0 and result.promo_seq_s > 0
+    assert np.isfinite(result.promo_speedup)
+
+
+def test_serve_csv_round_trip(result, tmp_path):
+    path = append_serve_result(result, tmp_path)
+    assert path == serve_csv_path("rowwise", tmp_path)
+    rows = read_csv(path)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["compiles_steady"] == 0
+    assert row["n_requests"] == 30
+    assert row["strategy"] == "rowwise"
+    assert row["b_star"] == 4
+    # Header is the documented schema (drift would corrupt resumed files).
+    assert path.read_text().splitlines()[0] == SERVE_CSV_HEADER
+
+
+def test_measure_promotion_prefers_gemm(devices, rng):
+    """The promotion check's core claim on any backend: one block dispatch
+    at b* is not slower than b* sequential dispatches (generously margined
+    — this is a smoke bound, not a benchmark)."""
+    mesh = make_mesh(8)
+    a = rng.uniform(0, 10, (256, 256)).astype(np.float32)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=8, max_bucket=8)
+    b, t_gemm, t_seq = measure_promotion(engine, {}, n_reps=5)
+    assert b == 8
+    assert t_gemm > 0 and t_seq > 0
+    assert t_gemm < 2.0 * t_seq  # noise guard only; the demo records ~3x
+
+
+def test_measure_promotion_disabled_reports_nan(devices, rng):
+    """With promotion off the engine's block path IS sequential dispatch;
+    the promo columns must say NaN, not fake a crossover measurement."""
+    mesh = make_mesh(8)
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=None)
+    b, t_gemm, t_seq = measure_promotion(engine, {}, n_reps=2)
+    assert b == 0 and np.isnan(t_gemm) and np.isnan(t_seq)
+    result = run_serve(
+        "rowwise", mesh, 64, 64, n_requests=5, max_bucket=4,
+        promote=None, promo_reps=2,
+    )
+    assert result.b_star is None and result.promo_b == 0
+    assert np.isnan(result.promo_speedup)
+
+
+def test_serve_sweep_skips_unsupported_combine(devices, capsys):
+    """--combine psum_scatter under a mixed strategy list: the colwise
+    config is measured, the rowwise one is skipped — not a sweep abort."""
+    from matvec_mpi_multiplier_tpu.bench.serve import main
+
+    rc = main([
+        "--strategy", "rowwise", "--sizes", "64", "--devices", "8",
+        "--combine", "psum_scatter", "--n-requests", "5",
+        "--max-bucket", "4", "--no-csv",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "skip rowwise 64x64 p=8" in out
+    assert "0 serve configs measured" in out
+
+
+def test_serve_cli_no_csv(devices, capsys):
+    from matvec_mpi_multiplier_tpu.bench.serve import main
+
+    rc = main([
+        "--strategy", "rowwise", "--sizes", "64", "--devices", "8",
+        "--n-requests", "10", "--max-bucket", "4", "--no-csv",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serve rowwise 64x64 p=8" in out
+    assert "1 serve configs measured" in out
+
+
+def test_sweep_op_serve_delegates(devices, tmp_path, capsys):
+    from matvec_mpi_multiplier_tpu.bench.sweep import main
+
+    rc = main([
+        "--op", "serve", "--strategy", "colwise", "--sizes", "64",
+        "--devices", "8", "--n-requests", "8", "--max-bucket", "4",
+        "--data-root", str(tmp_path),
+    ])
+    assert rc == 0
+    rows = read_csv(serve_csv_path("colwise", tmp_path))
+    assert len(rows) == 1 and rows[0]["compiles_steady"] == 0
+
+
+@pytest.mark.slow
+def test_serve_throughput_long_stream(devices):
+    """Long mixed stream: the compile count stays flat over hundreds of
+    requests and every bucket keeps getting hit."""
+    mesh = make_mesh(8)
+    result = run_serve(
+        "colwise", mesh, 512, 512, n_requests=400, max_bucket=32,
+        promote=4, seed=1,
+    )
+    assert result.compiles_steady == 0
+    assert result.hits_steady >= 400
+    assert result.promo_speedup > 1.0
