@@ -201,6 +201,7 @@ def run_serve(
     dtype: str = "float32",
     kernel: str = "xla",
     combine: str | None = None,
+    stages: int | None = None,
     n_requests: int = 200,
     max_bucket: int = 32,
     widths: Sequence[int] | None = None,
@@ -217,7 +218,8 @@ def run_serve(
     a = generate_matrix(m, k, seed=seed).astype(dtype)
     engine = MatvecEngine(
         a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
-        dtype=dtype, max_bucket=max_bucket, promote=promote, donate=donate,
+        stages=stages, dtype=dtype, max_bucket=max_bucket, promote=promote,
+        donate=donate,
     )
     pool = _request_pool(k, widths, engine.dtype, seed=seed + 1)
 
@@ -354,6 +356,7 @@ def run_serve_sweep(args: argparse.Namespace) -> int:
                     result = run_serve(
                         name, mesh, m, k, dtype=args.dtype,
                         kernel=args.kernel, combine=args.combine,
+                        stages=getattr(args, "stages", None),
                         n_requests=args.n_requests,
                         max_bucket=args.max_bucket, promote=promote,
                         seed=args.seed,
@@ -400,6 +403,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--combine", default=None,
         help="combine schedule (or 'auto' for the tuning-cache winner)",
+    )
+    p.add_argument(
+        "--stages", type=int, default=None,
+        help="with --combine overlap: pin the staged schedule's stage "
+        "count S (default: the tuned fifth axis, clamped per shape)",
     )
     p.add_argument(
         "--n-requests", type=int, default=200,

@@ -165,11 +165,23 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         choices=[
             "auto", "psum", "psum_scatter", "ring", "ring_overlap", "a2a",
-            "gather",
+            "gather", "overlap", "overlap_ring", "pallas_ring",
         ],
-        help="combine-schedule override (matvec only): a concrete schedule "
-        "name, or 'auto' for the tuning-cache winner per config (static "
-        "default on a miss) — see MatvecStrategy.build",
+        help="combine-schedule override: a concrete schedule name, or "
+        "'auto' for the tuning-cache winner per config (static default on "
+        "a miss) — see MatvecStrategy.build. 'overlap' is the staged "
+        "compute/communication pipeline (stage count from --stages or the "
+        "tuned fifth axis); 'pallas_ring' the fused collective kernel "
+        "(1-D meshes, matvec only)",
+    )
+    p.add_argument(
+        "--stages",
+        type=int,
+        default=None,
+        help="with --combine overlap (or auto resolving to it): pin the "
+        "software-pipeline stage count S instead of consulting the tuned "
+        "stage ladder; clamped down to the largest valid divisor of the "
+        "per-device chunk",
     )
     p.add_argument(
         "--tune",
@@ -564,6 +576,8 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                     )
                     if args.combine is not None:
                         bench_kwargs["combine"] = args.combine
+                    if args.stages is not None:
+                        bench_kwargs["stages"] = args.stages
                     if args.chain_samples is not None:
                         bench_kwargs["chain_samples"] = args.chain_samples
                     try:

@@ -573,18 +573,21 @@ def benchmark_strategy(
     gather_output: bool = True,
     chain_samples: int = DEFAULT_CHAIN_SAMPLES,
     combine: str | None = None,
+    stages: int | str | None = None,
 ) -> TimingResult:
     """Benchmark one (strategy, mesh, size) configuration — the body of the
     reference's per-config run (``src/multiplier_rowwise.c:54-176``) minus the
     CSV write (see bench.metrics).
 
     ``combine`` selects the combine schedule by name (``"auto"`` consults
-    the tuning cache) — see ``MatvecStrategy.build``."""
+    the tuning cache) and ``stages`` pins the staged ``overlap`` schedules'
+    stage count — see ``MatvecStrategy.build``."""
     measure = resolve_measure(mode, measure)
     a, x = _prepare_operands(a, x, dtype)
     strategy.validate(a.shape[0], a.shape[1], mesh)
     fn = strategy.build(
-        mesh, kernel=kernel, gather_output=gather_output, combine=combine
+        mesh, kernel=kernel, gather_output=gather_output, combine=combine,
+        stages=stages,
     )
     return _run_benchmark(
         fn=fn, a=a, rhs=x, shardings=strategy.shardings(mesh), mesh=mesh,
@@ -607,6 +610,7 @@ def benchmark_gemm(
     gather_output: bool = True,
     chain_samples: int = DEFAULT_CHAIN_SAMPLES,
     combine: str | None = None,
+    stages: int | str | None = None,
 ) -> TimingResult:
     """Benchmark one GEMM (strategy, mesh, size) configuration.
 
@@ -616,7 +620,8 @@ def benchmark_gemm(
     column to tell matvec and GEMM apart).
 
     ``combine`` selects the combine schedule by name (``"auto"`` consults
-    the tuning cache under ``op="gemm"``) — see ``build_gemm``.
+    the tuning cache under ``op="gemm"``) and ``stages`` the staged
+    ``overlap`` stage count — see ``build_gemm``.
     """
     from ..models.gemm import build_gemm, gemm_shardings, validate_gemm
 
@@ -625,7 +630,7 @@ def benchmark_gemm(
     validate_gemm(name, a.shape[0], a.shape[1], b.shape[1], mesh)
     fn = build_gemm(
         name, mesh, kernel=kernel, gather_output=gather_output,
-        combine=combine,
+        combine=combine, stages=stages,
     )
     return _run_benchmark(
         fn=fn, a=a, rhs=b, shardings=gemm_shardings(name, mesh), mesh=mesh,

@@ -23,7 +23,13 @@ and serves requests through three mechanisms:
 ``submit`` returns a :class:`MatvecFuture` immediately — dispatch is
 enqueue-only (JAX arrays are async by construction) and the host sync
 happens only when the caller materializes the result. The dispatch path is
-lint-enforced sync-free (``tests/test_lint.py``, ``scripts/tier1.sh``).
+lint-enforced sync-free (``tests/test_lint.py``, ``scripts/tier1.sh``),
+with one caller-opted exception: ``max_in_flight`` bounds the outstanding
+dispatch window, and at the high-water mark ``submit`` blocks draining the
+OLDEST dispatch (marked ``sync-ok``) instead of enqueueing unboundedly
+ahead of the device. A per-request ``deadline_ms`` fails the future at
+that gate rather than dispatching stale work; both are counted in
+:class:`EngineStats` next to the compile/hit counters.
 
 Requests are HOST arrays (numpy): the engine owns host→device placement,
 including dtype normalization and bucket padding. Handing it a device
@@ -33,6 +39,8 @@ a caller-visible sync the serving contract does not make.
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Callable, Sequence
 
 import jax
@@ -40,7 +48,7 @@ import numpy as np
 
 from ..models import get_strategy
 from ..models.base import MatvecStrategy, mesh_size
-from ..utils.errors import ConfigError
+from ..utils.errors import ConfigError, DeadlineExceededError
 from .buckets import (
     DEFAULT_MAX_BUCKET,
     bucket_for,
@@ -75,23 +83,41 @@ class MatvecFuture:
         # are real (the rest is bucket padding).
         self._parts = list(parts)
         self._vector = vector
+        self._error: Exception | None = None
+
+    @classmethod
+    def failed(cls, error: Exception) -> "MatvecFuture":
+        """A future that was never dispatched (deadline exceeded):
+        ``result()`` raises ``error``, ``done()`` is immediately True."""
+        fut = cls([], vector=True)
+        fut._error = error
+        return fut
 
     def device_values(self) -> list[jax.Array]:
         """The raw (still padded) device arrays — for callers chaining
-        device-side work without materializing."""
+        device-side work without materializing (empty for a failed
+        future)."""
         return [arr for arr, _ in self._parts]
 
     def done(self) -> bool:
         """True when every part's device computation has completed (never
-        blocks)."""
+        blocks). A failed future is done by definition."""
         return all(
             bool(arr.is_ready()) if hasattr(arr, "is_ready") else True
             for arr, _ in self._parts
         )
 
+    def exception(self) -> Exception | None:
+        """The failure this future carries (DeadlineExceededError), or
+        None for a dispatched request."""
+        return self._error
+
     def result(self) -> np.ndarray:
         """Materialize on host: ``(m,)`` for a vector request, ``(m, b)``
-        for a block request (pad columns sliced away)."""
+        for a block request (pad columns sliced away). A failed future
+        raises its error instead."""
+        if self._error is not None:
+            raise self._error
         if self._vector:
             arr, _ = self._parts[0]
             return np.asarray(arr)  # sync-ok: caller-requested materialization
@@ -103,16 +129,25 @@ class MatvecFuture:
 
 
 class EngineStats(ExecStats):
-    """Executable-cache counters plus dispatch-level ones."""
+    """Executable-cache counters plus dispatch-level ones.
+
+    ``in_flight`` is the outstanding-dispatch count at snapshot time;
+    ``drains`` counts blocking waits the backpressure high-water mark
+    forced; ``deadline_failures`` counts requests failed (never dispatched)
+    because their ``deadline_ms`` elapsed in the backpressure gate."""
 
     def __init__(
         self, compiles: int, hits: int, requests: int, dispatches: int,
-        cols: int,
+        cols: int, in_flight: int = 0, drains: int = 0,
+        deadline_failures: int = 0,
     ):
         super().__init__(compiles=compiles, hits=hits)
         self.requests = requests
         self.dispatches = dispatches
         self.cols = cols
+        self.in_flight = in_flight
+        self.drains = drains
+        self.deadline_failures = deadline_failures
 
 
 class MatvecEngine:
@@ -130,6 +165,11 @@ class MatvecEngine:
         construction from the tuning cache — per-dispatch resolution would
         put a cache lookup in the hot loop), or None for the static
         default.
+    stages : stage count for the staged ``overlap`` schedules — an int, or
+        None/``"auto"`` for the tuned fifth axis (``tune_overlap``; static
+        default on a miss). Resolved ONCE at construction (the engine's
+        shapes are fixed) and baked into the executable keys; ignored by
+        every non-overlap schedule.
     dtype : operand dtype (default: ``a``'s).
     max_bucket : widest bucket in the ladder; wider requests split.
     promote : the GEMV→GEMM crossover ``b*``: ``"auto"`` (tuned decision,
@@ -138,6 +178,12 @@ class MatvecEngine:
     donate : donate the RHS buffer to each dispatch (HBM reuse; ignored by
         backends that cannot donate, e.g. CPU).
     gather_output : as in ``MatvecStrategy.build`` (bools only).
+    max_in_flight : backpressure high-water mark — the most outstanding
+        dispatches ``submit`` tolerates before blocking on the OLDEST one
+        (drain-oldest: the stream stays ordered and bounded instead of
+        enqueueing unboundedly ahead of the device). None (default) keeps
+        the unbounded contract. Request-granular: one wide split request
+        may briefly overshoot by its part count.
     """
 
     def __init__(
@@ -148,11 +194,13 @@ class MatvecEngine:
         strategy: str | MatvecStrategy = "rowwise",
         kernel: str | Callable = "xla",
         combine: str | None = None,
+        stages: int | str | None = None,
         dtype=None,
         max_bucket: int = DEFAULT_MAX_BUCKET,
         promote: str | int | None = "auto",
         donate: bool = True,
         gather_output: bool = True,
+        max_in_flight: int | None = None,
     ):
         if mesh is None:
             from ..parallel.mesh import make_mesh
@@ -183,11 +231,20 @@ class MatvecEngine:
         self._matvec_combine, self._gemm_combine = self._resolve_combine(
             combine
         )
+        self.stages = self._resolve_stages(stages)
         self.b_star = self._resolve_promotion(promote)
+        if max_in_flight is not None and max_in_flight < 1:
+            raise ConfigError(
+                f"max_in_flight must be >= 1, got {max_in_flight}"
+            )
+        self.max_in_flight = max_in_flight
+        self._outstanding: deque[jax.Array] = deque()
         self._cache = ExecutableCache()
         self._requests = 0
         self._dispatches = 0
         self._cols = 0
+        self._drains = 0
+        self._deadline_failures = 0
 
     # ---- construction-time resolution ----
 
@@ -235,6 +292,35 @@ class MatvecEngine:
         )
         return combine, (combine if batched_ok else None)
 
+    def _effective_combine(self, combine: str | None) -> str | None:
+        """The schedule a path actually runs: the explicit/resolved name,
+        or the strategy instance's own binding (colwise_overlap & co.)
+        when none was given."""
+        if combine is not None:
+            return combine
+        return getattr(self.strategy, "combine", None)
+
+    def _is_overlap(self, combine: str | None) -> bool:
+        c = self._effective_combine(combine)
+        return c is not None and c.startswith("overlap")
+
+    def _resolve_stages(self, stages: int | str | None) -> int | None:
+        """Pin the overlap stage count S at construction (None when no
+        path runs an overlap schedule — explicitly, via the auto tier, or
+        through the strategy instance's own binding): the engine's shapes
+        are fixed, so the tuned decision — or the explicit int, clamped to
+        the shape's valid ladder — is resolved once and baked into the
+        executable keys rather than looked up per dispatch."""
+        if not (
+            self._is_overlap(self._matvec_combine)
+            or self._is_overlap(self._gemm_combine)
+        ):
+            return None
+        return self.strategy.resolve_stages(
+            self.m, self.k, self.mesh, stages,
+            self.strategy.overlap_chunk_devices(self.mesh), self.dtype,
+        )
+
     def _resolve_promotion(self, promote: str | int | None) -> int | None:
         """The crossover ``b*``: requests of ``b >= b_star`` columns take
         the single-GEMM path; below it, per-column GEMV dispatches. None
@@ -265,23 +351,33 @@ class MatvecEngine:
             self.kernel, "__name__", "custom"
         )
 
+    def _combine_label(self, combine: str | None) -> str | None:
+        """The combine identity an executable is cached under: the staged
+        schedules embed their pinned S (`overlap@4`) — a different stage
+        count is a different compiled program. Strategy-bound overlap
+        (colwise_overlap with combine=None) labels the same way."""
+        if self.stages is not None and self._is_overlap(combine):
+            return f"{self._effective_combine(combine)}@{self.stages}"
+        return combine
+
     def _matvec_key(self) -> ExecKey:
         return ExecKey(
             "matvec", self.strategy.name, self._kernel_label(),
-            self._matvec_combine, 1, str(self.dtype),
+            self._combine_label(self._matvec_combine), 1, str(self.dtype),
         )
 
     def _gemm_key(self, bucket: int) -> ExecKey:
         return ExecKey(
             "gemm", self.strategy.name, self._kernel_label(),
-            self._gemm_combine, bucket, str(self.dtype),
+            self._combine_label(self._gemm_combine), bucket,
+            str(self.dtype),
         )
 
     def _matvec_builder(self):
         fn = self.strategy.build(
             self.mesh, kernel=self.kernel,
             gather_output=self.gather_output,
-            combine=self._matvec_combine,
+            combine=self._matvec_combine, stages=self.stages,
         )
         structs = (
             jax.ShapeDtypeStruct(
@@ -296,7 +392,7 @@ class MatvecEngine:
             fn = self.strategy.build_batched(
                 self.mesh, kernel=self.kernel,
                 gather_output=self.gather_output,
-                combine=self._gemm_combine,
+                combine=self._gemm_combine, stages=self.stages,
             )
             structs = (
                 jax.ShapeDtypeStruct(
@@ -312,21 +408,68 @@ class MatvecEngine:
 
     # ---- dispatch (the hot path: enqueue-only, no host syncs) ----
 
+    def _reclaim(self) -> None:
+        """Drop completed dispatches from the outstanding window — a
+        non-blocking sweep (``is_ready`` never waits)."""
+        while self._outstanding and (
+            bool(self._outstanding[0].is_ready())
+            if hasattr(self._outstanding[0], "is_ready") else True
+        ):
+            self._outstanding.popleft()
+
+    def _admit(self) -> None:
+        """The backpressure gate: when the outstanding window is at its
+        high-water mark even after reclaiming completed work, block on the
+        OLDEST dispatch until it finishes (drain-oldest keeps the stream
+        ordered and the device queue bounded — the enqueue-unboundedly
+        contract the ROADMAP flagged). The blocking wait is a deliberate
+        exception to the sync-free dispatch rule, confined to the
+        over-high-water state the caller opted into."""
+        if self.max_in_flight is None:
+            return
+        self._reclaim()
+        while len(self._outstanding) >= self.max_in_flight:
+            oldest = self._outstanding.popleft()
+            if hasattr(oldest, "block_until_ready"):  # sync-ok: capability probe only, the wait is the next line
+                oldest.block_until_ready()  # sync-ok: backpressure drain-oldest at the caller-set high-water mark
+            self._drains += 1
+            self._reclaim()
+
+    def _track(self, arr: jax.Array) -> jax.Array:
+        if self.max_in_flight is not None:
+            self._outstanding.append(arr)
+        return arr
+
     def _dispatch_matvec(self, col: np.ndarray) -> jax.Array:
         exe = self._cache.get(self._matvec_key(), self._matvec_builder)
         self._dispatches += 1
-        return exe(self._a, jax.device_put(col, self._sh_x))
+        return self._track(exe(self._a, jax.device_put(col, self._sh_x)))
 
     def _dispatch_gemm(self, padded: np.ndarray) -> jax.Array:
         bucket = padded.shape[1]
         exe = self._cache.get(self._gemm_key(bucket), self._gemm_builder(bucket))
         self._dispatches += 1
-        return exe(self._a, jax.device_put(padded, self._sh_b))
+        return self._track(exe(self._a, jax.device_put(padded, self._sh_b)))
 
-    def submit(self, x) -> MatvecFuture:
+    def submit(self, x, *, deadline_ms: float | None = None) -> MatvecFuture:
         """Dispatch one request: a ``(k,)`` vector or a ``(k, b)`` block of
-        ``b`` right-hand sides (columns). Returns immediately; the result
-        future materializes (and unpads) on demand."""
+        ``b`` right-hand sides (columns). Returns immediately (unless the
+        backpressure high-water mark forces a drain); the result future
+        materializes (and unpads) on demand.
+
+        ``deadline_ms``: a request whose deadline has elapsed before
+        dispatch gets a FAILED future (``result()`` raises
+        :class:`DeadlineExceededError`) and no device work is enqueued —
+        stale work is dropped at the door, not served late. The deadline
+        is checked on entry (a non-positive value fails immediately) and
+        again after the backpressure drain; the drain itself is NOT
+        interrupted mid-wait — the outstanding window must shrink for
+        every later request regardless, and JAX exposes no timed wait — so
+        the call can outlast the deadline by up to one drain before the
+        failure is returned. A request that made it to dispatch always
+        completes.
+        """
+        t0 = time.monotonic()
         x = np.asarray(x, dtype=self.dtype)  # sync-ok: requests are host arrays (see module docstring)
         self._requests += 1
         if x.ndim == 1:
@@ -334,18 +477,39 @@ class MatvecEngine:
                 raise ConfigError(
                     f"request length {x.shape[0]} != A columns {self.k}"
                 )
-            self._cols += 1
-            return MatvecFuture(
-                [(self._dispatch_matvec(x), None)], vector=True
-            )
-        if x.ndim != 2 or x.shape[0] != self.k:
+        elif x.ndim != 2 or x.shape[0] != self.k:
             raise ConfigError(
                 f"request must be (k,) or (k, b) with k={self.k}; got "
                 f"shape {x.shape}"
             )
-        b = x.shape[1]
-        if b == 0:
+        elif x.shape[1] == 0:
             raise ConfigError("empty request (b=0)")
+
+        def _expired() -> bool:
+            return (
+                deadline_ms is not None
+                and (time.monotonic() - t0) * 1e3 > deadline_ms
+            )
+
+        def _fail() -> MatvecFuture:
+            self._deadline_failures += 1
+            return MatvecFuture.failed(DeadlineExceededError(
+                f"request deadline of {deadline_ms} ms elapsed in the "
+                "backpressure gate before dispatch"
+            ))
+
+        if deadline_ms is not None and deadline_ms <= 0:
+            # Stale on arrival (upstream queueing): skip even the drain.
+            return _fail()
+        self._admit()  # may block draining the oldest outstanding dispatch
+        if _expired():
+            return _fail()
+        if x.ndim == 1:
+            self._cols += 1
+            return MatvecFuture(
+                [(self._dispatch_matvec(x), None)], vector=True
+            )
+        b = x.shape[1]
         self._cols += b
         parts: list[tuple[jax.Array, int | None]] = []
         if self.b_star is not None and b >= self.b_star:
@@ -398,9 +562,12 @@ class MatvecEngine:
     @property
     def stats(self) -> EngineStats:
         s = self._cache.stats
+        self._reclaim()  # in_flight reports live work, not finished stubs
         return EngineStats(
             compiles=s.compiles, hits=s.hits, requests=self._requests,
             dispatches=self._dispatches, cols=self._cols,
+            in_flight=len(self._outstanding), drains=self._drains,
+            deadline_failures=self._deadline_failures,
         )
 
     @property

@@ -11,6 +11,7 @@ from .base import MatvecStrategy
 from .blockwise import BlockwiseStrategy
 from .colwise import (
     ColwiseAllToAllStrategy,
+    ColwiseOverlapStrategy,
     ColwiseRingOverlapStrategy,
     ColwiseRingStrategy,
     ColwiseStrategy,
@@ -23,6 +24,7 @@ STRATEGIES: dict[str, type[MatvecStrategy]] = {
     ColwiseRingStrategy.name: ColwiseRingStrategy,
     ColwiseRingOverlapStrategy.name: ColwiseRingOverlapStrategy,
     ColwiseAllToAllStrategy.name: ColwiseAllToAllStrategy,
+    ColwiseOverlapStrategy.name: ColwiseOverlapStrategy,
     BlockwiseStrategy.name: BlockwiseStrategy,
 }
 
@@ -48,6 +50,7 @@ __all__ = [
     "ColwiseRingStrategy",
     "ColwiseRingOverlapStrategy",
     "ColwiseAllToAllStrategy",
+    "ColwiseOverlapStrategy",
     "BlockwiseStrategy",
     "STRATEGIES",
     "get_strategy",

@@ -28,6 +28,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.gemv import get_kernel
 from ..utils.compat import shard_map
+from ..utils.errors import ShardingError
+
+# Static stage-count default for the staged `overlap` schedules on a
+# tuning-cache miss: the minimal genuinely-pipelined split (S=1 is the
+# degenerate un-overlapped schedule; deeper ladders are the tuner's call —
+# more stages shrink each collective but multiply dispatch overhead).
+DEFAULT_OVERLAP_STAGES = 2
 
 
 class MatvecStrategy(abc.ABC):
@@ -85,20 +92,31 @@ class MatvecStrategy(abc.ABC):
 
     # ---- combine-schedule machinery (the autotuner's third axis) ----
 
-    def with_combine(self, combine: str):
+    def with_combine(self, combine: str, *, stages: int | None = None):
         """Return a rebound strategy instance implementing ``combine`` as an
         in-body schedule, or None when this strategy has no in-body combine
         (the base: rowwise/blockwise, whose combine IS the output gather,
-        handled by :meth:`build`)."""
+        handled by :meth:`build`). ``stages`` pins the staged ``overlap``
+        schedule's stage count on the bound instance (None defers to the
+        tuning cache at trace time)."""
         return None
 
     def combine_candidates(self, mesh: Mesh) -> tuple[str, ...]:
         """Combine schedules the autotuner may measure/select for this
-        strategy. The base family is the output-gather pair; strategies
+        strategy. The base family is the output-gather triple — the XLA
+        gather, the explicit neighbor ring, and the staged ``overlap``
+        gather (compute pipelined against chunked ring hops); strategies
         owning an in-body combine (colwise) override."""
         if self.specs(mesh)[2] == P():
             return ()
-        return ("gather", "ring")
+        return ("gather", "ring", "overlap")
+
+    def overlap_reduce_axes(self, mesh: Mesh):
+        """Mesh axes the staged overlap gather must psum each stage's
+        partial over before gathering (blockwise's reduce-over-grid-columns;
+        None for strategies whose local block is already an exact y
+        slice)."""
+        return None
 
     def default_combine(self, mesh: Mesh) -> str:
         """The static default the ``auto`` tier falls back to on a tuning-
@@ -107,30 +125,40 @@ class MatvecStrategy(abc.ABC):
 
     def _build_combine(
         self, mesh: Mesh, combine: str, *, batched: bool = False,
-        **build_kwargs
+        stages: int | None = None, **build_kwargs
     ) -> Callable[[Array, Array], Array]:
         """Build the concrete matvec (or batched matmul) for one resolved
         combine schedule."""
-        bound = self.with_combine(combine)
+        bound = self.with_combine(combine, stages=stages)
         if bound is not None:
             if batched:
+                if not self.supports_combine_batched(combine):
+                    # e.g. pallas_ring: the fused kernel is rank-1 only.
+                    raise ValueError(
+                        f"strategy {self.name!r} has no batched combine "
+                        f"schedule {combine!r}"
+                    )
                 return bound.build_batched(mesh, **build_kwargs)
             return bound.build(mesh, **build_kwargs)
         if batched:
             if combine != "gather":
-                # The gather-schedule pair only exists for the matvec path:
-                # ring_all_gather is rank-1 (parallel/ring.py), and the
-                # batched output gather is XLA's to schedule.
+                # The gather-schedule family (ring/overlap) only exists for
+                # the matvec path: the batched output gather is XLA's to
+                # schedule (colwise's in-body overlap is the batched face).
                 raise ValueError(
                     f"strategy {self.name!r} has no batched combine "
                     f"schedule {combine!r}"
                 )
             return self._build_batched_plain(mesh, **build_kwargs)
-        if combine == "ring":
+        if combine in ("ring", "overlap"):
             # Gather-schedule knob: only meaningful when the output is being
             # gathered. gather_output=False keeps the caller's sharded y —
             # a cache-chosen schedule must never override that contract.
             if build_kwargs.get("gather_output", True):
+                if combine == "overlap":
+                    return self._build_overlap_gather(
+                        mesh, stages=stages, **build_kwargs
+                    )
                 build_kwargs["gather_output"] = "ring"
         elif combine != "gather":
             raise ValueError(
@@ -148,7 +176,7 @@ class MatvecStrategy(abc.ABC):
             bound = self.with_combine(combine)
         except ValueError:
             return False
-        return bound is not None or combine in ("gather", "ring")
+        return bound is not None or combine in ("gather", "ring", "overlap")
 
     def supports_combine_batched(self, combine: str | None) -> bool:
         """:meth:`supports_combine` for :meth:`build_batched`: the in-body
@@ -167,6 +195,130 @@ class MatvecStrategy(abc.ABC):
         if self.with_combine(self.default_combine(mesh)) is None:
             return ()
         return self.combine_candidates(mesh)
+
+    # ---- staged-overlap machinery (the autotuner's fifth axis) ----
+
+    def overlap_chunk_devices(self, mesh: Mesh) -> int:
+        """The number of devices one output chunk is divided across — the
+        denominator of the stage ladder (S must divide ``m /
+        chunk_devices``): the product of the axes in the overlap-bound
+        strategy's native y spec (the flat mesh for the 1-D strategies,
+        the 'rows' axis alone for blockwise). Single source for the
+        engine, the overlap-gather builder, and ``tune_overlap``."""
+        bound = self.with_combine("overlap") or self
+        spec_y = bound.specs(mesh)[2]
+        y_axes = spec_y[0]
+        names = (y_axes,) if isinstance(y_axes, str) else tuple(y_axes)
+        chunk_devices = 1
+        for name in names:
+            chunk_devices *= mesh.shape[name]
+        return chunk_devices
+
+    def resolve_stages(
+        self,
+        m: int,
+        k: int,
+        mesh: Mesh,
+        stages: int | str | None,
+        chunk_devices: int,
+        dtype,
+    ) -> int:
+        """The concrete stage count S one traced overlap program uses.
+
+        ``stages=None``/``"auto"`` consults the tuning cache
+        (``tuning.lookup_overlap`` — the measured fifth axis) and falls back
+        to :data:`DEFAULT_OVERLAP_STAGES` on a miss. The result is then
+        clamped DOWN to the largest entry of the shape's valid stage ladder
+        (``parallel.ring.stage_ladder``: S must divide the ``m /
+        chunk_devices`` per-device chunk) — a cache- or caller-chosen S
+        must degrade to a coarser pipeline on a shape it doesn't divide,
+        never crash a shape ``validate`` accepts. S=1 (the un-pipelined
+        degenerate schedule) is always valid there.
+        """
+        from ..parallel.ring import stage_ladder
+
+        ladder = stage_ladder(m, chunk_devices)
+        if not ladder:
+            # validate() admits no such shape for an overlap schedule; keep
+            # the error at the validate layer, not a silent S fallback.
+            raise ShardingError(
+                f"overlap schedule needs n_rows divisible by "
+                f"{chunk_devices} (got {m})"
+            )
+        if stages in (None, "auto"):
+            from ..tuning import lookup_overlap
+
+            decision = lookup_overlap(
+                strategy=self.name, m=m, k=k, p=mesh_size(mesh),
+                dtype=str(dtype),
+            )
+            stages = (
+                decision.get("stages") if decision is not None
+                else DEFAULT_OVERLAP_STAGES
+            ) or DEFAULT_OVERLAP_STAGES
+        stages = int(stages)
+        if stages < 1:
+            raise ValueError(f"stages must be >= 1, got {stages}")
+        for cand in ladder:  # descending; 1 is always present
+            if cand <= stages:
+                return cand
+        return ladder[-1]
+
+    def _build_overlap_gather(
+        self,
+        mesh: Mesh,
+        *,
+        kernel: str | Callable = "xla",
+        gather_output: bool | str = True,
+        check_vma: bool | None = None,
+        stages: int | str | None = None,
+    ) -> Callable[[Array, Array], Array]:
+        """The ``combine="overlap"`` face for sharded-output strategies:
+        the local GEMV is split into S row-stages and software-pipelined
+        against each stage's chunked ring all-gather (plus, for blockwise,
+        its chunked psum over the grid columns) —
+        ``parallel.ring.staged_overlap_gather``. The whole staged program
+        is one shard_map with ``out_specs=P()`` and the vma check off for
+        this stage only (ppermute outputs are replicated in value but not
+        provably — the ``ring_all_gather`` caveat).
+
+        The result equals the ``combine="gather"`` baseline bit-for-bit in
+        sharding (fully replicated) and allclose in value.
+        """
+        del gather_output, check_vma  # overlap IS the gather; vma scoped off
+        kern = get_kernel(kernel)
+        spec_a, spec_x, spec_y = self.specs(mesh)
+        y_axes = spec_y[0]
+        reduce_axes = self.overlap_reduce_axes(mesh)
+        chunk_devices = self.overlap_chunk_devices(mesh)
+
+        from ..parallel.ring import staged_overlap_gather
+
+        built: dict[int, Callable] = {}
+
+        def make(s: int) -> Callable:
+            def body(a_blk, x_loc):
+                y = staged_overlap_gather(
+                    a_blk, x_loc, y_axes, kern, s, reduce_axes
+                )
+                return y.astype(a_blk.dtype)
+
+            return shard_map(
+                body, mesh=mesh, in_specs=(spec_a, spec_x), out_specs=P(),
+                check_vma=False,
+            )
+
+        @jax.jit
+        def matvec(a: Array, x: Array) -> Array:
+            self.validate(a.shape[0], a.shape[1], mesh)
+            s = self.resolve_stages(
+                a.shape[0], a.shape[1], mesh, stages, chunk_devices, a.dtype
+            )
+            if s not in built:
+                built[s] = make(s)
+            return built[s](a, x)
+
+        return matvec
 
     def _build_auto_combine(
         self, mesh: Mesh, *, batched: bool = False, **build_kwargs
@@ -214,6 +366,7 @@ class MatvecStrategy(abc.ABC):
         gather_output: bool | str = True,
         check_vma: bool | None = None,
         combine: str | None = None,
+        stages: int | str | None = None,
     ) -> Callable[[Array, Array], Array]:
         """Return jitted ``matvec(a, x) -> y`` for this strategy on ``mesh``.
 
@@ -233,23 +386,31 @@ class MatvecStrategy(abc.ABC):
         ``combine`` selects the combine schedule by name instead of by
         strategy subclass: for the colwise family a reduction schedule
         (``"psum"`` / ``"psum_scatter"`` / ``"ring"`` / ``"ring_overlap"`` /
-        ``"a2a"``), for sharded-output strategies a gather schedule
-        (``"gather"`` / ``"ring"``). ``combine="auto"`` consults the tuning
-        cache (``tuning/``) per operand shape at trace time and falls back
-        to the strategy's static default on a miss — the measured-selection
-        tier the autotuner populates.
+        ``"a2a"`` / the staged ``"overlap"`` / the fused ``"pallas_ring"``),
+        for sharded-output strategies a gather schedule (``"gather"`` /
+        ``"ring"`` / the staged ``"overlap"`` gather).
+        ``combine="auto"`` consults the tuning cache (``tuning/``) per
+        operand shape at trace time and falls back to the strategy's static
+        default on a miss — the measured-selection tier the autotuner
+        populates.
+
+        ``stages`` pins the ``overlap`` schedules' stage count S (ignored by
+        every other schedule): None/``"auto"`` consults the tuning cache's
+        fifth axis (``tune_overlap``; static default on a miss), an int is
+        clamped down to the largest valid ladder entry for the shape — see
+        :meth:`resolve_stages`.
         """
         if combine is None:
             combine = self.requested_combine
         if combine == "auto":
             return self._build_auto_combine(
                 mesh, kernel=kernel, gather_output=gather_output,
-                check_vma=check_vma,
+                check_vma=check_vma, stages=stages,
             )
         if combine is not None:
             return self._build_combine(
                 mesh, combine, kernel=kernel, gather_output=gather_output,
-                check_vma=check_vma,
+                check_vma=check_vma, stages=stages,
             )
         return self._build_plain(
             mesh, kernel=kernel, gather_output=gather_output,
@@ -281,7 +442,12 @@ class MatvecStrategy(abc.ABC):
             # out_specs contracts are independently validated by the XLA-
             # kernel test matrix, so relax the check for pallas-backed
             # kernels only (keyed on the resolved kernel, not its name).
-            check_vma = not getattr(kern, "relax_vma_check", False)
+            # Strategies whose BODY is pallas-backed (colwise pallas_ring —
+            # the fused collective kernel) carry the same marker themselves.
+            check_vma = not (
+                getattr(kern, "relax_vma_check", False)
+                or getattr(self, "relax_vma_check", False)
+            )
 
         body = self.local_body(mesh, kern)
         mapped = shard_map(
@@ -332,6 +498,7 @@ class MatvecStrategy(abc.ABC):
         gather_output: bool = True,
         check_vma: bool | None = None,
         combine: str | None = None,
+        stages: int | str | None = None,
     ) -> Callable[[Array, Array], Array]:
         """Return jitted ``matmul(a, b) -> c`` for a BLOCK of right-hand
         sides: ``b`` is ``(k, n_rhs)`` — one column per request — and the
@@ -345,9 +512,11 @@ class MatvecStrategy(abc.ABC):
         GEMM kernel from the rank-2 registry (``ops/gemm_kernels.py``).
         ``kernel`` names a GEMM tier; GEMV-only tier names are mapped to
         their rank-2 counterpart (``gemm_kernel_name_for``). ``combine``
-        follows :meth:`build` minus the matvec-only ``"ring"`` output
-        gather; ``combine="auto"`` consults the tuning cache under
-        ``op="gemm"``.
+        follows :meth:`build` minus the matvec-only ``"ring"``/``"overlap"``
+        output gathers and the rank-1 ``"pallas_ring"`` kernel (colwise's
+        in-body ``"overlap"`` is rank-agnostic and batches fine);
+        ``combine="auto"`` consults the tuning cache under ``op="gemm"``,
+        and ``stages`` follows :meth:`build`.
         """
         if combine is None:
             combine = self.requested_combine
@@ -355,11 +524,13 @@ class MatvecStrategy(abc.ABC):
             return self._build_auto_combine(
                 mesh, batched=True, kernel=kernel,
                 gather_output=gather_output, check_vma=check_vma,
+                stages=stages,
             )
         if combine is not None:
             return self._build_combine(
                 mesh, combine, batched=True, kernel=kernel,
                 gather_output=gather_output, check_vma=check_vma,
+                stages=stages,
             )
         return self._build_batched_plain(
             mesh, kernel=kernel, gather_output=gather_output,

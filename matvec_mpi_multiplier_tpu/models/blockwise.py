@@ -73,6 +73,14 @@ class BlockwiseStrategy(MatvecStrategy):
 
         return body
 
+    def overlap_reduce_axes(self, mesh: Mesh):
+        # The staged overlap gather (combine="overlap", models/base.py)
+        # pipelines each stage's chunked psum over the grid columns — the
+        # reference's reduce-over-grid-columns (:144-210), 1/S rows at a
+        # time — against the next stage's GEMV, then ring-gathers over
+        # 'rows'.
+        return self.col_axis
+
     def validate(self, n_rows: int, n_cols: int, mesh: Mesh) -> None:
         self._check_mesh(mesh)
         r, c = mesh_grid_shape(mesh)

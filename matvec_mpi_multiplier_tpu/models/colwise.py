@@ -23,13 +23,27 @@ family the autotuner (``tuning/``) selects over:
   computes only the tile feeding the chunk in flight, overlapping compute
   with the previous hop's ppermute — the ring-attention schedule shape;
 * ``"a2a"``           — one balanced ``lax.all_to_all`` + local reduce (the
-  Ulysses-style face of sequence parallelism).
+  Ulysses-style face of sequence parallelism);
+* ``"overlap"``       — the staged software pipeline
+  (``parallel.ring.staged_overlap_scatter``): the local GEMV splits into S
+  stages and stage s's chunked psum_scatter runs while stage s+1's GEMV
+  computes — S is the autotuner's fifth measured axis (``tune_overlap``,
+  threaded through ``build(stages=...)``); rank-agnostic, so it batches;
+* ``"overlap_ring"``  — the same staged pipeline with each stage's combine
+  as the double-buffered neighbor-ring walk (``step="ring"``): stage s's
+  accumulator rides its p−1 ppermute hops under stage s+1's GEMV;
+* ``"pallas_ring"``   — the fused Pallas collective GEMV
+  (``ops/pallas_collective.py``): the whole ring walk inside one kernel,
+  hops issued as async remote copies under the next tile's compute.
+  Matvec-only, single-axis meshes only, interpret mode off-TPU — offered
+  to the tuner only where the tile ladders are (on TPU or under
+  ``MATVEC_TUNE_PALLAS=1``).
 
 The named registry strategies ``colwise_ring`` / ``colwise_ring_overlap`` /
-``colwise_a2a`` are thin bindings of these schedules, kept for CSV-label and
-CLI compatibility; ``ColwiseStrategy(combine=...)`` is the single
-implementation, and ``combine="auto"`` defers the choice to the tuning cache
-per operand shape (``models/base.py::MatvecStrategy.build``).
+``colwise_a2a`` / ``colwise_overlap`` are thin bindings of these schedules,
+kept for CSV-label and CLI compatibility; ``ColwiseStrategy(combine=...)``
+is the single implementation, and ``combine="auto"`` defers the choice to
+the tuning cache per operand shape (``models/base.py::MatvecStrategy.build``).
 
 The reference's explicit strided-panel staging is free here: XLA
 layouts/resharding do it (SURVEY.md §5.8). Constraint preserved:
@@ -46,25 +60,37 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .base import MatvecStrategy, flat_axes, mesh_size
-from ..utils.errors import check_divisible
+from ..utils.errors import ShardingError, check_divisible
 
 # Schedules whose output is row-sharded (the scatter family). "psum" is the
-# only replicated-output schedule.
-SCATTER_COMBINES = ("psum_scatter", "ring", "ring_overlap", "a2a")
+# only replicated-output schedule. "overlap" / "overlap_ring" are the two
+# step flavors of the staged pipeline (chunked psum_scatter vs the
+# double-buffered neighbor-ring walk per stage).
+SCATTER_COMBINES = (
+    "psum_scatter", "ring", "ring_overlap", "a2a", "overlap",
+    "overlap_ring", "pallas_ring",
+)
 COLWISE_COMBINES = ("psum",) + SCATTER_COMBINES
+# The staged-pipeline pair: both thread the tuned stage count S.
+OVERLAP_COMBINES = ("overlap", "overlap_ring")
 
 
 class ColwiseStrategy(MatvecStrategy):
     name = "colwise"
 
     def __init__(
-        self, scatter_output: bool = False, combine: str | None = None
+        self,
+        scatter_output: bool = False,
+        combine: str | None = None,
+        stages: int | str | None = None,
     ):
         # scatter_output=True selects the scatter family: y comes out
         # row-sharded over the mesh instead of replicated (requires
         # n_rows % p == 0 as well). ``combine`` names the schedule directly
         # (COLWISE_COMBINES) or defers to the tuning cache with "auto";
-        # None keeps the static default for the output form.
+        # None keeps the static default for the output form. ``stages``
+        # pins the "overlap" schedule's stage count (None/"auto": tuning
+        # cache, clamped per shape — MatvecStrategy.resolve_stages).
         if combine == "auto":
             self.requested_combine = "auto"
             combine = None
@@ -76,15 +102,72 @@ class ColwiseStrategy(MatvecStrategy):
         if combine is None:
             combine = "psum_scatter" if scatter_output else "psum"
         self.combine = combine
+        self.stages = stages
         self.scatter_output = combine in SCATTER_COMBINES
+        if combine == "pallas_ring":
+            # The fused kernel's interpret-mode body defeats the vma
+            # tracker the same way the tile kernels do (models/base.py).
+            self.relax_vma_check = True
 
-    def with_combine(self, combine: str) -> "ColwiseStrategy":
-        bound = ColwiseStrategy(combine=combine)
+    def with_combine(
+        self, combine: str, *, stages: int | str | None = None
+    ) -> "ColwiseStrategy":
+        bound = ColwiseStrategy(
+            combine=combine,
+            stages=stages if stages is not None else self.stages,
+        )
         bound.name = self.name  # keep the registry/CSV label stable
         return bound
 
     def combine_candidates(self, mesh: Mesh) -> tuple[str, ...]:
-        return COLWISE_COMBINES
+        # pallas_ring is offered only where it could actually win (and be
+        # affordably measured): a single-axis mesh, on TPU or with the
+        # interpret-mode ladder forced in — the tile-ladder gating rule
+        # (tuning/search.py). Filtering here also makes a foreign cache's
+        # pallas_ring decision read as invalid off-TPU (auto falls back).
+        import os
+
+        from ..ops.pallas_collective import pallas_ring_supported
+        from ..ops.pallas_gemv import _on_tpu
+
+        if pallas_ring_supported(mesh) and (
+            _on_tpu() or os.environ.get("MATVEC_TUNE_PALLAS") == "1"
+        ):
+            return COLWISE_COMBINES
+        return tuple(c for c in COLWISE_COMBINES if c != "pallas_ring")
+
+    def combine_candidates_batched(self, mesh: Mesh) -> tuple[str, ...]:
+        # The fused pallas kernel is rank-1 only; everything else batches.
+        return tuple(
+            c for c in self.combine_candidates(mesh) if c != "pallas_ring"
+        )
+
+    def supports_combine_batched(self, combine: str | None) -> bool:
+        if combine == "pallas_ring":
+            return False
+        return super().supports_combine_batched(combine)
+
+    def build(self, mesh: Mesh, *, combine=None, stages=None, **kwargs):
+        # An explicit ``stages`` must reach the traced body even when the
+        # overlap combine comes from THIS instance's binding (the
+        # colwise_overlap registry entry, ColwiseStrategy(combine=...))
+        # rather than the ``combine=`` argument: rebind the instance's own
+        # combine so the base machinery threads stages through
+        # with_combine. Without this, build(stages=8) on colwise_overlap
+        # would silently run at the tuned/default S.
+        if combine is None and stages is not None \
+                and self.requested_combine is None:
+            combine = self.combine
+        return super().build(mesh, combine=combine, stages=stages, **kwargs)
+
+    def build_batched(self, mesh: Mesh, *, combine=None, stages=None,
+                      **kwargs):
+        if combine is None and stages is not None \
+                and self.requested_combine is None:
+            combine = self.combine
+        return super().build_batched(
+            mesh, combine=combine, stages=stages, **kwargs
+        )
 
     def default_combine(self, mesh: Mesh) -> str:
         # The static default for this instance's output form — always valid
@@ -101,10 +184,12 @@ class ColwiseStrategy(MatvecStrategy):
             a2a_psum_scatter,
             ring_matvec,
             ring_psum_scatter,
+            staged_overlap_scatter,
         )
 
         axes = flat_axes(mesh)
         combine = self.combine
+        p = mesh_size(mesh)
 
         def body(a_panel, x_seg):
             # Full-length partial y from this device's column panel — the
@@ -113,7 +198,24 @@ class ColwiseStrategy(MatvecStrategy):
             # — combined across devices by the selected schedule. The
             # cross-device sum runs on the kernel's accumulator dtype (fp32
             # for bf16 storage) and casts back only afterwards.
-            if combine == "ring_overlap":
+            if combine in OVERLAP_COMBINES:
+                # Stage resolution is trace-time Python: shapes are
+                # concrete here, and the tuning-cache lookup (stages=None)
+                # happens once per traced program, not per dispatch.
+                s = self.resolve_stages(
+                    a_panel.shape[0], x_seg.shape[0] * p, mesh, self.stages,
+                    p, a_panel.dtype,
+                )
+                y = staged_overlap_scatter(
+                    a_panel, x_seg, axes, kernel, s,
+                    step="ring" if combine == "overlap_ring"
+                    else "psum_scatter",
+                )
+            elif combine == "pallas_ring":
+                from ..ops.pallas_collective import collective_ring_gemv
+
+                y = collective_ring_gemv(a_panel, x_seg, axes)
+            elif combine == "ring_overlap":
                 y = ring_matvec(a_panel, x_seg, axes, kernel)
             elif combine == "ring":
                 y = ring_psum_scatter(kernel(a_panel, x_seg), axes)
@@ -134,6 +236,14 @@ class ColwiseStrategy(MatvecStrategy):
         check_divisible(n_cols, p, "n_cols", "number of devices")
         if self.scatter_output:
             check_divisible(n_rows, p, "n_rows", "number of devices")
+        if self.combine == "pallas_ring" and len(mesh.axis_names) != 1:
+            # A ShardingError (not the kernel's trace-time ValueError) so
+            # sweep/engine callers skip or fail fast at the validate layer.
+            raise ShardingError(
+                "combine='pallas_ring' needs a single-axis (1-D) mesh for "
+                f"its neighbor ring; got axes {mesh.axis_names} — use the "
+                "XLA 'overlap'/'ring' schedules on multi-axis meshes"
+            )
 
 
 class ColwiseRingStrategy(ColwiseStrategy):
@@ -171,3 +281,16 @@ class ColwiseAllToAllStrategy(ColwiseStrategy):
 
     def __init__(self):
         super().__init__(combine="a2a")
+
+
+class ColwiseOverlapStrategy(ColwiseStrategy):
+    """Colwise with the combine bound to the staged software pipeline
+    (``combine="overlap"``): S-stage local GEMV, each stage's chunked
+    psum_scatter in flight under the next stage's compute. Output is always
+    row-sharded. ``stages`` pins S; the default defers to the autotuner's
+    fifth axis (``tune_overlap``)."""
+
+    name = "colwise_overlap"
+
+    def __init__(self, stages: int | str | None = None):
+        super().__init__(combine="overlap", stages=stages)

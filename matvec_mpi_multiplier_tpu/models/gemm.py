@@ -81,6 +81,9 @@ _GEMM_SPECS.update(
     # Same layout contract as the ring variants (C row-sharded); the combine
     # is one balanced all_to_all + local reduce instead of p-1 ring hops.
     colwise_a2a=_specs_colwise_ring,
+    # ... and the staged software pipeline (S-stage local GEMM, each
+    # stage's chunked psum_scatter under the next stage's MXU tile).
+    colwise_overlap=_specs_colwise_ring,
 )
 
 
@@ -102,7 +105,7 @@ def validate_gemm(
         check_divisible(m, p, "m (rows of A)", "number of devices")
     elif name == "colwise":
         check_divisible(k, p, "k (contraction dim)", "number of devices")
-    elif name.startswith("colwise_ring") or name == "colwise_a2a":
+    elif name.startswith("colwise_"):
         check_divisible(k, p, "k (contraction dim)", "number of devices")
         # Both scatter C rows: each device ends with m/p of them.
         check_divisible(m, p, "m (rows of A)", "number of devices")
@@ -136,6 +139,7 @@ def build_gemm(
     gather_output: bool = True,
     check_vma: bool | None = None,
     combine: str | None = None,
+    stages: int | str | None = None,
 ) -> Callable[[Array, Array], Array]:
     """Return jitted ``matmul(a, b) -> c`` for one strategy on ``mesh``.
 
@@ -146,11 +150,13 @@ def build_gemm(
     ``combine`` selects the combine schedule by name instead of by registry
     entry, exactly as ``MatvecStrategy.build`` does for matvec: for the
     colwise family a reduction schedule (``"psum"`` / ``"psum_scatter"`` /
-    ``"ring"`` / ``"ring_overlap"`` / ``"a2a"``), and ``combine="auto"``
-    consults the tuning cache per operand shape under ``op="gemm"``
-    (static default on a miss). The registry names ``colwise_ring`` /
-    ``colwise_a2a`` / ... remain as thin bindings for CSV-label and CLI
-    compatibility.
+    ``"ring"`` / ``"ring_overlap"`` / ``"a2a"`` / the staged
+    ``"overlap"``), and ``combine="auto"`` consults the tuning cache per
+    operand shape under ``op="gemm"`` (static default on a miss); the
+    rank-1-only ``"pallas_ring"`` is rejected. ``stages`` pins the
+    ``overlap`` stage count (None/"auto": the tuned fifth axis). The
+    registry names ``colwise_ring`` / ``colwise_a2a`` / ``colwise_overlap``
+    / ... remain as thin bindings for CSV-label and CLI compatibility.
 
     Implementation: the matvec strategies' own ``build_batched``
     (models/base.py) — the specs are rank-extended by ``batched_specs`` and
@@ -164,12 +170,12 @@ def build_gemm(
         )
     from . import get_strategy
 
-    # The matvec registry carries the same six names with the same combine
+    # The matvec registry carries the same names with the same combine
     # bindings (colwise_ring = ColwiseStrategy(combine="ring"), ...).
     strat = get_strategy(name)
     return strat.build_batched(
         mesh, kernel=kernel, gather_output=gather_output,
-        check_vma=check_vma, combine=combine,
+        check_vma=check_vma, combine=combine, stages=stages,
     )
 
 

@@ -1,0 +1,213 @@
+"""Fused Pallas collective GEMV: the ring matvec as ONE kernel.
+
+The XLA overlap schedules (``parallel/ring.py``) express compute/
+communication overlap at the program level — independent collectives and
+GEMV stages interleaved in program order, overlapped by XLA's async
+collective scheduling. This module pushes the same ring-matvec schedule
+*inside* a single Pallas kernel: each of the p ring steps issues an async
+remote copy (``pltpu.make_async_remote_copy`` — a raw ICI DMA, no XLA
+collective runtime in the loop) of the accumulator to the right neighbor,
+computes the next ``(m/p, k/p)`` GEMV tile while the DMA is in flight,
+then folds the arriving accumulator in. Double-buffered: two accumulator
+slots alternate as send/receive targets, so a step's outgoing copy never
+races the next step's incoming one.
+
+Semantics match ``parallel.ring.ring_matvec`` (device ``i`` ends holding
+chunk ``i`` of ``y``, the accumulator dtype) and therefore
+``lax.psum_scatter(kernel(a_panel, x_seg), axis, tiled=True)``.
+
+Gating mirrors the tile-ladder kernels (``ops/pallas_gemv.py``): interpret
+mode off-TPU — JAX's interpret-mode DMA discharge emulates the remote
+copies through lockstep collectives, so the CPU test mesh proves
+correctness of the same kernel body that runs on hardware. Two hardware
+honesties are encoded rather than hidden:
+
+* the ring requires a **single named mesh axis** (the interpret-mode DMA
+  emulation rejects multi-axis logical device ids, and on hardware a
+  flattened 2-D mesh has no single-link neighbor ring) — reachable from
+  colwise via ``combine="pallas_ring"`` on a 1-D mesh;
+* ``A``'s local panel lives in VMEM for the kernel's lifetime, so the
+  panel must fit (~16 MiB/core) — the production-scale path is the XLA
+  ``overlap`` family; this kernel is the measured lower bound on schedule
+  overhead for panels that fit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.compat import align_vma, axis_size, shape_dtype_struct, vma_of
+from .pallas_gemv import _on_tpu
+
+
+def _resolve_ring_axis(axis_name) -> str:
+    """The single mesh axis the ring runs over. A 1-tuple unwraps; a
+    multi-axis flat tuple is rejected (no single-link neighbor ring exists
+    over a flattened 2-D mesh, and the interpret-mode DMA emulation only
+    supports one named axis)."""
+    if isinstance(axis_name, str):
+        return axis_name
+    axes = tuple(axis_name)
+    if len(axes) != 1:
+        raise ValueError(
+            "pallas_ring needs a single-axis (1-D) mesh for its neighbor "
+            f"ring; got axes {axes!r} — use the XLA 'overlap'/'ring' "
+            "schedules on multi-axis meshes"
+        )
+    return axes[0]
+
+
+def _ring_gemv_kernel(
+    x_ref, a_ref, o_ref, comm_ref, scratch_ref, send_sem, recv_sem,
+    *, axis: str, p: int, barrier: bool,
+):
+    """The p-step ring walk: comm slot alternation per step, one remote DMA
+    in flight per step, the next tile's GEMV computed under it.
+
+    Ring schedule (``parallel.ring._ring_reduce`` semantics): the
+    accumulator starts as this device's tile for chunk ``my-1`` and moves
+    one neighbor right per step; after step s the arriving accumulator is
+    the partial for chunk ``my-2-s``, which is exactly the tile computed
+    under that step's DMA.
+    """
+    my = jax.lax.axis_index(axis)
+    chunk_rows = o_ref.shape[0]
+
+    def tile(i):
+        # Rows of this panel feeding output chunk i (traced ring index).
+        start = jnp.mod(i, p) * chunk_rows
+        a_tile = a_ref[pl.ds(start, chunk_rows), :].astype(o_ref.dtype)
+        x_row = x_ref[...].astype(o_ref.dtype)  # (1, k_loc)
+        return jnp.sum(a_tile * x_row, axis=1, keepdims=True)
+
+    if p == 1:
+        o_ref[...] = tile(0)
+        return
+
+    if barrier:
+        # Hardware-only: neighbors must have entered the kernel (and thus
+        # own their comm scratch) before the first DMA targets it. The
+        # interpret-mode emulation is lockstep by construction, and its
+        # discharge has no barrier-semaphore rule, so this is gated off.
+        barrier_sem = pltpu.get_barrier_semaphore()
+        for nbr in (jnp.mod(my - 1, p), jnp.mod(my + 1, p)):
+            pltpu.semaphore_signal(
+                barrier_sem, inc=1, device_id=nbr,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+        pltpu.semaphore_wait(barrier_sem, 2)
+
+    right = jnp.mod(my + 1, p)
+    comm_ref[0] = tile(my - 1)
+    for s in range(p - 1):
+        send_slot, recv_slot = s % 2, (s + 1) % 2
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[send_slot],
+            dst_ref=comm_ref.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        # The overlap window: the next chunk's GEMV tile computes while the
+        # accumulator is on the wire.
+        scratch_ref[...] = tile(my - 2 - s)
+        rdma.wait()
+        comm_ref[recv_slot] = comm_ref[recv_slot] + scratch_ref[...]
+    o_ref[...] = comm_ref[(p - 1) % 2]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("axis", "p", "interpret", "collective_id")
+)
+def _collective_ring_gemv(
+    a_panel: Array,
+    x_seg: Array,
+    *,
+    axis: str,
+    p: int,
+    interpret: bool,
+    collective_id: int,
+) -> Array:
+    m, k_loc = a_panel.shape
+    chunk_rows = m // p
+    acc = jnp.promote_types(a_panel.dtype, jnp.float32)
+    vma = vma_of(a_panel) | vma_of(x_seg)
+    a_panel, x_seg = align_vma(a_panel, x_seg)
+    kernel = functools.partial(
+        _ring_gemv_kernel, axis=axis, p=p, barrier=not interpret
+    )
+    kwargs = {}
+    if not interpret:
+        # The barrier semaphore is keyed by collective_id on hardware;
+        # interpret mode takes no compiler params.
+        kwargs["compiler_params"] = pltpu.TPUCompilerParams(
+            collective_id=collective_id,
+        )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=shape_dtype_struct((chunk_rows, 1), acc, vma=vma),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_rows, 1), acc),  # double-buffered acc
+            pltpu.VMEM((chunk_rows, 1), acc),     # in-flight tile
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(x_seg[None, :], a_panel)
+    return out[:, 0]
+
+
+def collective_ring_gemv(
+    a_panel: Array,
+    x_seg: Array,
+    axis_name,
+    *,
+    interpret: bool | None = None,
+    collective_id: int = 7,
+) -> Array:
+    """Fused ring matvec: must be called inside shard_map over a single
+    mesh axis. ``a_panel`` is the device's ``(m, k/p)`` column panel,
+    ``x_seg`` its ``(k/p,)`` x segment; device ``i`` returns chunk ``i``
+    of ``y`` (length ``m/p``, accumulator dtype) — the
+    ``parallel.ring.ring_matvec`` contract, with the ring's hops issued as
+    in-kernel async remote copies instead of ``ppermute``.
+
+    Matvec-only (one RHS column): the batched face stays on the XLA
+    schedules. ``interpret`` defaults to off-TPU detection, like the tile
+    kernels.
+    """
+    if x_seg.ndim != 1:
+        raise ValueError(
+            "pallas_ring is matvec-only (rank-1 x); use the XLA "
+            f"'overlap'/'ring' schedules for batched RHS, got rank "
+            f"{x_seg.ndim}"
+        )
+    axis = _resolve_ring_axis(axis_name)
+    p = axis_size(axis)
+    m = a_panel.shape[0]
+    if m % p != 0:
+        raise ValueError(
+            f"collective_ring_gemv: {m} rows not divisible by {p}"
+        )
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _collective_ring_gemv(
+        a_panel, x_seg, axis=axis, p=p, interpret=interpret,
+        collective_id=collective_id,
+    )
+
+
+def pallas_ring_supported(mesh) -> bool:
+    """True when the mesh admits the fused kernel's neighbor ring: exactly
+    one named axis. The colwise strategy consults this to fail fast (and
+    the tuner to skip the candidate) instead of erroring mid-trace."""
+    return len(mesh.axis_names) == 1

@@ -152,7 +152,9 @@ def a2a_psum_scatter(x: Array, axis_name: str) -> Array:
 def ring_all_gather(x: Array, axis_name: str) -> Array:
     """Ring all-gather: each device's chunk circulates p−1 hops; the result
     is the axis-ordered concatenation, identical to
-    ``lax.all_gather(x, axis_name, tiled=True)``.
+    ``lax.all_gather(x, axis_name, tiled=True)``. Rank-agnostic: a length-n
+    vector gathers to ``(p·n,)``, an ``(n, b)`` block to ``(p·n, b)`` —
+    the batched bodies ride the same walk.
 
     The rowwise strategy's final gather (``MPI_Gather``,
     ``src/multiplier_rowwise.c:141``) expressed as neighbor traffic.
@@ -171,11 +173,203 @@ def ring_all_gather(x: Array, axis_name: str) -> Array:
     idx = jax.lax.axis_index(axis_name)
     perm = _ring_perm(p)
     n = x.shape[0]
-    out = jnp.zeros((p, n), x.dtype)
+    out = jnp.zeros((p,) + x.shape, x.dtype)
     piece = x
     # After s hops, `piece` is the chunk originally owned by (idx - s).
     out = out.at[jnp.mod(idx, p)].set(piece)
     for s in range(1, p):
         piece = jax.lax.ppermute(piece, axis_name, perm)
         out = out.at[jnp.mod(idx - s, p)].set(piece)
-    return out.reshape(p * n)
+    return out.reshape((p * n,) + x.shape[1:])
+
+
+# --------------------------------------------------------------- overlap
+#
+# The staged `overlap` schedule family: split the contraction into S stages
+# and software-pipeline them, so stage s's partial-combine (a chunked
+# psum_scatter or a double-buffered neighbor-ring walk) is already in
+# flight while stage s+1's local partial GEMV computes. On a TPU this is
+# the latency-hiding shape of large-scale linear algebra (arXiv:2112.09017):
+# the ICI carries stage s while the MXU runs stage s+1, instead of the
+# whole interconnect idling until the full local GEMV finishes. On the CPU
+# test mesh the schedules are sequential but bit-equivalent in structure,
+# so correctness is provable off-hardware.
+#
+# Stage layout: the device's output chunk (m/p rows for the scatter family,
+# m_loc local rows for the gather family) is divided into S contiguous
+# sub-chunks, and stage s covers sub-chunk s of EVERY device — so each
+# stage's combine moves 1/S of the bytes the un-staged combine would, and
+# concatenating the S per-stage results reassembles the contiguous chunk.
+#
+# Lint contract (scripts/tier1.sh, tests/test_lint.py): overlap schedule
+# bodies in this module and ops/pallas_collective.py must never issue an
+# un-chunked full-width collective — every collective here handles one
+# stage's sub-chunk. Deliberate exceptions carry an `# overlap-ok:` marker
+# with a reason.
+
+
+def stage_ladder(m: int, p: int, ladder=(8, 4, 2, 1)) -> list[int]:
+    """Stage counts from ``ladder`` that evenly divide the per-device chunk
+    ``m // p`` (largest first; ``1`` — the un-pipelined degenerate schedule
+    — always qualifies when ``m % p == 0``). The autotuner measures exactly
+    these; dispatch clamps a requested S down to the first valid entry."""
+    if m % p != 0:
+        return []
+    chunk = m // p
+    return [s for s in sorted(set(ladder), reverse=True) if chunk % s == 0]
+
+
+def _pipeline_stages(compute, combine, stages: int) -> list:
+    """The software pipeline shared by the staged schedules: issue stage
+    s's combine BEFORE tracing stage s+1's compute, so in program order
+    every collective sits between two independent compute steps — the
+    window XLA's async collective scheduling overlaps on TPU. Returns the
+    S combined pieces in stage order."""
+    pieces = []
+    prev = compute(0)
+    for s in range(1, stages):
+        in_flight = combine(prev)  # stage s-1's combine, already issued...
+        prev = compute(s)          # ...while stage s's GEMV computes
+        pieces.append(in_flight)
+    pieces.append(combine(prev))
+    return pieces
+
+
+def staged_overlap_scatter(
+    a_panel: Array,
+    x_seg: Array,
+    axis_name,
+    kernel,
+    stages: int,
+    step: str = "psum_scatter",
+) -> Array:
+    """Pipelined colwise combine: S-stage local GEMV with each stage's
+    chunked reduce-scatter overlapping the next stage's compute.
+
+    Must be called inside shard_map. ``a_panel`` is the device's
+    ``(m, k/p)`` column panel, ``x_seg`` its x segment (rank-1 vector or
+    rank-2 ``(k/p, b)`` block — the walk is rank-agnostic); device ``i``
+    returns sub-chunk ``i`` of the combined result (leading dim ``m/p``,
+    the kernel's accumulator dtype) — the same contract as
+    ``ring_psum_scatter(kernel(a_panel, x_seg), axis_name)``.
+
+    ``step`` picks the per-stage combine primitive:
+
+    * ``"psum_scatter"`` — one chunked ``lax.psum_scatter`` per stage
+      (1/S of the full-width scatter's bytes), XLA-scheduled;
+    * ``"ring"`` — the double-buffered neighbor-ring walk
+      (:func:`ring_psum_scatter`): stage s's accumulator rides its p−1
+      ``ppermute`` hops while stage s+1's GEMV computes — two live
+      buffers, the explicit-schedule face.
+
+    Stage s computes rows ``{i·(m/p) + s·(m/(p·S)) ...}`` for every device
+    chunk i (the interleaved selection that makes the S per-stage scatter
+    results concatenate into the device's contiguous ``m/p`` rows).
+    Requires ``m % (p·S) == 0``.
+    """
+    p = axis_size(axis_name)
+    if stages < 1:
+        raise ValueError(f"staged_overlap_scatter: stages must be >= 1, got {stages}")
+    if step not in ("psum_scatter", "ring"):
+        raise ValueError(
+            f"staged_overlap_scatter: unknown step {step!r} "
+            "(expected 'psum_scatter' or 'ring')"
+        )
+    m = a_panel.shape[0]
+    if p == 1:
+        # Degenerate ring: no combine exists; stage the compute anyway so
+        # S>1 traces the same staged program shape it does on p>1.
+        if m % stages != 0:
+            raise ValueError(
+                f"staged_overlap_scatter: {m} rows not divisible by "
+                f"stages={stages}"
+            )
+        slabs = a_panel.reshape(stages, m // stages, *a_panel.shape[1:])
+        pieces = _pipeline_stages(
+            lambda s: kernel(slabs[s], x_seg), lambda v: v, stages
+        )
+        return jnp.concatenate(pieces, axis=0)
+    if m % (p * stages) != 0:
+        raise ValueError(
+            f"staged_overlap_scatter: {m} rows not divisible by "
+            f"p*stages={p}*{stages}"
+        )
+    sub = m // (p * stages)  # rows per (device chunk, stage) cell
+    # (p, S, sub, k_loc): axis 0 walks device chunks, axis 1 stages.
+    cells = a_panel.reshape(p, stages, sub, *a_panel.shape[1:])
+
+    def compute(s):
+        # Stage s's slab: sub-chunk s of every device chunk, device-major —
+        # a (p·sub, k_loc) GEMV, 1/S of the local panel's rows.
+        slab = cells[:, s].reshape(p * sub, *a_panel.shape[1:])
+        return kernel(slab, x_seg)
+
+    if step == "ring":
+        combine = lambda v: ring_psum_scatter(v, axis_name)
+    else:
+        combine = lambda v: jax.lax.psum_scatter(v, axis_name, tiled=True)
+    return jnp.concatenate(_pipeline_stages(compute, combine, stages), axis=0)
+
+
+def staged_overlap_gather(
+    a_blk: Array,
+    x_loc: Array,
+    gather_axes,
+    kernel,
+    stages: int,
+    reduce_axes=None,
+) -> Array:
+    """Pipelined output gather for the sharded-output strategies: S-stage
+    local GEMV with each stage's chunked ring all-gather (and, for
+    blockwise, its chunked psum over the grid columns) overlapping the
+    next stage's compute.
+
+    Must be called inside shard_map. ``a_blk`` is the device's local row
+    block (``(m_loc, k_loc)``), ``x_loc`` its local RHS (vector or block);
+    returns the FULL replicated result (``(m,)`` / ``(m, b)``, accumulator
+    dtype) — the same value as gathering ``kernel(a_blk, x_loc)`` over
+    ``gather_axes``, i.e. the ``combine="gather"`` baseline.
+
+    ``reduce_axes`` names mesh axes to psum each stage's partial over
+    before gathering (blockwise's reduce-over-grid-columns); each such
+    psum is chunked — it carries ``m_loc/S`` rows, not ``m_loc``.
+
+    Like :func:`ring_all_gather`, the result is replicated in value but
+    not provably so through ppermute: callers returning it through
+    ``out_specs=P()`` must build with ``check_vma=False`` (``models/base``
+    scopes that to this overlap program only). Requires
+    ``m_loc % S == 0``.
+    """
+    if stages < 1:
+        raise ValueError(f"staged_overlap_gather: stages must be >= 1, got {stages}")
+    m_loc = a_blk.shape[0]
+    if m_loc % stages != 0:
+        raise ValueError(
+            f"staged_overlap_gather: {m_loc} local rows not divisible by "
+            f"stages={stages}"
+        )
+    sub = m_loc // stages
+    p = axis_size(gather_axes)
+
+    def compute(s):
+        part = kernel(
+            jax.lax.dynamic_slice_in_dim(a_blk, s * sub, sub, axis=0), x_loc
+        )
+        if reduce_axes is not None:
+            # Chunked reduce-over-grid-columns: sub = m_loc/S rows per psum,
+            # pipelined against the next stage's GEMV like the gather hops.
+            part = jax.lax.psum(part, reduce_axes)  # overlap-ok: chunked (m_loc/S rows per stage)
+        return part
+
+    pieces = _pipeline_stages(
+        compute, lambda v: ring_all_gather(v, gather_axes), stages
+    )
+    if stages == 1:
+        return pieces[0]
+    # Each gathered piece is (p·sub, ...) device-major for ONE stage;
+    # stage-major stack -> (S, p, sub, ...) -> device-major reassembly.
+    stacked = jnp.stack(pieces, axis=0).reshape(
+        (stages, p, sub) + pieces[0].shape[1:]
+    )
+    moved = jnp.moveaxis(stacked, 0, 1)  # (p, S, sub, ...)
+    return moved.reshape((p * stages * sub,) + pieces[0].shape[1:])

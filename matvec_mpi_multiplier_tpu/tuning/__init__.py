@@ -34,6 +34,7 @@ from .cache import (
     default_cache_path,
     gemm_key,
     gemv_key,
+    overlap_key,
     platform_fingerprint,
     promote_key,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "default_cache_path",
     "gemm_key",
     "gemv_key",
+    "overlap_key",
     "platform_fingerprint",
     "promote_key",
     "get_cache",
@@ -55,6 +57,7 @@ __all__ = [
     "lookup_gemm",
     "lookup_combine",
     "lookup_promotion",
+    "lookup_overlap",
 ]
 
 # The dispatch-side singleton: loaded lazily on first lookup so importing
@@ -129,3 +132,15 @@ def lookup_promotion(
     width at which one sharded GEMM measured faster than ``b`` sequential
     single-RHS dispatches (null when promotion never won)."""
     return get_cache().lookup(promote_key(strategy, m, k, p, dtype))
+
+
+def lookup_overlap(
+    *, strategy: str, m: int, k: int, p: int, dtype: str
+) -> dict[str, Any] | None:
+    """The recorded staged-overlap stage count for this (GLOBAL shape,
+    mesh size), or None — ``MatvecStrategy.resolve_stages``'s question when
+    ``combine="overlap"`` is built with ``stages=None``/"auto". The
+    decision's ``stages`` is the measured winner of the stage ladder
+    (``search.tune_overlap``); a miss falls back to the static default and
+    a winner invalid for the dispatch shape is clamped down the ladder."""
+    return get_cache().lookup(overlap_key(strategy, m, k, p, dtype))

@@ -11,10 +11,10 @@ harmless on another (its entries simply never match, so dispatch falls back
 to the static defaults and a ``--tune`` run re-measures), and a single file
 can carry tunings for several platforms side by side.
 
-Schema (version 2)::
+Schema (version 3)::
 
     {
-      "version": 2,
+      "version": 3,
       "entries": {
         "<fingerprint>|gemv|<m>x<k>|<dtype>":
             {"kernel": "pallas", "bm": 512, "bk": 2048,
@@ -24,15 +24,21 @@ Schema (version 2)::
         "<fingerprint>|combine|<op>|<strategy>|<m>x<k>|p<p>|<dtype>":
             {"combine": "psum_scatter", "time_s": ..., "candidates": {...}},
         "<fingerprint>|promote|<strategy>|<m>x<k>|p<p>|<dtype>":
-            {"b_star": 4, "seq_time_s": ..., "gemm_times": {"4": ...}}
+            {"b_star": 4, "seq_time_s": ..., "gemm_times": {"4": ...}},
+        "<fingerprint>|overlap|<strategy>|<m>x<k>|p<p>|<dtype>":
+            {"stages": 4, "time_s": ..., "candidates": {"1": ..., "2": ...}}
       }
     }
 
-Version 2 over 1: GEMM decisions carry measured (bm, bn, bk) tile sizes,
-``combine`` keys exist for ``op="gemm"`` as well as ``"matvec"``, and the
-``promote`` kind records the GEMV→GEMM batch-promotion crossover ``b*``
-(the serving engine's fourth tuned axis — ``engine/``). Version-1 files are
-forward-compatible (their entries are a strict subset) and load as-is; a
+Version 3 over 2: the ``overlap`` kind records the measured stage count S
+of the staged compute/communication-overlap schedules
+(``combine="overlap"`` — the fifth tuned axis, ``search.tune_overlap``,
+ladder {1,2,4,8} filtered per shape). Version 2 over 1: GEMM decisions
+carry measured (bm, bn, bk) tile sizes, ``combine`` keys exist for
+``op="gemm"`` as well as ``"matvec"``, and the ``promote`` kind records
+the GEMV→GEMM batch-promotion crossover ``b*`` (the serving engine's
+fourth tuned axis — ``engine/``). Version-1 and version-2 files are
+forward-compatible (their entries are strict subsets) and load as-is; a
 file with any other ``version`` is ignored wholesale (treated as empty)
 rather than half-parsed.
 
@@ -49,11 +55,12 @@ import tempfile
 from pathlib import Path
 from typing import Any
 
-CACHE_VERSION = 2
-# Versions load() accepts: v1 entries are a strict subset of v2's (no
-# promote kind, no gemm tile fields), so an old cache keeps serving its
-# decisions after the upgrade instead of forcing a silent full re-tune.
-COMPATIBLE_VERSIONS = (1, CACHE_VERSION)
+CACHE_VERSION = 3
+# Versions load() accepts: v1/v2 entries are strict subsets of v3's (no
+# overlap kind; v1 also no promote kind or gemm tile fields), so an old
+# cache keeps serving its decisions after the upgrade instead of forcing a
+# silent full re-tune.
+COMPATIBLE_VERSIONS = (1, 2, CACHE_VERSION)
 CACHE_ENV = "MATVEC_TUNING_CACHE"
 CACHE_FILENAME = "tuning_cache.json"
 
@@ -128,6 +135,21 @@ def promote_key(
     + mesh size — the serving engine's fourth tuned axis)."""
     fp = fingerprint if fingerprint is not None else platform_fingerprint()
     return f"{fp}|promote|{strategy}|{m}x{k}|p{p}|{dtype}"
+
+
+def overlap_key(
+    strategy: str,
+    m: int,
+    k: int,
+    p: int,
+    dtype: str,
+    fingerprint: str | None = None,
+) -> str:
+    """Key for a staged-overlap stage-count decision (GLOBAL shape + mesh
+    size — the fifth tuned axis; ``MatvecStrategy.resolve_stages`` consults
+    it when ``stages`` is None/"auto")."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|overlap|{strategy}|{m}x{k}|p{p}|{dtype}"
 
 
 class TuningCache:

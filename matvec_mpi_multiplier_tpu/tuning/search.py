@@ -1,6 +1,6 @@
 """Candidate enumeration + measurement for the autotuner.
 
-Three measured axes, mirroring the repo's three static perf choices:
+Five measured axes, mirroring the repo's static perf choices:
 
 * **local kernel** — ``xla`` / ``pallas`` / ``native`` (when its .so is
   built), measured as the bare per-device kernel on one device;
@@ -8,9 +8,15 @@ Three measured axes, mirroring the repo's three static perf choices:
   budget (``ops.pallas_gemv.tile_ladder``), measured as distinct candidates
   of the kernel axis so a tile choice only wins by beating every tier;
 * **combine schedule** — the strategy-level combine family
-  (``psum_scatter`` / ``ring`` / ``ring_overlap`` / ``a2a`` for colwise,
-  ``gather`` / ``ring`` for sharded-output strategies), measured as the
-  full distributed matvec on the target mesh.
+  (``psum_scatter`` / ``ring`` / ``ring_overlap`` / ``a2a`` / ``overlap``
+  for colwise, ``gather`` / ``ring`` / ``overlap`` for sharded-output
+  strategies), measured as the full distributed matvec on the target mesh;
+* **GEMV→GEMM promotion** — the batch width ``b*`` where one sharded GEMM
+  overtakes sequential single-RHS dispatches (``tune_promotion``, the
+  serving engine's axis);
+* **overlap stage count** — the staged schedules' software-pipeline depth
+  S over the {1,2,4,8} ladder (``tune_overlap``), consulted by
+  ``build(combine="overlap", stages=None)``.
 
 All measurements ride the existing benchmark protocol (``bench.timing``):
 device-looped slope timing with median-of-samples, the same numbers the
@@ -30,7 +36,14 @@ from ..bench.timing import benchmark_gemm, benchmark_strategy, time_fn_looped
 from ..models import get_strategy
 from ..parallel.mesh import mesh_grid_shape
 from ..utils.errors import MatvecError, TimingError
-from .cache import TuningCache, combine_key, gemm_key, gemv_key, promote_key
+from .cache import (
+    TuningCache,
+    combine_key,
+    gemm_key,
+    gemv_key,
+    overlap_key,
+    promote_key,
+)
 
 # Tuning measures many candidates per config; the full 100-rep protocol
 # would make a --tune pre-pass cost more than the sweep it feeds. The slope
@@ -314,6 +327,7 @@ def tune_combine(
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
     memo: dict | None = None,
+    stages: int | None = None,
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """Measure the combine-schedule candidates for one GLOBAL
@@ -351,13 +365,15 @@ def tune_combine(
         benchmark_strategy(
             strat, mesh, a, x, dtype=dtype, n_reps=1, measure=measure,
             kernel=kernel, combine=candidates[0], chain_samples=1,
+            stages=stages,
         )
     except (MatvecError, TimingError):
         pass
     family = "colwise" if strategy_name.startswith("colwise") else strategy_name
     measured: dict[str, float] = {}
     for cand in candidates:
-        memo_key = (family, cand, m, k, p, dtype, kernel, measure)
+        memo_key = (family, cand, m, k, p, dtype, kernel, measure,
+                    stages if cand == "overlap" else None)
         if memo is not None and memo_key in memo:
             measured[cand] = memo[memo_key]
             continue
@@ -371,7 +387,7 @@ def tune_combine(
             result = benchmark_strategy(
                 strat, mesh, a, x, dtype=dtype, n_reps=n_reps,
                 measure=measure, kernel=kernel, combine=cand,
-                chain_samples=samples,
+                chain_samples=samples, stages=stages,
             )
         except TimingError:
             log(f"  combine {strategy_name} {m}x{k} p={p} {cand}: unmeasurable")
@@ -425,6 +441,7 @@ def tune_gemm_combine(
     force: bool = False,
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
+    stages: int | None = None,
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """GEMM face of :func:`tune_combine`: measure the in-body combine
@@ -457,7 +474,7 @@ def tune_gemm_combine(
         benchmark_gemm(
             strategy_name, mesh, a, b, dtype=dtype, n_reps=1,
             measure=measure, kernel=kernel, combine=candidates[0],
-            chain_samples=1,
+            chain_samples=1, stages=stages,
         )
     except (MatvecError, TimingError):
         pass
@@ -475,7 +492,7 @@ def tune_gemm_combine(
             result = benchmark_gemm(
                 strategy_name, mesh, a, b, dtype=dtype, n_reps=n_reps,
                 measure=measure, kernel=kernel, combine=cand,
-                chain_samples=samples,
+                chain_samples=samples, stages=stages,
             )
         except TimingError:
             log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} "
@@ -597,6 +614,108 @@ def tune_promotion(
     return best
 
 
+# ------------------------------------------------------------- overlap
+
+# Stage counts the overlap axis measures (filtered per shape: S must divide
+# the per-device output chunk — parallel.ring.stage_ladder). S=1 is the
+# un-pipelined degenerate schedule and doubles as the hysteresis default:
+# pipelining must beat not-pipelining by the margin to be recorded.
+OVERLAP_STAGE_LADDER = (1, 2, 4, 8)
+
+
+def tune_overlap(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    kernel: str = "xla",
+    measure: str = "auto",
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """The fifth autotuner axis: the staged-overlap stage count S.
+
+    For one GLOBAL (strategy, m, k, mesh, dtype) config, build the
+    ``combine="overlap"`` program at every valid ladder stage count and
+    measure the full distributed matvec under the benchmark protocol
+    (``measure`` follows ``tune_combine`` — the sync method matters on
+    oversubscribed virtual meshes, where queued loop chains can starve a
+    device thread past XLA's collective-rendezvous timeout); record the
+    winner under ``overlap_key`` — the decision
+    ``build(combine="overlap")`` (and the ``auto`` combine tier, and the
+    serving engine) resolves when no explicit ``stages`` is passed.
+    Strategies whose shape admits no staged schedule (or no overlap
+    candidate at all) record nothing.
+    """
+    from ..parallel.ring import stage_ladder
+    from ..utils.io import generate_matrix, generate_vector
+
+    p = int(mesh.devices.size)
+    key = overlap_key(strategy_name, m, k, p, dtype)
+    existing = cache.lookup(key)
+    if existing is not None and not force:
+        return existing
+    strat = get_strategy(strategy_name)
+    try:
+        if "overlap" not in strat.combine_candidates(mesh):
+            return None
+        bound = strat.with_combine("overlap") or strat
+        bound.validate(m, k, mesh)
+    except MatvecError:
+        return None
+    # The devices one output chunk is divided across (S must divide
+    # m / chunk_devices) — the shared derivation
+    # (MatvecStrategy.overlap_chunk_devices).
+    chunk_devices = strat.overlap_chunk_devices(mesh)
+    ladder = [
+        s for s in OVERLAP_STAGE_LADDER
+        if s in stage_ladder(m, chunk_devices, OVERLAP_STAGE_LADDER)
+    ]
+    if not ladder:
+        return None
+    a = generate_matrix(m, k, seed=seed)
+    x = generate_vector(k, seed=seed + 1)
+    # Discarded cold-process warmup (same rationale as tune_gemv): without
+    # it the first-measured stage count — the S=1 default — absorbs the
+    # one-time ramp and noise-picked winners slip past the hysteresis.
+    try:
+        benchmark_strategy(
+            strat, mesh, a, x, dtype=dtype, n_reps=1, measure=measure,
+            kernel=kernel, combine="overlap", stages=ladder[0],
+            chain_samples=1,
+        )
+    except (MatvecError, TimingError):
+        pass
+    measured: dict[str, float] = {}
+    for s in ladder:
+        try:
+            result = benchmark_strategy(
+                strat, mesh, a, x, dtype=dtype, n_reps=n_reps,
+                measure=measure, kernel=kernel, combine="overlap",
+                stages=s, chain_samples=samples,
+            )
+        except TimingError:
+            log(f"  overlap {strategy_name} {m}x{k} p={p} S={s}: unmeasurable")
+            continue
+        t = float(result.min_time_s)
+        measured[str(s)] = t
+        log(f"  overlap {strategy_name} {m}x{k} p={p} S={s}: {t * 1e6:.1f} us")
+    winner = _pick_winner(measured, default="1", min_gain=min_gain)
+    if winner is None:
+        return None
+    best = {"stages": int(winner), "time_s": measured[winner],
+            "candidates": measured}
+    cache.record(key, best)
+    return best
+
+
 # ------------------------------------------------------------ sweep-level
 
 
@@ -675,10 +794,20 @@ def tune_config(
                 lm, lk, ln, dtype, cache, n_reps=n_reps, samples=samples,
                 force=force, seed=seed, min_gain=min_gain, log=log,
             )
+        # The overlap stage decision is op-agnostic (keyed on the (m, k, p)
+        # communication shape, like promote): tune it here too so a
+        # gemm-only pass still measures it, and hand the fresh S to the
+        # combine race (the dispatch singleton hasn't re-read the cache).
+        ov = tune_overlap(
+            strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
+            measure=measure, n_reps=n_reps, samples=samples, force=force,
+            seed=seed, min_gain=min_gain, log=log,
+        )
         tune_gemm_combine(
             strategy_name, mesh, m, k, n, dtype, cache, kernel=kernel,
             measure=measure, n_reps=n_reps, samples=samples, force=force,
             seed=seed, min_gain=min_gain, log=log,
+            stages=(ov or {}).get("stages"),
         )
         return
     for lm, lk in sorted(local_gemv_shapes(strategy_name, m, k, mesh)):
@@ -686,10 +815,20 @@ def tune_config(
             lm, lk, dtype, cache, n_reps=n_reps, samples=samples,
             force=force, seed=seed, min_gain=min_gain, log=log,
         )
+    # Stage axis BEFORE the combine axis: the combine pass measures the
+    # "overlap" candidate at its resolved S (passed explicitly — the
+    # dispatch singleton hasn't re-read the cache yet), so the schedule
+    # race compares overlap at its best, not at the static default.
+    ov = tune_overlap(
+        strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
+        measure=measure, n_reps=n_reps, samples=samples, force=force,
+        seed=seed, min_gain=min_gain, log=log,
+    )
     tune_combine(
         strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
         measure=measure, n_reps=n_reps, samples=samples, force=force,
         seed=seed, min_gain=min_gain, memo=memo, log=log,
+        stages=(ov or {}).get("stages"),
     )
 
 

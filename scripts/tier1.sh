@@ -48,6 +48,27 @@ lint() {
     return 1
   fi
   echo "lint: ok (no unmarked host syncs in engine/ dispatch paths)"
+
+  # Overlap schedule bodies must stay chunked: a full-width all_gather or
+  # psum inside the staged-overlap/collective-kernel modules would serialize
+  # the very communication the schedule exists to hide. Deliberate chunked
+  # uses (e.g. the per-stage psum over grid columns) carry an
+  # `# overlap-ok: <reason>` marker. (Same rule in-suite:
+  # tests/test_lint.py::test_no_unchunked_collectives_in_overlap_bodies.)
+  bad=$(grep -rnE \
+      'jax\.lax\.all_gather\(|jax\.lax\.psum\(' \
+      --include='*.py' \
+      matvec_mpi_multiplier_tpu/parallel/ring.py \
+      matvec_mpi_multiplier_tpu/ops/pallas_collective.py \
+      2>/dev/null | grep -v 'overlap-ok:' || true)
+  if [ -n "$bad" ]; then
+    echo "LINT: un-chunked full-width collectives in overlap schedule bodies:" >&2
+    echo "$bad" >&2
+    echo "Stage the collective (1/S of the bytes per issue) or mark a" >&2
+    echo "deliberate chunked use with '# overlap-ok: <reason>'." >&2
+    return 1
+  fi
+  echo "lint: ok (no un-chunked collectives in overlap schedule bodies)"
 }
 
 lint

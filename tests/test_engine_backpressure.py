@@ -1,0 +1,239 @@
+"""Engine backpressure, deadlines, and bucket-ladder edge cases.
+
+ROADMAP item (serving deployment hardening): ``submit`` used to enqueue
+unboundedly; ``max_in_flight`` bounds the outstanding window with a
+drain-oldest high-water mark, and ``deadline_ms`` fails a request that
+waited past its deadline in that gate instead of dispatching stale work.
+Counters surface next to the compile/hit counters (``EngineStats``).
+
+Plus the bucket-ladder edges the serving contract must keep exact: a
+request wider than the max bucket, the b=1 block, and mixed-dtype streams
+(pad/unpad masking stays exact through every normalization).
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.engine import MatvecEngine
+from matvec_mpi_multiplier_tpu.utils.errors import (
+    ConfigError,
+    DeadlineExceededError,
+)
+
+
+def make_engine(rng, m=64, k=64, **kwargs):
+    a = rng.uniform(0, 10, (m, k)).astype(np.float32)
+    kwargs.setdefault("promote", 2)
+    kwargs.setdefault("max_bucket", 8)
+    return a, MatvecEngine(a, make_mesh(8), strategy="rowwise", **kwargs)
+
+
+class FakeOutstanding:
+    """A never-ready dispatch stub: lets the drain path be exercised
+    deterministically (on the CPU mesh real work finishes before the next
+    submit can observe it in flight)."""
+
+    def __init__(self):
+        self.blocked = 0
+        self.ready = False
+
+    def is_ready(self):
+        return self.ready
+
+    def block_until_ready(self):
+        self.blocked += 1
+        self.ready = True
+
+
+# ------------------------------------------------------------ backpressure
+
+
+def test_in_flight_window_bounded(devices, rng):
+    a, eng = make_engine(rng, max_in_flight=2)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    futures = [eng.submit(x) for _ in range(12)]
+    assert eng.stats.in_flight <= 2
+    for f in futures:
+        np.testing.assert_allclose(f.result(), a @ x, rtol=1e-5)
+    assert eng.stats.in_flight == 0
+    assert eng.stats.requests == 12
+
+
+def test_high_water_drains_oldest(devices, rng):
+    """At the high-water mark submit blocks on the OLDEST outstanding
+    dispatch (verified with never-ready stubs — FIFO drain order)."""
+    a, eng = make_engine(rng, max_in_flight=2)
+    first, second = FakeOutstanding(), FakeOutstanding()
+    eng._outstanding.extend([first, second])
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    y = eng.submit(x).result()
+    np.testing.assert_allclose(y, a @ x, rtol=1e-5)
+    assert first.blocked == 1          # oldest drained...
+    assert second.blocked == 0         # ...newer one left in flight
+    assert eng.stats.drains == 1
+
+
+def test_unbounded_by_default(devices, rng):
+    a, eng = make_engine(rng)
+    assert eng.max_in_flight is None
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    futures = [eng.submit(x) for _ in range(20)]
+    for f in futures:
+        f.result()
+    s = eng.stats
+    assert s.drains == 0 and s.deadline_failures == 0
+
+
+def test_max_in_flight_validation(devices, rng):
+    with pytest.raises(ConfigError, match="max_in_flight"):
+        make_engine(rng, max_in_flight=0)
+
+
+# --------------------------------------------------------------- deadlines
+
+
+def test_expired_deadline_fails_future_without_dispatch(devices, rng):
+    a, eng = make_engine(rng)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    before = eng.stats.dispatches
+    fut = eng.submit(x, deadline_ms=-1.0)  # already stale on arrival
+    assert fut.done()
+    assert isinstance(fut.exception(), DeadlineExceededError)
+    assert fut.device_values() == []
+    with pytest.raises(DeadlineExceededError):
+        fut.result()
+    s = eng.stats
+    assert s.dispatches == before, "stale request must never dispatch"
+    assert s.deadline_failures == 1
+    assert s.requests == 1
+
+
+def test_deadline_fires_when_drain_outlasts_it(devices, rng):
+    """A request whose backpressure wait exceeds its deadline is dropped at
+    the gate (the drain still happens — the window must shrink — but no
+    new work is enqueued)."""
+    import time as _time
+
+    a, eng = make_engine(rng, max_in_flight=1)
+    slow = FakeOutstanding()
+    slow.block_until_ready = lambda: (  # type: ignore[method-assign]
+        _time.sleep(0.02), setattr(slow, "ready", True),
+    )
+    eng._outstanding.append(slow)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    fut = eng.submit(x, deadline_ms=1.0)  # 1 ms < the 20 ms drain
+    with pytest.raises(DeadlineExceededError):
+        fut.result()
+    assert eng.stats.deadline_failures == 1
+
+
+def test_stale_on_arrival_skips_the_drain(devices, rng):
+    """A request already past deadline at entry must not pay the
+    backpressure drain it can never use — the window is left untouched."""
+    a, eng = make_engine(rng, max_in_flight=1)
+    pending = FakeOutstanding()
+    eng._outstanding.append(pending)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    with pytest.raises(DeadlineExceededError):
+        eng.submit(x, deadline_ms=0).result()
+    assert pending.blocked == 0
+    assert eng.stats.drains == 0
+    eng._outstanding.clear()
+
+
+def test_generous_deadline_dispatches_normally(devices, rng):
+    a, eng = make_engine(rng, max_in_flight=4)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    fut = eng.submit(x, deadline_ms=60_000.0)
+    assert fut.exception() is None
+    np.testing.assert_allclose(fut.result(), a @ x, rtol=1e-5)
+    assert eng.stats.deadline_failures == 0
+
+
+# ------------------------------------------------------ bucket-ladder edges
+
+
+def test_request_width_above_max_bucket(devices, rng):
+    """2·max_bucket + 3 columns: two full-bucket chunks plus a padded
+    remainder, reassembled in order, exact against the oracle."""
+    a, eng = make_engine(rng)
+    X = rng.uniform(0, 10, (64, 19)).astype(np.float32)  # 8 + 8 + 3->4
+    Y = eng.submit(X).result()
+    assert Y.shape == (64, 19)
+    np.testing.assert_allclose(Y, a @ X, rtol=1e-5)
+    # The chunks' columns are bitwise the full-bucket program's columns.
+    Y8 = eng.submit(X[:, :8]).result()
+    np.testing.assert_array_equal(Y[:, :8], Y8)
+
+
+def test_b1_block_both_promotion_modes(devices, rng):
+    """A (k, 1) block through the promoted path (b* = 1 forces the bucket-1
+    GEMM) and the per-column path must both match the vector request."""
+    x = None
+    for promote in (1, None):
+        rng2 = np.random.default_rng(7)
+        a, eng = make_engine(rng2, promote=promote)
+        X1 = rng2.uniform(0, 10, (64, 1)).astype(np.float32)
+        y_block = eng.submit(X1).result()
+        assert y_block.shape == (64, 1)
+        y_vec = eng.submit(X1[:, 0]).result()
+        np.testing.assert_allclose(y_block[:, 0], y_vec, rtol=1e-6)
+        np.testing.assert_allclose(y_block[:, 0], a @ X1[:, 0], rtol=1e-5)
+
+
+def test_mixed_dtype_stream_normalizes_exactly(devices, rng):
+    """Requests in dtypes other than the engine's are normalized to the
+    engine dtype at the door; the result equals serving the pre-cast
+    request — pad/unpad masking must stay exact through the cast."""
+    a, eng = make_engine(rng)
+    X = rng.uniform(0, 10, (64, 5))
+    for req_dtype in (np.float64, np.float32, np.int32):
+        Xr = X.astype(req_dtype)
+        Y = eng.submit(Xr).result()
+        Y_ref = eng.submit(Xr.astype(np.float32)).result()
+        np.testing.assert_array_equal(Y, Y_ref)
+        assert Y.dtype == np.float32
+
+
+def test_mixed_width_mixed_dtype_replay_exact(devices, rng):
+    """A mixed stream (widths 1..max, dtypes f64/f32) against a float64
+    engine: every result exact against the fp64 oracle per request."""
+    rng2 = np.random.default_rng(11)
+    a = rng2.uniform(0, 10, (64, 64))  # float64
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="colwise", promote=2, max_bucket=8,
+        max_in_flight=4,
+    )
+    assert eng.dtype == np.float64
+    futures, oracles = [], []
+    for w, dt in [(1, np.float64), (3, np.float32), (8, np.float64),
+                  (11, np.float32), (2, np.float64)]:
+        X = rng2.uniform(0, 10, (64, w)).astype(dt)
+        futures.append(eng.submit(X))
+        oracles.append(a @ X.astype(np.float64))
+    for fut, want in zip(futures, oracles):
+        np.testing.assert_allclose(fut.result(), want, rtol=1e-12)
+    assert eng.stats.in_flight <= 4
+
+
+def test_bfloat16_padding_stays_exact(devices, rng):
+    """The sub-fp32 storage path: zero pad columns cannot perturb real
+    columns even at bf16 (each output column is its own contraction)."""
+    import jax.numpy as jnp
+
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="rowwise", dtype=jnp.bfloat16,
+        promote=2, max_bucket=8,
+    )
+    X = rng.uniform(0, 10, (64, 5)).astype(np.float32)
+    Y5 = eng.submit(X).result()            # bucket 8, 3 pad columns
+    Y5_again = eng.submit(X).result()
+    np.testing.assert_array_equal(
+        np.asarray(Y5, np.float32), np.asarray(Y5_again, np.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(Y5, np.float32),
+        a.astype(np.float32) @ X, rtol=0.05,
+    )

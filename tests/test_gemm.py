@@ -16,8 +16,8 @@ from matvec_mpi_multiplier_tpu.utils.errors import ShardingError
 
 def test_registry():
     assert available_gemm_strategies() == [
-        "blockwise", "colwise", "colwise_a2a", "colwise_ring",
-        "colwise_ring_overlap", "rowwise",
+        "blockwise", "colwise", "colwise_a2a", "colwise_overlap",
+        "colwise_ring", "colwise_ring_overlap", "rowwise",
     ]
     with pytest.raises(KeyError, match="unknown gemm strategy"):
         build_gemm("diagonal", make_mesh(1))

@@ -94,3 +94,51 @@ def test_engine_sync_markers_carry_reasons():
                 if "#" not in line.split(_SYNC_EXEMPT)[0] or not tail:
                     bad.append(f"{path.relative_to(REPO)}:{lineno}")
     assert not bad, f"sync-ok markers without comment+reason: {bad}"
+
+
+# The staged overlap schedules exist to hide communication behind compute:
+# a full-width `jax.lax.all_gather(...)` / `jax.lax.psum(...)` inside an
+# overlap schedule body would re-serialize exactly the transfer the S-stage
+# pipeline chunks — the schedule would measure like the un-staged baseline
+# while claiming to overlap. Deliberate chunked uses (the per-stage psum
+# over blockwise's grid columns, 1/S of the rows per issue) carry an
+# `# overlap-ok: <reason>` marker. Mirrored fail-fast in scripts/tier1.sh.
+OVERLAP_BODIES = (
+    REPO / "matvec_mpi_multiplier_tpu" / "parallel" / "ring.py",
+    REPO / "matvec_mpi_multiplier_tpu" / "ops" / "pallas_collective.py",
+)
+
+_UNCHUNKED_PATTERN = re.compile(r"jax\.lax\.all_gather\(|jax\.lax\.psum\(")
+_OVERLAP_EXEMPT = "overlap-ok:"
+
+
+def test_no_unchunked_collectives_in_overlap_bodies():
+    offenders = []
+    for path in OVERLAP_BODIES:
+        for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1
+        ):
+            if _UNCHUNKED_PATTERN.search(line) and _OVERLAP_EXEMPT not in line:
+                offenders.append(
+                    f"{path.relative_to(REPO)}:{lineno}: {line.strip()}"
+                )
+    assert not offenders, (
+        "un-chunked full-width collectives in overlap schedule bodies "
+        "(stage the collective, or mark a deliberate chunked use with "
+        "`# overlap-ok: <reason>`):\n" + "\n".join(offenders)
+    )
+
+
+def test_overlap_markers_carry_reasons():
+    """Same contract as the sync-ok marker: a justification, not an escape
+    hatch."""
+    bad = []
+    for path in OVERLAP_BODIES:
+        for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1
+        ):
+            if _OVERLAP_EXEMPT in line:
+                tail = line.split(_OVERLAP_EXEMPT, 1)[1].strip()
+                if "#" not in line.split(_OVERLAP_EXEMPT)[0] or not tail:
+                    bad.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not bad, f"overlap-ok markers without comment+reason: {bad}"

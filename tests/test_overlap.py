@@ -1,0 +1,583 @@
+"""Staged `overlap` schedule family tests.
+
+The acceptance contract (ISSUE 3): every (strategy, S) overlap variant must
+be allclose-equivalent to that strategy's ``gather`` baseline on the CPU
+mesh, selectable via ``build(combine="overlap")`` and as a
+``combine="auto"`` candidate, with the stage count S resolved from the
+tuning cache's fifth axis (``tune_overlap``, schema v3) when not pinned.
+Covers the staged primitives (``parallel/ring.py``), the strategy-level
+wiring (``models/``), the tuner axis (``tuning/``), the serving engine's
+stage pinning (``engine/``), and the fused Pallas collective GEMV
+(``ops/pallas_collective.py``, interpret mode on this CPU mesh).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from matvec_mpi_multiplier_tpu import build_gemm, get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.engine import MatvecEngine
+from matvec_mpi_multiplier_tpu.models.base import DEFAULT_OVERLAP_STAGES
+from matvec_mpi_multiplier_tpu.ops.gemv import gemv_xla
+from matvec_mpi_multiplier_tpu.parallel.mesh import make_1d_mesh
+from matvec_mpi_multiplier_tpu.parallel.ring import (
+    stage_ladder,
+    staged_overlap_gather,
+    staged_overlap_scatter,
+)
+from matvec_mpi_multiplier_tpu.tuning import (
+    TuningCache,
+    combine_key,
+    lookup_overlap,
+    overlap_key,
+    reset_cache,
+)
+from matvec_mpi_multiplier_tpu.utils.compat import shard_map
+from matvec_mpi_multiplier_tpu.utils.errors import ShardingError
+
+OVERLAP_STRATEGIES = ("rowwise", "colwise", "blockwise")
+
+
+@pytest.fixture()
+def cache_path(tmp_path, monkeypatch):
+    path = tmp_path / "tuning_cache.json"
+    monkeypatch.setenv("MATVEC_TUNING_CACHE", str(path))
+    reset_cache()
+    yield path
+    reset_cache()
+
+
+# ------------------------------------------------------------- primitives
+
+
+def test_stage_ladder():
+    assert stage_ladder(64, 8) == [8, 4, 2, 1]
+    assert stage_ladder(48, 8) == [2, 1]  # chunk 6: only 2 and 1 divide
+    assert stage_ladder(60, 8) == []      # 60 % 8 != 0: no overlap at all
+    assert stage_ladder(8, 8) == [1]
+
+
+@pytest.mark.parametrize("step", ["psum_scatter", "ring"])
+@pytest.mark.parametrize("stages", [1, 2, 4, 8])
+def test_staged_scatter_matches_unstaged(devices, rng, stages, step):
+    """Both per-stage combine flavors, at every ladder depth, must agree
+    with the un-staged reduce-scatter of the full local partial."""
+    mesh = make_1d_mesh(8, axis_name="d")
+    m, k = 64, 32
+    a = rng.standard_normal((m, k))
+    x = rng.standard_normal(k)
+
+    ours = jax.jit(shard_map(
+        lambda ap, xs: staged_overlap_scatter(
+            ap, xs, ("d",), gemv_xla, stages, step
+        ),
+        mesh=mesh, in_specs=(P(None, "d"), P("d")), out_specs=P("d"),
+        check_vma=False,
+    ))(a, x)
+    theirs = jax.jit(shard_map(
+        lambda ap, xs: jax.lax.psum_scatter(
+            gemv_xla(ap, xs), "d", tiled=True
+        ),
+        mesh=mesh, in_specs=(P(None, "d"), P("d")), out_specs=P("d"),
+    ))(a, x)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs),
+                               rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(ours), a @ x, rtol=1e-12)
+
+
+def test_staged_scatter_batched(devices, rng):
+    """The walk is rank-agnostic: a (k/p, b) RHS block rides it unchanged."""
+    mesh = make_1d_mesh(8, axis_name="d")
+    a = rng.standard_normal((64, 32))
+    b = rng.standard_normal((32, 5))
+    c = jax.jit(shard_map(
+        lambda ap, bs: staged_overlap_scatter(
+            ap, bs, ("d",), lambda A, B: A @ B, 4, "ring"
+        ),
+        mesh=mesh, in_specs=(P(None, "d"), P("d", None)),
+        out_specs=P("d", None), check_vma=False,
+    ))(a, b)
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-12)
+
+
+@pytest.mark.parametrize("stages", [1, 2, 4])
+def test_staged_gather_matches_full(devices, rng, stages):
+    mesh = make_1d_mesh(8, axis_name="d")
+    a = rng.standard_normal((64, 32))
+    x = rng.standard_normal(32)
+    y = jax.jit(shard_map(
+        lambda ab, xf: staged_overlap_gather(ab, xf, ("d",), gemv_xla, stages),
+        mesh=mesh, in_specs=(P("d", None), P()), out_specs=P(),
+        check_vma=False,
+    ))(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-12)
+
+
+def test_staged_scatter_rejects_indivisible(devices):
+    mesh = make_1d_mesh(8, axis_name="d")
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.jit(shard_map(
+            lambda ap, xs: staged_overlap_scatter(
+                ap, xs, ("d",), gemv_xla, 4
+            ),
+            mesh=mesh, in_specs=(P(None, "d"), P("d")), out_specs=P("d"),
+            check_vma=False,
+        ))(np.ones((48, 16)), np.ones(16))  # chunk 6 % 4 != 0
+
+
+# ---------------------------------------------- strategies: the contract
+
+
+@pytest.mark.parametrize("name", OVERLAP_STRATEGIES)
+@pytest.mark.parametrize("stages", [1, 2, 4, 8])
+def test_overlap_allclose_gather_baseline(devices, rng, name, stages):
+    """The acceptance criterion: every (strategy, S) overlap variant is
+    allclose to the gather baseline on the CPU mesh."""
+    m, k = 64, 32
+    a = rng.standard_normal((m, k))
+    x = rng.standard_normal(k)
+    mesh = make_mesh(8)
+    strat = get_strategy(name)
+    baseline = np.asarray(strat.build(mesh)(jnp.asarray(a), jnp.asarray(x)))
+    y = np.asarray(
+        strat.build(mesh, combine="overlap", stages=stages)(
+            jnp.asarray(a), jnp.asarray(x)
+        )
+    )
+    np.testing.assert_allclose(y, baseline, rtol=1e-12)
+    np.testing.assert_allclose(y, a @ x, rtol=1e-10)
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+def test_overlap_across_mesh_sizes(devices, rng, n_dev):
+    a = rng.standard_normal((32, 32))
+    x = rng.standard_normal(32)
+    mesh = make_mesh(n_dev)
+    for name in OVERLAP_STRATEGIES:
+        y = get_strategy(name).build(mesh, combine="overlap", stages=2)(
+            jnp.asarray(a), jnp.asarray(x)
+        )
+        np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-10), name
+
+
+def test_overlap_fixture(devices, fixture_4x8):
+    """The committed 4x8 correctness fixture through the staged schedules
+    (4 rows: the stage ladder clamps hard)."""
+    from tests.conftest import FIXTURE_PRODUCT
+
+    a, x = fixture_4x8
+    mesh = make_mesh(2)
+    for name in OVERLAP_STRATEGIES:
+        y = get_strategy(name).build(mesh, combine="overlap", stages=4)(
+            jnp.asarray(a), jnp.asarray(x)
+        )
+        np.testing.assert_allclose(np.asarray(y), FIXTURE_PRODUCT, rtol=1e-12)
+
+
+def test_overlap_output_shardings(devices, rng):
+    """The gather-family overlap replicates y (it IS the gather); the
+    colwise overlap scatters it — and gather_output=False is never
+    overridden by a gather-schedule combine."""
+    a = rng.standard_normal((64, 64))
+    x = rng.standard_normal(64)
+    mesh = make_mesh(8)
+    y = get_strategy("rowwise").build(mesh, combine="overlap", stages=2)(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    assert y.sharding.is_fully_replicated
+    y = get_strategy("colwise").build(
+        mesh, combine="overlap", stages=2, gather_output=False
+    )(jnp.asarray(a), jnp.asarray(x))
+    assert y.sharding.spec == P(("rows", "cols"))
+    # The sharded-output contract survives a gather-schedule combine.
+    y = get_strategy("rowwise").build(
+        mesh, combine="overlap", gather_output=False
+    )(jnp.asarray(a), jnp.asarray(x))
+    assert y.sharding.spec != P()
+
+
+@pytest.mark.parametrize(
+    "kernel", ["xla", "pallas", "compensated", "ozaki"]
+)
+def test_overlap_kernel_matrix(devices, rng, kernel):
+    """The staged slabs reach every registered kernel tier (dynamic row
+    slabs of 1/S the panel) — each must survive and stay correct."""
+    a = rng.standard_normal((32, 32))
+    x = rng.standard_normal(32)
+    mesh = make_mesh(8)
+    for name in ("colwise", "rowwise"):
+        y = get_strategy(name).build(
+            mesh, combine="overlap", stages=2, kernel=kernel
+        )(jnp.asarray(a), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-6), name
+
+
+def test_overlap_reduced_precision(devices, rng):
+    a = rng.standard_normal((32, 32)).astype(np.float32)
+    x = rng.standard_normal(32).astype(np.float32)
+    mesh = make_mesh(8)
+    for dtype, rtol in (("float32", 1e-5), ("bfloat16", 0.03)):
+        y = get_strategy("colwise").build(mesh, combine="overlap", stages=4)(
+            jnp.asarray(a, dtype), jnp.asarray(x, dtype)
+        )
+        assert y.dtype == jnp.dtype(dtype)
+        np.testing.assert_allclose(
+            np.asarray(y, dtype=np.float32), a @ x, rtol=rtol, atol=rtol
+        )
+
+
+def test_colwise_overlap_registry_entry(devices, rng):
+    a = rng.standard_normal((64, 64))
+    x = rng.standard_normal(64)
+    mesh = make_mesh(8)
+    strat = get_strategy("colwise_overlap", stages=4)
+    y = np.asarray(strat.build(mesh)(jnp.asarray(a), jnp.asarray(x)))
+    np.testing.assert_allclose(y, a @ x, rtol=1e-10)
+    assert strat.default_combine(mesh) == "overlap"
+
+
+@pytest.mark.parametrize("stages", [1, 2, 8])
+def test_overlap_ring_step_flavor(devices, rng, stages):
+    """The double-buffered ring-step flavor is reachable by name, correct
+    at every depth, matvec and batched."""
+    a = rng.standard_normal((64, 64))
+    x = rng.standard_normal(64)
+    b = rng.standard_normal((64, 3))
+    mesh = make_mesh(8)
+    strat = get_strategy("colwise")
+    y = strat.build(mesh, combine="overlap_ring", stages=stages)(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-10)
+    c = strat.build_batched(mesh, combine="overlap_ring", stages=stages)(
+        jnp.asarray(a), jnp.asarray(b)
+    )
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-10)
+    assert "overlap_ring" in strat.combine_candidates(mesh)
+    # The ring-step flavor is colwise-only (the gather family's overlap
+    # already rides ring hops).
+    assert not get_strategy("rowwise").supports_combine("overlap_ring")
+
+
+def test_explicit_stages_reaches_bound_combine(devices, rng, monkeypatch):
+    """Regression: build(stages=N) on an instance whose overlap combine
+    comes from the BINDING (colwise_overlap registry entry), not the
+    combine= argument, must run at N — not silently at the tuned/default
+    stage count."""
+    import matvec_mpi_multiplier_tpu.parallel.ring as ring
+
+    a = rng.standard_normal((64, 64))
+    x = rng.standard_normal(64)
+    mesh = make_mesh(8)
+    calls = []
+    real = ring.staged_overlap_scatter
+
+    def spy(ap, xs, axes, kernel, stages, step="psum_scatter"):
+        calls.append(stages)
+        return real(ap, xs, axes, kernel, stages, step)
+
+    monkeypatch.setattr(ring, "staged_overlap_scatter", spy)
+    y = get_strategy("colwise_overlap").build(mesh, stages=8)(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-10)
+    assert calls == [8]
+
+
+def test_stage_clamping(devices, rng):
+    """A requested S that doesn't divide the per-device chunk clamps DOWN
+    the ladder instead of crashing a shape validate() accepts."""
+    mesh = make_mesh(8)
+    strat = get_strategy("colwise")
+    # m=48, p=8: chunk 6 — ladder [2, 1]; S=8 clamps to 2.
+    assert strat.resolve_stages(48, 32, mesh, 8, 8, "float32") == 2
+    assert strat.resolve_stages(48, 32, mesh, 1, 8, "float32") == 1
+    assert strat.resolve_stages(64, 32, mesh, 8, 8, "float32") == 8
+    a = rng.standard_normal((48, 32))
+    x = rng.standard_normal(32)
+    y = strat.build(mesh, combine="overlap", stages=8)(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-10)
+    with pytest.raises(ValueError, match="stages"):
+        strat.resolve_stages(64, 32, mesh, 0, 8, "float32")
+    with pytest.raises(ShardingError):
+        strat.resolve_stages(60, 32, mesh, 2, 8, "float32")
+
+
+def test_stages_default_on_cache_miss(devices, cache_path):
+    mesh = make_mesh(8)
+    s = get_strategy("colwise").resolve_stages(
+        64, 64, mesh, None, 8, "float32"
+    )
+    assert s == DEFAULT_OVERLAP_STAGES
+
+
+# ------------------------------------------------------------- batched
+
+
+@pytest.mark.parametrize("stages", [1, 2, 4])
+def test_overlap_batched_colwise(devices, rng, stages):
+    mesh = make_mesh(8)
+    a = rng.standard_normal((64, 64))
+    b = rng.standard_normal((64, 6))
+    c = get_strategy("colwise").build_batched(
+        mesh, combine="overlap", stages=stages
+    )(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-10)
+
+
+def test_build_gemm_overlap(devices, rng):
+    mesh = make_mesh(8)
+    a = rng.standard_normal((64, 64)).astype(np.float32)
+    b = rng.standard_normal((64, 8)).astype(np.float32)
+    c = build_gemm("colwise_overlap", mesh, stages=2)(a, b)
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4)
+    c = build_gemm("colwise", mesh, combine="overlap", stages=4)(a, b)
+    np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4)
+
+
+def test_overlap_gather_family_is_matvec_only(devices):
+    """rowwise/blockwise batched overlap has no in-body face — the batched
+    output gather is XLA's to schedule (same contract as 'ring')."""
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="batched combine"):
+        get_strategy("rowwise").build_batched(mesh, combine="overlap")
+    assert not get_strategy("rowwise").supports_combine_batched("overlap")
+    assert get_strategy("colwise").supports_combine_batched("overlap")
+
+
+# -------------------------------------------------------- auto + tuner
+
+
+def test_supports_combine_overlap_predicates(devices):
+    for name in OVERLAP_STRATEGIES:
+        assert get_strategy(name).supports_combine("overlap"), name
+    mesh = make_mesh(8)
+    for name in OVERLAP_STRATEGIES:
+        assert "overlap" in get_strategy(name).combine_candidates(mesh), name
+
+
+def test_combine_auto_dispatches_overlap_winner(
+    devices, rng, cache_path, monkeypatch
+):
+    """A recorded 'overlap' combine winner routes auto dispatch through the
+    staged scatter, at the stage count the overlap axis recorded."""
+    import matvec_mpi_multiplier_tpu.parallel.ring as ring
+
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    mesh = make_mesh(8)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        combine_key("matvec", "colwise", 64, 64, 8, "float32"),
+        {"combine": "overlap"},
+    )
+    cache.record(
+        overlap_key("colwise", 64, 64, 8, "float32"),
+        {"stages": 4},
+    )
+    cache.save()
+    reset_cache()
+    assert lookup_overlap(
+        strategy="colwise", m=64, k=64, p=8, dtype="float32"
+    ) == {"stages": 4}
+
+    calls = []
+    real = ring.staged_overlap_scatter
+
+    def spy(ap, xs, axes, kernel, stages, step="psum_scatter"):
+        calls.append(stages)
+        return real(ap, xs, axes, kernel, stages, step)
+
+    monkeypatch.setattr(ring, "staged_overlap_scatter", spy)
+    y = get_strategy("colwise").build(mesh, combine="auto")(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-4)
+    assert calls == [4], "auto winner did not route through staged scatter"
+
+
+def test_tune_overlap_smoke(devices, cache_path):
+    """One real (tiny) stage-axis pass: the whole valid ladder is measured,
+    the winner recorded, and resolve_stages then serves it."""
+    from matvec_mpi_multiplier_tpu.tuning.search import tune_overlap
+
+    mesh = make_mesh(4)
+    cache = TuningCache.load(cache_path)
+    decision = tune_overlap(
+        "colwise", mesh, 64, 64, "float32", cache,
+        measure="sync", n_reps=2, samples=1, log=lambda *_: None,
+    )
+    assert decision is not None
+    assert decision["stages"] in (1, 2, 4, 8)
+    assert set(decision["candidates"]) == {"1", "2", "4", "8"}
+    cache.save()
+    reset_cache()
+    assert lookup_overlap(
+        strategy="colwise", m=64, k=64, p=4, dtype="float32"
+    ) == decision
+    # Dispatch-side resolution serves the measured winner.
+    assert get_strategy("colwise").resolve_stages(
+        64, 64, mesh, None, 4, "float32"
+    ) == decision["stages"]
+    # Cache hit never re-measures.
+    again = tune_overlap(
+        "colwise", mesh, 64, 64, "float32", cache,
+        measure="sync", n_reps=2, samples=1,
+        log=lambda *_: pytest.fail("cache hit must not re-measure"),
+    )
+    assert again == decision
+    # A shape no overlap schedule accepts records nothing.
+    assert tune_overlap(
+        "colwise", mesh, 63, 64, "float32", cache,
+        measure="sync", n_reps=2, samples=1, log=lambda *_: None,
+    ) is None
+
+
+def test_cache_v2_file_still_loads(cache_path):
+    """Schema v3 bump compatibility: v2 files (pre-overlap entries) keep
+    serving their decisions instead of forcing a silent full re-tune."""
+    from matvec_mpi_multiplier_tpu.tuning import gemv_key
+
+    key = gemv_key(8, 8, "float32")
+    cache_path.write_text(json.dumps({
+        "version": 2, "entries": {key: {"kernel": "xla"}},
+    }))
+    assert TuningCache.load(cache_path).lookup(key) == {"kernel": "xla"}
+
+
+# --------------------------------------------------------------- engine
+
+
+def test_engine_overlap_combine(devices, rng, cache_path):
+    """The engine pins S at construction and bakes it into the executable
+    keys, so the AOT cache distinguishes stage counts."""
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    mesh = make_mesh(8)
+    eng = MatvecEngine(
+        a, mesh, strategy="colwise", combine="overlap", stages=4, promote=2,
+        max_bucket=8,
+    )
+    assert eng.stages == 4
+    assert eng._matvec_key().combine == "overlap@4"
+    assert eng._gemm_key(8).combine == "overlap@4"
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    np.testing.assert_allclose(eng(x), a @ x, rtol=1e-4)
+    blk = rng.uniform(0, 10, (64, 5)).astype(np.float32)
+    np.testing.assert_allclose(eng(blk), a @ blk, rtol=1e-4)
+    # Zero steady-state compiles holds for the staged schedules too.
+    eng.warmup()
+    baseline = eng.stats.compiles
+    for w in (1, 3, 5, 8, 2):
+        eng.submit(blk[:, :w]).result()
+    assert eng.stats.compiles == baseline
+
+
+def test_engine_overlap_stages_auto_from_cache(devices, rng, cache_path):
+    cache = TuningCache.load(cache_path)
+    cache.record(overlap_key("colwise", 64, 64, 8, "float32"), {"stages": 8})
+    cache.save()
+    reset_cache()
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="colwise", combine="overlap", promote=None,
+    )
+    assert eng.stages == 8
+    # Non-overlap engines resolve no stage count at all.
+    eng2 = MatvecEngine(a, make_mesh(8), strategy="colwise", promote=None)
+    assert eng2.stages is None
+
+
+def test_engine_strategy_bound_overlap_resolves_stages(devices, rng):
+    """Regression: an engine built on the colwise_overlap registry entry
+    (combine=None — the schedule comes from the strategy binding) must
+    still pin S and label its executables with it."""
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="colwise_overlap", stages=4, promote=2,
+        max_bucket=8,
+    )
+    assert eng.stages == 4
+    assert eng._matvec_key().combine == "overlap@4"
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    np.testing.assert_allclose(eng(x), a @ x, rtol=1e-4)
+    blk = rng.uniform(0, 10, (64, 5)).astype(np.float32)
+    np.testing.assert_allclose(eng(blk), a @ blk, rtol=1e-4)
+
+
+# ---------------------------------------------------- pallas collective
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+def test_pallas_collective_ring_gemv(devices, rng, n_dev):
+    from matvec_mpi_multiplier_tpu.ops.pallas_collective import (
+        collective_ring_gemv,
+    )
+
+    mesh = make_1d_mesh(n_dev, axis_name="d")
+    a = rng.standard_normal((64, 32))
+    x = rng.standard_normal(32)
+    y = jax.jit(shard_map(
+        lambda ap, xs: collective_ring_gemv(ap, xs, "d"),
+        mesh=mesh, in_specs=(P(None, "d"), P("d")), out_specs=P("d"),
+        check_vma=False,
+    ))(a, x)
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-12)
+
+
+def test_pallas_ring_combine_through_build(devices, rng):
+    a = rng.standard_normal((64, 64))
+    x = rng.standard_normal(64)
+    mesh = make_1d_mesh(8)
+    y = get_strategy("colwise").build(mesh, combine="pallas_ring")(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-12)
+
+
+def test_pallas_ring_fp32(devices, rng):
+    a = rng.standard_normal((32, 32)).astype(np.float32)
+    x = rng.standard_normal(32).astype(np.float32)
+    mesh = make_1d_mesh(4)
+    y = get_strategy("colwise").build(mesh, combine="pallas_ring")(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    assert y.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-5)
+
+
+def test_pallas_ring_needs_1d_mesh(devices, rng):
+    """Multi-axis meshes have no single-link neighbor ring: rejected at the
+    validate layer (ShardingError, skippable by the sweep driver)."""
+    a = rng.standard_normal((64, 64))
+    x = rng.standard_normal(64)
+    mesh = make_mesh(8)  # 2x4: two named axes
+    strat = get_strategy("colwise", combine="pallas_ring")
+    with pytest.raises(ShardingError, match="single-axis"):
+        strat.validate(64, 64, mesh)
+    with pytest.raises(ShardingError, match="single-axis"):
+        strat.build(mesh)(jnp.asarray(a), jnp.asarray(x))
+
+
+def test_pallas_ring_is_matvec_only(devices):
+    mesh = make_1d_mesh(8)
+    with pytest.raises(ValueError, match="batched combine"):
+        get_strategy("colwise").build_batched(mesh, combine="pallas_ring")
+    assert not get_strategy("colwise").supports_combine_batched("pallas_ring")
+
+
+def test_pallas_ring_candidate_gating(devices, monkeypatch):
+    """Offered to the tuner only where the tile ladders are: single-axis
+    mesh AND (TPU or the interpret ladder forced in)."""
+    strat = get_strategy("colwise")
+    mesh_1d, mesh_2d = make_1d_mesh(8), make_mesh(8)
+    monkeypatch.delenv("MATVEC_TUNE_PALLAS", raising=False)
+    assert "pallas_ring" not in strat.combine_candidates(mesh_1d)
+    monkeypatch.setenv("MATVEC_TUNE_PALLAS", "1")
+    assert "pallas_ring" in strat.combine_candidates(mesh_1d)
+    assert "pallas_ring" not in strat.combine_candidates(mesh_2d)
+    # Never a batched candidate, gating aside.
+    assert "pallas_ring" not in strat.combine_candidates_batched(mesh_1d)

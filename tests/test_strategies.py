@@ -28,7 +28,7 @@ from conftest import FIXTURE_MATRIX, FIXTURE_PRODUCT, FIXTURE_VECTOR
 # constraint-based skips (see test_fixture_4x8).
 ALL_STRATEGIES = [
     "rowwise", "colwise", "colwise_ring", "colwise_ring_overlap",
-    "colwise_a2a", "blockwise",
+    "colwise_a2a", "colwise_overlap", "blockwise",
 ]
 
 
@@ -191,8 +191,8 @@ def test_registry():
     from matvec_mpi_multiplier_tpu import available_strategies
 
     assert available_strategies() == [
-        "blockwise", "colwise", "colwise_a2a", "colwise_ring",
-        "colwise_ring_overlap", "rowwise",
+        "blockwise", "colwise", "colwise_a2a", "colwise_overlap",
+        "colwise_ring", "colwise_ring_overlap", "rowwise",
     ]
     with pytest.raises(KeyError, match="unknown strategy"):
         get_strategy("diagonal")

@@ -482,10 +482,12 @@ def test_tune_combine_smoke(devices, cache_path):
     )
     assert decision is not None
     assert decision["combine"] in (
-        "psum", "psum_scatter", "ring", "ring_overlap", "a2a"
+        "psum", "psum_scatter", "ring", "ring_overlap", "a2a",
+        "overlap", "overlap_ring"
     )
     assert set(decision["candidates"]) <= {
-        "psum", "psum_scatter", "ring", "ring_overlap", "a2a"
+        "psum", "psum_scatter", "ring", "ring_overlap", "a2a",
+        "overlap", "overlap_ring"
     }
     cache.save()
     reset_cache()
@@ -558,7 +560,8 @@ def test_tune_gemm_combine_smoke(devices, cache_path):
     )
     assert decision is not None
     assert decision["combine"] in (
-        "psum", "psum_scatter", "ring", "ring_overlap", "a2a"
+        "psum", "psum_scatter", "ring", "ring_overlap", "a2a",
+        "overlap", "overlap_ring"
     )
     cache.save()
     reset_cache()

@@ -1,0 +1,215 @@
+"""`check_rep` (JAX 0.4.x) vs `check_vma` (current JAX) parity audit.
+
+ROADMAP item: the compat shim (``utils/compat.py``) maps ``check_vma`` onto
+``check_rep`` on old installs — do the two enforce the same contract?
+
+Audit findings (probed on JAX 0.4.37, the container's install, and pinned
+here so a regression or a JAX upgrade surfaces as a test diff):
+
+1. **Acceptance parity holds.** Everything check_rep can analyze, it
+   enforces at least as strictly as check_vma: an under-replicated body
+   returned through ``out_specs=P()`` (missing psum, partial-axis psum on a
+   2-D mesh, a bare ``axis_index``, a ppermute chain that is replicated in
+   value but not provably) is REJECTED on both generations. No case was
+   found where check_rep silently accepts a body the vma checker rejects.
+
+2. **Coverage is the weaker contract.** check_rep has NO replication rule
+   for several primitives — ``while`` (lax.while_loop), ``pallas_call``
+   among them — and raises ``NotImplementedError`` even for perfectly VALID
+   bodies containing them. The only recourse is ``check_rep=False``, which
+   waives the psum/out_specs contract for the WHOLE body: on 0.4.x, any
+   shard_map whose body contains a while-loop or a pallas kernel runs with
+   replication checking silently absent, where the vma generation keeps
+   verifying everything else in the body. This is the one contract the
+   0.4.x path enforces more weakly — by coverage, not by acceptance.
+
+3. **The repo's mitigation is scoping.** Because turning the check off is
+   all-or-nothing per shard_map, ``models/base.py`` confines relaxation to
+   the smallest program unit: the ring-gather stage gets its own shard_map
+   with the check off while the compute body's psum contract stays
+   enforced, and pallas-backed kernels/bodies relax only their own build
+   (``relax_vma_check``). These scoping seams are pinned here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.parallel.mesh import make_1d_mesh
+from matvec_mpi_multiplier_tpu.utils.compat import HAS_VMA, shard_map
+
+
+def _run(body, mesh, in_specs, out_specs, x, check=True):
+    return jax.jit(shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check,
+    ))(x)
+
+
+# ------------------------------------------------ acceptance parity (1)
+
+
+def test_missing_psum_rejected(devices):
+    """A device-varying value through out_specs=P() must be rejected under
+    the check on BOTH generations."""
+    mesh = make_1d_mesh(8, axis_name="d")
+    x = jnp.arange(8.0)
+    with pytest.raises(Exception, match="replicat|vma"):
+        _run(lambda a: a.sum(keepdims=True), mesh, (P("d"),), P(), x)
+
+
+def test_partial_axis_psum_rejected(devices):
+    """psum over one axis of a 2-D mesh does not replicate over the other:
+    out_specs=P() must be rejected on both generations."""
+    mesh = make_mesh(8)  # ('rows', 'cols')
+    x = jnp.arange(8.0)
+    with pytest.raises(Exception, match="replicat|vma"):
+        _run(
+            lambda a: jax.lax.psum(a.sum(keepdims=True), "cols"),
+            mesh, (P(("rows", "cols")),), P(), x,
+        )
+
+
+def test_axis_index_rejected(devices):
+    mesh = make_1d_mesh(8, axis_name="d")
+    x = jnp.arange(8.0)
+    with pytest.raises(Exception, match="replicat|vma"):
+        _run(
+            lambda a: jnp.zeros((1,)) + jax.lax.axis_index("d"),
+            mesh, (P("d"),), P(), x,
+        )
+
+
+def test_full_psum_accepted(devices):
+    """The valid formulation passes the check on both generations."""
+    mesh = make_mesh(8)
+    x = jnp.arange(8.0)
+    out = _run(
+        lambda a: jax.lax.psum(a.sum(keepdims=True), ("rows", "cols")),
+        mesh, (P(("rows", "cols")),), P(), x,
+    )
+    np.testing.assert_allclose(np.asarray(out), [28.0])
+
+
+def test_ppermute_gather_unprovable_on_both(devices):
+    """A ring all-gather's result is replicated in VALUE but neither
+    checker can prove it (ppermute outputs stay axis-varying) — the reason
+    ring_all_gather callers must scope the check off. Pinned as rejected on
+    both generations so a future JAX that learns to prove it shows up."""
+    from matvec_mpi_multiplier_tpu.parallel.ring import ring_all_gather
+
+    mesh = make_1d_mesh(8, axis_name="d")
+    x = jnp.arange(8.0)
+    with pytest.raises(Exception, match="replicat|vma"):
+        _run(lambda a: ring_all_gather(a, "d"), mesh, (P("d"),), P(), x)
+    # With the check scoped off, the gather is correct.
+    out = _run(
+        lambda a: ring_all_gather(a, "d"), mesh, (P("d"),), P(), x,
+        check=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.arange(8.0))
+
+
+# ------------------------------------------------- coverage audit (2)
+
+
+@pytest.mark.skipif(
+    HAS_VMA, reason="vma-generation JAX tracks these primitives; the "
+    "no-rule failure mode is specific to the 0.4.x check_rep path",
+)
+def test_check_rep_has_no_rule_for_while(devices):
+    """THE documented weaker contract: a VALID body (value made replicated
+    by a full psum, then carried through a while_loop) cannot be verified
+    at all — check_rep raises NotImplementedError, forcing the caller to
+    disable checking wholesale."""
+    mesh = make_1d_mesh(8, axis_name="d")
+    x = jnp.arange(8.0)
+
+    def body(a):
+        s = jax.lax.psum(a.sum(), "d")
+        val = jax.lax.while_loop(lambda v: v < s, lambda v: v + 100.0, 0.0)
+        return jnp.zeros((1,)) + val
+
+    with pytest.raises(NotImplementedError, match="[Nn]o replication rule"):
+        _run(body, mesh, (P("d"),), P(), x)
+    # The forced waiver: with the check off the same body runs — and so
+    # would any OTHER contract violation in the body (the coverage gap).
+    out = _run(body, mesh, (P("d"),), P(), x, check=False)
+    assert np.asarray(out)[0] >= 28.0
+
+
+@pytest.mark.skipif(
+    HAS_VMA, reason="vma-generation JAX tracks pallas_call; the no-rule "
+    "failure mode is specific to the 0.4.x check_rep path",
+)
+def test_check_rep_has_no_rule_for_pallas_call(devices):
+    """Same coverage gap for pallas_call: the reason models/base.py keys
+    check relaxation off `relax_vma_check` rather than trusting the
+    checker to handle pallas-backed bodies."""
+    from jax.experimental import pallas as pl
+
+    mesh = make_1d_mesh(8, axis_name="d")
+    x = jnp.arange(8.0)
+
+    def kern(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 1.0
+
+    def body(a):
+        s = jax.lax.psum(a, "d")  # replicated — a valid P() output
+        return pl.pallas_call(
+            kern, out_shape=jax.ShapeDtypeStruct(s.shape, s.dtype),
+            interpret=True,
+        )(s)
+
+    with pytest.raises(NotImplementedError, match="[Nn]o replication rule"):
+        _run(body, mesh, (P("d"),), P(), x)
+
+
+# ------------------------------------------------ scoping seams (3)
+
+
+def test_ring_gather_scopes_check_to_gather_stage(devices, rng):
+    """build(gather_output='ring') relaxes the check ONLY for the gather
+    shard_map: the compute body keeps its psum/out_specs contract. Pinned
+    by checking both stages exist as separate shard_maps with the expected
+    flags is an implementation detail; the observable contract is that the
+    build works on both generations AND a compute-body violation still
+    fails."""
+    a = rng.standard_normal((16, 16))
+    x = rng.standard_normal(16)
+    mesh = make_mesh(8)
+    y = get_strategy("rowwise").build(mesh, gather_output="ring")(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-10)
+
+
+def test_pallas_kernel_relaxation_is_keyed_not_blanket(devices, rng):
+    """A pallas-backed kernel builds with the check relaxed (it could not
+    build otherwise on 0.4.x — the no-rule gap above); the XLA kernel path
+    keeps the checker on. Both must produce the oracle product."""
+    a = rng.standard_normal((16, 16))
+    x = rng.standard_normal(16)
+    mesh = make_mesh(8)
+    for kernel in ("xla", "pallas"):
+        y = get_strategy("colwise").build(mesh, kernel=kernel)(
+            jnp.asarray(a), jnp.asarray(x)
+        )
+        np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-6), kernel
+
+
+def test_overlap_gather_scopes_check_off(devices, rng):
+    """The staged overlap gather (combine='overlap' on sharded-output
+    strategies) rides ppermute chains through out_specs=P() — same
+    unprovable-replication situation as ring_all_gather, same scoped
+    check_vma=False, usable on both generations."""
+    a = rng.standard_normal((16, 16))
+    x = rng.standard_normal(16)
+    mesh = make_mesh(8)
+    y = get_strategy("blockwise").build(mesh, combine="overlap", stages=2)(
+        jnp.asarray(a), jnp.asarray(x)
+    )
+    np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-10)
