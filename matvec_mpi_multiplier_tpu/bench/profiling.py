@@ -6,6 +6,18 @@ protocol; this module adds the capability the reference lacked: on-device
 traces (TensorBoard/Perfetto format) of the benchmark region, showing the
 XLA fusion boundaries, collective schedule, and HBM traffic that the
 wall-clock numbers summarize.
+
+Two annotation layers compose inside a :func:`trace` capture:
+
+* :func:`annotate` — a host-side ``TraceAnnotation`` around a benchmark
+  region (the sweep wraps each config in one);
+* :func:`named_span` (re-exported from ``obs/annotations`` — the
+  implementation lives there so ``parallel``/``models`` can use it without
+  importing ``bench``) — trace-time spans INSIDE jitted programs: each
+  strategy's local GEMV, each combine schedule, and each overlap stage
+  (``stage{i}/compute`` / ``stage{i}/combine``). Off by default; enable
+  with ``--annotate`` on the serve/sweep CLIs, ``MATVEC_ANNOTATE=1``, or
+  :func:`set_annotations`. Capture recipe: ``docs/OBSERVABILITY.md``.
 """
 
 from __future__ import annotations
@@ -15,6 +27,13 @@ import os
 from pathlib import Path
 
 import jax
+
+from ..obs.annotations import (  # noqa: F401  (public re-exports)
+    annotations,
+    annotations_enabled,
+    named_span,
+    set_annotations,
+)
 
 
 @contextlib.contextmanager

@@ -8,7 +8,9 @@ sharded ``A`` and reports the numbers a serving system is judged on:
 * **requests/sec** and **columns/sec** over the steady phase;
 * **p50/p99 dispatch latency** — time from ``submit()`` entry to return,
   i.e. the host cost of one request *excluding* device execution (dispatch
-  never host-syncs; the stream drains once at the end);
+  never host-syncs; the stream drains once at the end). Percentiles come
+  from the shared obs histogram (``obs/registry.py`` — the one percentile
+  implementation in the repo; exact over the steady window);
 * **compile counts** per phase — the zero-recompilation criterion: after
   the warmup phase covers the bucket ladder, ``compiles_steady`` must be 0
   across any mixed-shape replay;
@@ -29,22 +31,34 @@ Usage::
     # or through the sweep driver:
     python -m matvec_mpi_multiplier_tpu.bench.sweep --op serve ...
 
+Observability: ``--metrics-out`` writes the engine's metrics snapshot
+(requests/dispatches/compiles/hits/drains + latency histograms — the same
+counters ``EngineStats`` reports, one source of truth) as JSON after each
+config; ``--trace-jsonl`` streams one span tree per request through the
+obs sink thread; ``--annotate`` enables the named device-trace spans
+(strategy bodies, overlap stages) for a ``--profile``-style capture.
+Render either with ``python -m matvec_mpi_multiplier_tpu.obs``.
+
 This is timing/driver code: host syncs are deliberate protocol fences here
-(the engine's own dispatch path stays lint-enforced sync-free).
+(the engine's own dispatch path stays lint-enforced sync-free), and the
+metrics-snapshot write happens after the timed phases.
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import sys
 import time
+from pathlib import Path
 from typing import Sequence
 
 import numpy as np
 
 from ..engine import MatvecEngine, bucket_for, split_widths
 from ..models import available_strategies
+from ..obs.registry import MetricsRegistry
 from ..utils.errors import MatvecError
 
 # Default request-width mix: single vectors through full buckets, with
@@ -209,17 +223,36 @@ def run_serve(
     donate: bool = True,
     seed: int = 0,
     promo_reps: int = 20,
+    metrics_out: str | None = None,
+    trace_jsonl: str | None = None,
 ) -> ServeResult:
-    """Run the serve protocol for one (strategy, shape, mesh) config."""
+    """Run the serve protocol for one (strategy, shape, mesh) config.
+
+    ``metrics_out``: write the run's metrics snapshot (engine counters +
+    the steady-phase dispatch-latency histogram, one registry) as JSON.
+    ``trace_jsonl``: stream every request's span tree to a JSONL file
+    (flushed before return, so the file is complete when this returns).
+    """
     from ..utils.io import generate_matrix
 
     if widths is None:
         widths = [w for w in DEFAULT_WIDTH_MIX if w <= max_bucket]
     a = generate_matrix(m, k, seed=seed).astype(dtype)
+    # One registry for the whole config: the engine's counters and the
+    # serve protocol's own latency histogram land in the same snapshot.
+    registry = MetricsRegistry()
     engine = MatvecEngine(
         a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
         stages=stages, dtype=dtype, max_bucket=max_bucket, promote=promote,
-        donate=donate,
+        donate=donate, metrics=registry, trace_jsonl=trace_jsonl,
+    )
+    latency_hist = registry.histogram(
+        "serve_dispatch_latency_ms",
+        "steady-phase submit() entry-to-return host time",
+        # Window sized to the run so percentiles are exact over the WHOLE
+        # steady phase — the default window would silently degrade a
+        # longer stream's p50/p99 to its most recent tail.
+        window=max(n_requests, 1),
     )
     pool = _request_pool(k, widths, engine.dtype, seed=seed + 1)
 
@@ -232,13 +265,12 @@ def run_serve(
     # ---- steady phase: mixed-width replay, drain once ----
     rng = np.random.default_rng(seed + 2)
     sequence = rng.choice(list(pool), size=n_requests)
-    latencies = np.empty(n_requests)
     futures = []
     start = time.perf_counter()
-    for i, w in enumerate(sequence):
+    for w in sequence:
         t0 = time.perf_counter()
         futures.append(engine.submit(pool[int(w)]))
-        latencies[i] = time.perf_counter() - t0
+        latency_hist.observe((time.perf_counter() - t0) * 1e3)
     _drain(futures)
     wall = time.perf_counter() - start
 
@@ -246,6 +278,20 @@ def run_serve(
     promo_b, promo_gemm, promo_seq = measure_promotion(
         engine, pool, n_reps=promo_reps
     )
+    if trace_jsonl is not None:
+        if not engine.flush_traces():
+            # A dead sink thread (unwritable path) must not masquerade as
+            # a successful capture.
+            print(
+                f"WARNING: trace sink could not confirm {trace_jsonl} — "
+                "the file is missing or incomplete", file=sys.stderr,
+            )
+        engine.close()  # one sink thread + file handle per config: release
+    if metrics_out is not None:
+        _ = engine.stats  # refresh the in_flight gauge before exporting
+        path = Path(metrics_out)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(registry.snapshot(), indent=2) + "\n")
     return ServeResult(
         n_rows=m,
         n_cols=k,
@@ -259,8 +305,10 @@ def run_serve(
         n_requests=n_requests,
         total_cols=int(sum(int(w) for w in sequence)),
         wall_s=wall,
-        p50_dispatch_ms=float(np.percentile(latencies, 50) * 1e3),
-        p99_dispatch_ms=float(np.percentile(latencies, 99) * 1e3),
+        # The shared histogram IS the percentile implementation (no
+        # private percentile math here): exact over the steady window.
+        p50_dispatch_ms=latency_hist.percentile(50),
+        p99_dispatch_ms=latency_hist.percentile(99),
         compiles_warmup=compiles_warmup,
         compiles_steady=steady_stats.compiles - compiles_warmup,
         hits_steady=steady_stats.hits - warm_stats.hits,
@@ -320,7 +368,18 @@ def tune_serve(
 
 def run_serve_sweep(args: argparse.Namespace) -> int:
     """The ``--op serve`` driver body shared by this module's CLI and
-    ``bench.sweep``."""
+    ``bench.sweep``. ``--annotate`` scopes the named-span override to this
+    run (an in-process caller must not find the process-global flag
+    flipped afterwards)."""
+    from ..obs.annotations import annotations
+
+    if getattr(args, "annotate", False):
+        with annotations(True):  # named spans in every program built below
+            return _run_serve_sweep(args)
+    return _run_serve_sweep(args)
+
+
+def _run_serve_sweep(args: argparse.Namespace) -> int:
     from ..parallel.mesh import make_mesh
     from .sweep import (
         SQUARE_SIZES,
@@ -347,6 +406,8 @@ def run_serve_sweep(args: argparse.Namespace) -> int:
     promote = args.promote
     if promote not in (None, "auto"):
         promote = int(promote)
+    metrics_out = getattr(args, "metrics_out", None)
+    trace_jsonl = getattr(args, "trace_jsonl", None)
     n_done = 0
     for m, k in sizes:
         for name in strategies:
@@ -360,6 +421,7 @@ def run_serve_sweep(args: argparse.Namespace) -> int:
                         n_requests=args.n_requests,
                         max_bucket=args.max_bucket, promote=promote,
                         seed=args.seed,
+                        metrics_out=metrics_out, trace_jsonl=trace_jsonl,
                     )
                 except MatvecError as e:
                     print(f"skip {name} {m}x{k} p={n_dev}: {e}")
@@ -381,6 +443,12 @@ def run_serve_sweep(args: argparse.Namespace) -> int:
                 if path is not None:
                     print(f"CSV: {path}")
                 n_done += 1
+    if n_done and metrics_out is not None:
+        # Per-config snapshot: with several configs the file holds the
+        # LAST one (each run_serve rewrites it; traces append).
+        print(f"metrics: {metrics_out}")
+    if n_done and trace_jsonl is not None:
+        print(f"trace: {trace_jsonl}")
     print(f"{n_done} serve configs measured")
     return 0
 
@@ -437,6 +505,27 @@ def build_parser() -> argparse.ArgumentParser:
         default="auto",
         help="with --tune: timing method for combine measurement "
         "(bench/timing.py)",
+    )
+    p.add_argument(
+        "--metrics-out", default=None, metavar="FILE",
+        help="write the metrics snapshot (engine counters + dispatch-"
+        "latency histogram, one JSON) after each config; render with "
+        "`python -m matvec_mpi_multiplier_tpu.obs metrics FILE`. With "
+        "several configs the file holds the last one",
+    )
+    p.add_argument(
+        "--trace-jsonl", default=None, metavar="FILE",
+        help="stream one request-lifecycle span tree per request "
+        "(submit->gate->pad->exec_lookup->dispatch->materialize) to FILE "
+        "via the obs sink thread; summarize with "
+        "`python -m matvec_mpi_multiplier_tpu.obs trace FILE`",
+    )
+    p.add_argument(
+        "--annotate", action="store_true",
+        help="enable named device-trace spans (strategy local-GEMV/"
+        "combine bodies, overlap stage{i}/compute|combine) in every "
+        "program this run builds — pair with a profiler capture "
+        "(docs/OBSERVABILITY.md)",
     )
     p.add_argument("--data-root", default=None)
     p.add_argument("--no-csv", action="store_true")

@@ -285,6 +285,32 @@ def build_parser() -> argparse.ArgumentParser:
         "(TensorBoard/Perfetto format; bench/profiling.py — the capability "
         "the reference lacked, SURVEY.md §5.1)",
     )
+    p.add_argument(
+        "--annotate",
+        action="store_true",
+        help="enable named device-trace spans (strategy local-GEMV/combine "
+        "bodies, overlap stage{i}/compute|combine) in every program this "
+        "sweep builds — pair with --profile-dir so the capture reads by "
+        "phase (docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--metrics-out",
+        default=None,
+        metavar="FILE",
+        help="write an obs metrics snapshot after the run: with --op serve "
+        "the engine's counters + latency histograms per config; otherwise "
+        "the process registry (e.g. the --tune pre-pass's per-candidate "
+        "measurement events). Render with "
+        "`python -m matvec_mpi_multiplier_tpu.obs metrics FILE`",
+    )
+    p.add_argument(
+        "--trace-jsonl",
+        default=None,
+        metavar="FILE",
+        help="with --op serve: stream one request-lifecycle span tree per "
+        "request to FILE (obs sink thread); summarize with "
+        "`python -m matvec_mpi_multiplier_tpu.obs trace FILE`",
+    )
     return p
 
 
@@ -339,6 +365,17 @@ def run_sweep(args: argparse.Namespace) -> int:
         if args.promote == "never":
             args.promote = None
         return run_serve_sweep(args)
+    if args.annotate:
+        # Scope the named-span override to this run: an in-process caller
+        # must not find the process-global flag flipped afterwards.
+        from .profiling import annotations
+
+        with annotations(True):
+            return _run_sweep(args)
+    return _run_sweep(args)
+
+
+def _run_sweep(args: argparse.Namespace) -> int:
     if args.measure in ("chain", "loop") and args.mode in ("reference", "both"):
         # Reject up front: time_matvec raises the same ConfigError, but only
         # deep inside the loop, after earlier configs already burned minutes.
@@ -363,6 +400,12 @@ def run_sweep(args: argparse.Namespace) -> int:
         raise SystemExit(
             "--skip-measured with --no-csv would re-skip forever (new "
             "results are never written back) — drop one of the two"
+        )
+    if args.trace_jsonl is not None:
+        raise SystemExit(
+            "--trace-jsonl is request-lifecycle tracing — serve-mode only "
+            "(--op serve); matvec/gemm sweeps have no request stream to "
+            "trace (use --profile-dir for a device trace)"
         )
     # Fail fast on an unknown kernel: get_*_kernel raises the same KeyError,
     # but only deep inside the loop, after earlier configs already ran.
@@ -448,6 +491,22 @@ def run_sweep(args: argparse.Namespace) -> int:
                 print(f"CSV: {csv_path(csv_name, args.data_root, mode=mode)}")
     if args.profile_dir is not None:
         print(f"trace: {args.profile_dir}")
+    if args.metrics_out is not None:
+        # The process registry: subsystem-level events this run emitted —
+        # chiefly the --tune pre-pass's per-candidate measurements
+        # (tuning/search.py). Serve-mode snapshots (engine counters) are
+        # written by the serve driver itself.
+        import json as _json
+        from pathlib import Path
+
+        from ..obs.registry import get_registry
+
+        out = Path(args.metrics_out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(
+            _json.dumps(get_registry().snapshot(), indent=2) + "\n"
+        )
+        print(f"metrics: {out}")
     print(
         f"{n_ok} configs timed, {n_skip} skipped, "
         f"{n_unmeasurable} unmeasurable, {n_failed} failed"

@@ -35,10 +35,22 @@ Requests are HOST arrays (numpy): the engine owns host→device placement,
 including dtype normalization and bucket padding. Handing it a device
 array still works but the normalization copy becomes a device fetch —
 a caller-visible sync the serving contract does not make.
+
+Telemetry (``obs/``): every counter the engine reports lives in a
+:class:`~..obs.registry.MetricsRegistry` (:class:`EngineStats` is a
+point-in-time view over it — one source of truth, atomic under the
+submit/materialize thread split), and every request records a span tree
+(submit → gate → bucket_pad → exec_lookup → dispatch → materialize) into
+the tracer's ring buffer — and, when ``trace_jsonl`` is set, onto the sink
+thread's JSONL file. Recording is lock-free on the dispatch path (list
+mutation + queue put; see ``obs/tracing.py``), and the I/O lint
+(``tests/test_lint.py``) keeps blocking file writes off this module
+entirely.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from typing import Callable, Sequence
@@ -48,6 +60,9 @@ import numpy as np
 
 from ..models import get_strategy
 from ..models.base import MatvecStrategy, mesh_size
+from ..obs.registry import MetricsRegistry
+from ..obs.sink import JsonlSink
+from ..obs.tracing import ActiveTrace, RequestTracer
 from ..utils.errors import ConfigError, DeadlineExceededError
 from .buckets import (
     DEFAULT_MAX_BUCKET,
@@ -76,7 +91,11 @@ class MatvecFuture:
     """
 
     def __init__(
-        self, parts: Sequence[tuple[jax.Array, int | None]], vector: bool
+        self,
+        parts: Sequence[tuple[jax.Array, int | None]],
+        vector: bool,
+        trace: ActiveTrace | None = None,
+        materialize_hist=None,
     ):
         # parts: (device_array, width) — width=None marks a rank-1 single
         # column; an int marks a rank-2 block whose first `width` columns
@@ -84,12 +103,19 @@ class MatvecFuture:
         self._parts = list(parts)
         self._vector = vector
         self._error: Exception | None = None
+        # Request-lifecycle trace: opened by submit, completed here — the
+        # materialize span and the finish that emits the record both run on
+        # whichever thread materializes (sequential hand-off; tracing.py).
+        self._trace = trace
+        self._materialize_hist = materialize_hist
 
     @classmethod
-    def failed(cls, error: Exception) -> "MatvecFuture":
+    def failed(
+        cls, error: Exception, trace: ActiveTrace | None = None
+    ) -> "MatvecFuture":
         """A future that was never dispatched (deadline exceeded):
         ``result()`` raises ``error``, ``done()`` is immediately True."""
-        fut = cls([], vector=True)
+        fut = cls([], vector=True, trace=trace)
         fut._error = error
         return fut
 
@@ -115,17 +141,42 @@ class MatvecFuture:
     def result(self) -> np.ndarray:
         """Materialize on host: ``(m,)`` for a vector request, ``(m, b)``
         for a block request (pad columns sliced away). A failed future
-        raises its error instead."""
+        raises its error instead. Records the ``materialize`` span and
+        finishes the request's trace (idempotent — a second call
+        re-materializes but never re-emits)."""
         if self._error is not None:
             raise self._error
-        if self._vector:
-            arr, _ = self._parts[0]
-            return np.asarray(arr)  # sync-ok: caller-requested materialization
-        cols = []
-        for arr, width in self._parts:
-            host = np.asarray(arr)  # sync-ok: caller-requested materialization
-            cols.append(host[:, None] if width is None else host[:, :width])
-        return cols[0] if len(cols) == 1 else np.concatenate(cols, axis=1)
+        trace = self._trace
+        t0 = time.perf_counter()
+        span = trace.span("materialize") if trace is not None else None
+        status = "ok"
+        try:
+            if self._vector:
+                arr, _ = self._parts[0]
+                return np.asarray(arr)  # sync-ok: caller-requested materialization
+            cols = []
+            for arr, width in self._parts:
+                host = np.asarray(arr)  # sync-ok: caller-requested materialization
+                cols.append(
+                    host[:, None] if width is None else host[:, :width]
+                )
+            return (
+                cols[0] if len(cols) == 1
+                else np.concatenate(cols, axis=1)
+            )
+        except BaseException:
+            # A device error surfacing at the host fetch must not be
+            # recorded as a fast successful request.
+            status = "materialize_error"
+            raise
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+                trace.finish(status=status)
+            if self._materialize_hist is not None and status == "ok":
+                self._materialize_hist.observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
 
 
 class EngineStats(ExecStats):
@@ -134,7 +185,14 @@ class EngineStats(ExecStats):
     ``in_flight`` is the outstanding-dispatch count at snapshot time;
     ``drains`` counts blocking waits the backpressure high-water mark
     forced; ``deadline_failures`` counts requests failed (never dispatched)
-    because their ``deadline_ms`` elapsed in the backpressure gate."""
+    because their ``deadline_ms`` elapsed in the backpressure gate.
+
+    A point-in-time VIEW over the engine's metrics registry (the counters
+    are the source of truth — ``engine.metrics.snapshot()`` reports the
+    same numbers under the ``engine_*`` names). Updates are atomic
+    registry increments, so concurrent submit/materialize/stats threads
+    never tear a count (the bare-attribute race this class used to
+    carry)."""
 
     def __init__(
         self, compiles: int, hits: int, requests: int, dispatches: int,
@@ -184,6 +242,15 @@ class MatvecEngine:
         enqueueing unboundedly ahead of the device). None (default) keeps
         the unbounded contract. Request-granular: one wide split request
         may briefly overshoot by its part count.
+    metrics : the obs MetricsRegistry the engine counts into (default: a
+        fresh private registry — per-instance isolation). Pass a shared
+        one to co-locate engine counters with caller-side metrics (the
+        serve bench's dispatch-latency histogram) in one snapshot.
+    trace_jsonl : path for the request-trace JSONL sink (``obs/sink.py``
+        thread; None — ring buffer only). One line per finished request;
+        ``flush_traces()`` fences the file.
+    trace_capacity : finished-request records the in-memory ring retains
+        (``tracer.traces()``).
     """
 
     def __init__(
@@ -201,6 +268,9 @@ class MatvecEngine:
         donate: bool = True,
         gather_output: bool = True,
         max_in_flight: int | None = None,
+        metrics: MetricsRegistry | None = None,
+        trace_jsonl: str | os.PathLike | None = None,
+        trace_capacity: int = 256,
     ):
         if mesh is None:
             from ..parallel.mesh import make_mesh
@@ -239,12 +309,48 @@ class MatvecEngine:
             )
         self.max_in_flight = max_in_flight
         self._outstanding: deque[jax.Array] = deque()
-        self._cache = ExecutableCache()
-        self._requests = 0
-        self._dispatches = 0
-        self._cols = 0
-        self._drains = 0
-        self._deadline_failures = 0
+        # One source of truth for every count the engine reports: the
+        # registry's atomic counters (EngineStats is a view; the serve
+        # bench's --metrics-out snapshot is the same numbers).
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self._c_requests = self.metrics.counter(
+            "engine_requests_total", "submit() calls"
+        )
+        self._c_dispatches = self.metrics.counter(
+            "engine_dispatches_total", "device programs enqueued"
+        )
+        self._c_cols = self.metrics.counter(
+            "engine_cols_total", "right-hand-side columns accepted"
+        )
+        self._c_drains = self.metrics.counter(
+            "engine_drains_total", "backpressure drain-oldest waits"
+        )
+        self._c_deadline_failures = self.metrics.counter(
+            "engine_deadline_failures_total",
+            "requests failed in the gate (deadline_ms elapsed)",
+        )
+        self._g_in_flight = self.metrics.gauge(
+            "engine_in_flight", "outstanding dispatches at last snapshot"
+        )
+        self._h_submit = self.metrics.histogram(
+            "engine_submit_latency_ms", "submit() entry-to-return host time"
+        )
+        self._h_materialize = self.metrics.histogram(
+            "engine_materialize_latency_ms",
+            "result() materialization host time (device wait included)",
+        )
+        self._cache = ExecutableCache(
+            compile_counter=self.metrics.counter(
+                "engine_compiles_total", "AOT executable compiles"
+            ),
+            hit_counter=self.metrics.counter(
+                "engine_hits_total", "executable-cache hits"
+            ),
+        )
+        self.tracer = RequestTracer(
+            capacity=trace_capacity,
+            sink=JsonlSink(trace_jsonl) if trace_jsonl is not None else None,
+        )
 
     # ---- construction-time resolution ----
 
@@ -432,7 +538,7 @@ class MatvecEngine:
             oldest = self._outstanding.popleft()
             if hasattr(oldest, "block_until_ready"):  # sync-ok: capability probe only, the wait is the next line
                 oldest.block_until_ready()  # sync-ok: backpressure drain-oldest at the caller-set high-water mark
-            self._drains += 1
+            self._c_drains.inc()
             self._reclaim()
 
     def _track(self, arr: jax.Array) -> jax.Array:
@@ -440,16 +546,38 @@ class MatvecEngine:
             self._outstanding.append(arr)
         return arr
 
-    def _dispatch_matvec(self, col: np.ndarray) -> jax.Array:
-        exe = self._cache.get(self._matvec_key(), self._matvec_builder)
-        self._dispatches += 1
-        return self._track(exe(self._a, jax.device_put(col, self._sh_x)))
+    def _get_traced(self, trace: ActiveTrace, key, builder):
+        """Executable-cache lookup under its span, the hit|compile outcome
+        read off the compile counter (no cache API change needed)."""
+        with trace.span("exec_lookup") as span:
+            before = self._cache.stats.compiles
+            exe = self._cache.get(key, builder)
+            span.attrs = {
+                "outcome": (
+                    "compile" if self._cache.stats.compiles > before
+                    else "hit"
+                )
+            }
+        return exe
 
-    def _dispatch_gemm(self, padded: np.ndarray) -> jax.Array:
+    def _dispatch_matvec(self, col: np.ndarray, trace: ActiveTrace) -> jax.Array:
+        exe = self._get_traced(
+            trace, self._matvec_key(), self._matvec_builder
+        )
+        self._c_dispatches.inc()
+        with trace.span("dispatch", op="matvec"):
+            out = exe(self._a, jax.device_put(col, self._sh_x))
+        return self._track(out)
+
+    def _dispatch_gemm(self, padded: np.ndarray, trace: ActiveTrace) -> jax.Array:
         bucket = padded.shape[1]
-        exe = self._cache.get(self._gemm_key(bucket), self._gemm_builder(bucket))
-        self._dispatches += 1
-        return self._track(exe(self._a, jax.device_put(padded, self._sh_b)))
+        exe = self._get_traced(
+            trace, self._gemm_key(bucket), self._gemm_builder(bucket)
+        )
+        self._c_dispatches.inc()
+        with trace.span("dispatch", op="gemm", bucket=bucket):
+            out = exe(self._a, jax.device_put(padded, self._sh_b))
+        return self._track(out)
 
     def submit(self, x, *, deadline_ms: float | None = None) -> MatvecFuture:
         """Dispatch one request: a ``(k,)`` vector or a ``(k, b)`` block of
@@ -470,8 +598,9 @@ class MatvecEngine:
         completes.
         """
         t0 = time.monotonic()
+        t0_perf = time.perf_counter()
         x = np.asarray(x, dtype=self.dtype)  # sync-ok: requests are host arrays (see module docstring)
-        self._requests += 1
+        self._c_requests.inc()
         if x.ndim == 1:
             if x.shape[0] != self.k:
                 raise ConfigError(
@@ -484,6 +613,10 @@ class MatvecEngine:
             )
         elif x.shape[1] == 0:
             raise ConfigError("empty request (b=0)")
+        trace = self.tracer.start(
+            cols=1 if x.ndim == 1 else int(x.shape[1]),
+            kind="vector" if x.ndim == 1 else "block",
+        )
 
         def _expired() -> bool:
             return (
@@ -492,39 +625,55 @@ class MatvecEngine:
             )
 
         def _fail() -> MatvecFuture:
-            self._deadline_failures += 1
+            self._c_deadline_failures.inc()
+            trace.finish(status="deadline_failed")
+            self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
             return MatvecFuture.failed(DeadlineExceededError(
                 f"request deadline of {deadline_ms} ms elapsed in the "
                 "backpressure gate before dispatch"
-            ))
+            ), trace=trace)
 
-        if deadline_ms is not None and deadline_ms <= 0:
-            # Stale on arrival (upstream queueing): skip even the drain.
-            return _fail()
-        self._admit()  # may block draining the oldest outstanding dispatch
-        if _expired():
-            return _fail()
-        if x.ndim == 1:
-            self._cols += 1
-            return MatvecFuture(
-                [(self._dispatch_matvec(x), None)], vector=True
-            )
-        b = x.shape[1]
-        self._cols += b
-        parts: list[tuple[jax.Array, int | None]] = []
-        if self.b_star is not None and b >= self.b_star:
-            offset = 0
-            for width in split_widths(b, self.max_bucket):
-                chunk = x[:, offset:offset + width]
-                offset += width
-                padded = pad_columns(
-                    chunk, bucket_for(width, self.max_bucket)
+        with trace.span("submit"):
+            if deadline_ms is not None and deadline_ms <= 0:
+                # Stale on arrival (upstream queueing): skip even the drain.
+                return _fail()
+            with trace.span("gate", max_in_flight=self.max_in_flight):
+                self._admit()  # may block draining the oldest dispatch
+            if _expired():
+                return _fail()
+            if x.ndim == 1:
+                self._c_cols.inc()
+                fut = MatvecFuture(
+                    [(self._dispatch_matvec(x, trace), None)], vector=True,
+                    trace=trace, materialize_hist=self._h_materialize,
                 )
-                parts.append((self._dispatch_gemm(padded), width))
-        else:
-            for j in range(b):
-                parts.append((self._dispatch_matvec(x[:, j]), None))
-        return MatvecFuture(parts, vector=False)
+                self._h_submit.observe(
+                    (time.perf_counter() - t0_perf) * 1e3
+                )
+                return fut
+            b = x.shape[1]
+            self._c_cols.inc(b)
+            parts: list[tuple[jax.Array, int | None]] = []
+            if self.b_star is not None and b >= self.b_star:
+                offset = 0
+                for width in split_widths(b, self.max_bucket):
+                    chunk = x[:, offset:offset + width]
+                    offset += width
+                    bucket = bucket_for(width, self.max_bucket)
+                    with trace.span("bucket_pad", width=width, bucket=bucket):
+                        padded = pad_columns(chunk, bucket)
+                    parts.append((self._dispatch_gemm(padded, trace), width))
+            else:
+                for j in range(b):
+                    parts.append(
+                        (self._dispatch_matvec(x[:, j], trace), None)
+                    )
+            fut = MatvecFuture(
+                parts, vector=False,
+                trace=trace, materialize_hist=self._h_materialize,
+            )
+            self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
+            return fut
 
     def __call__(self, x) -> np.ndarray:
         """Synchronous convenience: ``submit(x).result()``."""
@@ -563,12 +712,32 @@ class MatvecEngine:
     def stats(self) -> EngineStats:
         s = self._cache.stats
         self._reclaim()  # in_flight reports live work, not finished stubs
+        in_flight = len(self._outstanding)
+        self._g_in_flight.set(in_flight)
         return EngineStats(
-            compiles=s.compiles, hits=s.hits, requests=self._requests,
-            dispatches=self._dispatches, cols=self._cols,
-            in_flight=len(self._outstanding), drains=self._drains,
-            deadline_failures=self._deadline_failures,
+            compiles=s.compiles, hits=s.hits,
+            requests=self._c_requests.value,
+            dispatches=self._c_dispatches.value,
+            cols=self._c_cols.value,
+            in_flight=in_flight, drains=self._c_drains.value,
+            deadline_failures=self._c_deadline_failures.value,
         )
+
+    def flush_traces(self, timeout: float = 5.0) -> bool:
+        """Fence the JSONL trace sink: every request finished before this
+        call is on disk when it returns True (trivially so without
+        ``trace_jsonl``). False means the sink could not confirm — a dead
+        writer thread (unwritable path) or timeout — i.e. the trace file
+        is missing or incomplete. Driver/reader code only — never part of
+        the dispatch path."""
+        return self.tracer.flush(timeout=timeout)
+
+    def close(self) -> None:
+        """Release the trace sink (writer thread + file handle) after
+        draining it. An engine without ``trace_jsonl`` has nothing to
+        release; an engine WITH one should be closed when retired —
+        each sink is one daemon thread and one open append handle."""
+        self.tracer.close()
 
     @property
     def n_executables(self) -> int:

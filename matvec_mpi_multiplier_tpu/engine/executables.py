@@ -25,6 +25,8 @@ from typing import Any, Callable, NamedTuple
 
 import jax
 
+from ..obs.registry import Counter
+
 
 class ExecKey(NamedTuple):
     """Identity of one AOT executable in the cache."""
@@ -40,7 +42,9 @@ class ExecKey(NamedTuple):
 @dataclasses.dataclass
 class ExecStats:
     """Counters the serve bench reports: a flat ``compiles`` across a warm
-    request stream is the zero-recompilation acceptance criterion."""
+    request stream is the zero-recompilation acceptance criterion. A
+    point-in-time VIEW of the cache's registry counters (``stats``
+    property below) — the counters themselves are the source of truth."""
 
     compiles: int = 0
     hits: int = 0
@@ -57,11 +61,28 @@ class ExecutableCache:
     where ``arg_structs`` are ``jax.ShapeDtypeStruct``s carrying the input
     ``NamedSharding``s. The compiled executable accepts only arrays placed
     with exactly those shardings — the engine's dispatch contract.
+
+    Counting goes through obs counters (atomic — the thread-safety
+    contract ``EngineStats`` documents): pass the engine's registry
+    counters to share one source of truth with its metrics snapshot, or
+    let the cache own private ones (standalone use). ``stats`` stays the
+    familiar :class:`ExecStats` face, now a snapshot of those counters.
     """
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        compile_counter: Counter | None = None,
+        hit_counter: Counter | None = None,
+    ) -> None:
         self._executables: dict[ExecKey, Any] = {}
-        self.stats = ExecStats()
+        self._compiles = compile_counter or Counter("compiles")
+        self._hits = hit_counter or Counter("hits")
+
+    @property
+    def stats(self) -> ExecStats:
+        return ExecStats(
+            compiles=self._compiles.value, hits=self._hits.value
+        )
 
     def get(
         self,
@@ -70,7 +91,7 @@ class ExecutableCache:
     ):
         exe = self._executables.get(key)
         if exe is not None:
-            self.stats.hits += 1
+            self._hits.inc()
             return exe
         fn, arg_structs, donate = builder()
         exe = (
@@ -79,7 +100,7 @@ class ExecutableCache:
             .compile()
         )
         self._executables[key] = exe
-        self.stats.compiles += 1
+        self._compiles.inc()
         return exe
 
     def __len__(self) -> int:

@@ -26,6 +26,7 @@ import jax
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.annotations import named_span
 from ..ops.gemv import get_kernel
 from ..utils.compat import shard_map
 from ..utils.errors import ShardingError
@@ -298,9 +299,13 @@ class MatvecStrategy(abc.ABC):
 
         def make(s: int) -> Callable:
             def body(a_blk, x_loc):
-                y = staged_overlap_gather(
-                    a_blk, x_loc, y_axes, kern, s, reduce_axes
-                )
+                # One combine span for the whole staged program; each of
+                # its S stages carries its own stage{i}/compute|combine
+                # name inside (parallel.ring._pipeline_stages).
+                with named_span(f"{self.name}/combine/overlap@{s}"):
+                    y = staged_overlap_gather(
+                        a_blk, x_loc, y_axes, kern, s, reduce_axes
+                    )
                 return y.astype(a_blk.dtype)
 
             return shard_map(
@@ -469,8 +474,13 @@ class MatvecStrategy(abc.ABC):
             # with check_vma=False would also waive the psum/out_specs
             # checks on the compute body, which this way stay enforced.
             y_axes = spec_y[0]
+
+            def _ring_gather_body(y_blk):
+                with named_span(f"{self.name}/combine/ring_gather"):
+                    return ring_all_gather(y_blk, y_axes)
+
             ring_gather = shard_map(
-                lambda y_blk: ring_all_gather(y_blk, y_axes),
+                _ring_gather_body,
                 mesh=mesh, in_specs=(spec_y,), out_specs=P(),
                 check_vma=False,
             )

@@ -29,6 +29,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .base import MatvecStrategy
+from ..obs.annotations import named_span
 from ..parallel.mesh import mesh_grid_shape
 from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
 from ..utils.errors import ShardingError, check_divisible
@@ -68,8 +69,11 @@ class BlockwiseStrategy(MatvecStrategy):
             # reduce-over-grid-columns that gather_local_results hand-rolled
             # through root (reference :144-210) as one psum over 'cols' — run
             # on the kernel's accumulator dtype, cast back after.
-            partial = kernel(a_blk, x_seg)
-            return jax.lax.psum(partial, col_axis).astype(a_blk.dtype)
+            with named_span("blockwise/local_gemv"):
+                partial = kernel(a_blk, x_seg)
+            with named_span("blockwise/combine/psum"):
+                y = jax.lax.psum(partial, col_axis)
+            return y.astype(a_blk.dtype)
 
         return body
 

@@ -60,6 +60,7 @@ import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .base import MatvecStrategy, flat_axes, mesh_size
+from ..obs.annotations import named_span
 from ..utils.errors import ShardingError, check_divisible
 
 # Schedules whose output is row-sharded (the scatter family). "psum" is the
@@ -197,7 +198,11 @@ class ColwiseStrategy(MatvecStrategy):
             # (src/multiplier_colwise.c:107-122), fused by XLA into one dot
             # — combined across devices by the selected schedule. The
             # cross-device sum runs on the kernel's accumulator dtype (fp32
-            # for bf16 storage) and casts back only afterwards.
+            # for bf16 storage) and casts back only afterwards. Named spans
+            # (obs/annotations) label the local GEMV and the combine in
+            # device traces; schedules that fuse compute INTO the combine
+            # (overlap/ring_overlap/pallas_ring) carry one combine span —
+            # the staged pipeline adds its own per-stage names inside.
             if combine in OVERLAP_COMBINES:
                 # Stage resolution is trace-time Python: shapes are
                 # concrete here, and the tuning-cache lookup (stages=None)
@@ -206,27 +211,32 @@ class ColwiseStrategy(MatvecStrategy):
                     a_panel.shape[0], x_seg.shape[0] * p, mesh, self.stages,
                     p, a_panel.dtype,
                 )
-                y = staged_overlap_scatter(
-                    a_panel, x_seg, axes, kernel, s,
-                    step="ring" if combine == "overlap_ring"
-                    else "psum_scatter",
-                )
+                with named_span(f"colwise/combine/{combine}"):
+                    y = staged_overlap_scatter(
+                        a_panel, x_seg, axes, kernel, s,
+                        step="ring" if combine == "overlap_ring"
+                        else "psum_scatter",
+                    )
             elif combine == "pallas_ring":
                 from ..ops.pallas_collective import collective_ring_gemv
 
-                y = collective_ring_gemv(a_panel, x_seg, axes)
+                with named_span("colwise/combine/pallas_ring"):
+                    y = collective_ring_gemv(a_panel, x_seg, axes)
             elif combine == "ring_overlap":
-                y = ring_matvec(a_panel, x_seg, axes, kernel)
-            elif combine == "ring":
-                y = ring_psum_scatter(kernel(a_panel, x_seg), axes)
-            elif combine == "a2a":
-                y = a2a_psum_scatter(kernel(a_panel, x_seg), axes)
-            elif combine == "psum_scatter":
-                y = jax.lax.psum_scatter(
-                    kernel(a_panel, x_seg), axes, tiled=True
-                )
-            else:  # "psum"
-                y = jax.lax.psum(kernel(a_panel, x_seg), axes)
+                with named_span("colwise/combine/ring_overlap"):
+                    y = ring_matvec(a_panel, x_seg, axes, kernel)
+            else:
+                with named_span("colwise/local_gemv"):
+                    partial = kernel(a_panel, x_seg)
+                with named_span(f"colwise/combine/{combine}"):
+                    if combine == "ring":
+                        y = ring_psum_scatter(partial, axes)
+                    elif combine == "a2a":
+                        y = a2a_psum_scatter(partial, axes)
+                    elif combine == "psum_scatter":
+                        y = jax.lax.psum_scatter(partial, axes, tiled=True)
+                    else:  # "psum"
+                        y = jax.lax.psum(partial, axes)
             return y.astype(a_panel.dtype)
 
         return body

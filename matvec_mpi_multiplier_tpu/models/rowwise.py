@@ -22,6 +22,7 @@ from typing import Callable
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .base import MatvecStrategy, flat_axes, mesh_size
+from ..obs.annotations import named_span
 from ..utils.errors import check_divisible
 
 
@@ -37,7 +38,9 @@ class RowwiseStrategy(MatvecStrategy):
             # Local GEMV over this device's contiguous row block; the result
             # IS the device's exact slice of y (no collective needed). The
             # kernel returns its accumulator dtype; cast back to storage.
-            return kernel(a_blk, x_full).astype(a_blk.dtype)
+            with named_span("rowwise/local_gemv"):
+                y = kernel(a_blk, x_full)
+            return y.astype(a_blk.dtype)
 
         return body
 

@@ -1,0 +1,70 @@
+"""Unified telemetry: metrics registry, request-lifecycle tracing, named
+device-trace annotations.
+
+The reference's only instrumentation was the barrier/Wtime protocol and an
+append-only CSV (SURVEY.md §5.1/C8) — numbers about the *whole* run, with
+no way to see where inside one request the time went. This package adds the
+three observability layers a serving system is debugged with:
+
+* **metrics registry** (``registry.py``) — process-local counters, gauges
+  and fixed-bucket latency histograms (p50/p95/p99 summaries), exportable
+  as a JSON snapshot or Prometheus text. ``EngineStats`` is a view over
+  these counters — one source of truth for every count the serve bench
+  reports.
+* **request-lifecycle tracer** (``tracing.py``) — one structured span tree
+  per engine request (submit → backpressure gate → bucket/pad → exec-cache
+  lookup → dispatch → materialize) into an in-memory ring buffer, with an
+  optional JSONL sink (``sink.py``). The hot path never blocks on I/O:
+  recording is a ``deque.append``/``SimpleQueue.put`` (GIL-atomic, no
+  locks, no file handles) and all file writes happen on the sink thread —
+  the engine's sync-free dispatch lint extends to an I/O lint over this
+  package (``tests/test_lint.py``, ``scripts/tier1.sh``).
+* **named device-trace annotations** (``annotations.py``) — trace-time
+  ``jax.named_scope`` + ``jax.profiler.TraceAnnotation`` spans around each
+  strategy's local GEMV, each combine schedule, and each overlap stage
+  (``stage{i}/compute`` / ``stage{i}/combine``), so Perfetto captures show
+  the staged pipeline structure by name (the GSPMD/``arXiv:2112.09017``
+  debugging discipline, PAPERS.md).
+
+``python -m matvec_mpi_multiplier_tpu.obs`` pretty-prints a metrics
+snapshot or summarizes a JSONL trace (per-phase breakdown, top-k slowest
+requests). Capture recipe: ``docs/OBSERVABILITY.md``.
+
+Dependency-free by design (stdlib + numpy + jax only): the telemetry layer
+must be importable everywhere the engine is.
+"""
+
+from .annotations import (
+    annotations,
+    annotations_enabled,
+    named_span,
+    set_annotations,
+)
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    prometheus_text,
+    reset_registry,
+)
+from .sink import JsonlSink
+from .tracing import RequestTracer, Span
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "prometheus_text",
+    "reset_registry",
+    "RequestTracer",
+    "Span",
+    "JsonlSink",
+    "named_span",
+    "annotations",
+    "annotations_enabled",
+    "set_annotations",
+]

@@ -1,0 +1,174 @@
+"""Obs CLI: render a metrics snapshot or summarize a JSONL request trace.
+
+Usage::
+
+    python -m matvec_mpi_multiplier_tpu.obs metrics data/obs_demo/metrics.json
+    python -m matvec_mpi_multiplier_tpu.obs metrics snapshot.json --prometheus
+    python -m matvec_mpi_multiplier_tpu.obs trace data/obs_demo/trace.jsonl --top 5
+
+``metrics`` pretty-prints a ``MetricsRegistry.snapshot()`` JSON (the
+``--metrics-out`` payload of ``bench/serve.py``). ``trace`` aggregates a
+request-trace JSONL (the ``--trace-jsonl`` payload): per-phase time
+breakdown across every span tree, and the top-k slowest requests with
+their per-phase split.
+
+This is driver code — it reads files freely; the I/O lint exempts this
+module by name (the hot-path rule lives in ``registry``/``tracing``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from pathlib import Path
+
+
+def _fmt_ms(v: float) -> str:
+    if v is None or (isinstance(v, float) and math.isnan(v)):
+        return "nan"
+    return f"{v:.3f}ms"
+
+
+def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
+    """Human-readable (or Prometheus text) rendering of a snapshot dict."""
+    if prometheus:
+        from .registry import prometheus_text
+
+        return prometheus_text(snapshot).rstrip("\n")
+    out = []
+    counters = snapshot.get("counters", {})
+    if counters:
+        out.append("counters:")
+        width = max(len(n) for n in counters)
+        for name, value in counters.items():
+            out.append(f"  {name:<{width}}  {value}")
+    gauges = snapshot.get("gauges", {})
+    if gauges:
+        out.append("gauges:")
+        width = max(len(n) for n in gauges)
+        for name, value in gauges.items():
+            out.append(f"  {name:<{width}}  {value}")
+    histograms = snapshot.get("histograms", {})
+    if histograms:
+        out.append("histograms:")
+        for name, summ in histograms.items():
+            out.append(
+                f"  {name}: n={summ.get('count', 0)} "
+                f"sum={_fmt_ms(summ.get('sum'))} "
+                f"p50={_fmt_ms(summ.get('p50'))} "
+                f"p95={_fmt_ms(summ.get('p95'))} "
+                f"p99={_fmt_ms(summ.get('p99'))}"
+            )
+    return "\n".join(out) if out else "(empty snapshot)"
+
+
+def _walk(spans: list[dict], phases: dict[str, list[float]]) -> None:
+    for span in spans:
+        phases.setdefault(span["name"], []).append(span["dur_ms"])
+        _walk(span.get("children", []), phases)
+
+
+def _phase_split(record: dict) -> str:
+    phases: dict[str, list[float]] = {}
+    _walk(record.get("spans", []), phases)
+    return " ".join(
+        f"{name}={sum(vals):.3f}ms" for name, vals in phases.items()
+    )
+
+
+def summarize_trace(records: list[dict], top: int = 5) -> str:
+    """Per-phase breakdown + top-k slowest requests for a trace JSONL."""
+    if not records:
+        return "(empty trace)"
+    phases: dict[str, list[float]] = {}
+    for record in records:
+        _walk(record.get("spans", []), phases)
+    durs = [float(r.get("dur_ms", 0.0)) for r in records]
+    n_failed = sum(1 for r in records if r.get("status") != "ok")
+    out = [
+        f"{len(records)} requests, total {sum(durs):.3f}ms"
+        + (f" ({n_failed} failed)" if n_failed else ""),
+        "",
+        "per-phase breakdown (host time inside spans of that name):",
+    ]
+    width = max(len(n) for n in phases)
+    for name, vals in sorted(
+        phases.items(), key=lambda kv: -sum(kv[1])
+    ):
+        total = sum(vals)
+        out.append(
+            f"  {name:<{width}}  total={total:10.3f}ms  n={len(vals):>5}  "
+            f"mean={total / len(vals):8.4f}ms"
+        )
+    ranked = sorted(
+        records, key=lambda r: float(r.get("dur_ms", 0.0)), reverse=True
+    )[:top]
+    out += ["", f"top {len(ranked)} slowest requests:"]
+    for record in ranked:
+        out.append(
+            f"  #{record.get('request_id')}: "
+            f"{float(record.get('dur_ms', 0.0)):.3f}ms "
+            f"[{record.get('status', '?')}] {_phase_split(record)}"
+        )
+    return "\n".join(out)
+
+
+def load_trace(path: str | Path) -> list[dict]:
+    records = []
+    for line in Path(path).read_text().splitlines():
+        line = line.strip()
+        if line:
+            records.append(json.loads(line))
+    return records
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m matvec_mpi_multiplier_tpu.obs",
+        description="Render a metrics snapshot or summarize a request-trace "
+        "JSONL (see docs/OBSERVABILITY.md).",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pm = sub.add_parser("metrics", help="pretty-print a metrics snapshot")
+    pm.add_argument("file", help="snapshot JSON (serve --metrics-out)")
+    pm.add_argument(
+        "--prometheus", action="store_true",
+        help="emit Prometheus text format instead of the table",
+    )
+    pt = sub.add_parser("trace", help="summarize a request-trace JSONL")
+    pt.add_argument("file", help="trace JSONL (serve --trace-jsonl)")
+    pt.add_argument(
+        "--top", type=int, default=5,
+        help="slowest requests to list (default 5)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    path = Path(args.file)
+    if not path.exists():
+        print(f"no such file: {path}", file=sys.stderr)
+        return 1
+    try:
+        if args.cmd == "metrics":
+            print(render_metrics(
+                json.loads(path.read_text()), prometheus=args.prometheus
+            ))
+        else:
+            print(summarize_trace(load_trace(path), top=args.top))
+    except BrokenPipeError:
+        # `obs ... | head` closing the pipe early is normal CLI usage.
+        # Point stdout at devnull so the interpreter-shutdown flush of the
+        # broken pipe can't fail either (which would turn exit 0 into the
+        # flush error's nonzero status despite this handler).
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

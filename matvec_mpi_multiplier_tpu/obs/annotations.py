@@ -1,0 +1,75 @@
+"""Named device-trace annotations: make Perfetto captures read by phase.
+
+A device trace of an overlap schedule without names is a wall of fused
+ops; GSPMD-style collective schedules (PAPERS.md) are only debuggable when
+each pipeline stage carries its name into the capture. ``named_span``
+wraps a trace-time region in BOTH
+
+* ``jax.named_scope`` — pushes the name onto JAX's name stack, so it lands
+  in the lowered program's op metadata (visible in the compiled HLO and in
+  the device rows of a Perfetto capture); and
+* ``jax.profiler.TraceAnnotation`` — a host TraceMe, so the same name
+  shows on the host timeline while the region traces.
+
+Both are *trace-time* constructs: they cost nothing per dispatch (the
+traced program is compiled once and replayed), and toggling the enable
+flag only affects programs traced afterwards — already-compiled
+executables keep whatever names they were traced with.
+
+Enablement: off by default (byte-identical lowered programs to the
+un-annotated build); ``--annotate`` on the serve/sweep CLIs,
+``MATVEC_ANNOTATE=1`` in the environment, or :func:`set_annotations` turn
+it on. Tests scope it with the :func:`annotations` context manager.
+
+Lives in ``obs`` (imports jax only) so ``parallel/ring.py`` and the
+strategy bodies can use it without touching ``bench`` — which imports
+``models`` and would cycle. ``bench.profiling`` re-exports the public
+face.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+_override: bool | None = None  # None -> consult the environment
+
+
+def annotations_enabled() -> bool:
+    """Whether :func:`named_span` annotates (checked at trace time)."""
+    if _override is not None:
+        return _override
+    return os.environ.get("MATVEC_ANNOTATE", "0") == "1"
+
+
+def set_annotations(enabled: bool | None) -> None:
+    """Force annotations on/off (None restores the environment default).
+    Only programs traced after the change are affected."""
+    global _override
+    _override = enabled
+
+
+@contextlib.contextmanager
+def annotations(enabled: bool):
+    """Scoped :func:`set_annotations` — the test/capture-script form."""
+    global _override
+    saved = _override
+    _override = enabled
+    try:
+        yield
+    finally:
+        _override = saved
+
+
+@contextlib.contextmanager
+def named_span(name: str):
+    """Annotate the enclosed trace-time region with ``name`` (no-op when
+    annotations are disabled). Nests: inner spans extend the name stack
+    (``colwise/combine/overlap`` containing ``stage0/compute``)."""
+    if not annotations_enabled():
+        yield
+        return
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,282 @@
+"""Process-local metrics registry: counters, gauges, latency histograms.
+
+Design constraints, in order:
+
+* **atomic under threads** — the engine's submit path and a caller's
+  materialize/stats threads update and read the same counters concurrently
+  (the race ``EngineStats`` used to carry as bare ``int += 1`` attributes);
+  every metric guards its state with one small mutex, so a snapshot never
+  reads a half-applied update;
+* **no I/O** — this module only mutates memory. Exporting a snapshot to
+  disk is driver code (``bench/serve.py``, the obs CLI) or the sink thread
+  (``sink.py``); the I/O lint (``tests/test_lint.py``) enforces it;
+* **exact percentiles over a bounded window** — the histogram keeps fixed
+  cumulative buckets (the Prometheus export shape) AND a bounded window of
+  raw observations; ``percentile`` computes over the window with
+  ``np.percentile``, so for runs shorter than the window (every committed
+  demo) the summary is bit-identical to what ``np.percentile`` over the
+  full sample would report — the property the serve bench's p50/p99
+  unification test pins.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import deque
+from typing import Iterable
+
+import numpy as np
+
+# Default bucket upper bounds, in milliseconds: tuned to dispatch/serve
+# latencies (tens of microseconds through seconds). The terminal +Inf
+# bucket is implicit — ``observe`` always lands somewhere.
+DEFAULT_BUCKETS_MS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    25.0, 50.0, 100.0, 250.0, 500.0, 1000.0,
+)
+
+# Raw observations retained for exact percentiles. Beyond this, percentile
+# reports over the most recent WINDOW observations (documented, bounded
+# memory); bucket counts remain exact forever.
+DEFAULT_WINDOW = 8192
+
+
+class Counter:
+    """Monotone counter. ``inc`` is atomic (one mutex), ``value`` reads
+    under the same mutex — a snapshot never sees a torn update."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with exact windowed percentiles.
+
+    ``observe(v)`` updates the cumulative bucket counts (Prometheus
+    semantics: bucket ``le`` counts observations ``<= le``), the running
+    sum/count, and a bounded deque of raw observations. ``percentile(q)``
+    is ``np.percentile`` over that window — exact (not bucket-interpolated)
+    whenever fewer than ``window`` values were observed, which covers every
+    in-process serve run the bench reports on.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Iterable[float] = DEFAULT_BUCKETS_MS,
+        window: int = DEFAULT_WINDOW,
+    ):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram {name!r} needs at least one bucket")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._window: deque[float] = deque(maxlen=window)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            i = np.searchsorted(self.buckets, v, side="left")
+            self._counts[i] += 1
+            self._window.append(v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q: float) -> float:
+        """``np.percentile`` over the retained window (NaN when empty) —
+        the single percentile implementation the serve bench and the
+        engine's latency summaries share."""
+        with self._lock:
+            if not self._window:
+                return float("nan")
+            return float(np.percentile(np.asarray(self._window), q))
+
+    def summary(self) -> dict:
+        with self._lock:
+            window = np.asarray(self._window) if self._window else None
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        if window is None:
+            p50 = p95 = p99 = float("nan")
+        else:
+            p50, p95, p99 = (
+                float(np.percentile(window, q)) for q in (50, 95, 99)
+            )
+        cumulative = []
+        running = 0
+        for le, c in zip(self.buckets, counts):
+            running += c
+            cumulative.append([le, running])
+        cumulative.append(["+Inf", running + counts[-1]])
+        return {
+            "count": total,
+            "sum": s,
+            "p50": p50,
+            "p95": p95,
+            "p99": p99,
+            "buckets": cumulative,
+        }
+
+
+class MetricsRegistry:
+    """Named metrics, get-or-create. One registry per engine (isolated
+    counters per serving instance) plus a process default
+    (:func:`get_registry`) for subsystem-level events (the tuner's
+    per-candidate measurements)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name, help)
+            return c
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, help)
+            return g
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Iterable[float] = DEFAULT_BUCKETS_MS,
+        window: int = DEFAULT_WINDOW,
+    ) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(
+                    name, help, buckets=buckets, window=window
+                )
+            return h
+
+    def snapshot(self) -> dict:
+        """JSON-able view of every metric — the ``--metrics-out`` payload
+        and the obs CLI's input. Values are read metric-by-metric under
+        each metric's own lock (atomic per metric; the registry makes no
+        cross-metric consistency claim)."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {n: c.value for n, c in sorted(counters.items())},
+            "gauges": {n: g.value for n, g in sorted(gauges.items())},
+            "histograms": {
+                n: h.summary() for n, h in sorted(histograms.items())
+            },
+        }
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition of the registry (counters, gauges,
+        histograms with cumulative ``le`` buckets)."""
+        return prometheus_text(self.snapshot())
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Prometheus text exposition of a :meth:`MetricsRegistry.snapshot`
+    dict — the ONE serializer, shared by live registries and the obs CLI
+    (which renders snapshots read back from ``--metrics-out`` files)."""
+    lines: list[str] = []
+    for name, value in snapshot.get("counters", {}).items():
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {value}")
+    for name, value in snapshot.get("gauges", {}).items():
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_fmt(value)}")
+    for name, summ in snapshot.get("histograms", {}).items():
+        lines.append(f"# TYPE {name} histogram")
+        for le, cum in summ.get("buckets", []):
+            le_s = "+Inf" if le == "+Inf" else _fmt(le)
+            lines.append(f'{name}_bucket{{le="{le_s}"}} {cum}')
+        lines.append(f"{name}_sum {_fmt(summ.get('sum', 0))}")
+        lines.append(f"{name}_count {summ.get('count', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and (math.isinf(v) or math.isnan(v)):
+        return str(v)
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+# ---- process default registry (subsystem-level events, e.g. the tuner) ----
+
+_default: MetricsRegistry | None = None
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-local default registry. Engine instances carry their own
+    (isolated per serving instance); subsystem-level emitters that have no
+    instance to hang metrics on — the tuner's per-candidate measurement
+    events — land here."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = MetricsRegistry()
+        return _default
+
+
+def reset_registry() -> None:
+    """Drop the process default registry (tests; mirrors
+    ``tuning.reset_cache``)."""
+    global _default
+    with _default_lock:
+        _default = None

@@ -42,6 +42,7 @@ from jax import Array
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs.annotations import named_span
 from ..utils.compat import align_vma, axis_size, shape_dtype_struct, vma_of
 from .pallas_gemv import _on_tpu
 
@@ -151,18 +152,24 @@ def _collective_ring_gemv(
         kwargs["compiler_params"] = pltpu.TPUCompilerParams(
             collective_id=collective_id,
         )
-    out = pl.pallas_call(
-        kernel,
-        out_shape=shape_dtype_struct((chunk_rows, 1), acc, vma=vma),
-        scratch_shapes=[
-            pltpu.VMEM((2, chunk_rows, 1), acc),  # double-buffered acc
-            pltpu.VMEM((chunk_rows, 1), acc),     # in-flight tile
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
-        interpret=interpret,
-        **kwargs,
-    )(x_seg[None, :], a_panel)
+    # Named span at the pallas_call boundary — the interpret-safe point:
+    # inside the kernel body there is no trace-time name stack to push
+    # (and interpret mode's DMA discharge would reject host context
+    # managers mid-kernel), so the whole fused ring walk is one named
+    # region; its per-step structure is the kernel's own DMA waits.
+    with named_span(f"pallas_ring/ring_walk@p{p}"):
+        out = pl.pallas_call(
+            kernel,
+            out_shape=shape_dtype_struct((chunk_rows, 1), acc, vma=vma),
+            scratch_shapes=[
+                pltpu.VMEM((2, chunk_rows, 1), acc),  # double-buffered acc
+                pltpu.VMEM((chunk_rows, 1), acc),     # in-flight tile
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+            **kwargs,
+        )(x_seg[None, :], a_panel)
     return out[:, 0]
 
 

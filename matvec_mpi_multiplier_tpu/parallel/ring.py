@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
+from ..obs.annotations import named_span
 from ..utils.compat import axis_size
 
 
@@ -224,14 +225,29 @@ def _pipeline_stages(compute, combine, stages: int) -> list:
     s's combine BEFORE tracing stage s+1's compute, so in program order
     every collective sits between two independent compute steps — the
     window XLA's async collective scheduling overlaps on TPU. Returns the
-    S combined pieces in stage order."""
+    S combined pieces in stage order.
+
+    Each stage's two halves carry named device-trace annotations
+    (``stage{s}/compute`` / ``stage{s}/combine``, ``obs/annotations``):
+    with ``--annotate`` a Perfetto capture shows the pipeline's interleaved
+    structure by name instead of as an anonymous op soup — the only way a
+    staged schedule's overlap is verifiable in a device trace."""
+
+    def _compute(s):
+        with named_span(f"stage{s}/compute"):
+            return compute(s)
+
+    def _combine(s, v):
+        with named_span(f"stage{s}/combine"):
+            return combine(v)
+
     pieces = []
-    prev = compute(0)
+    prev = _compute(0)
     for s in range(1, stages):
-        in_flight = combine(prev)  # stage s-1's combine, already issued...
-        prev = compute(s)          # ...while stage s's GEMV computes
+        in_flight = _combine(s - 1, prev)  # stage s-1's combine, issued...
+        prev = _compute(s)                 # ...while stage s's GEMV computes
         pieces.append(in_flight)
-    pieces.append(combine(prev))
+    pieces.append(_combine(stages - 1, prev))
     return pieces
 
 

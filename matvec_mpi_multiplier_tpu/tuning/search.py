@@ -75,6 +75,30 @@ def _measure_fn(
     return float(np.median(times))
 
 
+def _record_candidate(axis: str, t: float | None) -> None:
+    """Per-candidate measurement event into the process obs registry
+    (``obs.registry.get_registry``): how many candidates each tuning axis
+    measured, how many were unmeasurable, and the distribution of measured
+    candidate times — the visibility a ``--tune`` pre-pass otherwise only
+    leaves in its log lines. A sweep's ``--metrics-out`` snapshots these."""
+    from ..obs.registry import get_registry
+
+    registry = get_registry()
+    registry.counter(
+        f"tuning_{axis}_candidates_total",
+        f"{axis}-axis candidates measured",
+    ).inc()
+    if t is None:
+        registry.counter(
+            f"tuning_{axis}_unmeasurable_total",
+            f"{axis}-axis candidates the noise floor rejected",
+        ).inc()
+    else:
+        registry.histogram(
+            "tuning_candidate_time_ms", "measured candidate times"
+        ).observe(t * 1e3)
+
+
 def _pick_winner(
     measured: dict[str, float], default: str, min_gain: float = TUNE_MIN_GAIN
 ) -> str | None:
@@ -174,6 +198,7 @@ def tune_gemv(
         t = _measure_fn(
             _candidate_gemv_fn(cand), (a, x), n_reps=n_reps, samples=samples
         )
+        _record_candidate("gemv", t)
         if t is None:
             log(f"  gemv {m}x{k} {dtype} {label}: unmeasurable")
             continue
@@ -281,6 +306,7 @@ def tune_gemm(
         t = _measure_fn(
             _candidate_gemm_fn(cand), (a, b), n_reps=n_reps, samples=samples
         )
+        _record_candidate("gemm", t)
         if t is None:
             log(f"  gemm {m}x{k}x{n} {dtype} {label}: unmeasurable")
             continue
@@ -390,11 +416,13 @@ def tune_combine(
                 chain_samples=samples, stages=stages,
             )
         except TimingError:
+            _record_candidate("combine", None)
             log(f"  combine {strategy_name} {m}x{k} p={p} {cand}: unmeasurable")
             continue
         # Rank on the MINIMUM rep time: on shared hosts the mean absorbs
         # contention spikes that have nothing to do with the schedule.
         t = float(result.min_time_s)
+        _record_candidate("combine", t)
         measured[cand] = t
         if memo is not None:
             memo[memo_key] = t
@@ -495,10 +523,12 @@ def tune_gemm_combine(
                 chain_samples=samples, stages=stages,
             )
         except TimingError:
+            _record_candidate("gemm_combine", None)
             log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} "
                 f"{cand}: unmeasurable")
             continue
         t = float(result.min_time_s)
+        _record_candidate("gemm_combine", t)
         measured[cand] = t
         log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} {cand}: "
             f"{t * 1e6:.1f} us")
@@ -582,6 +612,7 @@ def tune_promotion(
     t_seq = _measure_fn(
         matvec, (a, jax.device_put(x, sh_x)), n_reps=n_reps, samples=samples
     )
+    _record_candidate("promotion", t_seq)
     if t_seq is None:
         return None
     log(f"  promote {strategy_name} {m}x{k} p={p} {dtype} "
@@ -596,6 +627,7 @@ def tune_promotion(
             gemm, (a, jax.device_put(rhs, sh_b)), n_reps=n_reps,
             samples=samples,
         )
+        _record_candidate("promotion", t_gemm)
         if t_gemm is None:
             log(f"  promote {strategy_name} {m}x{k} p={p} b={b}: "
                 "unmeasurable")
@@ -702,9 +734,11 @@ def tune_overlap(
                 stages=s, chain_samples=samples,
             )
         except TimingError:
+            _record_candidate("overlap", None)
             log(f"  overlap {strategy_name} {m}x{k} p={p} S={s}: unmeasurable")
             continue
         t = float(result.min_time_s)
+        _record_candidate("overlap", t)
         measured[str(s)] = t
         log(f"  overlap {strategy_name} {m}x{k} p={p} S={s}: {t * 1e6:.1f} us")
     winner = _pick_winner(measured, default="1", min_gain=min_gain)

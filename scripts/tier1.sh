@@ -69,6 +69,31 @@ lint() {
     return 1
   fi
   echo "lint: ok (no un-chunked collectives in overlap schedule bodies)"
+
+  # The engine dispatch hot path (engine/ plus the obs in-memory layer)
+  # must never block on file I/O: a file write or json.dump inside submit
+  # would stall every request behind the filesystem — the reason the trace
+  # sink is a separate thread. Exempt by name: obs/sink.py (the sink
+  # thread — the ONE place obs touches files) and obs/__main__.py (the
+  # CLI, driver code). Deliberate exceptions elsewhere carry an
+  # `# obs-ok: <reason>` marker. (Same rule in-suite:
+  # tests/test_lint.py::test_no_blocking_io_on_dispatch_hot_path.)
+  bad=$(grep -rnE \
+      '\bopen\(|json\.dump|\.write\(|write_text\(|write_bytes\(' \
+      --include='*.py' \
+      matvec_mpi_multiplier_tpu/engine matvec_mpi_multiplier_tpu/obs \
+      2>/dev/null \
+      | grep -v 'matvec_mpi_multiplier_tpu/obs/sink\.py' \
+      | grep -v 'matvec_mpi_multiplier_tpu/obs/__main__\.py' \
+      | grep -v 'obs-ok:' || true)
+  if [ -n "$bad" ]; then
+    echo "LINT: blocking I/O on the engine dispatch hot path:" >&2
+    echo "$bad" >&2
+    echo "Route file writes through the obs sink thread (obs/sink.py) or" >&2
+    echo "mark a deliberate non-hot-path write with '# obs-ok: <reason>'." >&2
+    return 1
+  fi
+  echo "lint: ok (no blocking I/O on the engine dispatch hot path)"
 }
 
 lint

@@ -194,6 +194,100 @@ def test_tpu_loop_rows_monotone_in_size():
         pytest.skip("no loop-measure TPU row pairs with a >=4x size gap yet")
 
 
+# ---- obs_demo: the committed telemetry capture (data/obs_demo/) ----
+#
+# Same doctrine as the CSV gates above: a committed artifact that can rot
+# silently is a liability, so its schema and internal consistency are
+# regression-tested. The capture command is in data/obs_demo/README.md.
+
+OBS_DEMO = REPO / "data" / "obs_demo"
+
+
+def _obs_demo_metrics() -> dict:
+    path = OBS_DEMO / "metrics.json"
+    if not path.exists():
+        pytest.skip(f"{path} not committed")
+    import json
+
+    return json.loads(path.read_text())
+
+
+def _obs_demo_trace() -> list[dict]:
+    path = OBS_DEMO / "trace.jsonl"
+    if not path.exists():
+        pytest.skip(f"{path} not committed")
+    import json
+
+    records = [
+        json.loads(ln) for ln in path.read_text().splitlines() if ln.strip()
+    ]
+    assert records, f"{path} exists but holds no records"
+    return records
+
+
+def test_obs_demo_metrics_schema_and_consistency():
+    snap = _obs_demo_metrics()
+    counters = snap["counters"]
+    # The engine counter vocabulary (EngineStats' registry names).
+    for name in (
+        "engine_requests_total", "engine_dispatches_total",
+        "engine_cols_total", "engine_compiles_total", "engine_hits_total",
+        "engine_drains_total", "engine_deadline_failures_total",
+    ):
+        assert name in counters and counters[name] >= 0, name
+    # A 200-request steady phase plus warmup/promotion submits.
+    assert counters["engine_requests_total"] >= 200
+    assert counters["engine_cols_total"] >= counters["engine_requests_total"]
+    assert counters["engine_dispatches_total"] >= counters[
+        "engine_requests_total"
+    ]
+    # Zero steady-state recompilation, read off the snapshot alone: after
+    # warmup's compiles every dispatch-time lookup hit.
+    assert counters["engine_compiles_total"] > 0
+    assert (
+        counters["engine_hits_total"] == counters["engine_dispatches_total"]
+    )
+    hists = snap["histograms"]
+    lat = hists["serve_dispatch_latency_ms"]
+    assert lat["count"] == 200  # exactly the steady phase
+    assert 0 < lat["p50"] <= lat["p95"] <= lat["p99"]
+    assert lat["buckets"][-1][0] == "+Inf"
+    assert lat["buckets"][-1][1] == lat["count"]
+    assert hists["engine_submit_latency_ms"]["count"] == counters[
+        "engine_requests_total"
+    ]
+
+
+def test_obs_demo_trace_complete_span_trees():
+    snap = _obs_demo_metrics()
+    records = _obs_demo_trace()
+    # One line per submitted request — ties the trace to the snapshot.
+    assert len(records) == snap["counters"]["engine_requests_total"]
+    ids = [r["request_id"] for r in records]
+    assert len(set(ids)) == len(ids), "duplicate request_ids"
+    n_compiles = 0
+    for rec in records:
+        assert rec["status"] == "ok"
+        assert rec["dur_ms"] >= 0
+        names = [s["name"] for s in rec["spans"]]
+        assert names == ["submit", "materialize"], rec
+        children = [c["name"] for c in rec["spans"][0]["children"]]
+        assert children[0] == "gate"
+        assert "exec_lookup" in children and "dispatch" in children
+        for span in rec["spans"]:
+            assert span["dur_ms"] >= 0
+            for child in span.get("children", []):
+                assert child["dur_ms"] >= 0
+        n_compiles += sum(
+            1 for c in rec["spans"][0]["children"]
+            if c["name"] == "exec_lookup"
+            and c.get("attrs", {}).get("outcome") == "compile"
+        )
+    # warmup() pre-compiled the ladder before any submit, so no request's
+    # lookup ever compiled — the zero-recompile criterion span-by-span.
+    assert n_compiles == 0
+
+
 def test_vmem_roof_derivation(tmp_path, monkeypatch):
     """scripts/derive_vmem_roof.py: ceiling = headroom x the fastest
     committed sub-VMEM loop row (per chip); refuses to derive from too few

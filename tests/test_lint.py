@@ -142,3 +142,59 @@ def test_overlap_markers_carry_reasons():
                 if "#" not in line.split(_OVERLAP_EXEMPT)[0] or not tail:
                     bad.append(f"{path.relative_to(REPO)}:{lineno}")
     assert not bad, f"overlap-ok markers without comment+reason: {bad}"
+
+
+# The engine dispatch hot path (engine/ plus the obs in-memory layer) must
+# never block on file I/O: a file write or json.dump inside submit would
+# stall every request behind the filesystem — the whole reason the trace
+# sink is a separate thread (obs/sink.py, the ONE exempt file besides the
+# obs CLI, which is driver code). Deliberate non-hot-path writes elsewhere
+# carry an `# obs-ok: <reason>` marker. Mirrored fail-fast in
+# scripts/tier1.sh.
+OBS = REPO / "matvec_mpi_multiplier_tpu" / "obs"
+_IO_EXEMPT_FILES = (OBS / "sink.py", OBS / "__main__.py")
+
+_IO_PATTERN = re.compile(
+    r"\bopen\(|json\.dump|\.write\(|write_text\(|write_bytes\("
+)
+_IO_EXEMPT = "obs-ok:"
+
+
+def _hot_path_sources():
+    yield from sorted(ENGINE.rglob("*.py"))
+    for path in sorted(OBS.rglob("*.py")):
+        if path not in _IO_EXEMPT_FILES:
+            yield path
+
+
+def test_no_blocking_io_on_dispatch_hot_path():
+    offenders = []
+    for path in _hot_path_sources():
+        for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1
+        ):
+            if _IO_PATTERN.search(line) and _IO_EXEMPT not in line:
+                offenders.append(
+                    f"{path.relative_to(REPO)}:{lineno}: {line.strip()}"
+                )
+    assert not offenders, (
+        "blocking I/O on the engine dispatch hot path (route file writes "
+        "through the obs sink thread, obs/sink.py, or mark a deliberate "
+        "non-hot-path write with `# obs-ok: <reason>`):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_obs_markers_carry_reasons():
+    """Same contract as the sync-ok/overlap-ok markers: a justification,
+    not an escape hatch."""
+    bad = []
+    for path in _hot_path_sources():
+        for lineno, line in enumerate(
+            path.read_text().splitlines(), start=1
+        ):
+            if _IO_EXEMPT in line:
+                tail = line.split(_IO_EXEMPT, 1)[1].strip()
+                if "#" not in line.split(_IO_EXEMPT)[0] or not tail:
+                    bad.append(f"{path.relative_to(REPO)}:{lineno}")
+    assert not bad, f"obs-ok markers without comment+reason: {bad}"

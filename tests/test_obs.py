@@ -1,0 +1,453 @@
+"""Telemetry-subsystem tests (obs/): registry semantics, percentile
+unification, request-lifecycle tracing, the JSONL sink, the obs CLI, and
+the engine integration — metrics snapshot == EngineStats (one source of
+truth), complete span trees per request, and counter atomicity under a
+concurrent submit/stats hammer.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import MatvecEngine, make_mesh
+from matvec_mpi_multiplier_tpu.obs import (
+    Counter,
+    Histogram,
+    JsonlSink,
+    MetricsRegistry,
+    RequestTracer,
+    get_registry,
+    reset_registry,
+)
+from matvec_mpi_multiplier_tpu.obs.__main__ import (
+    main as obs_main,
+    render_metrics,
+    summarize_trace,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import DeadlineExceededError
+
+# ---------------------------------------------------------------- registry
+
+
+def test_counter_and_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c", "help text")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert reg.counter("c") is c  # get-or-create returns the same metric
+    g = reg.gauge("g")
+    g.set(2.5)
+    assert g.value == 2.5
+    snap = reg.snapshot()
+    assert snap["counters"] == {"c": 5}
+    assert snap["gauges"] == {"g": 2.5}
+
+
+def test_counter_increments_are_atomic_under_threads():
+    """The thread-safety contract EngineStats now rides on: N threads of
+    M increments lose nothing."""
+    c = Counter("hammer")
+    n_threads, n_incs = 8, 2000
+
+    def work():
+        for _ in range(n_incs):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * n_incs
+
+
+def test_histogram_percentiles_identical_to_np_percentile():
+    """The unification contract: serve's p50/p99 now COME from this
+    histogram, and over a window-sized sample they must be bit-identical
+    to what ``np.percentile`` reports (the math serve.py used to own)."""
+    rng = np.random.default_rng(7)
+    sample = rng.uniform(0.01, 50.0, 500)
+    h = Histogram("lat")
+    for v in sample:
+        h.observe(v)
+    for q in (50, 95, 99):
+        assert h.percentile(q) == float(np.percentile(sample, q))
+    summ = h.summary()
+    assert summ["count"] == 500
+    assert summ["p50"] == float(np.percentile(sample, 50))
+    assert summ["p99"] == float(np.percentile(sample, 99))
+    assert summ["sum"] == pytest.approx(float(sample.sum()))
+
+
+def test_histogram_buckets_cumulative_and_bounded_window():
+    h = Histogram("lat", buckets=(1.0, 10.0), window=4)
+    for v in (0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    summ = h.summary()
+    # Cumulative le semantics: <=1 holds two, <=10 adds one, +Inf all.
+    assert summ["buckets"] == [[1.0, 2], [10.0, 3], ["+Inf", 4]]
+    # Window keeps the most recent 4; a 5th observation evicts the oldest
+    # from the percentile window but bucket counts stay exact.
+    h.observe(0.5)
+    assert h.count == 5
+    assert h.summary()["buckets"][-1][1] == 5
+    assert h.percentile(0) == 0.5
+    empty = Histogram("none")
+    assert np.isnan(empty.percentile(50))
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("reqs").inc(3)
+    reg.histogram("lat", buckets=(1.0,)).observe(0.5)
+    text = reg.to_prometheus()
+    assert "# TYPE reqs counter\nreqs 3" in text
+    assert '# TYPE lat histogram' in text
+    assert 'lat_bucket{le="1.0"} 1' in text
+    assert 'lat_bucket{le="+Inf"} 1' in text
+    assert "lat_count 1" in text
+
+
+def test_default_registry_reset():
+    reset_registry()
+    get_registry().counter("x").inc()
+    assert get_registry().counter("x").value == 1
+    reset_registry()
+    assert get_registry().counter("x").value == 0
+
+
+# ----------------------------------------------------------------- tracer
+
+
+def test_tracer_builds_nested_span_tree():
+    tracer = RequestTracer(capacity=8)
+    t = tracer.start(cols=2)
+    with t.span("submit"):
+        with t.span("gate"):
+            pass
+        with t.span("dispatch", bucket=4):
+            pass
+    with t.span("materialize"):
+        pass
+    t.finish()
+    t.finish()  # idempotent: emits exactly once
+    records = tracer.traces()
+    assert len(records) == 1
+    rec = records[0]
+    assert rec["status"] == "ok" and rec["attrs"] == {"cols": 2}
+    names = [s["name"] for s in rec["spans"]]
+    assert names == ["submit", "materialize"]
+    children = [c["name"] for c in rec["spans"][0]["children"]]
+    assert children == ["gate", "dispatch"]
+    assert rec["spans"][0]["children"][1]["attrs"] == {"bucket": 4}
+    for span in rec["spans"]:
+        assert span["dur_ms"] >= 0
+
+
+def test_tracer_ring_capacity_bounds_memory():
+    tracer = RequestTracer(capacity=3)
+    for _ in range(10):
+        tracer.start().finish()
+    records = tracer.traces()
+    assert len(records) == 3
+    assert [r["request_id"] for r in records] == [7, 8, 9]
+
+
+def test_tracer_finish_closes_open_spans():
+    """A deadline failure finishes the trace from INSIDE the submit span;
+    the emitted record must still carry a closed span."""
+    tracer = RequestTracer()
+    t = tracer.start()
+    with t.span("submit"):
+        t.finish(status="deadline_failed")
+    rec = tracer.traces()[0]
+    assert rec["status"] == "deadline_failed"
+    assert rec["spans"][0]["dur_ms"] >= 0
+
+
+def test_jsonl_sink_writes_and_flushes(tmp_path):
+    path = tmp_path / "nested" / "trace.jsonl"
+    sink = JsonlSink(path)
+    tracer = RequestTracer(capacity=2, sink=sink)
+    for i in range(5):
+        t = tracer.start(i=i)
+        with t.span("submit"):
+            pass
+        t.finish()
+    assert tracer.flush() is True
+    lines = path.read_text().splitlines()
+    # The sink sees EVERY record — the ring cap bounds memory, not disk.
+    assert len(lines) == 5
+    assert [json.loads(ln)["attrs"]["i"] for ln in lines] == list(range(5))
+    sink.close()
+
+
+def test_sink_flush_reports_dead_writer(tmp_path):
+    """An unwritable path kills the writer thread; flush must say so
+    (False) instead of letting a capture silently vanish."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")  # a FILE where the sink needs a directory
+    sink = JsonlSink(blocker / "sub" / "trace.jsonl")
+    sink.put({"x": 1})
+    deadline = 50
+    while sink._thread.is_alive() and deadline:
+        import time
+
+        time.sleep(0.01)
+        deadline -= 1
+    assert sink.flush(timeout=0.5) is False
+    tracer = RequestTracer(sink=sink)
+    assert tracer.flush(timeout=0.5) is False
+    assert RequestTracer().flush() is True  # no sink: nothing to flush
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def _sample_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("engine_requests_total").inc(3)
+    reg.gauge("engine_in_flight").set(1)
+    reg.histogram("serve_dispatch_latency_ms").observe(0.4)
+    return reg.snapshot()
+
+
+def test_cli_render_metrics_table_and_prometheus():
+    snap = _sample_snapshot()
+    table = render_metrics(snap)
+    assert "engine_requests_total" in table and "3" in table
+    assert "serve_dispatch_latency_ms" in table and "p99" in table
+    prom = render_metrics(snap, prometheus=True)
+    assert "engine_requests_total 3" in prom
+    assert 'serve_dispatch_latency_ms_bucket{le="+Inf"} 1' in prom
+
+
+def test_cli_summarize_trace_breakdown_and_topk():
+    tracer = RequestTracer()
+    for i in range(4):
+        t = tracer.start()
+        with t.span("submit"):
+            with t.span("dispatch"):
+                pass
+        with t.span("materialize"):
+            pass
+        t.finish()
+    out = summarize_trace(tracer.traces(), top=2)
+    assert "4 requests" in out
+    for phase in ("submit", "dispatch", "materialize"):
+        assert phase in out
+    assert "top 2 slowest requests" in out
+    assert summarize_trace([]) == "(empty trace)"
+
+
+def test_cli_main_end_to_end(tmp_path, capsys):
+    snap_path = tmp_path / "metrics.json"
+    snap_path.write_text(json.dumps(_sample_snapshot()))
+    assert obs_main(["metrics", str(snap_path)]) == 0
+    assert "engine_requests_total" in capsys.readouterr().out
+    tracer = RequestTracer()
+    t = tracer.start()
+    with t.span("submit"):
+        pass
+    t.finish()
+    trace_path = tmp_path / "trace.jsonl"
+    trace_path.write_text(
+        "\n".join(json.dumps(r) for r in tracer.traces()) + "\n"
+    )
+    assert obs_main(["trace", str(trace_path)]) == 0
+    assert "per-phase breakdown" in capsys.readouterr().out
+    assert obs_main(["metrics", str(tmp_path / "missing.json")]) == 1
+
+
+# ------------------------------------------------------ engine integration
+
+
+def make_engine(rng, tmp_path=None, **kwargs):
+    mesh = make_mesh(8)
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    kwargs.setdefault("strategy", "rowwise")
+    kwargs.setdefault("promote", 4)
+    kwargs.setdefault("max_bucket", 8)
+    if tmp_path is not None:
+        kwargs["trace_jsonl"] = str(tmp_path / "trace.jsonl")
+    return MatvecEngine(a, mesh, **kwargs), a
+
+
+def test_engine_metrics_snapshot_matches_stats(devices, rng):
+    """The one-source-of-truth acceptance: every count EngineStats reports
+    equals the registry counter of the same meaning."""
+    engine, a = make_engine(rng)
+    X = rng.uniform(0, 10, (64, 11)).astype(np.float32)
+    engine.warmup([1, 8])
+    for w in (1, 3, 8, 11):
+        engine.submit(X[:, :w] if w > 1 else X[:, 0]).result()
+    stats = engine.stats
+    counters = engine.metrics.snapshot()["counters"]
+    assert counters["engine_requests_total"] == stats.requests == 4
+    assert counters["engine_dispatches_total"] == stats.dispatches
+    assert counters["engine_cols_total"] == stats.cols == 1 + 3 + 8 + 11
+    assert counters["engine_compiles_total"] == stats.compiles
+    assert counters["engine_hits_total"] == stats.hits
+    assert counters["engine_drains_total"] == stats.drains == 0
+    assert (
+        counters["engine_deadline_failures_total"]
+        == stats.deadline_failures == 0
+    )
+    hists = engine.metrics.snapshot()["histograms"]
+    assert hists["engine_submit_latency_ms"]["count"] == 4
+    assert hists["engine_materialize_latency_ms"]["count"] == 4
+
+
+def test_engine_request_trace_is_complete(devices, rng, tmp_path):
+    """Acceptance: every materialized request carries a complete span tree
+    (submit -> ... -> materialize) with per-phase durations, the
+    exec-cache lookup labeled hit|compile, and the JSONL sink holds one
+    line per request."""
+    engine, a = make_engine(rng, tmp_path)
+    X = rng.uniform(0, 10, (64, 8)).astype(np.float32)
+    engine.submit(X[:, 0]).result()   # cold: compile
+    engine.submit(X[:, 0]).result()   # warm: hit
+    engine.submit(X).result()         # promoted block: pad + gemm
+    engine.flush_traces()
+    records = [
+        json.loads(ln)
+        for ln in (tmp_path / "trace.jsonl").read_text().splitlines()
+    ]
+    assert len(records) == 3 == len(engine.tracer.traces())
+    assert [r["request_id"] for r in records] == [0, 1, 2]
+    for rec in records:
+        assert rec["status"] == "ok"
+        roots = [s["name"] for s in rec["spans"]]
+        assert roots == ["submit", "materialize"]
+        for span in rec["spans"]:
+            assert span["dur_ms"] >= 0
+        children = [c["name"] for c in rec["spans"][0]["children"]]
+        assert children[0] == "gate"
+        assert "exec_lookup" in children and "dispatch" in children
+
+    def outcome(rec):
+        return [
+            c["attrs"]["outcome"]
+            for c in rec["spans"][0]["children"]
+            if c["name"] == "exec_lookup"
+        ]
+
+    assert outcome(records[0]) == ["compile"]
+    assert outcome(records[1]) == ["hit"]
+    # Block request: bucket_pad recorded with its width/bucket facts.
+    pads = [
+        c for c in records[2]["spans"][0]["children"]
+        if c["name"] == "bucket_pad"
+    ]
+    assert pads and pads[0]["attrs"] == {"width": 8, "bucket": 8}
+
+
+def test_engine_deadline_failure_traced(devices, rng):
+    engine, a = make_engine(rng)
+    fut = engine.submit(np.ones(64, np.float32), deadline_ms=0)
+    with pytest.raises(DeadlineExceededError):
+        fut.result()
+    records = engine.tracer.traces()
+    assert records[-1]["status"] == "deadline_failed"
+    assert engine.stats.deadline_failures == 1
+    assert (
+        engine.metrics.snapshot()["counters"][
+            "engine_deadline_failures_total"
+        ] == 1
+    )
+
+
+def test_engine_counters_exact_under_concurrent_hammer(devices, rng):
+    """The thread-safety satellite: submits and stats reads from many
+    threads; the final counts are exact (no lost increments, no torn
+    snapshot)."""
+    engine, a = make_engine(rng, promote=2, max_bucket=8)
+    X = rng.uniform(0, 10, (64, 4)).astype(np.float32)
+    engine.warmup([1, 4])
+    n_threads, n_reqs = 6, 25
+    errors = []
+
+    def work():
+        try:
+            futs = []
+            for i in range(n_reqs):
+                futs.append(engine.submit(X if i % 2 else X[:, 0]))
+                _ = engine.stats  # concurrent snapshot reads
+            for fut in futs:
+                fut.result()
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    stats = engine.stats
+    total = n_threads * n_reqs
+    assert stats.requests == total
+    # Odd i (12 of 25): 4-col promoted block (1 gemm dispatch); even i
+    # (13 of 25): a single vector.
+    assert stats.cols == n_threads * (12 * 4 + 13 * 1)
+    assert stats.dispatches == total
+    # Warmup pre-compiled both executables (matvec + bucket-4), so every
+    # concurrent dispatch is a hit — and none is lost.
+    assert stats.compiles == 2
+    assert stats.hits == total
+    counters = engine.metrics.snapshot()["counters"]
+    assert counters["engine_requests_total"] == total
+    assert counters["engine_cols_total"] == stats.cols
+    # Every trace finished exactly once despite cross-thread materialize.
+    assert len(engine.tracer.traces()) == min(256, total)
+
+
+# ------------------------------------------------------------ tuner events
+
+
+def test_tuner_emits_per_candidate_events(devices):
+    from matvec_mpi_multiplier_tpu.tuning.search import _record_candidate
+
+    reset_registry()
+    _record_candidate("gemv", 1e-5)
+    _record_candidate("gemv", None)
+    _record_candidate("combine", 2e-5)
+    snap = get_registry().snapshot()
+    assert snap["counters"]["tuning_gemv_candidates_total"] == 2
+    assert snap["counters"]["tuning_gemv_unmeasurable_total"] == 1
+    assert snap["counters"]["tuning_combine_candidates_total"] == 1
+    assert snap["histograms"]["tuning_candidate_time_ms"]["count"] == 2
+    reset_registry()
+
+
+def test_tune_gemv_populates_default_registry(devices, tmp_path, monkeypatch):
+    """A real (tiny) tune pass lands measurement events in the process
+    registry — the numbers a sweep's --metrics-out exports."""
+    from matvec_mpi_multiplier_tpu.tuning import TuningCache, reset_cache
+    from matvec_mpi_multiplier_tpu.tuning import search
+
+    monkeypatch.setenv(
+        "MATVEC_TUNING_CACHE", str(tmp_path / "tuning_cache.json")
+    )
+    reset_cache()
+    reset_registry()
+
+    def fake_measure(fn, args, *, n_reps, samples):
+        return 1e-5
+
+    # Events are emitted at the tune_* call sites, not inside _measure_fn,
+    # so faking the measurement still exercises the emission path.
+    monkeypatch.setattr(search, "_measure_fn", fake_measure)
+    cache = TuningCache.load()
+    decision = search.tune_gemv(
+        16, 16, "float32", cache, n_reps=2, samples=1, log=lambda *_: None
+    )
+    assert decision is not None
+    snap = get_registry().snapshot()
+    assert snap["counters"]["tuning_gemv_candidates_total"] >= 1
+    assert snap["histograms"]["tuning_candidate_time_ms"]["count"] >= 1
+    reset_registry()
+    reset_cache()

@@ -136,6 +136,86 @@ def test_sweep_op_serve_delegates(devices, tmp_path, capsys):
     assert len(rows) == 1 and rows[0]["compiles_steady"] == 0
 
 
+def test_serve_percentiles_unified_with_obs_histogram(devices, tmp_path):
+    """The percentile-unification satellite: serve.py owns no percentile
+    math anymore — its p50/p99 ARE the obs histogram's summary, so the CSV
+    fields and the --metrics-out snapshot must report identical values
+    (and match an np.percentile cross-check over the same window)."""
+    import json
+
+    mesh = make_mesh(8)
+    metrics_path = tmp_path / "metrics.json"
+    result = run_serve(
+        "rowwise", mesh, 64, 64, n_requests=40, max_bucket=8,
+        promote=4, seed=3, promo_reps=2, metrics_out=str(metrics_path),
+    )
+    snap = json.loads(metrics_path.read_text())
+    hist = snap["histograms"]["serve_dispatch_latency_ms"]
+    assert hist["count"] == 40
+    assert result.p50_dispatch_ms == hist["p50"]
+    assert result.p99_dispatch_ms == hist["p99"]
+
+
+def test_serve_metrics_snapshot_matches_engine_stats(devices, tmp_path):
+    """Acceptance: the snapshot's request/compile/hit/drain counts exactly
+    match EngineStats (same counters, one source of truth) and the JSONL
+    trace holds one complete span tree per request."""
+    import json
+
+    mesh = make_mesh(8)
+    metrics_path = tmp_path / "metrics.json"
+    trace_path = tmp_path / "trace.jsonl"
+    result = run_serve(
+        "rowwise", mesh, 64, 64, n_requests=25, max_bucket=8,
+        promote=4, seed=0, promo_reps=2,
+        metrics_out=str(metrics_path), trace_jsonl=str(trace_path),
+    )
+    snap = json.loads(metrics_path.read_text())
+    counters = snap["counters"]
+    records = [
+        json.loads(ln) for ln in trace_path.read_text().splitlines()
+    ]
+    # Every submitted request (warmup + steady + promotion check) was
+    # materialized by the protocol's drains, so the trace is complete and
+    # its cardinality ties the snapshot to the stream.
+    assert counters["engine_requests_total"] == len(records)
+    assert counters["engine_compiles_total"] == result.compiles_warmup
+    assert counters["engine_drains_total"] == 0
+    assert counters["engine_deadline_failures_total"] == 0
+    # warmup() pre-compiled the whole ladder (those cache gets are the
+    # compiles), so every dispatch-time lookup is a hit: zero steady-state
+    # recompilation, cross-checked through the snapshot alone.
+    assert (
+        counters["engine_hits_total"] == counters["engine_dispatches_total"]
+    )
+    for rec in records:
+        names = [s["name"] for s in rec["spans"]]
+        assert names == ["submit", "materialize"], rec
+        assert all(s["dur_ms"] >= 0 for s in rec["spans"])
+
+
+def test_serve_cli_obs_flags(devices, tmp_path, capsys, monkeypatch):
+    from matvec_mpi_multiplier_tpu.bench.serve import main
+    from matvec_mpi_multiplier_tpu.obs.annotations import annotations_enabled
+
+    monkeypatch.delenv("MATVEC_ANNOTATE", raising=False)
+    metrics_path = tmp_path / "m.json"
+    trace_path = tmp_path / "t.jsonl"
+    rc = main([
+        "--strategy", "rowwise", "--sizes", "64", "--devices", "8",
+        "--n-requests", "5", "--max-bucket", "4", "--no-csv",
+        "--metrics-out", str(metrics_path),
+        "--trace-jsonl", str(trace_path), "--annotate",
+    ])
+    assert rc == 0
+    # --annotate is scoped to the run: the process-global flag is restored.
+    assert not annotations_enabled()
+    out = capsys.readouterr().out
+    assert f"metrics: {metrics_path}" in out
+    assert f"trace: {trace_path}" in out
+    assert metrics_path.exists() and trace_path.exists()
+
+
 @pytest.mark.slow
 def test_serve_throughput_long_stream(devices):
     """Long mixed stream: the compile count stays flat over hundreds of
