@@ -21,6 +21,7 @@ just without the reuse.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -75,6 +76,7 @@ class ExecutableCache:
         hit_counter: Counter | None = None,
     ) -> None:
         self._executables: dict[ExecKey, Any] = {}
+        self._fingerprints: dict[ExecKey, str] = {}
         self._compiles = compile_counter or Counter("compiles")
         self._hits = hit_counter or Counter("hits")
 
@@ -94,14 +96,28 @@ class ExecutableCache:
             self._hits.inc()
             return exe
         fn, arg_structs, donate = builder()
-        exe = (
-            jax.jit(fn, donate_argnums=donate)
-            .lower(*arg_structs)
-            .compile()
-        )
+        lowered = jax.jit(fn, donate_argnums=donate).lower(*arg_structs)
+        # Fingerprint the lowering: the same ExecKey must always map to
+        # the same program text, or the AOT cache would silently recompile
+        # (or serve divergent programs) across restarts. The staticcheck
+        # HLO auditor applies the same determinism gate to its own
+        # strategy lowerings (staticcheck/hlo.py::run_hlo_audit — a
+        # different lowering recipe, so its hashes are not comparable to
+        # these); recording the hash here makes any one cache's identity
+        # checkable across engines built from the same config. Hashed now,
+        # stored only once compile() succeeds — a failed compile must not
+        # leave a fingerprint for a key with no executable.
+        fingerprint = hashlib.sha256(lowered.as_text().encode()).hexdigest()
+        exe = lowered.compile()
         self._executables[key] = exe
+        self._fingerprints[key] = fingerprint
         self._compiles.inc()
         return exe
+
+    def fingerprint(self, key: ExecKey) -> str | None:
+        """sha256 of the lowered program compiled for ``key`` (None before
+        its first compile)."""
+        return self._fingerprints.get(key)
 
     def __len__(self) -> int:
         return len(self._executables)

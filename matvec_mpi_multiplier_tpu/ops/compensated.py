@@ -40,13 +40,16 @@ values embed exactly), fp64 inputs run the same algorithm in fp64 pairs
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from .gemv import register_kernel
 
 # Dekker split constant for radix-2 precision p: 2^ceil(p/2) + 1.
-# fp32: p=24 -> 2^12 + 1; fp64: p=53 -> 2^27 + 1.
-_SPLITTERS = {jnp.dtype(jnp.float32): 4097.0, jnp.dtype(jnp.float64): 134217729.0}
+# fp32: p=24 -> 2^12 + 1; fp64: p=53 -> 2^27 + 1. Keyed on numpy dtypes
+# (jnp.dtype IS np.dtype) so building the table does no jnp work at import
+# time (staticcheck: import-time-jnp).
+_SPLITTERS = {np.dtype(np.float32): 4097.0, np.dtype(np.float64): 134217729.0}
 
 
 def two_sum(a: Array, b: Array) -> tuple[Array, Array]:

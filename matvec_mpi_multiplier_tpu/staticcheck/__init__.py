@@ -1,0 +1,55 @@
+"""Static analysis for the repo's schedule invariants.
+
+Two layers, one CLI (``python -m matvec_mpi_multiplier_tpu.staticcheck``):
+
+* **AST rule engine** (``rules``): visitor-based lint over the Python
+  corpus — the four grep rules ``scripts/tier1.sh`` and ``tests/test_lint.py``
+  used to duplicate, reimplemented on the AST (no false positives inside
+  strings/docstrings, import aliases resolved), plus rules regex cannot
+  express (implicit fp64 promotion, import-time ``jnp`` work, mutable
+  default arguments). Exemptions are per-rule ``# <marker>: <reason>``
+  comment markers; the marker registry drives the reason-required check.
+* **Lowered-HLO auditor** (``hlo``): every registered strategy × combine ×
+  kernel config is lowered on an abstract CPU mesh and its StableHLO is
+  audited — a collective census pinned against the committed golden
+  schedule table (``data/staticcheck/golden_schedule.json``), per-config
+  transfer-byte accounting, the staged-overlap chunking assertion
+  (``overlap@S`` must lower to S chunked collectives, never one full-width
+  one), and a lowering-fingerprint stability gate (same ExecKey → same
+  lowering hash — the engine-cache silent-recompile guard).
+
+``scripts/tier1.sh --lint-only`` runs the rule layer fail-fast (pure AST
+work, no device backend touched); ``tests/test_lint.py`` and
+``tests/test_staticcheck.py`` are the
+in-suite adapters over the same engine. One source of truth — the paper's
+communication-schedule claims become CI-time compile errors
+(docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+from .corpus import SCAN_FILES, SCAN_ROOTS, SourceFile, iter_corpus, repo_root
+from .findings import Finding, render_json, render_text
+from .rules import (
+    MARKERS,
+    RULES,
+    check_marker_reasons,
+    get_rule,
+    run_rules,
+)
+
+__all__ = [
+    "Finding",
+    "MARKERS",
+    "RULES",
+    "SCAN_FILES",
+    "SCAN_ROOTS",
+    "SourceFile",
+    "check_marker_reasons",
+    "get_rule",
+    "iter_corpus",
+    "render_json",
+    "render_text",
+    "repo_root",
+    "run_rules",
+]

@@ -1,0 +1,146 @@
+"""Staticcheck CLI — the single lint entry point CI and tests share.
+
+Usage::
+
+    python -m matvec_mpi_multiplier_tpu.staticcheck            # rules + HLO audit
+    python -m matvec_mpi_multiplier_tpu.staticcheck --rules    # AST rules only, ~1 s
+    python -m matvec_mpi_multiplier_tpu.staticcheck --hlo-audit
+    python -m matvec_mpi_multiplier_tpu.staticcheck --json
+    python -m matvec_mpi_multiplier_tpu.staticcheck --write-golden
+    python -m matvec_mpi_multiplier_tpu.staticcheck --list
+
+``scripts/tier1.sh --lint-only`` runs ``--rules`` (fail-fast: the AST
+layer never initializes a device backend — the parent package import
+still pulls jax in, but no compile/trace work runs). ``--hlo-audit``
+lowers every audited config on
+an abstract 8-device CPU mesh — this process forces the virtual-device
+flags itself, so it works from any shell. ``--root`` points the rule layer
+at another corpus (the seeded-violation agreement test). Exit status: 0
+clean, 1 findings, 2 usage/environment error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+
+def _force_cpu_mesh() -> None:
+    """Pin the abstract audit mesh BEFORE jax initializes (same contract
+    as tests/conftest.py). An inherited device-count flag is REPLACED, not
+    kept — the audit needs its exact mesh regardless of the shell's own
+    XLA_FLAGS tuning."""
+    import re
+
+    from .hlo import AUDIT_DEVICES
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={AUDIT_DEVICES}"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m matvec_mpi_multiplier_tpu.staticcheck",
+        description=(
+            "AST lint rules + lowered-HLO collective-schedule audit "
+            "(docs/STATIC_ANALYSIS.md)"
+        ),
+    )
+    parser.add_argument(
+        "--rules", action="store_true",
+        help="run the AST rule layer (default: rules + HLO audit)",
+    )
+    parser.add_argument(
+        "--hlo-audit", action="store_true",
+        help="run the lowered-HLO collective-schedule audit",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings on stdout",
+    )
+    parser.add_argument(
+        "--rule", action="append", metavar="NAME",
+        help="restrict the rule layer to NAME (repeatable)",
+    )
+    parser.add_argument(
+        "--root", type=Path, default=None,
+        help="corpus root for the RULE layer only (default: this "
+        "checkout); the HLO audit always runs against this checkout's "
+        "strategies and golden table",
+    )
+    parser.add_argument(
+        "--write-golden", action="store_true",
+        help="re-lower every audited config and bless the golden "
+        "schedule table (data/staticcheck/golden_schedule.json)",
+    )
+    parser.add_argument(
+        "--list", action="store_true",
+        help="list the rule catalogue and exit",
+    )
+    args = parser.parse_args(argv)
+
+    from .findings import render_json, render_text
+    from .rules import RULES, get_rule
+
+    if args.list:
+        width = max(len(n) for n in RULES)
+        for name, rule in sorted(RULES.items()):
+            marker = f"# {rule.marker}:" if rule.marker else "(no marker)"
+            print(f"{name:<{width}}  {marker:<14}  {rule.description}")
+        return 0
+
+    if args.rule:
+        try:
+            for name in args.rule:
+                get_rule(name)
+        except KeyError as e:
+            print(f"staticcheck: {e.args[0]}", file=sys.stderr)
+            return 2
+
+    run_rules_layer = args.rules or not (args.rules or args.hlo_audit)
+    run_hlo_layer = args.hlo_audit or not (args.rules or args.hlo_audit)
+    if args.write_golden:
+        run_hlo_layer = True
+
+    findings = []
+    if run_rules_layer:
+        from .rules import run_rules
+
+        findings.extend(run_rules(root=args.root, rules=args.rule))
+
+    if run_hlo_layer:
+        _force_cpu_mesh()
+        from .hlo import run_hlo_audit, write_golden
+
+        try:
+            # Note: --root deliberately does NOT reach the audit — the
+            # lowered schedules and the golden table are properties of
+            # THIS checkout, not of an alternate lint corpus.
+            if args.write_golden:
+                path = write_golden()
+                print(f"staticcheck: golden schedule table written to {path}",
+                      file=sys.stderr)
+            findings.extend(run_hlo_audit())
+        except RuntimeError as e:
+            print(f"staticcheck: {e}", file=sys.stderr)
+            return 2
+
+    findings = sorted(set(findings))
+    print(render_json(findings) if args.json else render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+"""Findings: the one result type both analysis layers report.
+
+A finding is (rule, file, line, message) — file repo-relative, line
+1-indexed (0 for whole-artifact findings like a golden-table mismatch).
+Reporters render the same list as ``file:line: [rule] message`` text (the
+CI log format) or as JSON (``--json``, the machine face the seeded-corpus
+agreement test compares across entry points).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation (or audit mismatch), sorted file-then-line."""
+
+    path: str   # repo-relative posix path ("" for repo-level findings)
+    line: int   # 1-indexed; 0 when no single line applies
+    rule: str   # rule slug, e.g. "engine-host-sync"
+    message: str
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else (self.path or "-")
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def dedup(findings) -> list[Finding]:
+    """Sorted, duplicate-free view (alias chains can hit one line twice)."""
+    return sorted(set(findings))
+
+
+def render_text(findings) -> str:
+    lines = [f"{f.location}: [{f.rule}] {f.message}" for f in findings]
+    n = len(findings)
+    lines.append(
+        "staticcheck: ok (0 findings)" if n == 0
+        else f"staticcheck: {n} finding{'s' if n != 1 else ''}"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings, **extra) -> str:
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    payload = {
+        "findings": [f.as_dict() for f in findings],
+        "counts": {"total": len(findings), "by_rule": by_rule},
+        **extra,
+    }
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,425 @@
+"""Layer 2: the lowered-artifact auditor.
+
+The paper's result is a communication-schedule story — which collectives
+each partitioning strategy issues and how many bytes they move. The AST
+rules can only check what the *source* says; this layer checks what a
+strategy actually *lowers to*: every audited strategy × combine × kernel
+config is built on an abstract CPU mesh, lowered to StableHLO (trace-only,
+no compile — ~1 s for the whole table), and audited four ways:
+
+* **Collective census** — counts of ``all-reduce`` / ``all-gather`` /
+  ``reduce-scatter`` / ``collective-permute`` / ``all-to-all`` ops, pinned
+  per config against BOTH the structural formula (what the schedule is
+  *supposed* to issue: e.g. ``colwise|overlap@S`` → exactly S chunked
+  reduce-scatters) and the committed golden table
+  (``data/staticcheck/golden_schedule.json``). Code drift and golden drift
+  each trip one side.
+* **Transfer-byte accounting** — per-device collective payload (operand
+  bytes presented to the interconnect per op, not wire traffic; the wire
+  factor — e.g. 2(p−1)/p for a ring all-reduce — is topology's, the
+  payload is the schedule's).
+* **Staged-overlap chunking** — an ``overlap@S`` / ``overlap_ring@S`` body
+  must lower to S chunked collectives carrying 1/S of the un-staged bytes
+  each, never one full-width op (the ROADMAP's "overlap measures like the
+  un-staged baseline while claiming to overlap" failure mode, made a
+  compile-time error).
+* **Fingerprint stability** — building the same :class:`ExecKey` twice
+  must produce byte-identical lowerings (same sha256). A nondeterministic
+  lowering would make the engine's AOT executable cache silently recompile
+  (or worse, serve divergent programs) across restarts.
+
+Census caveat, documented because it WILL surprise: ``rowwise|gather``
+shows an empty census. Its final gather is a ``with_sharding_constraint``,
+which lowers to a sharding custom-call that GSPMD turns into an all-gather
+only at *compile* time — the census covers the collectives the program
+issues explicitly (everything shard_map bodies do), which is exactly the
+set the repo's schedule invariants are about.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+from pathlib import Path
+from typing import Iterable, NamedTuple
+
+from .corpus import repo_root
+from .findings import Finding, dedup
+
+# The audit operand: one shape/dtype exercises every schedule (divisible by
+# the 8-device mesh, its 2x4 grid, and the S∈{2,4} stage ladder).
+AUDIT_DEVICES = 8
+AUDIT_M = 64
+AUDIT_K = 64
+AUDIT_DTYPE = "float32"
+GOLDEN_REL = "data/staticcheck/golden_schedule.json"
+GOLDEN_SCHEMA = 1
+
+# StableHLO op → the census name (the HLO spelling the paper's tables use).
+_KINDS = {
+    "all_reduce": "all-reduce",
+    "all_gather": "all-gather",
+    "reduce_scatter": "reduce-scatter",
+    "collective_permute": "collective-permute",
+    "all_to_all": "all-to-all",
+}
+
+_ITEMSIZE = {"float32": 4, "float64": 8, "bfloat16": 2, "float16": 2}
+_TENSOR_RE = re.compile(r"tensor<(?:([0-9x]+)x)?([a-z][a-z0-9]*)>")
+
+
+class AuditConfig(NamedTuple):
+    """One audited lowering: a strategy × combine(@stages) × kernel cell."""
+
+    strategy: str
+    combine: str
+    stages: int | None = None
+    kernel: str = "xla"
+
+    @property
+    def key(self) -> str:
+        combine = self.combine + (
+            f"@{self.stages}" if self.stages is not None else ""
+        )
+        return f"{self.strategy}|{combine}|{self.kernel}"
+
+
+# The audited table: all three paper strategies across their combine
+# families (models/colwise.py COLWISE_COMBINES; the gather family for the
+# sharded-output strategies), the staged pair at S ∈ {2, 4}. pallas_ring
+# is absent by design: the fused kernel is interpret-gated off-TPU and its
+# collective lives inside the pallas call, invisible to StableHLO op
+# counting. Kernel axis: "xla" (the tile kernels are interpret-gated too;
+# their bodies carry no collectives, so the schedule census is
+# kernel-invariant).
+AUDIT_CONFIGS: tuple[AuditConfig, ...] = (
+    AuditConfig("rowwise", "gather"),
+    AuditConfig("rowwise", "ring"),
+    AuditConfig("rowwise", "overlap", 2),
+    AuditConfig("rowwise", "overlap", 4),
+    AuditConfig("colwise", "psum"),
+    AuditConfig("colwise", "psum_scatter"),
+    AuditConfig("colwise", "ring"),
+    AuditConfig("colwise", "ring_overlap"),
+    AuditConfig("colwise", "a2a"),
+    AuditConfig("colwise", "overlap", 2),
+    AuditConfig("colwise", "overlap", 4),
+    AuditConfig("colwise", "overlap_ring", 2),
+    AuditConfig("colwise", "overlap_ring", 4),
+    AuditConfig("blockwise", "gather"),
+    AuditConfig("blockwise", "ring"),
+    AuditConfig("blockwise", "overlap", 2),
+    AuditConfig("blockwise", "overlap", 4),
+)
+
+
+def _audit_mesh():
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < AUDIT_DEVICES:
+        raise RuntimeError(
+            f"the HLO audit needs {AUDIT_DEVICES} devices (an abstract CPU "
+            f"mesh), got {len(devices)}; run under JAX_PLATFORMS=cpu with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{AUDIT_DEVICES} (the CLI and tests/conftest.py both set this)"
+        )
+    return make_mesh(AUDIT_DEVICES, devices=devices)
+
+
+def lower_config(cfg: AuditConfig, mesh):
+    """Build and lower one config against the audit operand (trace-only)."""
+    import jax
+    import numpy as np
+
+    from ..models import get_strategy
+
+    kwargs: dict = {"combine": cfg.combine, "kernel": cfg.kernel}
+    if cfg.stages is not None:
+        kwargs["stages"] = cfg.stages
+    fn = get_strategy(cfg.strategy).build(mesh, **kwargs)
+    dtype = np.dtype(AUDIT_DTYPE)
+    a = jax.ShapeDtypeStruct((AUDIT_M, AUDIT_K), dtype)
+    x = jax.ShapeDtypeStruct((AUDIT_K,), dtype)
+    return fn.lower(a, x)
+
+
+def _tensor_bytes(type_str: str) -> int:
+    m = _TENSOR_RE.match(type_str)
+    if not m:
+        return 0
+    dims, elem = m.groups()
+    count = 1
+    for d in (dims or "").split("x"):
+        if d:
+            count *= int(d)
+    return count * _ITEMSIZE.get(
+        {"f32": "float32", "f64": "float64", "bf16": "bfloat16",
+         "f16": "float16"}.get(elem, elem),
+        0,
+    )
+
+
+def collective_census(lowered) -> tuple[dict[str, int], dict[str, int]]:
+    """Walk the lowered StableHLO module: per-kind op counts and per-kind
+    payload bytes (sum of operand tensor bytes — the per-device bytes each
+    op hands the interconnect)."""
+    census: dict[str, int] = {}
+    payload: dict[str, int] = {}
+
+    def walk(op):
+        for region in op.regions:
+            for block in region.blocks:
+                for child in block.operations:
+                    name = child.operation.name
+                    if name.startswith("stablehlo."):
+                        kind = _KINDS.get(name.split(".", 1)[1])
+                        if kind is not None:
+                            census[kind] = census.get(kind, 0) + 1
+                            payload[kind] = payload.get(kind, 0) + sum(
+                                _tensor_bytes(str(o.type))
+                                for o in child.operands
+                            )
+                    walk(child.operation)
+
+    walk(lowered.compiler_ir(dialect="stablehlo").operation)
+    return census, payload
+
+
+def expected_schedule(
+    cfg: AuditConfig, mesh
+) -> tuple[dict[str, int], dict[str, int]]:
+    """The structural formula: what each schedule must issue, derived from
+    the mesh (p devices, (r, c) grid) and the audit operand — the second,
+    golden-independent pin on the census. An ``overlap@S`` entry is by
+    construction S chunked collectives at 1/S of the un-staged bytes."""
+    from ..parallel.mesh import mesh_grid_shape
+
+    p = int(mesh.devices.size)
+    r, _c = mesh_grid_shape(mesh)
+    m = AUDIT_M
+    itemsize = _ITEMSIZE[AUDIT_DTYPE]
+    s = cfg.stages or 1
+
+    def entry(**kinds: tuple[int, int]):
+        # each kind: (op count, elements per op)
+        census = {k: n for k, (n, _) in kinds.items()}
+        payload = {k: n * e * itemsize for k, (n, e) in kinds.items()}
+        return census, payload
+
+    strat, comb = cfg.strategy, cfg.combine
+    if strat in ("rowwise", "colwise"):
+        if comb == "gather":
+            # with_sharding_constraint: GSPMD's all-gather, invisible to
+            # the StableHLO census (module docstring).
+            return entry()
+        if comb == "psum":
+            return entry(**{"all-reduce": (1, m)})
+        if comb == "psum_scatter":
+            return entry(**{"reduce-scatter": (1, m)})
+        if comb in ("ring", "ring_overlap"):
+            # p−1 neighbor hops, each moving one m/p accumulator chunk.
+            return entry(**{"collective-permute": (p - 1, m // p)})
+        if comb == "a2a":
+            return entry(**{"all-to-all": (1, m)})
+        if comb == "overlap" and strat == "colwise":
+            # S chunked reduce-scatters, m/S rows each.
+            return entry(**{"reduce-scatter": (s, m // s)})
+        if comb == "overlap" and strat == "rowwise":
+            # S chunked ring all-gathers: (p−1) hops of m/(p·S) rows each.
+            return entry(**{"collective-permute": (s * (p - 1), m // (p * s))})
+        if comb == "overlap_ring":
+            # S staged ring reduce-scatters: each stage's m/S-row partial
+            # rides p−1 hops of m/(p·S)-row accumulator chunks.
+            return entry(**{"collective-permute": (s * (p - 1), m // (p * s))})
+    if strat == "blockwise":
+        if comb == "gather":
+            # The in-body reduce-over-grid-columns; the final gather over
+            # 'rows' is GSPMD's (as above).
+            return entry(**{"all-reduce": (1, m // r)})
+        if comb == "ring":
+            return entry(**{
+                "all-reduce": (1, m // r),
+                "collective-permute": (r - 1, m // r),
+            })
+        if comb == "overlap":
+            # Per stage: one chunked psum over grid cols + (r−1) chunked
+            # ring-gather hops over grid rows, m/(r·S) rows each.
+            return entry(**{
+                "all-reduce": (s, m // (r * s)),
+                "collective-permute": (s * (r - 1), m // (r * s)),
+            })
+    raise KeyError(f"no expected-schedule formula for {cfg.key}")
+
+
+def lowering_fingerprint(lowered) -> str:
+    return hashlib.sha256(lowered.as_text().encode()).hexdigest()
+
+
+def exec_key(cfg: AuditConfig):
+    """The engine-cache identity this config dispatches under — the
+    fingerprint gate's subject (engine/executables.py records the same
+    hash at compile time)."""
+    from ..engine.executables import ExecKey
+
+    combine = cfg.combine + (
+        f"@{cfg.stages}" if cfg.stages is not None else ""
+    )
+    return ExecKey(
+        op="matvec", strategy=cfg.strategy, kernel=cfg.kernel,
+        combine=combine, bucket=1, dtype=AUDIT_DTYPE,
+    )
+
+
+def audit_entry(cfg: AuditConfig, mesh, lowered=None) -> dict:
+    """Package one config's observed schedule (lowering it unless the
+    caller already has the lowered artifact in hand)."""
+    if lowered is None:
+        lowered = lower_config(cfg, mesh)
+    census, payload = collective_census(lowered)
+    return {
+        "census": dict(sorted(census.items())),
+        "payload_bytes": dict(sorted(payload.items())),
+        "payload_total_bytes": sum(payload.values()),
+    }
+
+
+def build_schedule_table(configs: Iterable[AuditConfig] | None = None) -> dict:
+    """The full golden-table payload for the current tree."""
+    import jax
+
+    mesh = _audit_mesh()
+    entries = {
+        cfg.key: audit_entry(cfg, mesh)
+        for cfg in (configs or AUDIT_CONFIGS)
+    }
+    return {
+        "schema": GOLDEN_SCHEMA,
+        "mesh": {
+            "devices": AUDIT_DEVICES,
+            "grid": list(mesh.devices.shape),
+        },
+        "operand": {"m": AUDIT_M, "k": AUDIT_K, "dtype": AUDIT_DTYPE},
+        "jax_version_at_capture": jax.__version__,
+        "configs": entries,
+    }
+
+
+def write_golden(root: Path | None = None, path: Path | None = None) -> Path:
+    """Regenerate the committed golden schedule table — the bless step
+    after a deliberate schedule change (docs/STATIC_ANALYSIS.md)."""
+    root = Path(root) if root is not None else repo_root()
+    path = Path(path) if path is not None else root / GOLDEN_REL
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(build_schedule_table(), indent=2) + "\n")
+    return path
+
+
+def run_hlo_audit(
+    root: Path | None = None,
+    golden_path: Path | None = None,
+    configs: Iterable[AuditConfig] | None = None,
+    check_fingerprints: bool = True,
+) -> list[Finding]:
+    """The full audit: census + bytes vs formula and golden, the overlap
+    chunking gate (folded into both pins), and fingerprint stability.
+    Returns findings; empty means every schedule lowers as pinned."""
+    root = Path(root) if root is not None else repo_root()
+    golden_path = (
+        Path(golden_path) if golden_path is not None else root / GOLDEN_REL
+    )
+    configs = tuple(configs or AUDIT_CONFIGS)
+    findings: list[Finding] = []
+
+    golden_cfgs: dict = {}
+    have_golden = golden_path.is_file()
+    if have_golden:
+        golden = json.loads(golden_path.read_text())
+        if golden.get("schema") != GOLDEN_SCHEMA:
+            findings.append(Finding(
+                GOLDEN_REL, 0, "hlo-golden",
+                f"golden schema {golden.get('schema')!r} != "
+                f"{GOLDEN_SCHEMA}; regenerate with --write-golden",
+            ))
+        golden_cfgs = golden.get("configs", {})
+    else:
+        findings.append(Finding(
+            GOLDEN_REL, 0, "hlo-golden",
+            "golden collective-schedule table missing; generate it with "
+            "`python -m matvec_mpi_multiplier_tpu.staticcheck "
+            "--write-golden`",
+        ))
+
+    mesh = _audit_mesh()
+    for cfg in configs:
+        lowered = lower_config(cfg, mesh)
+        observed = audit_entry(cfg, mesh, lowered)
+        exp_census, exp_payload = expected_schedule(cfg, mesh)
+
+        overlap_hint = ""
+        if cfg.stages is not None:
+            overlap_hint = (
+                f" — a staged overlap body must lower to S={cfg.stages} "
+                "chunked collectives (1/S of the un-staged bytes each), "
+                "never a full-width one"
+            )
+        if observed["census"] != dict(sorted(exp_census.items())):
+            findings.append(Finding(
+                f"<hlo:{cfg.key}>", 0, "hlo-schedule",
+                f"collective census {observed['census']} != structural "
+                f"expectation {dict(sorted(exp_census.items()))}"
+                f"{overlap_hint}",
+            ))
+        elif observed["payload_bytes"] != dict(sorted(exp_payload.items())):
+            findings.append(Finding(
+                f"<hlo:{cfg.key}>", 0, "hlo-schedule",
+                f"collective payload {observed['payload_bytes']} != "
+                f"structural expectation "
+                f"{dict(sorted(exp_payload.items()))}{overlap_hint}",
+            ))
+
+        if have_golden:
+            # Empty/absent "configs" must read as every pin missing, not
+            # as a clean audit — a truncated golden would otherwise turn
+            # the whole pin layer off silently.
+            pinned = golden_cfgs.get(cfg.key)
+            if pinned is None:
+                findings.append(Finding(
+                    GOLDEN_REL, 0, "hlo-golden",
+                    f"config {cfg.key} missing from the golden table; "
+                    "bless it with --write-golden",
+                ))
+            elif pinned != observed:
+                findings.append(Finding(
+                    GOLDEN_REL, 0, "hlo-census",
+                    f"{cfg.key}: lowered schedule {observed} != golden "
+                    f"{pinned}{overlap_hint}; if the change is deliberate, "
+                    "bless it with --write-golden",
+                ))
+
+        if check_fingerprints:
+            # The census pass's lowering doubles as the first sample; one
+            # fresh rebuild probes determinism.
+            fp_a = lowering_fingerprint(lowered)
+            fp_b = lowering_fingerprint(lower_config(cfg, mesh))
+            if fp_a != fp_b:
+                findings.append(Finding(
+                    f"<hlo:{cfg.key}>", 0, "hlo-fingerprint",
+                    f"two lowerings of ExecKey {exec_key(cfg)} hash "
+                    f"differently ({fp_a[:12]} vs {fp_b[:12]}): the "
+                    "engine's AOT cache would silently recompile (or "
+                    "serve divergent programs) across restarts",
+                ))
+
+    if have_golden:
+        audited = {cfg.key for cfg in AUDIT_CONFIGS}
+        for stale in sorted(set(golden_cfgs) - audited):
+            findings.append(Finding(
+                GOLDEN_REL, 0, "hlo-golden",
+                f"golden table pins unknown config {stale}; regenerate "
+                "with --write-golden",
+            ))
+    return dedup(findings)

@@ -1,0 +1,468 @@
+"""The AST rule engine: registry, exemption markers, and the rule catalogue.
+
+Each rule is a small checker over one parsed :class:`~.corpus.SourceFile`,
+scoped to the paths where its invariant holds, with an optional exemption
+marker. A finding on a statement is suppressed when any comment on the
+statement's physical lines carries ``# <marker>: <reason>`` — and the
+marker registry enforces that every marker occurrence in a rule's scope is
+a real comment with a non-empty reason (the justification-not-escape-hatch
+contract ``tests/test_lint.py`` parameterizes over :data:`MARKERS`).
+
+Rule catalogue (docs/STATIC_ANALYSIS.md has the long form):
+
+========================  ===========  ====================================
+rule                      marker       invariant
+========================  ===========  ====================================
+shard-map-direct          —            shard_map refs only via utils/compat
+engine-host-sync          sync-ok      no host syncs on engine dispatch
+overlap-unchunked-        overlap-ok   no full-width all_gather/psum in
+collective                             staged-overlap schedule bodies
+hot-path-blocking-io      obs-ok       no file I/O on the dispatch hot path
+fp64-implicit-promotion   fp64-ok      no implicit float64 in traced code
+import-time-jnp           import-ok    no jnp work at module import time
+mutable-default-arg       default-ok   no mutable default arguments
+========================  ===========  ====================================
+
+The first four are the old grep rules from ``scripts/tier1.sh`` /
+``tests/test_lint.py``, now alias-aware and string/docstring-proof; the
+last three are inexpressible as greps.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+from .corpus import SourceFile, iter_corpus, repo_root
+from .findings import Finding, dedup
+
+# ------------------------------------------------------------ framework
+
+_PKG = "matvec_mpi_multiplier_tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One registered invariant: where it applies, how it checks, how a
+    deliberate exception is marked."""
+
+    name: str                       # slug used in findings and --rule
+    marker: str | None              # "<marker>: <reason>" comment exempts
+    description: str                # one line, shown by --list
+    scope: Callable[[str], bool]    # repo-relative posix path predicate
+    check: Callable[[SourceFile], Iterator[tuple[ast.AST, str]]]
+
+
+RULES: dict[str, Rule] = {}
+
+
+def _register(name, marker, description, scope):
+    def deco(fn):
+        RULES[name] = Rule(name, marker, description, scope, fn)
+        return fn
+
+    return deco
+
+
+def get_rule(name: str) -> Rule:
+    try:
+        return RULES[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown rule {name!r}; available: {sorted(RULES)}"
+        ) from None
+
+
+def _markers() -> dict[str, str]:
+    return {r.marker: r.name for r in RULES.values() if r.marker}
+
+
+def _exempt(sf: SourceFile, node: ast.AST, marker: str) -> bool:
+    return f"{marker}:" in sf.span_comments(node)
+
+
+def _marker_reason_findings(
+    sf: SourceFile, rules: Iterable[Rule]
+) -> Iterator[Finding]:
+    """Every marker occurrence in an in-scope file must carry a reason.
+    (Marker text inside strings never exempts — comments only — so only
+    comments are validated.)"""
+    for rule in rules:
+        if not rule.marker:
+            continue
+        token = f"{rule.marker}:"
+        for lineno, comment in sf.comments.items():
+            if token in comment and not comment.split(token, 1)[1].strip():
+                yield Finding(
+                    sf.rel, lineno, "marker-missing-reason",
+                    f"'# {token}' without a reason (the {rule.name} "
+                    f"exemption marker documents WHY, or it is an escape "
+                    f"hatch)",
+                )
+
+
+def run_rules(
+    root: Path | None = None,
+    rules: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Run the (selected) rule catalogue over the corpus under ``root``
+    (the repo by default). Returns sorted, deduplicated findings — empty
+    means the tree is clean."""
+    root = Path(root) if root is not None else repo_root()
+    selected = (
+        list(RULES.values()) if rules is None
+        else [get_rule(n) for n in rules]
+    )
+    findings: list[Finding] = []
+    for path in iter_corpus(root):
+        try:
+            sf = SourceFile(path, root)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            rel = path.relative_to(root).as_posix()
+            findings.append(
+                Finding(rel, getattr(e, "lineno", 0) or 0, "parse-error",
+                        f"unparseable source: {e}")
+            )
+            continue
+        in_scope = [r for r in selected if r.scope(sf.rel)]
+        for rule in in_scope:
+            for node, message in rule.check(sf):
+                if rule.marker and _exempt(sf, node, rule.marker):
+                    continue
+                findings.append(
+                    Finding(sf.rel, getattr(node, "lineno", 0), rule.name,
+                            message)
+                )
+        findings.extend(_marker_reason_findings(sf, in_scope))
+    return dedup(findings)
+
+
+def check_marker_reasons(
+    marker: str, root: Path | None = None
+) -> list[Finding]:
+    """Reason-required check for ONE marker over its rule's scope — the
+    per-marker face ``tests/test_lint.py`` parameterizes over."""
+    rule = get_rule(MARKERS[marker])
+    root = Path(root) if root is not None else repo_root()
+    findings: list[Finding] = []
+    for path in iter_corpus(root):
+        rel = path.relative_to(root).as_posix()
+        if not rule.scope(rel):
+            continue
+        try:
+            sf = SourceFile(path, root)
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # run_rules owns the parse-error finding
+        findings.extend(_marker_reason_findings(sf, [rule]))
+    return dedup(findings)
+
+
+# ----------------------------------------------------------- AST helpers
+
+
+def _calls(tree: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _has_float_literal(nodes: Iterable[ast.AST]) -> bool:
+    for root in nodes:
+        for node in ast.walk(root):
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, float
+            ):
+                return True
+    return False
+
+
+def _import_time_nodes(tree: ast.Module) -> Iterator[ast.AST]:
+    """Expressions executed at import: module/class bodies plus function
+    decorators and default-argument expressions — but never the deferred
+    function/lambda bodies themselves."""
+    stack: list[ast.AST] = list(tree.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            stack.extend(_defaults(node.args))
+        elif isinstance(node, ast.Lambda):
+            stack.extend(_defaults(node.args))
+        elif isinstance(node, ast.ClassDef):
+            stack.extend(node.decorator_list)
+            stack.extend(node.body)
+        else:
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _defaults(args: ast.arguments) -> list[ast.AST]:
+    return list(args.defaults) + [d for d in args.kw_defaults if d]
+
+
+# ------------------------------------------------------ scope predicates
+
+
+def _all_but_compat(rel: str) -> bool:
+    return rel != f"{_PKG}/utils/compat.py"
+
+
+def _engine(rel: str) -> bool:
+    return rel.startswith(f"{_PKG}/engine/")
+
+
+def _overlap_bodies(rel: str) -> bool:
+    return rel in (f"{_PKG}/parallel/ring.py", f"{_PKG}/ops/pallas_collective.py")
+
+
+def _hot_path(rel: str) -> bool:
+    # engine/ plus the obs in-memory layer; the sink thread and the obs CLI
+    # are the two files allowed to touch the filesystem by design.
+    if _engine(rel):
+        return True
+    return rel.startswith(f"{_PKG}/obs/") and rel not in (
+        f"{_PKG}/obs/sink.py", f"{_PKG}/obs/__main__.py",
+    )
+
+
+def _package(rel: str) -> bool:
+    return rel.startswith(f"{_PKG}/")
+
+
+# -------------------------------------------------------------- catalogue
+
+
+def _is_shard_map_path(q: str) -> bool:
+    return q == "jax.shard_map" or q.startswith("jax.experimental.shard_map")
+
+
+@_register(
+    "shard-map-direct", None,
+    "direct jax.shard_map / jax.experimental.shard_map reference outside "
+    "utils/compat.py (the cross-version shim chokepoint)",
+    _all_but_compat,
+)
+def _check_shard_map(sf: SourceFile):
+    msg = (
+        "direct shard_map reference; route it through "
+        f"{_PKG}.utils.compat so a JAX API bump stays a one-file change"
+    )
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod in ("jax", "jax.experimental") and any(
+                a.name == "shard_map" for a in node.names
+            ):
+                yield node, msg
+            elif mod.startswith("jax.experimental.shard_map"):
+                yield node, msg
+        elif isinstance(node, ast.Import):
+            if any(
+                a.name.startswith("jax.experimental.shard_map")
+                for a in node.names
+            ):
+                yield node, msg
+        elif isinstance(node, (ast.Attribute, ast.Name)):
+            # Name catches the bare-alias call site (`from jax import
+            # shard_map as sm; sm(...)`); the alias table resolves it. A
+            # local name that merely spells "shard_map" resolves to itself
+            # and stays clean.
+            if _is_shard_map_path(sf.qualname(node) or ""):
+                yield node, msg
+
+
+_SYNC_ATTRS = ("block_until_ready", "device_get")
+_SYNC_CALLS = ("numpy.asarray", "numpy.array", "jax.numpy.asarray")
+
+
+@_register(
+    "engine-host-sync", "sync-ok",
+    "host synchronization on the engine dispatch path (breaks the async "
+    "submit contract)",
+    _engine,
+)
+def _check_host_sync(sf: SourceFile):
+    for call in _calls(sf.tree):
+        fn = call.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if attr in _SYNC_ATTRS:
+            yield call, (
+                f"{attr}() host-syncs; a dispatch-path round-trip turns "
+                "async submit into per-request blocking (move it to "
+                "bench/serve.py or mark the deliberate materialization "
+                "point)"
+            )
+        elif (sf.qualname(fn) or "") in _SYNC_CALLS:
+            yield call, (
+                f"{ast.unparse(fn)}() materializes device values on the "
+                "dispatch path (host staging belongs behind a "
+                "'# sync-ok: <reason>' marker)"
+            )
+
+
+_FULL_WIDTH = ("jax.lax.all_gather", "jax.lax.psum")
+
+
+@_register(
+    "overlap-unchunked-collective", "overlap-ok",
+    "full-width collective inside a staged-overlap schedule body "
+    "(re-serializes the transfer the S-stage pipeline exists to hide)",
+    _overlap_bodies,
+)
+def _check_overlap(sf: SourceFile):
+    for call in _calls(sf.tree):
+        q = sf.qualname(call.func)
+        if q in _FULL_WIDTH:
+            yield call, (
+                f"un-chunked {q}() in an overlap schedule body: stage the "
+                "collective (1/S of the bytes per issue) or mark a "
+                "deliberate chunked use"
+            )
+
+
+# "open" in the attribute set covers Path.open()-style method calls, which
+# the old grep's `\bopen\(` matched too (word boundary after the dot).
+_IO_ATTRS = ("open", "write", "write_text", "write_bytes")
+_IO_CALLS = ("open", "io.open", "json.dump")
+
+
+@_register(
+    "hot-path-blocking-io", "obs-ok",
+    "blocking file I/O on the engine dispatch hot path (file writes go "
+    "through the obs sink thread)",
+    _hot_path,
+)
+def _check_blocking_io(sf: SourceFile):
+    for call in _calls(sf.tree):
+        fn = call.func
+        q = sf.qualname(fn) or ""
+        if q in _IO_CALLS:
+            yield call, (
+                f"{q}() blocks on the filesystem; route writes through "
+                "obs/sink.py (the sink thread) or mark a non-hot-path "
+                "write"
+            )
+        elif isinstance(fn, ast.Attribute) and fn.attr in _IO_ATTRS:
+            yield call, (
+                f".{fn.attr}() blocks on the filesystem; route writes "
+                "through obs/sink.py (the sink thread) or mark a "
+                "non-hot-path write"
+            )
+
+
+# jnp constructors: {qualified name: positional index of dtype}. Under the
+# test tier's x64 mode their default dtype is float64, so a missing dtype
+# is an implicit promotion: always for the default-float family below,
+# and for array/asarray whenever a Python float literal flows in.
+_JNP_CTOR_DTYPE_POS = {
+    "jax.numpy.array": 1,
+    "jax.numpy.asarray": 1,
+    "jax.numpy.zeros": 1,
+    "jax.numpy.ones": 1,
+    "jax.numpy.empty": 1,
+    "jax.numpy.full": 2,
+    "jax.numpy.eye": 3,
+    "jax.numpy.arange": 3,
+    "jax.numpy.linspace": 5,
+}
+_JNP_DEFAULT_FLOAT = (
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.empty", "jax.numpy.eye",
+)
+_F64_CTORS = ("numpy.float64", "jax.numpy.float64")
+
+
+@_register(
+    "fp64-implicit-promotion", "fp64-ok",
+    "implicit float64 promotion (bare float literals / np.float64 scalars "
+    "flowing into traced bodies under x64)",
+    _package,
+)
+def _check_fp64(sf: SourceFile):
+    for call in _calls(sf.tree):
+        q = sf.qualname(call.func) or ""
+        if q in _F64_CTORS:
+            yield call, (
+                f"{q}() builds a float64 scalar; in a bf16/f32 pipeline "
+                "this silently promotes every downstream op (use the "
+                "operand's dtype, or mark a deliberate fp64 tier)"
+            )
+            continue
+        for kw in call.keywords:
+            if kw.arg == "dtype" and sf.qualname(kw.value) == "float":
+                yield call, (
+                    "dtype=float is float64 under x64; name the width "
+                    "explicitly"
+                )
+        pos = _JNP_CTOR_DTYPE_POS.get(q)
+        if pos is None:
+            continue
+        has_dtype = len(call.args) > pos or any(
+            kw.arg == "dtype" for kw in call.keywords
+        )
+        if has_dtype:
+            continue
+        if q in _JNP_DEFAULT_FLOAT:
+            yield call, (
+                f"{q}() without a dtype defaults to float64 under x64 "
+                "(the test tier); pass the intended dtype"
+            )
+        elif _has_float_literal(call.args):
+            yield call, (
+                f"{q}() over Python float literals without a dtype makes "
+                "a float64 constant under x64; pass the intended dtype"
+            )
+
+
+@_register(
+    "import-time-jnp", "import-ok",
+    "jnp work executed at module import time (initializes the backend / "
+    "traces before any caller chose a platform)",
+    _package,
+)
+def _check_import_time_jnp(sf: SourceFile):
+    for top in _import_time_nodes(sf.tree):
+        if not isinstance(top, ast.Call):
+            continue
+        q = sf.qualname(top.func) or ""
+        if q == "jax.numpy" or q.startswith("jax.numpy."):
+            yield top, (
+                f"{q}() runs at import time — backend init and constant "
+                "materialization before any caller chose a platform; "
+                "compute it lazily or with numpy"
+            )
+
+
+_MUTABLE_FACTORIES = (
+    "list", "dict", "set", "collections.defaultdict", "collections.deque",
+)
+
+
+@_register(
+    "mutable-default-arg", "default-ok",
+    "mutable default argument (shared across calls — and across traces "
+    "for functions that end up jitted)",
+    _package,
+)
+def _check_mutable_default(sf: SourceFile):
+    for node in ast.walk(sf.tree):
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        for default in _defaults(node.args):
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(default, ast.Call)
+                and sf.qualname(default.func) in _MUTABLE_FACTORIES
+            ):
+                yield default, (
+                    "mutable default argument is evaluated once and shared "
+                    "across every call (and every trace); default to None "
+                    "and construct inside the body"
+                )
+
+
+MARKERS: dict[str, str] = _markers()

@@ -1,110 +1,52 @@
 #!/bin/sh
-# Tier-1 verify wrapper: the ROADMAP.md tier-1 command plus the repo lint
-# gate, as one entry point for CI and local runs.
+# Tier-1 verify wrapper: the ROADMAP.md tier-1 command plus the repo's
+# static-analysis gate, as one entry point for CI and local runs.
 #
 #   ./scripts/tier1.sh            # lint + tier-1 test suite
 #   ./scripts/tier1.sh --lint-only
 #
-# Lint: direct `jax.shard_map` / `jax.experimental.shard_map` references are
-# forbidden outside utils/compat.py — every module goes through the
-# cross-version shim so a JAX API bump is a one-file change. (The same rule
-# is enforced in-suite by tests/test_lint.py; this wrapper lets CI fail fast
-# before spending the full suite's runtime.)
+# Lint is the staticcheck AST rule layer (matvec_mpi_multiplier_tpu/
+# staticcheck — rule catalogue in docs/STATIC_ANALYSIS.md): shard_map only
+# via utils/compat.py, no host syncs on the engine dispatch path, no
+# full-width collectives in staged-overlap bodies, no blocking I/O on the
+# dispatch hot path, no implicit fp64 promotion / import-time jnp work /
+# mutable default arguments. The same engine backs tests/test_lint.py
+# in-suite; this wrapper lets CI fail fast before spending the full
+# suite's runtime. --rules skips the lowered-HLO collective-schedule
+# audit (which needs the 8-device CPU mesh, and rides the suite via
+# tests/test_staticcheck.py) — the rule layer never initializes a device
+# backend (package import still pulls jax in; ~1 s total), keeping
+# --lint-only well under its 10-second budget.
 
 set -eu
 cd "$(dirname "$0")/.."
 
-lint() {
-  # --include limits the sweep to Python sources; compat.py is the one
-  # allowed importer. Matches attribute use AND both import spellings.
-  bad=$(grep -rnE \
-      'jax\.shard_map|jax\.experimental\.shard_map|from jax\.experimental import shard_map' \
-      --include='*.py' \
-      matvec_mpi_multiplier_tpu tests scripts bench.py __graft_entry__.py \
-      2>/dev/null | grep -v 'matvec_mpi_multiplier_tpu/utils/compat\.py' || true)
-  if [ -n "$bad" ]; then
-    echo "LINT: direct shard_map references outside utils/compat.py:" >&2
-    echo "$bad" >&2
-    echo "Route them through matvec_mpi_multiplier_tpu.utils.compat." >&2
-    return 1
-  fi
-  echo "lint: ok (no direct shard_map references outside utils/compat.py)"
-
-  # Engine dispatch paths must never host-sync (the async submit contract):
-  # block_until_ready / device_get / materializing asarray are forbidden in
-  # engine/ except on lines whose `# sync-ok: <reason>` marker documents a
-  # deliberate materialization point (future.result, one-time host staging).
-  # Timing code is exempt by living in bench/serve.py. (Same rule in-suite:
-  # tests/test_lint.py::test_no_host_syncs_in_engine_dispatch.)
-  bad=$(grep -rnE \
-      'block_until_ready|device_get|np\.asarray|np\.array\(|jnp\.asarray' \
-      --include='*.py' matvec_mpi_multiplier_tpu/engine \
-      2>/dev/null | grep -v 'sync-ok:' || true)
-  if [ -n "$bad" ]; then
-    echo "LINT: host syncs in engine/ dispatch paths:" >&2
-    echo "$bad" >&2
-    echo "Mark deliberate materialization points with '# sync-ok: <reason>'" >&2
-    echo "or move timing code to bench/serve.py." >&2
-    return 1
-  fi
-  echo "lint: ok (no unmarked host syncs in engine/ dispatch paths)"
-
-  # Overlap schedule bodies must stay chunked: a full-width all_gather or
-  # psum inside the staged-overlap/collective-kernel modules would serialize
-  # the very communication the schedule exists to hide. Deliberate chunked
-  # uses (e.g. the per-stage psum over grid columns) carry an
-  # `# overlap-ok: <reason>` marker. (Same rule in-suite:
-  # tests/test_lint.py::test_no_unchunked_collectives_in_overlap_bodies.)
-  bad=$(grep -rnE \
-      'jax\.lax\.all_gather\(|jax\.lax\.psum\(' \
-      --include='*.py' \
-      matvec_mpi_multiplier_tpu/parallel/ring.py \
-      matvec_mpi_multiplier_tpu/ops/pallas_collective.py \
-      2>/dev/null | grep -v 'overlap-ok:' || true)
-  if [ -n "$bad" ]; then
-    echo "LINT: un-chunked full-width collectives in overlap schedule bodies:" >&2
-    echo "$bad" >&2
-    echo "Stage the collective (1/S of the bytes per issue) or mark a" >&2
-    echo "deliberate chunked use with '# overlap-ok: <reason>'." >&2
-    return 1
-  fi
-  echo "lint: ok (no un-chunked collectives in overlap schedule bodies)"
-
-  # The engine dispatch hot path (engine/ plus the obs in-memory layer)
-  # must never block on file I/O: a file write or json.dump inside submit
-  # would stall every request behind the filesystem — the reason the trace
-  # sink is a separate thread. Exempt by name: obs/sink.py (the sink
-  # thread — the ONE place obs touches files) and obs/__main__.py (the
-  # CLI, driver code). Deliberate exceptions elsewhere carry an
-  # `# obs-ok: <reason>` marker. (Same rule in-suite:
-  # tests/test_lint.py::test_no_blocking_io_on_dispatch_hot_path.)
-  bad=$(grep -rnE \
-      '\bopen\(|json\.dump|\.write\(|write_text\(|write_bytes\(' \
-      --include='*.py' \
-      matvec_mpi_multiplier_tpu/engine matvec_mpi_multiplier_tpu/obs \
-      2>/dev/null \
-      | grep -v 'matvec_mpi_multiplier_tpu/obs/sink\.py' \
-      | grep -v 'matvec_mpi_multiplier_tpu/obs/__main__\.py' \
-      | grep -v 'obs-ok:' || true)
-  if [ -n "$bad" ]; then
-    echo "LINT: blocking I/O on the engine dispatch hot path:" >&2
-    echo "$bad" >&2
-    echo "Route file writes through the obs sink thread (obs/sink.py) or" >&2
-    echo "mark a deliberate non-hot-path write with '# obs-ok: <reason>'." >&2
-    return 1
-  fi
-  echo "lint: ok (no blocking I/O on the engine dispatch hot path)"
-}
-
-lint
+python -m matvec_mpi_multiplier_tpu.staticcheck --rules
 [ "${1:-}" = "--lint-only" ] && exit 0
 
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
-set -o pipefail 2>/dev/null || true
+# Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
+# `pytest | tee` reads TEE's status, so a failing suite could exit 0. The
+# status file captures pytest's (or timeout's) real status from inside the
+# pipeline's left-hand subshell instead — via `|| echo $?`, which is also
+# exempt from errexit (a bare failing pytest would kill that subshell
+# under `set -e` before any capture ran). pipefail, where supported,
+# additionally covers a tee failure — probed in a subshell, because dash
+# treats `set -o pipefail` as a special-builtin error and exits the whole
+# script even behind `|| true`.
+if (set -o pipefail) 2>/dev/null; then set -o pipefail; fi
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
-  --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
-  -p no:randomly 2>&1 | tee /tmp/_t1.log
-rc=$?
+# Private rc file (mktemp): a fixed /tmp name would let two concurrent
+# tier-1 runs cross-contaminate exit codes. /tmp/_t1.log stays fixed —
+# it is the ROADMAP tier-1 command's own convention.
+rc_file=$(mktemp /tmp/_t1_rc.XXXXXX)
+echo 0 > "$rc_file"
+{
+  timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 || echo $? > "$rc_file"
+} | tee /tmp/_t1.log
+rc=$(cat "$rc_file")
+rm -f "$rc_file"
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
-exit $rc
+exit "$rc"

@@ -332,3 +332,97 @@ def test_vmem_roof_derivation(tmp_path, monkeypatch):
         (out / "vmem_roof.json").read_text()
     )
     assert dq._vmem_sanity_gbps() == pytest.approx(2100.0)
+
+
+# --------------------------------------------------------------- staticcheck
+# The committed golden collective-schedule table (data/staticcheck/) is the
+# HLO auditor's pin: if its shape rots, the audit silently weakens. These
+# gates hold the artifact itself to schema; whether the pinned numbers still
+# match what the tree lowers to is tests/test_staticcheck.py's job (which
+# re-lowers every config).
+
+GOLDEN_SCHEDULE = REPO / "data" / "staticcheck" / "golden_schedule.json"
+
+_CENSUS_KINDS = {
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+}
+
+
+def _golden():
+    import json
+
+    assert GOLDEN_SCHEDULE.is_file(), (
+        "golden schedule table missing; generate with "
+        "`python -m matvec_mpi_multiplier_tpu.staticcheck --write-golden`"
+    )
+    return json.loads(GOLDEN_SCHEDULE.read_text())
+
+
+def test_golden_schedule_schema():
+    from matvec_mpi_multiplier_tpu.models import STRATEGIES
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        AUDIT_CONFIGS,
+        GOLDEN_SCHEMA,
+    )
+
+    payload = _golden()
+    assert payload["schema"] == GOLDEN_SCHEMA
+    mesh = payload["mesh"]
+    assert mesh["devices"] == 8
+    assert mesh["grid"][0] * mesh["grid"][1] == mesh["devices"]
+    operand = payload["operand"]
+    assert operand["m"] > 0 and operand["k"] > 0
+    assert operand["dtype"] in ("float32", "float64", "bfloat16")
+    # Literal map, not np.dtype(): bfloat16 only registers with numpy once
+    # ml_dtypes is imported, which this test must not depend on.
+    itemsize = {"float32": 4, "float64": 8, "bfloat16": 2}[operand["dtype"]]
+
+    configs = payload["configs"]
+    # Exactly the audited table: no missing pins, no stale ones.
+    assert set(configs) == {cfg.key for cfg in AUDIT_CONFIGS}
+    for key, entry in configs.items():
+        strategy, combine, kernel = key.split("|")
+        assert strategy in STRATEGIES, key
+        assert kernel == "xla", key
+        if "@" in combine:
+            base, s = combine.split("@")
+            assert base in ("overlap", "overlap_ring"), key
+            assert int(s) >= 2, key
+        census, bytes_ = entry["census"], entry["payload_bytes"]
+        assert set(census) <= _CENSUS_KINDS, key
+        assert set(census) == set(bytes_), key
+        for kind, count in census.items():
+            assert isinstance(count, int) and count > 0, (key, kind)
+            # payload is whole operands: divisible by the dtype itemsize.
+            assert bytes_[kind] > 0 and bytes_[kind] % itemsize == 0, (
+                key, kind,
+            )
+        assert entry["payload_total_bytes"] == sum(bytes_.values()), key
+
+
+def test_golden_schedule_pins_staged_overlap_chunking():
+    """The committed numbers must themselves encode the overlap story:
+    overlap@S issues S× the collectives of its S-free baseline while the
+    per-config payload stays equal — chunking, not extra traffic."""
+    configs = _golden()["configs"]
+    assert (
+        configs["colwise|overlap@2|xla"]["census"]["reduce-scatter"] == 2
+    )
+    assert (
+        configs["colwise|overlap@4|xla"]["census"]["reduce-scatter"] == 4
+    )
+    assert (
+        configs["colwise|overlap@2|xla"]["payload_total_bytes"]
+        == configs["colwise|overlap@4|xla"]["payload_total_bytes"]
+        == configs["colwise|psum_scatter|xla"]["payload_total_bytes"]
+    )
+    # The staged ring gather: same total bytes as the un-staged ring, S×
+    # the hops at 1/S the chunk.
+    ring = configs["rowwise|ring|xla"]
+    for s in (2, 4):
+        staged = configs[f"rowwise|overlap@{s}|xla"]
+        assert staged["census"]["collective-permute"] == s * ring["census"][
+            "collective-permute"
+        ]
+        assert staged["payload_total_bytes"] == ring["payload_total_bytes"]

@@ -1,0 +1,385 @@
+"""staticcheck behavior tests: per-rule fixtures, engine mechanics, and
+the lowered-HLO collective-schedule audit.
+
+Layer 1 coverage contract (one table, every rule): each registered AST
+rule must flag its known-bad fixture snippet AND stay quiet on the marked
+(or structurally clean) twin — so the fixture table going stale relative
+to the registry is itself a test failure. The seeded-violation corpus is
+also run through the CLI (`python -m ... --rules --root ... --json`) and
+compared finding-for-finding with the API — the two entry points
+(scripts/tier1.sh fail-fast and this suite) must agree.
+
+Layer 2: the audit must pass on the untouched tree against the committed
+golden table, and a mutation that swaps a staged collective in
+parallel/ring.py for one full-width ``jax.lax.all_gather`` must fail it —
+the acceptance criterion that turns "overlap measures like the un-staged
+baseline while claiming to overlap" into a red CI run.
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu.staticcheck import RULES, run_rules
+from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+    AUDIT_CONFIGS,
+    AUDIT_DEVICES,
+    AuditConfig,
+    lower_config,
+    lowering_fingerprint,
+    run_hlo_audit,
+    write_golden,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+PKG = "matvec_mpi_multiplier_tpu"
+
+# rule -> (repo-relative path in the rule's scope, bad snippet, clean twin).
+# The clean twin differs only by the exemption marker (or the structurally
+# clean form) — proving the marker contract, not just the detector.
+RULE_FIXTURES = {
+    "shard-map-direct": (
+        f"{PKG}/models/seeded.py",
+        "from jax.experimental import shard_map\n",
+        "from matvec_mpi_multiplier_tpu.utils.compat import shard_map\n",
+    ),
+    "engine-host-sync": (
+        f"{PKG}/engine/seeded.py",
+        "import numpy as np\n"
+        "def dispatch(y):\n"
+        "    return np.asarray(y)\n",
+        "import numpy as np\n"
+        "def dispatch(y):\n"
+        "    return np.asarray(y)  # sync-ok: seeded deliberate sync\n",
+    ),
+    "overlap-unchunked-collective": (
+        f"{PKG}/parallel/ring.py",
+        # the alias evasion the greps could not see through
+        "from jax import lax as L\n"
+        "def gather(x, ax):\n"
+        "    return L.all_gather(x, ax, tiled=True)\n",
+        "from jax import lax as L\n"
+        "def gather(x, ax):\n"
+        "    return L.all_gather(x, ax, tiled=True)  # overlap-ok: seeded\n",
+    ),
+    "hot-path-blocking-io": (
+        f"{PKG}/obs/tracing.py",
+        "import json\n"
+        "def flush(path, payload):\n"
+        "    json.dump(payload, open(path, 'w'))\n"
+        "def flush_via_path(path, text):\n"
+        "    with path.open('w') as fh:\n"     # the Path.open() spelling
+        "        fh.write(text)\n",
+        "import json\n"
+        "def describe():\n"
+        "    return 'the sink thread owns json.dump(payload, open(...))'\n",
+    ),
+    "fp64-implicit-promotion": (
+        f"{PKG}/ops/seeded.py",
+        "import jax.numpy as jnp\n"
+        "def padding(n):\n"
+        "    return jnp.zeros(n)\n",
+        "import jax.numpy as jnp\n"
+        "def padding(n, dtype):\n"
+        "    return jnp.zeros(n, dtype)\n",
+    ),
+    "import-time-jnp": (
+        f"{PKG}/ops/seeded.py",
+        "import jax.numpy as jnp\n"
+        "TABLE = jnp.arange(0, 8, 1, jnp.int32)\n",
+        "import numpy as np\n"
+        "TABLE = np.arange(0, 8, 1, np.int32)\n",
+    ),
+    "mutable-default-arg": (
+        f"{PKG}/ops/seeded.py",
+        "def accumulate(x, acc=[]):\n"
+        "    acc.append(x)\n"
+        "    return acc\n",
+        "def accumulate(x, acc=None):\n"
+        "    acc = [] if acc is None else acc\n"
+        "    acc.append(x)\n"
+        "    return acc\n",
+    ),
+}
+
+
+def _seed(root: Path, rel: str, source: str) -> None:
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+
+
+def test_fixture_table_covers_every_rule():
+    """Adding a rule without a known-bad fixture is itself a failure."""
+    assert set(RULE_FIXTURES) == set(RULES), (
+        "RULE_FIXTURES out of sync with the staticcheck rule registry"
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_flags_bad_and_passes_clean(rule, tmp_path):
+    rel, bad, clean = RULE_FIXTURES[rule]
+    _seed(tmp_path, rel, bad)
+    found = run_rules(root=tmp_path, rules=[rule])
+    assert any(f.rule == rule and f.path == rel for f in found), (
+        f"{rule} missed its known-bad fixture: {found}"
+    )
+    _seed(tmp_path, rel, clean)
+    found = run_rules(root=tmp_path, rules=[rule])
+    assert not [f for f in found if f.rule == rule], (
+        f"{rule} flagged its clean/marked twin: {found}"
+    )
+
+
+def test_shard_map_rule_catches_top_level_and_bare_alias(tmp_path):
+    """The evasion spellings: the modern top-level `from jax import
+    shard_map` (aliased, called by bare name) must be caught, while the
+    compat-shim import resolves clean."""
+    _seed(
+        tmp_path, f"{PKG}/models/seeded.py",
+        "from jax import shard_map as sm\n"
+        "def build(fn, mesh):\n"
+        "    return sm(fn, mesh=mesh)\n",
+    )
+    found = run_rules(root=tmp_path, rules=["shard-map-direct"])
+    assert {f.line for f in found} == {1, 3}, found
+    _seed(
+        tmp_path, f"{PKG}/models/seeded.py",
+        "from matvec_mpi_multiplier_tpu.utils.compat import shard_map\n"
+        "def build(fn, mesh):\n"
+        "    return shard_map(fn, mesh=mesh)\n",
+    )
+    assert run_rules(root=tmp_path, rules=["shard-map-direct"]) == []
+
+
+def test_strings_and_docstrings_do_not_trip_rules(tmp_path):
+    """The regex rules' false-positive class, now structurally impossible:
+    forbidden patterns inside strings and docstrings are not code."""
+    _seed(
+        tmp_path, f"{PKG}/parallel/ring.py",
+        '"""Never call jax.lax.all_gather( or jax.lax.psum( here."""\n'
+        "PATTERN = 'jax.lax.all_gather(x)'\n",
+    )
+    _seed(
+        tmp_path, f"{PKG}/engine/doc.py",
+        '"""np.asarray(y) and y.block_until_ready() are forbidden."""\n'
+        "RULE = 'jax.experimental.shard_map'\n",
+    )
+    assert run_rules(root=tmp_path) == []
+
+
+def test_marker_without_reason_is_a_finding(tmp_path):
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import numpy as np\n"
+        "def dispatch(y):\n"
+        "    return np.asarray(y)  # sync-ok:\n",
+    )
+    found = run_rules(root=tmp_path)
+    rules = {f.rule for f in found}
+    # The empty marker still suppresses the sync finding but is itself
+    # flagged — an escape hatch cannot be silent.
+    assert rules == {"marker-missing-reason"}, found
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    _seed(tmp_path, f"{PKG}/ops/seeded.py", "def broken(:\n")
+    found = run_rules(root=tmp_path)
+    assert [f.rule for f in found] == ["parse-error"]
+
+
+def test_cli_and_api_agree_on_seeded_corpus(tmp_path):
+    """The two lint entry points (tier1.sh fail-fast → CLI; the suite →
+    API) must return the same verdict on the same tree."""
+    for rule, (rel, bad, _clean) in sorted(RULE_FIXTURES.items()):
+        # One tree with every seeded violation; later seeds of the same
+        # path overwrite — keep the union deterministic by suffixing.
+        _seed(tmp_path, rel.replace("seeded", f"seeded_{rule[:8]}"), bad)
+    api = run_rules(root=tmp_path)
+    assert api, "seeded corpus produced no findings"
+    proc = subprocess.run(
+        [sys.executable, "-m", "matvec_mpi_multiplier_tpu.staticcheck",
+         "--rules", "--root", str(tmp_path), "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stderr
+    cli = json.loads(proc.stdout)["findings"]
+    assert [(f["path"], f["line"], f["rule"]) for f in cli] == [
+        (f.path, f.line, f.rule) for f in api
+    ]
+
+
+# ---------------------------------------------------------------- layer 2
+
+
+def test_audit_table_covers_acceptance_family():
+    """All three strategies, across the combine family the paper's
+    schedule story names, at two staged depths."""
+    strategies = {c.strategy for c in AUDIT_CONFIGS}
+    assert strategies == {"rowwise", "colwise", "blockwise"}
+    colwise = {
+        c.combine + (f"@{c.stages}" if c.stages else "")
+        for c in AUDIT_CONFIGS if c.strategy == "colwise"
+    }
+    assert {
+        "psum_scatter", "ring", "a2a", "overlap@2", "overlap@4",
+        "overlap_ring@2", "overlap_ring@4",
+    } <= colwise
+    for strategy in ("rowwise", "blockwise"):
+        assert any(
+            c.strategy == strategy and c.combine == "overlap"
+            for c in AUDIT_CONFIGS
+        )
+
+
+def test_hlo_audit_clean_on_untouched_tree(devices):
+    findings = run_hlo_audit()
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings
+    )
+
+
+def test_mutation_full_width_gather_fails_audit(devices, monkeypatch):
+    """Swap the staged gather in parallel/ring.py for ONE full-width
+    jax.lax.all_gather: the audit must go red (S chunked collectives
+    became a single full-width one) while the untouched tree passes."""
+    import jax
+
+    from matvec_mpi_multiplier_tpu.parallel import ring
+
+    def full_width(a_blk, x_loc, gather_axes, kernel, stages,
+                   reduce_axes=None):
+        part = kernel(a_blk, x_loc)
+        if reduce_axes is not None:
+            part = jax.lax.psum(part, reduce_axes)
+        return jax.lax.all_gather(part, gather_axes, tiled=True)
+
+    monkeypatch.setattr(ring, "staged_overlap_gather", full_width)
+    cfg = AuditConfig("rowwise", "overlap", 2)
+    findings = run_hlo_audit(configs=[cfg], check_fingerprints=False)
+    assert any(f.rule == "hlo-schedule" for f in findings), findings
+    assert any(f.rule == "hlo-census" for f in findings), findings
+    # And the same config passes un-mutated.
+    monkeypatch.undo()
+    assert run_hlo_audit(configs=[cfg], check_fingerprints=False) == []
+
+
+def test_mutation_unchunked_scatter_fails_audit(devices, monkeypatch):
+    """The colwise face: collapsing the S-stage scatter pipeline into one
+    full-width psum_scatter breaks the overlap census pin."""
+    import jax
+
+    from matvec_mpi_multiplier_tpu.parallel import ring
+
+    def full_width(a_panel, x_seg, axis_name, kernel, stages,
+                   step="psum_scatter"):
+        return jax.lax.psum_scatter(
+            kernel(a_panel, x_seg), axis_name, tiled=True
+        )
+
+    monkeypatch.setattr(ring, "staged_overlap_scatter", full_width)
+    cfg = AuditConfig("colwise", "overlap", 4)
+    findings = run_hlo_audit(configs=[cfg], check_fingerprints=False)
+    assert any(
+        f.rule == "hlo-schedule" and "S=4" in f.message for f in findings
+    ), findings
+
+
+def test_fingerprint_stability_gate(devices):
+    """Same config, two fresh builds → byte-identical lowering hashes (the
+    engine-cache silent-recompile guard), and the audit's gate agrees."""
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    cfg = AuditConfig("colwise", "overlap", 2)
+    assert lowering_fingerprint(lower_config(cfg, mesh)) == \
+        lowering_fingerprint(lower_config(cfg, mesh))
+
+
+def test_engine_cache_records_matching_fingerprints(devices):
+    """Two independent engines compiling the same ExecKey must record the
+    same lowering fingerprint — the cross-restart identity the AOT cache
+    claims (engine/executables.py)."""
+    from matvec_mpi_multiplier_tpu import MatvecEngine, make_mesh
+
+    mesh = make_mesh(8)
+    a = np.arange(64 * 64, dtype=np.float32).reshape(64, 64) / 64.0
+
+    def fingerprints():
+        engine = MatvecEngine(
+            a, mesh, strategy="colwise", combine="psum_scatter",
+            promote=None,
+        )
+        engine.warmup(widths=(1,))
+        cache = engine._cache
+        fps = {key: cache.fingerprint(key) for key in cache._executables}
+        engine.close()
+        return fps
+
+    first, second = fingerprints(), fingerprints()
+    assert first and first == second
+
+
+def test_golden_roundtrip_and_drift_detection(devices, tmp_path):
+    golden = tmp_path / "golden_schedule.json"
+    cfg = AuditConfig("colwise", "psum_scatter")
+    write_golden(path=golden)
+    assert run_hlo_audit(
+        golden_path=golden, configs=[cfg], check_fingerprints=False
+    ) == []
+
+    # Golden drift: a tampered census pin must surface as hlo-census.
+    payload = json.loads(golden.read_text())
+    payload["configs"][cfg.key]["census"] = {"all-gather": 3}
+    golden.write_text(json.dumps(payload))
+    findings = run_hlo_audit(
+        golden_path=golden, configs=[cfg], check_fingerprints=False
+    )
+    assert any(f.rule == "hlo-census" for f in findings), findings
+
+    # A stale pinned config (not in the audit table) is also drift.
+    payload["configs"][cfg.key]["census"] = {"reduce-scatter": 1}
+    payload["configs"]["colwise|retired_combine|xla"] = {"census": {}}
+    golden.write_text(json.dumps(payload))
+    findings = run_hlo_audit(
+        golden_path=golden, configs=[cfg], check_fingerprints=False
+    )
+    assert any(
+        f.rule == "hlo-golden" and "retired_combine" in f.message
+        for f in findings
+    ), findings
+
+
+def test_empty_golden_configs_is_not_a_clean_audit(devices, tmp_path):
+    """A golden file whose 'configs' object is empty (bad merge, hand
+    edit) must read as every pin missing — never as a silently disabled
+    pin layer."""
+    golden = tmp_path / "golden_schedule.json"
+    golden.write_text(json.dumps({"schema": 1, "configs": {}}))
+    findings = run_hlo_audit(
+        golden_path=golden,
+        configs=[AuditConfig("colwise", "psum")],
+        check_fingerprints=False,
+    )
+    assert any(
+        f.rule == "hlo-golden" and "missing from the golden table"
+        in f.message
+        for f in findings
+    ), findings
+
+
+def test_missing_golden_is_a_finding(devices, tmp_path):
+    findings = run_hlo_audit(
+        golden_path=tmp_path / "nope.json",
+        configs=[AuditConfig("colwise", "psum")],
+        check_fingerprints=False,
+    )
+    assert any(
+        f.rule == "hlo-golden" and "--write-golden" in f.message
+        for f in findings
+    ), findings
