@@ -21,7 +21,7 @@ from .models import (
     available_strategies,
     get_strategy,
 )
-from .engine import MatvecEngine
+from .engine import ArrivalWindowScheduler, MatvecEngine
 from .models.gemm import available_gemm_strategies, build_gemm
 from .parallel.mesh import make_1d_mesh, make_mesh, mesh_grid_shape, most_square_factors
 from .utils import io
@@ -40,6 +40,7 @@ __all__ = [
     "build_gemm",
     "available_gemm_strategies",
     "MatvecEngine",
+    "ArrivalWindowScheduler",
     "make_mesh",
     "make_1d_mesh",
     "mesh_grid_shape",

@@ -19,8 +19,23 @@ sharded ``A`` and reports the numbers a serving system is judged on:
   the same engine under the same wall-clock protocol (the tuned crossover
   must actually pay off in the serving loop, not just in the tuner).
 
+**Load mode** (:func:`run_serve_load`) drives the *continuous-batching*
+face instead: realistic traffic — a closed-loop ``--concurrency`` axis
+(N clients, each submit→materialize→repeat) or an open-loop arrival
+process (``--arrival poisson|burst --rate``) — optionally through the
+arrival-window scheduler (``engine/scheduler.py``, ``--coalesce``), so
+coalescing is exercised by concurrency instead of back-to-back submits.
+Load rows report requests/sec under offered load, **end-to-end** p50/p99
+latency (submit entry to materialized result — the latency columns'
+meaning in load rows, where dispatch-only time would hide the window),
+and the batching-efficiency columns: mean batch width and coalesce ratio
+(NaN in uncoalesced rows). ``--coalesce both`` measures each config
+uncoalesced then coalesced — the committed ``data/batching_demo/``
+capture's protocol, and the ≥2× acceptance comparison.
+
 Rows land in ``data/out/serve_<strategy>.csv`` (``--data-root`` to
-redirect; the committed demo lives under ``data/engine_demo/``).
+redirect; the committed demos live under ``data/engine_demo/`` and
+``data/batching_demo/``).
 
 Usage::
 
@@ -49,14 +64,22 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import queue
 import sys
+import threading
 import time
 from pathlib import Path
 from typing import Sequence
 
 import numpy as np
 
-from ..engine import MatvecEngine, bucket_for, split_widths
+from ..engine import (
+    ArrivalWindowScheduler,
+    DEFAULT_MAX_WINDOW_MS,
+    MatvecEngine,
+    bucket_for,
+    split_widths,
+)
 from ..models import available_strategies
 from ..obs.registry import MetricsRegistry
 from ..utils.errors import MatvecError
@@ -66,11 +89,18 @@ from ..utils.errors import MatvecError
 # exercised. Clipped to --max-bucket.
 DEFAULT_WIDTH_MIX = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
 
+# Load-mode width mix: heavy single-RHS traffic — the workload coalescing
+# exists for (ISSUE/ROADMAP: every lone dispatch re-reads all of A for one
+# output column).
+LOAD_WIDTH_MIX = (1,)
+
 SERVE_CSV_HEADER = (
     "n_rows, n_cols, n_devices, strategy, dtype, kernel, combine, "
     "b_star, max_bucket, n_requests, total_cols, wall_s, rps, cols_per_s, "
     "p50_dispatch_ms, p99_dispatch_ms, compiles_warmup, compiles_steady, "
-    "hits_steady, promo_b, promo_gemm_s, promo_seq_s, promo_speedup"
+    "hits_steady, promo_b, promo_gemm_s, promo_seq_s, promo_speedup, "
+    "arrival, rate_req_s, concurrency, coalesce, mean_batch_width, "
+    "coalesce_ratio"
 )
 
 
@@ -100,6 +130,17 @@ class ServeResult:
     promo_b: int
     promo_gemm_s: float
     promo_seq_s: float
+    # Load-mode columns (run_serve_load): the traffic shape offered and
+    # the batching efficiency achieved. The sequential protocol's rows
+    # carry the defaults (closed-loop, one client, uncoalesced). In load
+    # rows the latency columns above are END-TO-END (submit entry to
+    # materialized result), not dispatch-only.
+    arrival: str = "closed"
+    rate_req_s: float = float("nan")
+    concurrency: int = 1
+    coalesce: int = 0
+    mean_batch_width: float = float("nan")
+    coalesce_ratio: float = float("nan")
 
     @property
     def rps(self) -> float:
@@ -142,7 +183,10 @@ def append_serve_result(result: ServeResult, root=None):
         f"{result.compiles_warmup}, {result.compiles_steady}, "
         f"{result.hits_steady}, {result.promo_b}, "
         f"{result.promo_gemm_s:.6f}, {result.promo_seq_s:.6f}, "
-        f"{result.promo_speedup:.3f}"
+        f"{result.promo_speedup:.3f}, {result.arrival}, "
+        f"{result.rate_req_s:.2f}, {result.concurrency}, "
+        f"{result.coalesce}, {result.mean_batch_width:.3f}, "
+        f"{result.coalesce_ratio:.3f}"
     )
     _append_row(path, SERVE_CSV_HEADER, row)
     return path
@@ -204,6 +248,257 @@ def measure_promotion(
     _drain(futures)
     t_seq = (time.perf_counter() - start) / n_reps
     return b, t_gemm, t_seq
+
+
+def _arrival_gaps(
+    arrival: str, n: int, rate: float, burst: int, rng
+) -> list[float]:
+    """Inter-arrival gaps (seconds) for the open-loop processes: Poisson
+    (exponential gaps at ``rate`` req/s) or bursty (groups of ``burst``
+    simultaneous arrivals, one group per ``burst/rate`` seconds — same
+    offered rate, maximally coalescable)."""
+    if rate <= 0:
+        raise MatvecError(f"open-loop arrival needs rate > 0, got {rate}")
+    if arrival == "poisson":
+        return list(rng.exponential(1.0 / rate, size=n))
+    if arrival == "burst":
+        if burst < 1:
+            raise MatvecError(f"burst size must be >= 1, got {burst}")
+        return [
+            (burst / rate) if i % burst == 0 else 0.0 for i in range(n)
+        ]
+    raise MatvecError(f"unknown arrival process {arrival!r}")
+
+
+def _closed_loop(
+    submit, blocks: Sequence[np.ndarray], concurrency: int, hist
+) -> float:
+    """Closed-loop load: ``concurrency`` client threads, each
+    submit→materialize→repeat over its slice of the request trace (the
+    classic offered-concurrency protocol). Returns steady-phase wall
+    seconds; per-request END-TO-END latency lands in ``hist``."""
+    barrier = threading.Barrier(concurrency + 1)
+    errors: list[BaseException] = []
+
+    def client(tid: int) -> None:
+        try:
+            barrier.wait()
+            for i in range(tid, len(blocks), concurrency):
+                t0 = time.perf_counter()
+                submit(blocks[i]).result()
+                hist.observe((time.perf_counter() - t0) * 1e3)
+        except BaseException as e:  # surface on the driver thread
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(t,), daemon=True)
+        for t in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    start = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - start
+    if errors:
+        raise errors[0]
+    return wall
+
+
+def _open_loop(
+    submit, blocks: Sequence[np.ndarray], gaps: Sequence[float], hist,
+    flush=None,
+) -> float:
+    """Open-loop load: requests arrive on the precomputed gap schedule
+    regardless of completion (one submitter thread paces arrivals; one
+    drainer thread materializes in order and records arrival→result
+    latency). Returns wall seconds from first arrival to last result."""
+    results: queue.Queue = queue.Queue()
+    errors: list[BaseException] = []
+
+    def drainer() -> None:
+        while True:
+            item = results.get()
+            if item is None:
+                return
+            t_arrival, fut = item
+            try:
+                fut.result()
+            except BaseException as e:
+                errors.append(e)
+                continue
+            hist.observe((time.perf_counter() - t_arrival) * 1e3)
+
+    drain_thread = threading.Thread(target=drainer, daemon=True)
+    drain_thread.start()
+    start = time.perf_counter()
+    next_at = start
+    for x, gap in zip(blocks, gaps):
+        next_at += gap
+        while True:
+            now = time.perf_counter()
+            if now >= next_at:
+                break
+            time.sleep(min(next_at - now, 5e-4))
+        results.put((time.perf_counter(), submit(x)))
+    if flush is not None:
+        flush()  # fence the open window so the drain is prompt
+    results.put(None)
+    drain_thread.join()
+    wall = time.perf_counter() - start
+    if errors:
+        raise errors[0]
+    return wall
+
+
+def run_serve_load(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    *,
+    dtype: str = "float32",
+    kernel: str = "xla",
+    combine: str | None = None,
+    stages: int | None = None,
+    n_requests: int = 200,
+    max_bucket: int = 32,
+    widths: Sequence[int] | None = None,
+    promote: str | int | None = "auto",
+    donate: bool = True,
+    concurrency: int = 8,
+    coalesce: bool = True,
+    arrival: str = "closed",
+    rate: float = 500.0,
+    burst: int = 8,
+    window_ms: str | float = "auto",
+    max_window_ms: float = DEFAULT_MAX_WINDOW_MS,
+    flush_width: str | int = "auto",
+    seed: int = 0,
+    metrics_out: str | None = None,
+    trace_jsonl: str | None = None,
+) -> ServeResult:
+    """Run the load protocol for one (strategy, shape, mesh, traffic)
+    config: realistic concurrent/open-loop traffic, optionally coalesced
+    through the arrival-window scheduler. The request trace (widths +
+    payloads, seeded) is identical for coalesced and uncoalesced runs of
+    the same config — the acceptance comparison is same-trace by
+    construction."""
+    from ..utils.io import generate_matrix
+
+    if widths is None:
+        widths = [w for w in LOAD_WIDTH_MIX if w <= max_bucket]
+    a = generate_matrix(m, k, seed=seed).astype(dtype)
+    registry = MetricsRegistry()
+    engine = MatvecEngine(
+        a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
+        stages=stages, dtype=dtype, max_bucket=max_bucket, promote=promote,
+        donate=donate, metrics=registry, trace_jsonl=trace_jsonl,
+    )
+    latency_hist = registry.histogram(
+        "serve_e2e_latency_ms",
+        "steady-phase submit-entry to materialized-result host time",
+        window=max(n_requests, 1),
+    )
+    pool = _request_pool(k, widths, engine.dtype, seed=seed + 1)
+    rng = np.random.default_rng(seed + 2)
+    sequence = [int(w) for w in rng.choice(list(pool), size=n_requests)]
+    blocks = [
+        pool[w] if pool[w].shape[1] > 1 else pool[w][:, 0]
+        for w in sequence
+    ]
+
+    scheduler = (
+        ArrivalWindowScheduler(
+            engine, window_ms=window_ms, max_window_ms=max_window_ms,
+            flush_width=flush_width,
+        )
+        if coalesce else None
+    )
+    submit = scheduler.submit if scheduler is not None else engine.submit
+    try:
+        # ---- warmup: the whole ladder — coalesced widths are emergent,
+        # so every bucket a flush could land on must be compiled AND run
+        # once (first execution of an AOT program carries one-time costs
+        # a p99 must not absorb) ----
+        from ..engine import bucket_ladder
+
+        engine.warmup()
+        _drain([engine.submit(pool[w]) for w in sorted(set(sequence))])
+        if engine.b_star is not None:
+            warm_rng = np.random.default_rng(seed + 9)
+            _drain([
+                engine.submit(
+                    warm_rng.uniform(0, 10, (k, b)).astype(engine.dtype)
+                )
+                for b in bucket_ladder(max_bucket) if b >= engine.b_star
+            ])
+        warm_stats = engine.stats
+        compiles_warmup = warm_stats.compiles
+
+        # ---- steady phase under load ----
+        if arrival == "closed":
+            wall = _closed_loop(submit, blocks, concurrency, latency_hist)
+        else:
+            gaps = _arrival_gaps(
+                arrival, n_requests, rate, burst,
+                np.random.default_rng(seed + 3),
+            )
+            wall = _open_loop(
+                submit, blocks, gaps, latency_hist,
+                flush=scheduler.flush if scheduler is not None else None,
+            )
+        steady_stats = engine.stats
+        if scheduler is not None:
+            sched_stats = scheduler.stats
+            mean_batch_width = sched_stats.mean_batch_width
+            coalesce_ratio = sched_stats.coalesce_ratio
+        else:
+            mean_batch_width = coalesce_ratio = float("nan")
+    finally:
+        if scheduler is not None:
+            scheduler.close()
+    if trace_jsonl is not None:
+        if not engine.flush_traces():
+            print(
+                f"WARNING: trace sink could not confirm {trace_jsonl} — "
+                "the file is missing or incomplete", file=sys.stderr,
+            )
+        engine.close()
+    if metrics_out is not None:
+        _ = engine.stats  # refresh the in_flight gauge before exporting
+        path = Path(metrics_out)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(registry.snapshot(), indent=2) + "\n")
+    return ServeResult(
+        n_rows=m,
+        n_cols=k,
+        n_devices=int(mesh.devices.size),
+        strategy=strategy_name,
+        dtype=str(engine.dtype),
+        kernel=kernel if isinstance(kernel, str) else "custom",
+        combine=combine or "default",
+        b_star=engine.b_star,
+        max_bucket=max_bucket,
+        n_requests=n_requests,
+        total_cols=int(sum(sequence)),
+        wall_s=wall,
+        p50_dispatch_ms=latency_hist.percentile(50),
+        p99_dispatch_ms=latency_hist.percentile(99),
+        compiles_warmup=compiles_warmup,
+        compiles_steady=steady_stats.compiles - compiles_warmup,
+        hits_steady=steady_stats.hits - warm_stats.hits,
+        promo_b=0,
+        promo_gemm_s=float("nan"),
+        promo_seq_s=float("nan"),
+        arrival=arrival,
+        rate_req_s=rate if arrival != "closed" else float("nan"),
+        concurrency=concurrency,
+        coalesce=int(coalesce),
+        mean_batch_width=mean_batch_width,
+        coalesce_ratio=coalesce_ratio,
+    )
 
 
 def run_serve(
@@ -408,41 +703,120 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
         promote = int(promote)
     metrics_out = getattr(args, "metrics_out", None)
     trace_jsonl = getattr(args, "trace_jsonl", None)
+    arrival = getattr(args, "arrival", "closed") or "closed"
+    concurrency = getattr(args, "concurrency", None) or [1]
+    coalesce_arg = getattr(args, "coalesce", None)
+    # Load mode engages when the traffic shape asks for it: an open-loop
+    # arrival process, offered concurrency, or an explicit coalesce
+    # request. The bare legacy invocation stays on the sequential
+    # protocol (promotion check included).
+    load_mode = (
+        arrival != "closed"
+        or any(c > 1 for c in concurrency)
+        or coalesce_arg is not None
+    )
+    # Uncoalesced first so `--coalesce both` leaves the coalesced run's
+    # snapshot in --metrics-out (the batching panel's input).
+    coalesce_modes = {
+        None: (True,), "on": (True,), "off": (False,),
+        "both": (False, True),
+    }[coalesce_arg]
+    window_ms = getattr(args, "window_ms", "auto")
+    if window_ms not in (None, "auto"):
+        window_ms = float(window_ms)
+    flush_width = getattr(args, "flush_width", "auto")
+    if flush_width not in (None, "auto"):
+        flush_width = int(flush_width)
     n_done = 0
     for m, k in sizes:
         for name in strategies:
             for n_dev in counts:
                 mesh = meshes[n_dev]
-                try:
-                    result = run_serve(
-                        name, mesh, m, k, dtype=args.dtype,
-                        kernel=args.kernel, combine=args.combine,
-                        stages=getattr(args, "stages", None),
-                        n_requests=args.n_requests,
-                        max_bucket=args.max_bucket, promote=promote,
-                        seed=args.seed,
-                        metrics_out=metrics_out, trace_jsonl=trace_jsonl,
+                if not load_mode:
+                    try:
+                        result = run_serve(
+                            name, mesh, m, k, dtype=args.dtype,
+                            kernel=args.kernel, combine=args.combine,
+                            stages=getattr(args, "stages", None),
+                            n_requests=args.n_requests,
+                            max_bucket=args.max_bucket, promote=promote,
+                            seed=args.seed,
+                            metrics_out=metrics_out,
+                            trace_jsonl=trace_jsonl,
+                        )
+                    except MatvecError as e:
+                        print(f"skip {name} {m}x{k} p={n_dev}: {e}")
+                        continue
+                    if not args.no_csv:
+                        path = append_serve_result(result, args.data_root)
+                    else:
+                        path = None
+                    print(
+                        f"serve {name} {m}x{k} p={n_dev} "
+                        f"b*={result.b_star} {result.rps:.1f} req/s "
+                        f"{result.cols_per_s:.1f} cols/s "
+                        f"p50={result.p50_dispatch_ms:.3f}ms "
+                        f"p99={result.p99_dispatch_ms:.3f}ms "
+                        f"compiles={result.compiles_warmup}+"
+                        f"{result.compiles_steady} "
+                        f"promo x{result.promo_speedup:.2f} "
+                        f"@b={result.promo_b}"
                     )
-                except MatvecError as e:
-                    print(f"skip {name} {m}x{k} p={n_dev}: {e}")
+                    if path is not None:
+                        print(f"CSV: {path}")
+                    n_done += 1
                     continue
-                if not args.no_csv:
-                    path = append_serve_result(result, args.data_root)
-                else:
-                    path = None
-                print(
-                    f"serve {name} {m}x{k} p={n_dev} "
-                    f"b*={result.b_star} {result.rps:.1f} req/s "
-                    f"{result.cols_per_s:.1f} cols/s "
-                    f"p50={result.p50_dispatch_ms:.3f}ms "
-                    f"p99={result.p99_dispatch_ms:.3f}ms "
-                    f"compiles={result.compiles_warmup}+"
-                    f"{result.compiles_steady} "
-                    f"promo x{result.promo_speedup:.2f} @b={result.promo_b}"
-                )
-                if path is not None:
-                    print(f"CSV: {path}")
-                n_done += 1
+                for n_clients in concurrency:
+                    for coalesce in coalesce_modes:
+                        try:
+                            result = run_serve_load(
+                                name, mesh, m, k, dtype=args.dtype,
+                                kernel=args.kernel, combine=args.combine,
+                                stages=getattr(args, "stages", None),
+                                n_requests=args.n_requests,
+                                max_bucket=args.max_bucket,
+                                promote=promote,
+                                concurrency=n_clients, coalesce=coalesce,
+                                arrival=arrival,
+                                rate=getattr(args, "rate", 500.0),
+                                burst=getattr(args, "burst", 8),
+                                window_ms=window_ms,
+                                max_window_ms=getattr(
+                                    args, "max_window_ms",
+                                    DEFAULT_MAX_WINDOW_MS,
+                                ),
+                                flush_width=flush_width,
+                                seed=args.seed,
+                                metrics_out=metrics_out,
+                                trace_jsonl=trace_jsonl,
+                            )
+                        except MatvecError as e:
+                            print(
+                                f"skip {name} {m}x{k} p={n_dev} "
+                                f"c={n_clients}: {e}"
+                            )
+                            continue
+                        if not args.no_csv:
+                            path = append_serve_result(
+                                result, args.data_root
+                            )
+                        else:
+                            path = None
+                        print(
+                            f"serve-load {name} {m}x{k} p={n_dev} "
+                            f"{arrival} c={n_clients} "
+                            f"coalesce={'on' if coalesce else 'off'} "
+                            f"{result.rps:.1f} req/s "
+                            f"p50={result.p50_dispatch_ms:.3f}ms "
+                            f"p99={result.p99_dispatch_ms:.3f}ms "
+                            f"width={result.mean_batch_width:.2f} "
+                            f"ratio={result.coalesce_ratio:.2f} "
+                            f"compiles={result.compiles_warmup}+"
+                            f"{result.compiles_steady}"
+                        )
+                        if path is not None:
+                            print(f"CSV: {path}")
+                        n_done += 1
     if n_done and metrics_out is not None:
         # Per-config snapshot: with several configs the file holds the
         # LAST one (each run_serve rewrites it; traces append).
@@ -488,6 +862,45 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--promote", default="auto",
         help="GEMV->GEMM crossover b*: 'auto' (tuned), an int, or 'never'",
+    )
+    p.add_argument(
+        "--arrival", choices=["closed", "poisson", "burst"],
+        default="closed",
+        help="traffic shape: closed-loop clients (--concurrency) or an "
+        "open-loop arrival process at --rate req/s",
+    )
+    p.add_argument(
+        "--rate", type=float, default=500.0,
+        help="with --arrival poisson|burst: offered request rate (req/s)",
+    )
+    p.add_argument(
+        "--burst", type=int, default=8,
+        help="with --arrival burst: simultaneous arrivals per burst",
+    )
+    p.add_argument(
+        "--concurrency", nargs="+", type=int, default=None,
+        help="closed-loop client counts to sweep (the offered-concurrency "
+        "axis; any value engages load mode)",
+    )
+    p.add_argument(
+        "--coalesce", choices=["on", "off", "both"], default=None,
+        help="serve through the arrival-window batching scheduler "
+        "(engine/scheduler.py); 'both' measures each config uncoalesced "
+        "then coalesced on the same trace. Any value engages load mode",
+    )
+    p.add_argument(
+        "--window-ms", default="auto",
+        help="coalescing window: 'auto' (adaptive from the arrival-rate "
+        "estimator) or a fixed window in ms",
+    )
+    p.add_argument(
+        "--max-window-ms", type=float, default=DEFAULT_MAX_WINDOW_MS,
+        help="adaptive coalescing window cap (ms)",
+    )
+    p.add_argument(
+        "--flush-width", default="auto",
+        help="batch width that flushes the window early: 'auto' (the "
+        "tuned promotion point b*) or an int",
     )
     p.add_argument(
         "--tune", action="store_true",

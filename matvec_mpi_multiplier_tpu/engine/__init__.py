@@ -2,10 +2,13 @@
 
 The serving-shape subsystem (ROADMAP north star): where ``bench/`` measures
 one matvec at a time, this package serves a *stream* of right-hand sides —
-shape-bucketed, AOT-compiled, buffer-donating, GEMV→GEMM-promoting. See
-``core.py`` for the architecture, ``buckets.py`` for the shape ladder,
-``executables.py`` for the AOT cache, and the README's "Serving engine"
-section for usage. Benchmarked by ``bench/serve.py`` (``--op serve``).
+shape-bucketed, AOT-compiled, buffer-donating, GEMV→GEMM-promoting, and
+(``scheduler.py``) continuously batched: an arrival-window scheduler
+coalesces concurrent requests into one column-stacked multi-RHS dispatch.
+See ``core.py`` for the engine architecture, ``buckets.py`` for the shape
+ladder, ``executables.py`` for the AOT cache, ``scheduler.py`` for
+coalescing, and ``docs/SERVING.md`` for usage. Benchmarked by
+``bench/serve.py`` (``--op serve``).
 """
 
 from .buckets import (
@@ -17,11 +20,23 @@ from .buckets import (
 )
 from .core import DEFAULT_PROMOTE_B, EngineStats, MatvecEngine, MatvecFuture
 from .executables import ExecKey, ExecStats, ExecutableCache
+from .scheduler import (
+    DEFAULT_MAX_WINDOW_MS,
+    QOS_TIERS,
+    ArrivalWindowScheduler,
+    CoalescedFuture,
+    SchedulerStats,
+)
 
 __all__ = [
     "MatvecEngine",
     "MatvecFuture",
     "EngineStats",
+    "ArrivalWindowScheduler",
+    "CoalescedFuture",
+    "SchedulerStats",
+    "QOS_TIERS",
+    "DEFAULT_MAX_WINDOW_MS",
     "ExecutableCache",
     "ExecKey",
     "ExecStats",

@@ -31,6 +31,10 @@ ahead of the device. A per-request ``deadline_ms`` fails the future at
 that gate rather than dispatching stale work; both are counted in
 :class:`EngineStats` next to the compile/hit counters.
 
+Each ``submit`` dispatches alone; coalescing *concurrent* requests into
+one wider dispatch — the continuous-batching layer — is
+``scheduler.py``'s job, stacked in front of this class.
+
 Requests are HOST arrays (numpy): the engine owns host→device placement,
 including dtype normalization and bucket padding. Handing it a device
 array still works but the normalization copy becomes a device fetch —

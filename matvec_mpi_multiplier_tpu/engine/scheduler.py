@@ -1,0 +1,710 @@
+"""Arrival-window batching scheduler: continuous batching for the engine.
+
+``MatvecEngine.submit`` dispatches each request alone; under heavy
+single-RHS traffic every dispatch re-reads all of ``A`` for one column of
+output, so the stream is HBM-bandwidth-bound at 1× amortization. This
+module coalesces *concurrent* requests against the same resident ``A``
+into one column-stacked multi-RHS dispatch through the engine's existing
+bucket ladder — ``b`` requests per dispatch amortize the dominant memory
+traffic ``b``-fold (the physics of the distributed GEMM in "Large Scale
+Distributed Linear Algebra With TPUs" and of GSPMD's sharded-batch
+execution model, PAPERS.md).
+
+Mechanics:
+
+* **arrival window** — the first pending request opens a window; requests
+  arriving inside it column-stack into one batch. The window is adaptive:
+  sized from an obs :class:`~..obs.registry.RateEstimator` so it stays
+  near zero at low arrival rate (latency flat — a lone request dispatches
+  immediately) and widens under load up to ``max_window_ms``
+  (``window = cap · λ/(1+λ)`` with ``λ`` = expected arrivals per cap
+  window — saturating, never past the cap).
+* **tuner-aware flush** — three flush triggers, earliest wins. (1) The
+  window expires: whatever is pending dispatches. (2) The accumulated
+  width reaches the engine's widest bucket: flush immediately — past the
+  largest warm bucket a batch only splits into a second dispatch, so
+  waiting buys latency, not amortization. (3) The width reaches the
+  tuned GEMV→GEMM promotion point ``b*`` (``tuning.lookup_promotion``,
+  the measured width where one block GEMM beats sequential dispatch;
+  static :data:`~.core.DEFAULT_PROMOTE_B` when the cache is cold) AND
+  arrivals pause for ``settle_ms``: once the tuner has declared the
+  batch a win, the scheduler stops *insisting* on the window and
+  flushes at the first lull — a closed-loop stampede of N clients
+  coalesces into width-N batches without ever waiting out the window,
+  while a continuing arrival stream keeps filling toward the bucket
+  cap.
+* **deadline- and priority-aware admission** — each request carries a QoS
+  tier (:data:`QOS_TIERS`): ``interactive`` flushes the open window
+  immediately (coalesces with whatever is already waiting, adds zero
+  wait), ``standard`` rides the adaptive window, ``bulk`` is content to
+  wait the full cap. A request whose ``deadline_ms`` cannot survive the
+  current window **bypasses coalescing** and dispatches alone through the
+  engine (with its deadline intact); one that expires while its window is
+  open fails via :class:`DeadlineExceededError` *before* dispatch and is
+  sliced out of the batch — the rest of the batch dispatches unpoisoned.
+* **per-request masked unpad** — one flush is ONE engine request; each
+  :class:`CoalescedFuture` resolves to its own columns of the shared
+  result (materialized once, sliced per request), so callers see exactly
+  the ``MatvecFuture`` contract. Exactness: each output column is a
+  contraction over its own input column only, and within one bucket
+  executable the result is position- and pad-independent
+  (``tests/test_scheduler.py`` pins coalesced columns bitwise against the
+  same request dispatched alone through the same bucket).
+* **backpressure on whole batches** — a flush is one ``engine.submit``,
+  so the engine's ``max_in_flight`` gate counts and drains whole
+  coalesced batches oldest-first; the scheduler never re-implements the
+  gate.
+
+Threading/locking discipline (lint-enforced:
+``staticcheck`` rule ``scheduler-lock-across-dispatch``): all pending
+state lives under one condition variable; a flush *swaps the batch out*
+under the lock and dispatches after releasing it — the engine dispatch
+(which may block in the backpressure drain) must never hold the lock
+against new arrivals. The flusher thread exists only for window expiry;
+width-threshold and interactive flushes dispatch on the submitting
+caller's thread, so backpressure lands on the thread that caused it.
+The host-sync and blocking-I/O lints cover this module like the rest of
+``engine/`` (host staging is marked, no file I/O).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..utils.errors import ConfigError, DeadlineExceededError
+from .buckets import split_widths
+from .core import DEFAULT_PROMOTE_B, MatvecEngine, MatvecFuture
+
+# QoS tiers, most to least latency-sensitive. interactive: flush the open
+# window now; standard: adaptive window; bulk: full window cap.
+QOS_TIERS = ("interactive", "standard", "bulk")
+
+# Widest coalescing window the adaptive sizing may reach (and the fixed
+# window bulk requests wait). Milliseconds of added latency are traded for
+# batch width only when the rate estimator says partners will arrive.
+DEFAULT_MAX_WINDOW_MS = 2.0
+
+# Batch-width histogram buckets (requests-per-flush, not milliseconds).
+WIDTH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class _SharedResult:
+    """One flush's materialization, shared by every request in the batch.
+
+    The first ``value()`` caller materializes the engine future (host
+    fetch of the whole stacked block); siblings wait on the same lock and
+    read the cached host array. This lock guards *materialization* —
+    caller-side, after dispatch — not the scheduler's pending state.
+    """
+
+    __slots__ = ("_future", "_lock", "_value", "_error", "_done")
+
+    def __init__(self, future: MatvecFuture):
+        self._future = future
+        self._lock = threading.Lock()
+        self._value: np.ndarray | None = None
+        self._error: Exception | None = None
+        self._done = False
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def value(self) -> np.ndarray:
+        with self._lock:
+            if not self._done:
+                try:
+                    self._value = self._future.result()
+                except Exception as e:  # device error surfaces to every waiter
+                    self._error = e
+                self._done = True
+            if self._error is not None:
+                raise self._error
+            return self._value
+
+
+class CoalescedFuture:
+    """Async handle to one scheduled request's result.
+
+    Mirrors the :class:`~.core.MatvecFuture` face (``result`` /
+    ``done`` / ``exception``) and resolves one of three ways: sliced out
+    of a coalesced batch's shared result, adopted from a bypass dispatch's
+    own engine future, or failed (deadline expired before dispatch).
+
+    Batch-placement metadata (``offset``, ``width``, ``batch_width``,
+    ``coalesced``) is exposed for introspection and the exactness tests —
+    ``None``/``False`` until resolution, and for adopted futures.
+    """
+
+    def __init__(self, vector: bool, width: int):
+        self._vector = vector
+        self.width = width
+        self._event = threading.Event()
+        self._shared: _SharedResult | None = None
+        self._inner: MatvecFuture | None = None
+        self._error: Exception | None = None
+        self.offset: int | None = None
+        self.batch_width: int | None = None
+        self.coalesced = False
+
+    # ---- resolution (scheduler-internal) ----
+
+    def _adopt(self, inner: MatvecFuture) -> None:
+        self._inner = inner
+        self._event.set()
+
+    def _resolve(
+        self, shared: _SharedResult, offset: int, batch_width: int,
+        n_requests: int,
+    ) -> None:
+        self._shared = shared
+        self.offset = offset
+        self.batch_width = batch_width
+        self.coalesced = n_requests > 1
+        self._event.set()
+
+    def _fail(self, error: Exception) -> None:
+        self._error = error
+        self._event.set()
+
+    # ---- the MatvecFuture face ----
+
+    def done(self) -> bool:
+        """True when the result is ready to materialize without blocking
+        on the device (a failed future is done by definition). False
+        while the request is still waiting in an open window."""
+        if not self._event.is_set():
+            return False
+        if self._error is not None:
+            return True
+        if self._inner is not None:
+            return self._inner.done()
+        return self._shared.done()
+
+    def exception(self) -> Exception | None:
+        """The failure this future carries (``DeadlineExceededError``),
+        or None — including while still pending in a window."""
+        if self._error is not None:
+            return self._error
+        if self._inner is not None:
+            return self._inner.exception()
+        return None
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        """Materialize this request's columns: ``(m,)`` for a vector
+        request, ``(m, b)`` for a block. Blocks until the window flushes
+        (``timeout`` bounds only that wait — ``None`` waits forever) and
+        the shared batch result materializes; a failed future raises its
+        error instead."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                "request still pending in the coalescing window after "
+                f"{timeout} s (is the scheduler's flusher running?)"
+            )
+        if self._error is not None:
+            raise self._error
+        if self._inner is not None:
+            return self._inner.result()
+        block = self._shared.value()
+        if self._vector:
+            return block[:, self.offset]
+        return block[:, self.offset:self.offset + self.width]
+
+
+class _Pending:
+    """One request waiting in the window: its normalized host block, its
+    absolute deadline (scheduler-clock seconds, None = none), and the
+    future its batch placement will resolve."""
+
+    __slots__ = ("block", "width", "deadline", "qos", "future")
+
+    def __init__(self, block, width, deadline, qos, future):
+        self.block = block
+        self.width = width
+        self.deadline = deadline
+        self.qos = qos
+        self.future = future
+
+
+class SchedulerStats:
+    """Point-in-time view over the scheduler's registry counters (same
+    one-source-of-truth doctrine as :class:`~.core.EngineStats`)."""
+
+    def __init__(
+        self, requests: int, batches: int, coalesced_requests: int,
+        bypass: int, deadline_failures: int, mean_batch_width: float,
+    ):
+        self.requests = requests
+        self.batches = batches
+        self.coalesced_requests = coalesced_requests
+        self.bypass = bypass
+        self.deadline_failures = deadline_failures
+        self.mean_batch_width = mean_batch_width
+
+    @property
+    def coalesce_ratio(self) -> float:
+        """Fraction of scheduled requests that shared a dispatch with at
+        least one other (NaN before any request)."""
+        if self.requests == 0:
+            return float("nan")
+        return self.coalesced_requests / self.requests
+
+
+class ArrivalWindowScheduler:
+    """Coalesce concurrent requests into batched engine dispatches.
+
+    Parameters
+    ----------
+    engine : the :class:`~.core.MatvecEngine` to dispatch through. The
+        scheduler counts into ``engine.metrics`` (one snapshot holds both
+        vocabularies) and inherits the engine's dtype/shape validation.
+    window_ms : ``"auto"`` (adaptive from the arrival-rate estimator, the
+        default) or a fixed window in milliseconds (0 = flush every
+        request immediately unless a partner is already waiting).
+    max_window_ms : adaptive-window cap, and the fixed window ``bulk``
+        requests wait.
+    flush_width : accumulated batch width past which the scheduler stops
+        insisting on the window (flush at the first ``settle_ms`` lull):
+        ``"auto"`` (the tuned promotion point ``b*`` via
+        ``tuning.lookup_promotion``, static default on a cold cache,
+        engine ``max_bucket`` when the tuner measured promotion never
+        winning) or an explicit int. Always clamped to
+        ``engine.max_bucket``; width reaching ``max_bucket`` itself
+        flushes immediately (a wider batch only splits).
+    settle_ms : the arrival lull that flushes a batch already at/above
+        ``flush_width`` — long enough that a thread stampede lands
+        whole, short next to any real window.
+    bypass_margin_ms : slack added to the current window when deciding
+        whether a request's deadline can survive coalescing; a deadline
+        inside ``window + margin`` bypasses the window and dispatches
+        alone, carrying its deadline into the engine's own gate.
+    rate_tau_s : time constant of the arrival-rate EWMA.
+    auto_flush : start the window-expiry flusher thread (default). Tests
+        that drive a fake clock disable it and flush explicitly —
+        width-threshold and interactive flushes still happen inline on
+        the submitting thread either way.
+    clock : injectable monotonic clock (seconds).
+    """
+
+    def __init__(
+        self,
+        engine: MatvecEngine,
+        *,
+        window_ms: str | float = "auto",
+        max_window_ms: float = DEFAULT_MAX_WINDOW_MS,
+        flush_width: str | int = "auto",
+        settle_ms: float = 0.2,
+        bypass_margin_ms: float = 0.2,
+        rate_tau_s: float = 0.25,
+        auto_flush: bool = True,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.engine = engine
+        if window_ms != "auto":
+            window_ms = float(window_ms)
+            if window_ms < 0:
+                raise ConfigError(
+                    f"window_ms must be >= 0, got {window_ms}"
+                )
+        if max_window_ms < 0:
+            raise ConfigError(
+                f"max_window_ms must be >= 0, got {max_window_ms}"
+            )
+        if settle_ms < 0:
+            raise ConfigError(f"settle_ms must be >= 0, got {settle_ms}")
+        self._window_ms = window_ms
+        self.max_window_ms = float(max_window_ms)
+        self.settle_ms = float(settle_ms)
+        self.bypass_margin_ms = float(bypass_margin_ms)
+        self.flush_width = self._resolve_flush_width(flush_width)
+        self._clock = clock
+        # All pending state lives under this condition variable; dispatch
+        # NEVER happens while it is held (scheduler-lock-across-dispatch).
+        self._cond = threading.Condition()
+        self._pending: list[_Pending] = []
+        self._pending_width = 0
+        self._flush_at: float | None = None
+        self._last_arrival = 0.0
+        self._closed = False
+
+        metrics = engine.metrics
+        self._rate = metrics.rate_estimator(
+            "sched_arrival_req_per_s",
+            "EWMA request arrival rate at the scheduler",
+            tau_s=rate_tau_s, clock=clock,
+        )
+        self._c_requests = metrics.counter(
+            "sched_requests_total", "scheduler submit() calls"
+        )
+        self._c_batches = metrics.counter(
+            "sched_batches_total", "coalesced batches dispatched"
+        )
+        self._c_coalesced = metrics.counter(
+            "sched_coalesced_requests_total",
+            "requests that shared a dispatch with >= 1 other",
+        )
+        self._c_bypass = metrics.counter(
+            "sched_bypass_total",
+            "deadline-tight requests dispatched outside the window",
+        )
+        self._c_deadline_failures = metrics.counter(
+            "sched_deadline_failures_total",
+            "requests that expired inside an open window (failed before "
+            "dispatch)",
+        )
+        self._c_amortized_bytes = metrics.counter(
+            "sched_amortized_bytes_total",
+            "bytes of A re-read traffic coalescing avoided vs per-request "
+            "dispatch",
+        )
+        self._h_batch_width = metrics.histogram(
+            "sched_batch_width", "columns per coalesced flush",
+            buckets=WIDTH_BUCKETS,
+        )
+        self._g_window = metrics.gauge(
+            "sched_coalesce_window_ms",
+            "coalescing window at the last admission decision",
+        )
+        # Bytes of A one dispatch re-reads — the amortization unit.
+        self._a_bytes = engine.m * engine.k * engine.dtype.itemsize
+
+        self._flusher: threading.Thread | None = None
+        if auto_flush:
+            self._flusher = threading.Thread(
+                target=self._flusher_loop,
+                name="matvec-sched-flusher", daemon=True,
+            )
+            self._flusher.start()
+
+    # ---- construction-time resolution ----
+
+    def _resolve_flush_width(self, flush_width: str | int) -> int:
+        """Pin the early-flush threshold at construction.
+
+        ``"auto"`` routes through the tuned promotion decision
+        (``tune_promotion``'s ``b*`` — the measured width where one block
+        GEMM beats sequential dispatch): a cold cache falls back to the
+        static :data:`~.core.DEFAULT_PROMOTE_B`, and a measured
+        "promotion never won" accumulates to the widest bucket instead
+        (coalescing still saves per-request dispatch overhead even when
+        the GEMM itself does not win). Always clamped to the engine's
+        ``max_bucket``.
+        """
+        engine = self.engine
+        if flush_width == "auto":
+            from ..models.base import mesh_size
+            from ..tuning import lookup_promotion
+
+            decision = lookup_promotion(
+                strategy=engine.strategy.name, m=engine.m, k=engine.k,
+                p=mesh_size(engine.mesh), dtype=str(engine.dtype),
+            )
+            if decision is None:  # cold cache: static default
+                b_star = DEFAULT_PROMOTE_B
+            else:
+                b_star = decision.get("b_star")
+                if b_star is None:  # measured: promotion never won
+                    b_star = engine.max_bucket
+            return max(1, min(int(b_star), engine.max_bucket))
+        flush_width = int(flush_width)
+        if flush_width < 1:
+            raise ConfigError(
+                f"flush_width must be >= 1, got {flush_width}"
+            )
+        return min(flush_width, engine.max_bucket)
+
+    # ---- window sizing ----
+
+    def current_window_ms(self, now: float | None = None) -> float:
+        """The coalescing window a standard request arriving now would
+        wait: the fixed override, or the adaptive size — ``cap · λ/(1+λ)``
+        with ``λ = rate · cap``, the expected number of arrivals during a
+        cap-wide window. Near zero when arrivals are rare (a lone request
+        dispatches immediately; latency stays flat), saturating toward
+        the cap as the estimated rate grows."""
+        if self._window_ms != "auto":
+            return self._window_ms
+        if now is None:
+            now = self._clock()
+        lam = self._rate.rate_per_s(now=now) * (self.max_window_ms / 1e3)
+        return self.max_window_ms * lam / (1.0 + lam)
+
+    # ---- admission ----
+
+    def submit(
+        self,
+        x,
+        *,
+        deadline_ms: float | None = None,
+        qos: str = "standard",
+    ) -> CoalescedFuture:
+        """Admit one request — a ``(k,)`` vector or ``(k, b)`` block —
+        into the coalescing window (or past it; see the module
+        docstring's admission rules). Returns immediately unless this
+        submission itself trips a flush, in which case the dispatch (and
+        any engine backpressure it absorbs) runs on this thread before
+        returning."""
+        if qos not in QOS_TIERS:
+            raise ConfigError(
+                f"unknown QoS tier {qos!r}; expected one of {QOS_TIERS}"
+            )
+        if self._closed:
+            # Checked again under the condition on the queued path; this
+            # early check keeps the refusal uniform across the bypass and
+            # stale-on-arrival paths too.
+            raise ConfigError("scheduler is closed")
+        engine = self.engine
+        now = self._clock()
+        x = np.asarray(x, dtype=engine.dtype)  # sync-ok: requests are host arrays (engine contract)
+        if x.ndim == 1:
+            if x.shape[0] != engine.k:
+                raise ConfigError(
+                    f"request length {x.shape[0]} != A columns {engine.k}"
+                )
+            vector, block = True, x[:, None]
+        elif x.ndim != 2 or x.shape[0] != engine.k:
+            raise ConfigError(
+                f"request must be (k,) or (k, b) with k={engine.k}; got "
+                f"shape {x.shape}"
+            )
+        elif x.shape[1] == 0:
+            raise ConfigError("empty request (b=0)")
+        else:
+            vector, block = False, x
+        width = block.shape[1]
+        self._c_requests.inc()
+        self._rate.observe(now=now)
+        fut = CoalescedFuture(vector, width)
+        if deadline_ms is not None and deadline_ms <= 0:
+            # Stale on arrival (upstream queueing): fail without touching
+            # the window or the engine.
+            self._c_deadline_failures.inc()
+            fut._fail(DeadlineExceededError(
+                f"request deadline of {deadline_ms} ms elapsed before "
+                "admission"
+            ))
+            return fut
+
+        window_ms = self.current_window_ms(now)
+        self._g_window.set(window_ms)
+        if deadline_ms is not None and deadline_ms <= (
+            window_ms + self.bypass_margin_ms
+        ):
+            # The deadline cannot survive the window: dispatch alone, now,
+            # with the deadline intact for the engine's own gate.
+            self._c_bypass.inc()
+            fut._adopt(engine.submit(x, deadline_ms=deadline_ms))
+            return fut
+
+        deadline = (
+            now + deadline_ms / 1e3 if deadline_ms is not None else None
+        )
+        pend = _Pending(block, width, deadline, qos, fut)
+        batch = None
+        with self._cond:
+            if self._closed:
+                raise ConfigError("scheduler is closed")
+            self._pending.append(pend)
+            self._pending_width += width
+            self._last_arrival = now
+            tier_window_s = (
+                self.max_window_ms if qos == "bulk" else window_ms
+            ) / 1e3
+            flush_at = now + tier_window_s
+            if self._flush_at is None or len(self._pending) == 1:
+                self._flush_at = flush_at
+            else:
+                # A later, more latency-sensitive arrival pulls the whole
+                # batch's flush forward; it never pushes it back.
+                self._flush_at = min(self._flush_at, flush_at)
+            if deadline is not None:
+                # Never *plan* to hold a request past its deadline; the
+                # margin leaves room for the dispatch itself.
+                self._flush_at = min(
+                    self._flush_at,
+                    deadline - self.bypass_margin_ms / 1e3,
+                )
+            if (
+                qos == "interactive"
+                or self._pending_width >= self.engine.max_bucket
+            ):
+                # Immediate triggers: latency-sensitive tier, or a batch
+                # already at the widest bucket (wider only splits).
+                batch = self._take_locked()
+            else:
+                self._cond.notify_all()  # re-arm the flusher's timer
+        if batch is not None:
+            self._dispatch(batch)
+        return fut
+
+    def __call__(self, x) -> np.ndarray:
+        """Synchronous convenience: ``submit(x).result()``."""
+        return self.submit(x).result()
+
+    # ---- flushing ----
+
+    def _take_locked(self) -> list[_Pending] | None:
+        """Swap the pending batch out (caller holds the condition). The
+        dispatch happens after release — never under the lock."""
+        if not self._pending:
+            return None
+        batch = self._pending
+        self._pending = []
+        self._pending_width = 0
+        self._flush_at = None
+        return batch
+
+    def _dispatch(self, batch: list[_Pending]) -> None:
+        """Dispatch one swapped-out batch: fail requests whose deadline
+        expired while the window was open (before dispatch, without
+        poisoning the rest), column-stack the survivors, and hand the
+        stacked block to the engine as ONE request. Runs with no
+        scheduler lock held — the engine's backpressure gate may block
+        here, and new arrivals must keep queueing meanwhile."""
+        now = self._clock()
+        live: list[_Pending] = []
+        for p in batch:
+            if p.deadline is not None and now > p.deadline:
+                self._c_deadline_failures.inc()
+                p.future._fail(DeadlineExceededError(
+                    "request deadline elapsed inside the coalescing "
+                    "window before dispatch"
+                ))
+            else:
+                live.append(p)
+        if not live:
+            return
+        width = sum(p.width for p in live)
+        stacked = (
+            live[0].block if len(live) == 1
+            else np.concatenate([p.block for p in live], axis=1)
+        )
+        try:
+            inner = self.engine.submit(stacked)
+        except Exception as e:
+            # A failed dispatch (engine closed underneath us, backend
+            # error) must fail every future in the batch — never leave a
+            # client hanging in result(), and never kill the flusher
+            # thread with an escaped exception.
+            for p in live:
+                p.future._fail(e)
+            return
+        shared = _SharedResult(inner)
+        offset = 0
+        for p in live:
+            p.future._resolve(shared, offset, width, len(live))
+            offset += p.width
+        self._c_batches.inc()
+        self._h_batch_width.observe(width)
+        if len(live) > 1:
+            self._c_coalesced.inc(len(live))
+        saved = sum(
+            self._dispatches_for(p.width) for p in live
+        ) - self._dispatches_for(width)
+        if saved > 0:
+            self._c_amortized_bytes.inc(saved * self._a_bytes)
+
+    def _dispatches_for(self, width: int) -> int:
+        """How many device programs the engine runs for a block of this
+        width: bucketed GEMM chunks at/above the promotion point,
+        per-column GEMVs below it."""
+        engine = self.engine
+        if engine.b_star is not None and width >= engine.b_star:
+            return len(split_widths(width, engine.max_bucket))
+        return width
+
+    def flush(self) -> int:
+        """Flush the open window now (driver/test code — the serve bench
+        fences with it before draining). Returns the number of requests
+        dispatched or failed."""
+        with self._cond:
+            batch = self._take_locked()
+        if batch is None:
+            return 0
+        self._dispatch(batch)
+        return len(batch)
+
+    def _flush_due_locked(self, now: float) -> float | None:
+        """When the open batch should flush (caller holds the condition):
+        the window deadline, pulled forward to the next ``settle_ms``
+        lull once the accumulated width has reached the tuned flush
+        threshold. None with nothing pending."""
+        if not self._pending:
+            return None
+        due = self._flush_at if self._flush_at is not None else now
+        if self._pending_width >= self.flush_width:
+            due = min(due, self._last_arrival + self.settle_ms / 1e3)
+        return due
+
+    def _flusher_loop(self) -> None:
+        """Flush watchdog: dispatches the open batch at its due time —
+        window expiry, or the first arrival lull once the batch width
+        passed the tuned threshold. Interactive and widest-bucket flushes
+        happen inline in ``submit``; this thread covers every batch whose
+        partners stopped arriving. Note dispatch happens after the
+        condition is released — when the engine's backpressure gate
+        blocks here, the next whole batch simply accumulates until the
+        oldest one drains (batch-granular backpressure)."""
+        while True:
+            batch = None
+            with self._cond:
+                if self._closed:
+                    return
+                now = self._clock()
+                due = self._flush_due_locked(now)
+                if due is None:
+                    self._cond.wait()
+                    continue
+                if now < due:
+                    self._cond.wait(timeout=due - now)
+                    continue
+                batch = self._take_locked()
+            if batch is not None:
+                self._dispatch(batch)
+
+    # ---- lifecycle & introspection ----
+
+    @property
+    def stats(self) -> SchedulerStats:
+        h = self._h_batch_width
+        count = h.count
+        return SchedulerStats(
+            requests=self._c_requests.value,
+            batches=self._c_batches.value,
+            coalesced_requests=self._c_coalesced.value,
+            bypass=self._c_bypass.value,
+            deadline_failures=self._c_deadline_failures.value,
+            mean_batch_width=(
+                h.sum / count if count else float("nan")
+            ),
+        )
+
+    @property
+    def pending_width(self) -> int:
+        """Columns waiting in the open window right now."""
+        with self._cond:
+            return self._pending_width
+
+    def close(self) -> None:
+        """Flush the open window, stop the flusher thread, and refuse
+        further submits. Does NOT close the engine (the scheduler is a
+        front-end; the engine may serve other callers)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            batch = self._take_locked()
+            self._cond.notify_all()
+        if batch is not None:
+            self._dispatch(batch)
+        if self._flusher is not None:
+            self._flusher.join(timeout=5.0)
+
+    def __enter__(self) -> "ArrivalWindowScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

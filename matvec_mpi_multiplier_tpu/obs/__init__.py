@@ -45,6 +45,7 @@ from .registry import (
     Gauge,
     Histogram,
     MetricsRegistry,
+    RateEstimator,
     get_registry,
     prometheus_text,
     reset_registry,
@@ -57,6 +58,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "RateEstimator",
     "get_registry",
     "prometheus_text",
     "reset_registry",

@@ -31,8 +31,47 @@ def _fmt_ms(v: float) -> str:
     return f"{v:.3f}ms"
 
 
+def render_batching(snapshot: dict) -> str | None:
+    """The batching panel: coalescing efficiency read off the scheduler's
+    ``sched_*`` metrics (``engine/scheduler.py``). None when the snapshot
+    holds no scheduler counters (a run without coalescing)."""
+    counters = snapshot.get("counters", {})
+    if "sched_batches_total" not in counters:
+        return None
+    gauges = snapshot.get("gauges", {})
+    requests = counters.get("sched_requests_total", 0)
+    batches = counters.get("sched_batches_total", 0)
+    coalesced = counters.get("sched_coalesced_requests_total", 0)
+    width = snapshot.get("histograms", {}).get("sched_batch_width", {})
+    mean_width = (
+        width["sum"] / width["count"] if width.get("count") else float("nan")
+    )
+    out = [
+        "batching:",
+        f"  requests          {requests} "
+        f"({counters.get('sched_bypass_total', 0)} bypassed, "
+        f"{counters.get('sched_deadline_failures_total', 0)} deadline-"
+        "failed)",
+        f"  batches           {batches}",
+        f"  mean batch width  {mean_width:.2f}",
+        f"  coalesce ratio    "
+        f"{(coalesced / requests) if requests else float('nan'):.2f} "
+        "(requests that shared a dispatch)",
+        f"  window            "
+        f"{gauges.get('sched_coalesce_window_ms', float('nan')):.3f}ms "
+        f"@ {gauges.get('sched_arrival_req_per_s', float('nan')):.1f} "
+        "req/s",
+        f"  amortized bytes   "
+        f"{counters.get('sched_amortized_bytes_total', 0):.3e} "
+        "(A re-reads coalescing avoided)",
+    ]
+    return "\n".join(out)
+
+
 def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
-    """Human-readable (or Prometheus text) rendering of a snapshot dict."""
+    """Human-readable (or Prometheus text) rendering of a snapshot dict.
+    Snapshots carrying batching-scheduler metrics get the ``batching``
+    panel appended (:func:`render_batching`)."""
     if prometheus:
         from .registry import prometheus_text
 
@@ -61,6 +100,9 @@ def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
                 f"p95={_fmt_ms(summ.get('p95'))} "
                 f"p99={_fmt_ms(summ.get('p99'))}"
             )
+    batching = render_batching(snapshot)
+    if batching is not None:
+        out.append(batching)
     return "\n".join(out) if out else "(empty snapshot)"
 
 

@@ -17,14 +17,21 @@ Design constraints, in order:
   demo) the summary is bit-identical to what ``np.percentile`` over the
   full sample would report — the property the serve bench's p50/p99
   unification test pins.
+
+Plus one derived metric: :class:`RateEstimator`, the windowed EWMA
+arrival-rate (req/s) the batching scheduler (``engine/scheduler.py``)
+sizes its coalescing window from. It exports as a gauge in snapshots —
+no new wire type — and takes an injectable clock so its dynamics are
+unit-testable without sleeping.
 """
 
 from __future__ import annotations
 
 import math
 import threading
+import time
 from collections import deque
-from typing import Iterable
+from typing import Callable, Iterable
 
 import numpy as np
 
@@ -165,6 +172,85 @@ class Histogram:
         }
 
 
+class RateEstimator:
+    """Windowed EWMA arrival-rate estimator: events in, req/s out.
+
+    ``observe()`` records one (or ``n`` simultaneous) arrivals;
+    ``rate_per_s()`` reports an exponentially-weighted moving average of
+    the instantaneous arrival rate with time constant ``tau_s`` — the
+    effective averaging window. Two properties the consumer (the
+    batching scheduler's adaptive coalescing window) depends on:
+
+    * **burst-safe** — arrivals sharing one clock reading accumulate and
+      enter the average as ``count / gap`` at the next distinct
+      timestamp, so a thread stampede reads as a high rate, not a
+      division by zero;
+    * **idle decay** — ``rate_per_s`` discounts the stored average by
+      the time since the last arrival (``exp(-idle/tau)``), so a stream
+      that stops reads as a falling rate instead of freezing at its
+      last burst (the scheduler must shrink its window when traffic
+      drains, not keep serving yesterday's estimate).
+
+    The clock is injectable (``time.monotonic`` by default) so the
+    dynamics are testable without real sleeps. Exported by the registry
+    snapshot as a plain gauge value — sampled at snapshot time.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        tau_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if tau_s <= 0:
+            raise ValueError(f"rate estimator {name!r} needs tau_s > 0")
+        self.name = name
+        self.help = help
+        self.tau_s = float(tau_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rate = 0.0
+        self._last: float | None = None
+        self._burst = 0  # arrivals at the last timestamp, not yet averaged
+        self._count = 0
+
+    def observe(self, n: int = 1, now: float | None = None) -> None:
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            self._count += n
+            if self._last is None:
+                self._last = now
+                self._burst = n
+                return
+            dt = now - self._last
+            if dt <= 0:  # same (or regressed) clock reading: accumulate
+                self._burst += n
+                return
+            inst = self._burst / dt
+            w = math.exp(-dt / self.tau_s)
+            self._rate = w * self._rate + (1.0 - w) * inst
+            self._last = now
+            self._burst = n
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def rate_per_s(self, now: float | None = None) -> float:
+        """The EWMA arrival rate, discounted for idle time since the last
+        arrival (0.0 before any event)."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            if self._last is None:
+                return 0.0
+            idle = max(0.0, now - self._last)
+            return self._rate * math.exp(-idle / self.tau_s)
+
+
 class MetricsRegistry:
     """Named metrics, get-or-create. One registry per engine (isolated
     counters per serving instance) plus a process default
@@ -176,6 +262,7 @@ class MetricsRegistry:
         self._counters: dict[str, Counter] = {}
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
+        self._rates: dict[str, RateEstimator] = {}
 
     def counter(self, name: str, help: str = "") -> Counter:
         with self._lock:
@@ -206,18 +293,37 @@ class MetricsRegistry:
                 )
             return h
 
+    def rate_estimator(
+        self,
+        name: str,
+        help: str = "",
+        tau_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> RateEstimator:
+        with self._lock:
+            r = self._rates.get(name)
+            if r is None:
+                r = self._rates[name] = RateEstimator(
+                    name, help, tau_s=tau_s, clock=clock
+                )
+            return r
+
     def snapshot(self) -> dict:
         """JSON-able view of every metric — the ``--metrics-out`` payload
         and the obs CLI's input. Values are read metric-by-metric under
         each metric's own lock (atomic per metric; the registry makes no
-        cross-metric consistency claim)."""
+        cross-metric consistency claim). Rate estimators export as
+        gauges, sampled at snapshot time."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
+            rates = dict(self._rates)
+        gauge_values = {n: g.value for n, g in gauges.items()}
+        gauge_values.update({n: r.rate_per_s() for n, r in rates.items()})
         return {
             "counters": {n: c.value for n, c in sorted(counters.items())},
-            "gauges": {n: g.value for n, g in sorted(gauges.items())},
+            "gauges": dict(sorted(gauge_values.items())),
             "histograms": {
                 n: h.summary() for n, h in sorted(histograms.items())
             },

@@ -21,11 +21,19 @@ hot-path-blocking-io      obs-ok       no file I/O on the dispatch hot path
 fp64-implicit-promotion   fp64-ok      no implicit float64 in traced code
 import-time-jnp           import-ok    no jnp work at module import time
 mutable-default-arg       default-ok   no mutable default arguments
+scheduler-lock-across-    lock-ok      no engine dispatch/drain entered
+dispatch                               while holding a scheduler lock
 ========================  ===========  ====================================
 
 The first four are the old grep rules from ``scripts/tier1.sh`` /
 ``tests/test_lint.py``, now alias-aware and string/docstring-proof; the
-last three are inexpressible as greps.
+last four are inexpressible as greps. The engine host-sync and hot-path
+I/O rules scope over ``engine/`` as a prefix, so the batching scheduler
+(``engine/scheduler.py``) is covered by construction; the lock rule is
+its own flush-loop discipline (a flush must swap the batch out under the
+lock and dispatch only after releasing it — an engine dispatch can block
+in the backpressure drain, and a blocked flush must not freeze
+admission).
 """
 
 from __future__ import annotations
@@ -434,6 +442,78 @@ def _check_import_time_jnp(sf: SourceFile):
                 "materialization before any caller chose a platform; "
                 "compute it lazily or with numpy"
             )
+
+
+def _scheduler(rel: str) -> bool:
+    return rel == f"{_PKG}/engine/scheduler.py"
+
+
+# Calls that enter the engine's dispatch path (or block draining it).
+# Holding the scheduler's admission lock across any of these turns a
+# backpressure stall into a total admission freeze.
+_DISPATCH_CALLS = ("submit", "warmup", "block_until_ready")
+# Context-manager name fragments that mark a scheduler lock (Lock,
+# RLock, Condition — the flush loop's admission guard).
+_LOCKISH = ("lock", "cond", "mutex")
+
+
+def _lockish_with(node: ast.With) -> bool:
+    for item in node.items:
+        expr = item.context_expr
+        # `with self._cond:` / `with lock:` / `with self._lock.acquire()`…
+        for sub in ast.walk(expr):
+            name = (
+                sub.attr if isinstance(sub, ast.Attribute)
+                else sub.id if isinstance(sub, ast.Name) else None
+            )
+            if name is not None and any(
+                frag in name.lower() for frag in _LOCKISH
+            ):
+                return True
+    return False
+
+
+def _walk_excluding_deferred(nodes: Iterable[ast.AST]) -> Iterator[ast.AST]:
+    """Walk statements executed *inside* a with-block, skipping function
+    and lambda bodies (deferred — they run under whatever lock state
+    exists at call time, not this one)."""
+    stack: list[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@_register(
+    "scheduler-lock-across-dispatch", "lock-ok",
+    "engine dispatch (or blocking drain) entered while holding a "
+    "scheduler lock: swap the batch out under the lock, dispatch after "
+    "releasing it",
+    _scheduler,
+)
+def _check_lock_across_dispatch(sf: SourceFile):
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.With) or not _lockish_with(node):
+            continue
+        for inner in _walk_excluding_deferred(node.body):
+            if not isinstance(inner, ast.Call):
+                continue
+            fn = inner.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if attr in _DISPATCH_CALLS:
+                yield inner, (
+                    f"{attr}() under a held scheduler lock: an engine "
+                    "dispatch can block in the backpressure drain, and a "
+                    "blocked flush must not freeze admission — take the "
+                    "batch out under the lock and dispatch after "
+                    "releasing it"
+                )
 
 
 _MUTABLE_FACTORIES = (

@@ -119,6 +119,70 @@ def test_default_registry_reset():
     assert get_registry().counter("x").value == 0
 
 
+# ---------------------------------------------------------- rate estimator
+
+
+class TickClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_rate_estimator_converges_to_steady_rate():
+    from matvec_mpi_multiplier_tpu.obs.registry import RateEstimator
+
+    clock = TickClock()
+    r = RateEstimator("rate", tau_s=0.5, clock=clock)
+    assert r.rate_per_s() == 0.0  # no traffic yet
+    for _ in range(500):  # 100 req/s for 5 s >> tau
+        clock.t += 0.01
+        r.observe()
+    assert r.rate_per_s() == pytest.approx(100.0, rel=0.05)
+    assert r.count == 500
+
+
+def test_rate_estimator_idle_decay_and_burst():
+    from matvec_mpi_multiplier_tpu.obs.registry import RateEstimator
+
+    clock = TickClock()
+    r = RateEstimator("rate", tau_s=0.5, clock=clock)
+    # A burst of 10 at one instant enters the average as count/gap once
+    # the clock advances — high rate, no division by zero.
+    clock.t = 1.0
+    for _ in range(10):
+        r.observe()
+    clock.t = 1.1
+    r.observe()
+    assert r.rate_per_s() > 15.0  # 10 events / 0.1 s, EWMA-damped
+    peak = r.rate_per_s()
+    # Idle decay: 5 tau of silence collapses the estimate.
+    clock.t = 3.6
+    assert r.rate_per_s() < 0.01 * peak
+
+
+def test_rate_estimator_validation_and_registry_face():
+    from matvec_mpi_multiplier_tpu.obs.registry import RateEstimator
+
+    with pytest.raises(ValueError):
+        RateEstimator("bad", tau_s=0.0)
+    clock = TickClock()
+    reg = MetricsRegistry()
+    r = reg.rate_estimator("sched_arrival_req_per_s", tau_s=0.5, clock=clock)
+    assert reg.rate_estimator("sched_arrival_req_per_s") is r
+    for _ in range(100):
+        clock.t += 0.02  # 50 req/s
+        r.observe()
+    snap = reg.snapshot()
+    # Exported as a plain gauge (sampled at snapshot time) — one wire
+    # format for the CLI and the Prometheus text.
+    assert snap["gauges"]["sched_arrival_req_per_s"] == pytest.approx(
+        r.rate_per_s()
+    )
+    assert "sched_arrival_req_per_s" in reg.to_prometheus()
+
+
 # ----------------------------------------------------------------- tracer
 
 
@@ -223,6 +287,36 @@ def test_cli_render_metrics_table_and_prometheus():
     prom = render_metrics(snap, prometheus=True)
     assert "engine_requests_total 3" in prom
     assert 'serve_dispatch_latency_ms_bucket{le="+Inf"} 1' in prom
+
+
+def test_cli_batching_panel_renders_scheduler_metrics():
+    """Snapshots carrying scheduler counters get the batching panel:
+    mean batch width, coalesce ratio, window @ rate, amortized bytes —
+    and snapshots without them stay panel-free."""
+    from matvec_mpi_multiplier_tpu.obs.__main__ import render_batching
+
+    assert render_batching(_sample_snapshot()) is None
+    assert "batching:" not in render_metrics(_sample_snapshot())
+
+    reg = MetricsRegistry()
+    reg.counter("sched_requests_total").inc(12)
+    reg.counter("sched_batches_total").inc(3)
+    reg.counter("sched_coalesced_requests_total").inc(9)
+    reg.counter("sched_bypass_total").inc(1)
+    reg.counter("sched_deadline_failures_total").inc(2)
+    reg.counter("sched_amortized_bytes_total").inc(4096)
+    reg.gauge("sched_coalesce_window_ms").set(1.25)
+    reg.gauge("sched_arrival_req_per_s").set(500.0)
+    h = reg.histogram("sched_batch_width", buckets=(1, 2, 4, 8))
+    for w in (2, 3, 4):
+        h.observe(w)
+    out = render_metrics(reg.snapshot())
+    assert "batching:" in out
+    assert "mean batch width  3.00" in out
+    assert "coalesce ratio    0.75" in out
+    assert "1.250ms" in out and "500.0" in out
+    assert "1 bypassed" in out and "2 deadline" in out
+    assert "4.096e+03" in out
 
 
 def test_cli_summarize_trace_breakdown_and_topk():

@@ -1,0 +1,657 @@
+"""Arrival-window scheduler tests (engine/scheduler.py): coalescing
+correctness, exactness of the per-request masked unpad, deadline × window
+interaction, QoS admission, the adaptive window, and the tuned flush
+threshold.
+
+Exactness doctrine (pinned here, relied on by the serving contract): each
+output column is a contraction over its own input column only, and within
+ONE bucket executable the result is position- and pad-content-independent
+— so a request's columns through a coalesced dispatch are BITWISE what the
+same bucket executable produces for the request alone. Across *different*
+bucket executables the backend may legally re-order the reduction (the
+same caveat the engine's promotion path documents), so the bitwise
+comparisons below always reconstruct the coalesced placement.
+
+Most tests drive a fake clock with ``auto_flush=False`` and flush
+explicitly — window logic becomes deterministic; the threaded flusher is
+exercised separately with real time and generous bounds.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.engine import (
+    ArrivalWindowScheduler,
+    DEFAULT_PROMOTE_B,
+    MatvecEngine,
+    bucket_for,
+    pad_columns,
+    split_widths,
+)
+from matvec_mpi_multiplier_tpu.tuning import (
+    TuningCache,
+    promote_key,
+    reset_cache,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import (
+    ConfigError,
+    DeadlineExceededError,
+)
+
+
+class FakeClock:
+    """Deterministic monotonic clock (seconds)."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance_ms(self, ms: float) -> None:
+        self.t += ms / 1e3
+
+
+def make_engine(rng, m=64, k=64, dtype="float32", **kwargs):
+    a = rng.uniform(0, 10, (m, k)).astype(dtype)
+    kwargs.setdefault("promote", 4)
+    kwargs.setdefault("max_bucket", 8)
+    return a, MatvecEngine(a, make_mesh(8), strategy="rowwise", **kwargs)
+
+
+def make_sched(engine, **kwargs):
+    kwargs.setdefault("auto_flush", False)
+    kwargs.setdefault("window_ms", 50.0)  # wide fixed window by default
+    kwargs.setdefault("flush_width", 8)
+    return ArrivalWindowScheduler(engine, **kwargs)
+
+
+# ------------------------------------------------------------- coalescing
+
+
+def test_coalesces_into_one_engine_request(devices, rng):
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width=4)
+    X = rng.uniform(0, 10, (64, 4)).astype(np.float32)
+    futs = [sched.submit(X[:, j]) for j in range(3)]
+    assert eng.stats.requests == 0  # window open, nothing dispatched
+    assert all(not f.done() for f in futs)
+    assert sched.flush() == 3
+    for j, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(), a @ X[:, j], rtol=1e-5)
+        assert f.coalesced and f.batch_width == 3 and f.offset == j
+    s = eng.stats
+    assert s.requests == 1, "3 requests must coalesce into ONE dispatch"
+    assert sched.stats.batches == 1
+    assert sched.stats.coalesced_requests == 3
+
+
+def test_lull_flush_threshold_triggers_via_flusher(devices, rng):
+    """Reaching flush_width arms the settle-lull flush (flusher thread,
+    real clock): a stampede of flush_width submits dispatches without
+    waiting out the window."""
+    a, eng = make_engine(rng)
+    sched = ArrivalWindowScheduler(
+        eng, window_ms=10_000.0, flush_width=4, settle_ms=0.2,
+    )
+    try:
+        X = rng.uniform(0, 10, (64, 4)).astype(np.float32)
+        futs = [sched.submit(X[:, j]) for j in range(4)]
+        for j, f in enumerate(futs):
+            np.testing.assert_allclose(
+                f.result(timeout=30.0), a @ X[:, j], rtol=1e-5
+            )
+        assert eng.stats.requests == 1
+    finally:
+        sched.close()
+
+
+def test_widest_bucket_flushes_inline(devices, rng):
+    """Width reaching the engine's max bucket flushes immediately on the
+    submitting thread — no flusher needed."""
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width=8)  # == max_bucket
+    X = rng.uniform(0, 10, (64, 8)).astype(np.float32)
+    futs = [sched.submit(X[:, j]) for j in range(8)]
+    assert all(f._event.is_set() for f in futs)  # resolved without flush()
+    Y = np.stack([f.result() for f in futs], axis=1)
+    np.testing.assert_allclose(Y, a @ X, rtol=1e-5)
+    assert eng.stats.requests == 1
+
+
+def test_block_requests_coalesce_and_split_exactly(devices, rng):
+    """Mixed-width blocks stack in arrival order; reaching the widest
+    bucket flushes inline (width 3+1+5 = 9 >= max_bucket 8, which then
+    splits 8 + 1), the tail flushes explicitly, and every request unpads
+    to exactly its own columns."""
+    rng2 = np.random.default_rng(3)
+    a, eng = make_engine(rng2, dtype="float64", promote=2)
+    sched = make_sched(eng, flush_width=32)
+    blocks = [
+        rng2.uniform(0, 10, (64, w)) for w in (3, 1, 5, 2)
+    ]
+    futs = [sched.submit(b) for b in blocks]
+    vec = rng2.uniform(0, 10, (64,))
+    fut_vec = sched.submit(vec)
+    assert sched.flush() == 2  # the width-2 block + the vector
+    for b, f in zip(blocks, futs):
+        np.testing.assert_allclose(f.result(), a @ b, rtol=1e-12)
+        assert f.result().shape == (64, b.shape[1])
+    np.testing.assert_allclose(fut_vec.result(), a @ vec, rtol=1e-12)
+    assert fut_vec.result().shape == (64,)
+    assert eng.stats.requests == 2  # widest-bucket batch + the tail
+    assert futs[0].batch_width == 9 and fut_vec.batch_width == 3
+
+
+def test_empty_flush_and_pending_width(devices, rng):
+    a, eng = make_engine(rng)
+    sched = make_sched(eng)
+    assert sched.flush() == 0
+    sched.submit(rng.uniform(0, 10, (64, 2)).astype(np.float32))
+    assert sched.pending_width == 2
+    assert sched.flush() == 1
+    assert sched.pending_width == 0
+
+
+def test_request_validation_mirrors_engine(devices, rng):
+    a, eng = make_engine(rng)
+    sched = make_sched(eng)
+    with pytest.raises(ConfigError):
+        sched.submit(np.ones(32, np.float32))  # wrong k
+    with pytest.raises(ConfigError):
+        sched.submit(np.ones((32, 3), np.float32))
+    with pytest.raises(ConfigError):
+        sched.submit(np.ones((64, 0), np.float32))
+    with pytest.raises(ConfigError):
+        sched.submit(np.ones(64, np.float32), qos="nope")
+    assert sched.pending_width == 0  # rejected requests never queue
+
+
+# ---------------------------------------------------------------- exactness
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_coalesced_bitwise_equals_alone_same_bucket(devices, rng, dtype):
+    """The acceptance pin: every coalesced result is bit-identical to the
+    request dispatched alone through the same bucket executable — across
+    mixed widths, dtypes, and a bucket-boundary split. The solo baseline
+    reconstructs the coalesced placement (same bucket, any position:
+    position/pad independence is what makes coalescing invisible)."""
+    rng2 = np.random.default_rng(11)
+    a = rng2.uniform(0, 10, (64, 64)).astype(dtype)
+    mesh = make_mesh(8)
+    eng = MatvecEngine(
+        a, mesh, strategy="colwise", promote=2, max_bucket=8, dtype=dtype
+    )
+    sched = make_sched(eng, flush_width=32)
+    widths = (3, 1, 5, 2)  # 3rd submit reaches 9 >= max_bucket: inline
+    blocks = [                # flush -> batch A (9: chunks [8, 1]);
+        rng2.uniform(0, 10, (64, w)).astype(dtype) for w in widths
+    ]                         # the width-2 tail flushes as batch B.
+    futs = [sched.submit(b) for b in blocks]
+    sched.flush()
+    got = [f.result() for f in futs]
+    assert {f.batch_width for f in futs} == {9, 2}
+
+    # A solo engine that always rides the GEMM bucket path (promote=1),
+    # same A, same strategy: the same executables the batches used.
+    solo = MatvecEngine(
+        a, mesh, strategy="colwise", promote=1, max_bucket=8, dtype=dtype
+    )
+    for b, f, y in zip(blocks, futs, got):
+        # Reconstruct this request's coalesced placement from its own
+        # batch metadata: which max-bucket chunk each column landed in,
+        # and that chunk's bucket.
+        chunk_widths = split_widths(f.batch_width, eng.max_bucket)
+        chunk_starts = np.cumsum([0] + chunk_widths[:-1])
+        for j in range(b.shape[1]):
+            col_at = f.offset + j
+            ci = max(
+                i for i, s in enumerate(chunk_starts) if s <= col_at
+            )
+            bucket = bucket_for(chunk_widths[ci], eng.max_bucket)
+            alone = solo.submit(
+                pad_columns(b[:, j:j + 1], bucket)
+            ).result()
+            np.testing.assert_array_equal(
+                np.asarray(y)[:, j] if y.ndim == 2 else y,
+                alone[:, 0],
+                err_msg=f"width={b.shape[1]} col={j} bucket={bucket}",
+            )
+
+
+def test_sub_promotion_batch_bitwise_equals_solo_vectors(devices, rng):
+    """A flushed batch below the engine's b* rides the per-column matvec
+    path — the SAME executable a solo vector submit uses, so the results
+    are bitwise equal with no reconstruction needed."""
+    rng2 = np.random.default_rng(5)
+    a, eng = make_engine(rng2, dtype="float64", promote=4)
+    sched = make_sched(eng, flush_width=8)
+    X = rng2.uniform(0, 10, (64, 3))
+    futs = [sched.submit(X[:, j]) for j in range(3)]
+    sched.flush()  # width 3 < b*=4: three matvec dispatches
+    assert eng.stats.dispatches == 3
+    for j, f in enumerate(futs):
+        solo = eng.submit(X[:, j]).result()
+        np.testing.assert_array_equal(f.result(), solo)
+
+
+def test_coalesced_matches_serial_oracle_mixed_dtypes(devices, rng):
+    """Per-request unpad against the serial kernel across a mixed-dtype
+    request stream (requests normalize to the engine dtype at the door,
+    exactly as engine.submit does)."""
+    rng2 = np.random.default_rng(7)
+    a, eng = make_engine(rng2, dtype="float64", promote=2)
+    sched = make_sched(eng, flush_width=32)
+    futs, oracles = [], []
+    for w, dt in [(1, np.float64), (3, np.float32), (2, np.int32),
+                  (5, np.float64)]:
+        X = rng2.uniform(0, 10, (64, w)).astype(dt)
+        futs.append(sched.submit(X))
+        oracles.append(a @ X.astype(np.float64))
+    sched.flush()
+    for f, want in zip(futs, oracles):
+        np.testing.assert_allclose(
+            f.result().reshape(64, -1), want.reshape(64, -1), rtol=1e-12
+        )
+
+
+# ----------------------------------------------------- deadlines and QoS
+
+
+def test_stale_on_arrival_fails_without_touching_window(devices, rng):
+    a, eng = make_engine(rng)
+    sched = make_sched(eng)
+    fut = sched.submit(
+        rng.uniform(0, 10, (64,)).astype(np.float32), deadline_ms=-1.0
+    )
+    assert fut.done()
+    assert isinstance(fut.exception(), DeadlineExceededError)
+    with pytest.raises(DeadlineExceededError):
+        fut.result()
+    assert sched.pending_width == 0
+    assert eng.stats.requests == 0
+    assert sched.stats.deadline_failures == 1
+
+
+def test_tight_deadline_bypasses_the_window(devices, rng):
+    """A deadline that cannot survive the current window dispatches
+    immediately, alone, with the deadline intact — it neither waits nor
+    flushes the open batch."""
+    clock = FakeClock()
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, window_ms=20.0, clock=clock)
+    x_wait = rng.uniform(0, 10, (64,)).astype(np.float32)
+    waiting = sched.submit(x_wait)  # opens the 20 ms window
+    x_rush = rng.uniform(0, 10, (64,)).astype(np.float32)
+    rushed = sched.submit(x_rush, deadline_ms=5.0)  # 5 < 20: bypass
+    np.testing.assert_allclose(rushed.result(), a @ x_rush, rtol=1e-5)
+    assert not rushed.coalesced
+    assert not waiting.done(), "bypass must not flush the open window"
+    assert sched.stats.bypass == 1
+    assert eng.stats.requests == 1  # the bypass dispatch only
+    sched.flush()
+    np.testing.assert_allclose(waiting.result(), a @ x_wait, rtol=1e-5)
+
+
+def test_deadline_expiry_in_window_fails_without_poisoning_batch(
+    devices, rng
+):
+    """A request whose deadline elapses while the window is open fails
+    via DeadlineExceededError BEFORE dispatch; its batchmates dispatch
+    and resolve exactly as if it had never queued."""
+    clock = FakeClock()
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, window_ms=50.0, clock=clock)
+    x_ok1 = rng.uniform(0, 10, (64,)).astype(np.float32)
+    x_doomed = rng.uniform(0, 10, (64, 2)).astype(np.float32)
+    x_ok2 = rng.uniform(0, 10, (64,)).astype(np.float32)
+    f_ok1 = sched.submit(x_ok1)
+    f_doomed = sched.submit(x_doomed, deadline_ms=60.0)  # > window: queues
+    f_ok2 = sched.submit(x_ok2)
+    before = eng.stats.dispatches
+    clock.advance_ms(100.0)  # past the doomed deadline
+    sched.flush()
+    with pytest.raises(DeadlineExceededError):
+        f_doomed.result()
+    assert sched.stats.deadline_failures == 1
+    np.testing.assert_allclose(f_ok1.result(), a @ x_ok1, rtol=1e-5)
+    np.testing.assert_allclose(f_ok2.result(), a @ x_ok2, rtol=1e-5)
+    # The survivors coalesced into one width-2 batch (the doomed block's
+    # columns were sliced out before dispatch, not zeroed or served).
+    assert f_ok1.batch_width == 2 and f_ok2.batch_width == 2
+    assert eng.stats.dispatches > before
+    # Bitwise: the survivor batch is exactly a width-2 submit.
+    direct = eng.submit(np.stack([x_ok1, x_ok2], axis=1)).result()
+    np.testing.assert_array_equal(f_ok1.result(), direct[:, 0])
+    np.testing.assert_array_equal(f_ok2.result(), direct[:, 1])
+
+
+def test_queued_deadline_pulls_flush_forward(devices, rng):
+    """A queued (not bypassed) deadline caps the batch's planned flush
+    time — the scheduler never *plans* to hold a request past its
+    deadline."""
+    clock = FakeClock()
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, window_ms=50.0, clock=clock)
+    sched.submit(rng.uniform(0, 10, (64,)).astype(np.float32))
+    assert sched._flush_at == pytest.approx(clock() + 0.050)
+    sched.submit(
+        rng.uniform(0, 10, (64,)).astype(np.float32), deadline_ms=60.0
+    )
+    # 60 ms > 50 ms window: queued, and flush_at stays the earlier window.
+    assert sched._flush_at == pytest.approx(clock() + 0.050)
+    sched2_deadline = 55.0
+    sched.submit(
+        rng.uniform(0, 10, (64,)).astype(np.float32),
+        deadline_ms=sched2_deadline,
+    )
+    assert sched._flush_at <= clock() + sched2_deadline / 1e3
+    sched.flush()
+
+
+def test_interactive_qos_flushes_pending_now(devices, rng):
+    """interactive coalesces with whatever is already waiting and
+    dispatches immediately — zero added wait, amortization included."""
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width=8)
+    x1 = rng.uniform(0, 10, (64,)).astype(np.float32)
+    x2 = rng.uniform(0, 10, (64,)).astype(np.float32)
+    f1 = sched.submit(x1)
+    f2 = sched.submit(x2, qos="interactive")
+    assert f1._event.is_set() and f2._event.is_set()
+    assert f1.coalesced and f2.coalesced and f2.batch_width == 2
+    np.testing.assert_allclose(f2.result(), a @ x2, rtol=1e-5)
+    assert eng.stats.requests == 1
+
+
+def test_bulk_qos_waits_the_full_cap(devices, rng):
+    """bulk arrivals never shorten the window below the cap; a later
+    standard arrival pulls the flush forward."""
+    clock = FakeClock()
+    a, eng = make_engine(rng)
+    sched = ArrivalWindowScheduler(
+        eng, window_ms="auto", max_window_ms=10.0, flush_width=8,
+        auto_flush=False, clock=clock,
+    )
+    sched.submit(rng.uniform(0, 10, (64,)).astype(np.float32), qos="bulk")
+    assert sched._flush_at == pytest.approx(clock() + 0.010)
+    # Standard request at (estimated) zero rate: adaptive window ~ 0.
+    sched.submit(rng.uniform(0, 10, (64,)).astype(np.float32))
+    assert sched._flush_at < clock() + 0.001
+    sched.flush()
+
+
+# --------------------------------------------------------- adaptive window
+
+
+def test_adaptive_window_grows_with_rate(devices, rng):
+    """The admission window is ~0 at low arrival rate (latency flat for
+    lone requests) and saturates toward the cap under load."""
+    clock = FakeClock()
+    a, eng = make_engine(rng)
+    sched = ArrivalWindowScheduler(
+        eng, window_ms="auto", max_window_ms=2.0, flush_width=8,
+        auto_flush=False, clock=clock, rate_tau_s=0.25,
+    )
+    assert sched.current_window_ms() == 0.0  # no traffic yet
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    # Offer ~2000 req/s for a while: lambda = 2000 * 2ms = 4 -> w = 1.6ms.
+    for _ in range(300):
+        clock.advance_ms(0.5)
+        sched.submit(x)
+        if sched.pending_width >= 8:
+            sched.flush()
+    w_loaded = sched.current_window_ms()
+    assert 1.0 < w_loaded < 2.0
+    # Traffic stops: the estimate decays and the window shrinks.
+    clock.advance_ms(2000.0)
+    assert sched.current_window_ms() < 0.1
+    sched.flush()
+
+
+def test_fixed_window_zero_flushes_every_submit_via_flusher(devices, rng):
+    """window_ms=0: a lone request's batch is due immediately — the
+    flusher dispatches it without partners (real clock)."""
+    a, eng = make_engine(rng)
+    sched = ArrivalWindowScheduler(eng, window_ms=0.0, flush_width=8)
+    try:
+        x = rng.uniform(0, 10, (64,)).astype(np.float32)
+        fut = sched.submit(x)
+        np.testing.assert_allclose(
+            fut.result(timeout=30.0), a @ x, rtol=1e-5
+        )
+        assert not fut.coalesced
+    finally:
+        sched.close()
+
+
+# ------------------------------------------- tuned flush threshold (b*)
+
+
+@pytest.fixture()
+def cache_path(tmp_path, monkeypatch):
+    path = tmp_path / "tuning_cache.json"
+    monkeypatch.setenv("MATVEC_TUNING_CACHE", str(path))
+    reset_cache()
+    yield path
+    reset_cache()
+
+
+def test_flush_width_auto_consults_tune_promotion(devices, rng, cache_path):
+    a, _ = make_engine(rng)
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        promote_key("rowwise", 64, 64, 8, "float32"),
+        {"b_star": 6, "seq_time_s": 1e-5, "gemm_times": {"6": 1e-5}},
+    )
+    cache.save()
+    reset_cache()
+    _, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width="auto")
+    assert sched.flush_width == 6
+
+
+def test_flush_width_cold_cache_uses_static_default(
+    devices, rng, cache_path
+):
+    """The cold-cache path: no tuned decision -> DEFAULT_PROMOTE_B, not a
+    crash and not a hardcoded magic width."""
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width="auto")
+    assert sched.flush_width == DEFAULT_PROMOTE_B
+
+
+def test_flush_width_never_won_accumulates_to_max_bucket(
+    devices, rng, cache_path
+):
+    """b_star=null (promotion measurably never won) is not a miss: the
+    scheduler accumulates to the widest bucket instead."""
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        promote_key("rowwise", 64, 64, 8, "float32"),
+        {"b_star": None, "seq_time_s": 1e-5, "gemm_times": {"4": 9.0}},
+    )
+    cache.save()
+    reset_cache()
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width="auto")
+    assert sched.flush_width == eng.max_bucket
+
+
+def test_flush_width_clamps_to_max_bucket(devices, rng, cache_path):
+    cache = TuningCache.load(cache_path)
+    cache.record(
+        promote_key("rowwise", 64, 64, 8, "float32"),
+        {"b_star": 999, "seq_time_s": 1e-5, "gemm_times": {"8": 1e-5}},
+    )
+    cache.save()
+    reset_cache()
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width="auto")
+    assert sched.flush_width == eng.max_bucket
+    with pytest.raises(ConfigError):
+        make_sched(eng, flush_width=0)
+
+
+# ------------------------------------------------- backpressure & metrics
+
+
+def test_backpressure_applies_to_whole_batches(devices, rng):
+    """Flushes go through engine.submit, so the engine's max_in_flight
+    gate counts and drains whole coalesced batches — the scheduler never
+    bypasses it."""
+    a, eng = make_engine(rng, max_in_flight=1)
+    sched = make_sched(eng, flush_width=2)
+    X = rng.uniform(0, 10, (64, 6)).astype(np.float32)
+    futs = []
+    for j in range(0, 6, 2):
+        futs.append(sched.submit(X[:, j]))
+        futs.append(sched.submit(X[:, j + 1]))
+        sched.flush()
+    for j, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(), a @ X[:, j], rtol=1e-5)
+    assert eng.stats.requests == 3
+    assert eng.stats.in_flight <= 1
+
+
+def test_scheduler_metrics_and_amortized_bytes(devices, rng):
+    a, eng = make_engine(rng)  # 64x64 f32: A = 16384 bytes
+    sched = make_sched(eng, flush_width=8)
+    X = rng.uniform(0, 10, (64, 4)).astype(np.float32)
+    futs = [sched.submit(X[:, j]) for j in range(4)]
+    sched.flush()
+    for f in futs:
+        f.result()
+    snap = eng.metrics.snapshot()
+    c = snap["counters"]
+    assert c["sched_requests_total"] == 4
+    assert c["sched_batches_total"] == 1
+    assert c["sched_coalesced_requests_total"] == 4
+    # Alone: 4 matvec dispatches re-read A 4x; coalesced (width 4 = b*):
+    # ONE bucket-4 GEMM -> 3 re-reads saved.
+    assert c["sched_amortized_bytes_total"] == 3 * 64 * 64 * 4
+    h = snap["histograms"]["sched_batch_width"]
+    assert h["count"] == 1 and h["sum"] == 4.0
+    assert "sched_arrival_req_per_s" in snap["gauges"]
+    assert "sched_coalesce_window_ms" in snap["gauges"]
+    stats = sched.stats
+    assert stats.mean_batch_width == 4.0
+    assert stats.coalesce_ratio == 1.0
+
+
+def test_concurrent_closed_loop_hammer(devices, rng):
+    """The real threading shape: N client threads submit->result->repeat
+    through one scheduler (flusher on). Every result exact; the stream
+    coalesces (mean width > 1); the engine never recompiles."""
+    rng2 = np.random.default_rng(13)
+    a = rng2.uniform(0, 10, (64, 64)).astype(np.float32)
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="rowwise", promote=2, max_bucket=8
+    )
+    eng.warmup()
+    baseline = eng.stats.compiles
+    sched = ArrivalWindowScheduler(
+        eng, window_ms=5.0, flush_width=4, settle_ms=0.2,
+    )
+    X = rng2.uniform(0, 10, (64, 8)).astype(np.float32)
+    errors = []
+
+    def client(j):
+        try:
+            for _ in range(6):
+                y = sched.submit(X[:, j]).result(timeout=60.0)
+                np.testing.assert_allclose(y, a @ X[:, j], rtol=1e-5)
+        except BaseException as e:
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(j,)) for j in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+    sched.close()
+    assert not errors, errors
+    assert eng.stats.compiles == baseline, "steady coalesced stream compiled"
+    assert sched.stats.mean_batch_width > 1.0
+    assert sched.stats.requests == 48
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+def test_close_flushes_pending_and_refuses_new(devices, rng):
+    a, eng = make_engine(rng)
+    sched = make_sched(eng)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    fut = sched.submit(x)
+    sched.close()
+    np.testing.assert_allclose(fut.result(), a @ x, rtol=1e-5)
+    with pytest.raises(ConfigError, match="closed"):
+        sched.submit(x)
+    # The refusal is uniform across admission paths: the deadline-bypass
+    # and stale-on-arrival branches must not slip past a closed gate.
+    with pytest.raises(ConfigError, match="closed"):
+        sched.submit(x, deadline_ms=0.001)
+    with pytest.raises(ConfigError, match="closed"):
+        sched.submit(x, deadline_ms=-1.0)
+    assert eng.stats.requests == 1
+    sched.close()  # idempotent
+
+
+def test_failed_dispatch_fails_every_future_in_batch(devices, rng):
+    """engine.submit raising at flush time must fail the whole batch's
+    futures (no client hangs in result()) and leave the scheduler
+    serviceable — not kill the flusher or swallow the batch."""
+    a, eng = make_engine(rng)
+    sched = make_sched(eng)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    f1, f2 = sched.submit(x), sched.submit(x)
+    boom = RuntimeError("backend exploded")
+    real_submit = eng.submit
+    eng.submit = lambda *a, **k: (_ for _ in ()).throw(boom)
+    try:
+        sched.flush()
+    finally:
+        eng.submit = real_submit
+    for f in (f1, f2):
+        assert f.done()
+        with pytest.raises(RuntimeError, match="backend exploded"):
+            f.result()
+    # The scheduler still serves after the failed flush.
+    f3 = sched.submit(x)
+    sched.flush()
+    np.testing.assert_allclose(f3.result(), a @ x, rtol=1e-5)
+
+
+def test_context_manager(devices, rng):
+    a, eng = make_engine(rng)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    with make_sched(eng) as sched:
+        fut = sched.submit(x)
+    np.testing.assert_allclose(fut.result(), a @ x, rtol=1e-5)
+
+
+def test_result_timeout_while_window_open(devices, rng):
+    a, eng = make_engine(rng)
+    sched = make_sched(eng)
+    fut = sched.submit(rng.uniform(0, 10, (64,)).astype(np.float32))
+    with pytest.raises(TimeoutError):
+        fut.result(timeout=0.01)
+    sched.flush()
+    fut.result()
+
+
+def test_call_is_submit_result(devices, rng):
+    a, eng = make_engine(rng)
+    sched = ArrivalWindowScheduler(eng, window_ms=0.0, flush_width=8)
+    try:
+        x = rng.uniform(0, 10, (64,)).astype(np.float32)
+        np.testing.assert_allclose(sched(x), a @ x, rtol=1e-5)
+    finally:
+        sched.close()

@@ -14,9 +14,11 @@ from matvec_mpi_multiplier_tpu import make_mesh
 from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
 from matvec_mpi_multiplier_tpu.bench.serve import (
     SERVE_CSV_HEADER,
+    _arrival_gaps,
     append_serve_result,
     measure_promotion,
     run_serve,
+    run_serve_load,
     serve_csv_path,
 )
 from matvec_mpi_multiplier_tpu.engine import MatvecEngine
@@ -214,6 +216,141 @@ def test_serve_cli_obs_flags(devices, tmp_path, capsys, monkeypatch):
     assert f"metrics: {metrics_path}" in out
     assert f"trace: {trace_path}" in out
     assert metrics_path.exists() and trace_path.exists()
+
+
+# ------------------------------------------------------------- load mode
+
+
+def test_arrival_gap_processes():
+    rng = np.random.default_rng(0)
+    poisson = _arrival_gaps("poisson", 1000, rate=100.0, burst=8, rng=rng)
+    assert len(poisson) == 1000 and all(g >= 0 for g in poisson)
+    assert np.mean(poisson) == pytest.approx(0.01, rel=0.2)
+    burst = _arrival_gaps("burst", 16, rate=100.0, burst=8, rng=rng)
+    # Groups of 8 simultaneous arrivals, one group per 80 ms — the same
+    # offered rate, maximally coalescable.
+    assert burst[0] == pytest.approx(0.08) and burst[8] == pytest.approx(0.08)
+    assert all(g == 0.0 for i, g in enumerate(burst) if i % 8)
+    from matvec_mpi_multiplier_tpu.utils.errors import MatvecError
+
+    with pytest.raises(MatvecError):
+        _arrival_gaps("poisson", 4, rate=0.0, burst=8, rng=rng)
+    with pytest.raises(MatvecError):
+        _arrival_gaps("nope", 4, rate=1.0, burst=8, rng=rng)
+
+
+def test_serve_load_coalesced_closed_loop(devices):
+    """Load-mode protocol invariants: concurrent clients coalesce (mean
+    batch width > 1 in the scheduler metrics), no steady-state compiles,
+    every batching column populated."""
+    mesh = make_mesh(8)
+    result = run_serve_load(
+        "rowwise", mesh, 64, 64, n_requests=48, max_bucket=8,
+        promote=4, concurrency=4, coalesce=True, seed=0,
+    )
+    assert result.arrival == "closed" and result.concurrency == 4
+    assert result.coalesce == 1
+    assert result.compiles_steady == 0
+    assert result.mean_batch_width > 1.0
+    assert 0.0 < result.coalesce_ratio <= 1.0
+    assert result.rps > 0 and result.total_cols == 48
+    assert 0 < result.p50_dispatch_ms <= result.p99_dispatch_ms
+    # Load rows carry no promotion check.
+    assert result.promo_b == 0 and np.isnan(result.promo_speedup)
+
+
+def test_serve_load_uncoalesced_reports_nan_batching(devices):
+    mesh = make_mesh(8)
+    result = run_serve_load(
+        "rowwise", mesh, 64, 64, n_requests=24, max_bucket=8,
+        promote=4, concurrency=2, coalesce=False, seed=0,
+    )
+    assert result.coalesce == 0
+    assert np.isnan(result.mean_batch_width)
+    assert np.isnan(result.coalesce_ratio)
+    assert result.compiles_steady == 0
+
+
+def test_serve_load_open_loop_poisson_and_metrics(devices, tmp_path):
+    """Open-loop arrivals drive the scheduler; the metrics snapshot holds
+    both vocabularies (engine_* and sched_*) — the batching panel's
+    input."""
+    import json
+
+    metrics_path = tmp_path / "m.json"
+    mesh = make_mesh(8)
+    result = run_serve_load(
+        "rowwise", mesh, 64, 64, n_requests=40, max_bucket=8,
+        promote=4, concurrency=1, coalesce=True,
+        arrival="poisson", rate=2000.0, seed=0,
+        metrics_out=str(metrics_path),
+    )
+    assert result.arrival == "poisson"
+    assert result.rate_req_s == pytest.approx(2000.0)
+    assert result.compiles_steady == 0
+    snap = json.loads(metrics_path.read_text())
+    c = snap["counters"]
+    assert c["sched_requests_total"] == 40
+    assert c["sched_batches_total"] >= 1
+    assert c["engine_requests_total"] >= c["sched_batches_total"]
+    assert "sched_batch_width" in snap["histograms"]
+    assert "sched_arrival_req_per_s" in snap["gauges"]
+    assert snap["histograms"]["serve_e2e_latency_ms"]["count"] == 40
+
+
+def test_serve_load_csv_round_trip(devices, tmp_path):
+    mesh = make_mesh(8)
+    result = run_serve_load(
+        "colwise", mesh, 64, 64, n_requests=24, max_bucket=8,
+        promote=4, concurrency=4, coalesce=True, seed=0,
+    )
+    path = append_serve_result(result, tmp_path)
+    rows = read_csv(path)
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["arrival"] == "closed"
+    assert row["concurrency"] == 4 and row["coalesce"] == 1
+    assert row["mean_batch_width"] > 1.0
+    assert 0.0 < row["coalesce_ratio"] <= 1.0
+    assert path.read_text().splitlines()[0] == SERVE_CSV_HEADER
+
+
+def test_serve_cli_load_mode(devices, capsys):
+    from matvec_mpi_multiplier_tpu.bench.serve import main
+
+    rc = main([
+        "--strategy", "rowwise", "--sizes", "64", "--devices", "8",
+        "--n-requests", "16", "--max-bucket", "8", "--no-csv",
+        "--arrival", "burst", "--rate", "2000", "--burst", "4",
+        "--coalesce", "both",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serve-load rowwise 64x64 p=8 burst c=1 coalesce=off" in out
+    assert "serve-load rowwise 64x64 p=8 burst c=1 coalesce=on" in out
+    assert "2 serve configs measured" in out
+
+
+@pytest.mark.slow
+def test_serve_load_coalescing_speedup_acceptance(devices):
+    """The PR-6 acceptance criterion: at offered concurrency >= 8,
+    coalesced req/s >= 2x the uncoalesced engine path on the SAME trace,
+    with zero steady-state compiles and mean batch width > 1 (the
+    committed data/batching_demo/ capture pins the same numbers)."""
+    mesh = make_mesh(8)
+    results = {}
+    for coalesce in (False, True):
+        results[coalesce] = run_serve_load(
+            "rowwise", mesh, 512, 512, n_requests=160, max_bucket=32,
+            promote="auto", concurrency=8, coalesce=coalesce, seed=0,
+        )
+    on, off = results[True], results[False]
+    assert off.compiles_steady == 0 and on.compiles_steady == 0
+    assert on.mean_batch_width > 1.0
+    assert on.rps >= 2.0 * off.rps, (
+        f"coalesced {on.rps:.1f} req/s vs uncoalesced {off.rps:.1f} "
+        f"req/s — below the 2x acceptance bar"
+    )
 
 
 @pytest.mark.slow

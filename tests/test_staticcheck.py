@@ -105,6 +105,47 @@ RULE_FIXTURES = {
         "    acc.append(x)\n"
         "    return acc\n",
     ),
+    "scheduler-lock-across-dispatch": (
+        f"{PKG}/engine/scheduler.py",
+        # dispatch under the held admission lock: a backpressure stall
+        # would freeze every submitter
+        "class Sched:\n"
+        "    def flush(self):\n"
+        "        with self._cond:\n"
+        "            batch = list(self._pending)\n"
+        "            return self.engine.submit(batch)\n",
+        # the discipline: swap out under the lock, dispatch after release
+        "class Sched:\n"
+        "    def flush(self):\n"
+        "        with self._cond:\n"
+        "            batch = list(self._pending)\n"
+        "        return self.engine.submit(batch)\n",
+    ),
+}
+
+# The PR-6 scope-extension pins: the engine host-sync and hot-path I/O
+# rules cover engine/scheduler.py by construction (engine/ prefix scope) —
+# each gets its own known-bad fixture AT that path so a future scope
+# narrowing cannot silently uncover the flush loop.
+SCHEDULER_SCOPE_FIXTURES = {
+    "engine-host-sync": (
+        f"{PKG}/engine/scheduler.py",
+        "import numpy as np\n"
+        "def flush(self, batch):\n"
+        "    return [np.asarray(p.block) for p in batch]\n",
+        "import numpy as np\n"
+        "def flush(self, batch):\n"
+        "    return [np.asarray(p.block) for p in batch]  # sync-ok: seeded host staging\n",
+    ),
+    "hot-path-blocking-io": (
+        f"{PKG}/engine/scheduler.py",
+        "import json\n"
+        "def flush(self, batch, path):\n"
+        "    json.dump([p.width for p in batch], open(path, 'w'))\n",
+        "import json\n"
+        "def describe():\n"
+        "    return 'batch logs go through obs/sink.py, never json.dump'\n",
+    ),
 }
 
 
@@ -134,6 +175,41 @@ def test_rule_flags_bad_and_passes_clean(rule, tmp_path):
     assert not [f for f in found if f.rule == rule], (
         f"{rule} flagged its clean/marked twin: {found}"
     )
+
+
+@pytest.mark.parametrize("rule", sorted(SCHEDULER_SCOPE_FIXTURES))
+def test_rule_covers_scheduler_module(rule, tmp_path):
+    """The flush loop's home (engine/scheduler.py) is inside the engine
+    rules' scope: a seeded violation there must be flagged, and its
+    marked/clean twin must pass."""
+    rel, bad, clean = SCHEDULER_SCOPE_FIXTURES[rule]
+    _seed(tmp_path, rel, bad)
+    found = run_rules(root=tmp_path, rules=[rule])
+    assert any(f.rule == rule and f.path == rel for f in found), (
+        f"{rule} does not cover {rel}: {found}"
+    )
+    _seed(tmp_path, rel, clean)
+    found = run_rules(root=tmp_path, rules=[rule])
+    assert not [f for f in found if f.rule == rule], found
+
+
+def test_lock_rule_ignores_deferred_bodies_and_nonlock_contexts(tmp_path):
+    """A function defined (not called) under the lock runs later — not a
+    finding; a non-lock context manager (e.g. a span) is not a lock."""
+    _seed(
+        tmp_path, f"{PKG}/engine/scheduler.py",
+        "class Sched:\n"
+        "    def flush(self):\n"
+        "        with self._cond:\n"
+        "            def later():\n"
+        "                return self.engine.submit(None)\n"
+        "            self._callback = later\n"
+        "        with self.trace.span('dispatch'):\n"
+        "            return self.engine.submit(None)\n",
+    )
+    assert run_rules(
+        root=tmp_path, rules=["scheduler-lock-across-dispatch"]
+    ) == []
 
 
 def test_shard_map_rule_catches_top_level_and_bare_alias(tmp_path):
