@@ -33,9 +33,21 @@ and the batching-efficiency columns: mean batch width and coalesce ratio
 uncoalesced then coalesced — the committed ``data/batching_demo/``
 capture's protocol, and the ≥2× acceptance comparison.
 
+**Chaos mode** (``--fault-spec``; docs/RESILIENCE.md) arms a seeded
+:class:`~..resilience.FaultPlan` on the engine's compile/dispatch sites
+and (by default) the retry + circuit-breaker + degradation-ladder
+recovery policy, so availability is *measured*, not assumed: the load
+loops tolerate per-request failures, and every row carries
+``success_rate`` / ``failed_requests`` (fault failures — deadline
+failures stay in the ``*_deadline_failures`` counters, so the two are
+distinguishable) plus the ``retries`` / ``downgrades`` recovery tallies.
+``--poison-rate`` marks a seeded fraction of requests with a payload
+signature a poison fault spec matches — the deterministic "bad request"
+whose blast radius the scheduler's batch bisection must contain.
+
 Rows land in ``data/out/serve_<strategy>.csv`` (``--data-root`` to
-redirect; the committed demos live under ``data/engine_demo/`` and
-``data/batching_demo/``).
+redirect; the committed demos live under ``data/engine_demo/``,
+``data/batching_demo/`` and ``data/resilience_demo/``).
 
 Usage::
 
@@ -82,7 +94,20 @@ from ..engine import (
 )
 from ..models import available_strategies
 from ..obs.registry import MetricsRegistry
-from ..utils.errors import MatvecError
+from ..resilience import (
+    FaultPlan,
+    FaultSpec,
+    ResiliencePolicy,
+    RetryPolicy,
+    parse_fault_spec,
+)
+from ..utils.errors import ConfigError, DeadlineExceededError, MatvecError
+
+# The payload signature --poison-rate plants in row 0 of a poisoned
+# request (and the matching FaultSpec(poison=...) keys on): far outside
+# the uniform(0, 10) request distribution, exactly representable in every
+# served float dtype.
+POISON_SIGNATURE = 1e30
 
 # Default request-width mix: single vectors through full buckets, with
 # off-bucket widths (3, 6, 12, 24) so the pad/unpad path is always
@@ -100,7 +125,7 @@ SERVE_CSV_HEADER = (
     "p50_dispatch_ms, p99_dispatch_ms, compiles_warmup, compiles_steady, "
     "hits_steady, promo_b, promo_gemm_s, promo_seq_s, promo_speedup, "
     "arrival, rate_req_s, concurrency, coalesce, mean_batch_width, "
-    "coalesce_ratio"
+    "coalesce_ratio, success_rate, failed_requests, retries, downgrades"
 )
 
 
@@ -141,6 +166,22 @@ class ServeResult:
     coalesce: int = 0
     mean_batch_width: float = float("nan")
     coalesce_ratio: float = float("nan")
+    # Availability columns (chaos mode / ISSUE 7): failed_requests counts
+    # FAULT failures — requests whose result() raised something other
+    # than a deadline (those stay in the *_deadline_failures counters, so
+    # the two failure classes are distinguishable); retries/downgrades
+    # are the recovery policy's tallies (0 without --fault-spec).
+    failed_requests: int = 0
+    retries: int = 0
+    downgrades: int = 0
+
+    @property
+    def success_rate(self) -> float:
+        """Fraction of offered requests that returned a result (fault
+        failures excluded; 1.0 for a fault-free run)."""
+        if self.n_requests == 0:
+            return float("nan")
+        return (self.n_requests - self.failed_requests) / self.n_requests
 
     @property
     def rps(self) -> float:
@@ -186,7 +227,8 @@ def append_serve_result(result: ServeResult, root=None):
         f"{result.promo_speedup:.3f}, {result.arrival}, "
         f"{result.rate_req_s:.2f}, {result.concurrency}, "
         f"{result.coalesce}, {result.mean_batch_width:.3f}, "
-        f"{result.coalesce_ratio:.3f}"
+        f"{result.coalesce_ratio:.3f}, {result.success_rate:.4f}, "
+        f"{result.failed_requests}, {result.retries}, {result.downgrades}"
     )
     _append_row(path, SERVE_CSV_HEADER, row)
     return path
@@ -271,12 +313,20 @@ def _arrival_gaps(
 
 
 def _closed_loop(
-    submit, blocks: Sequence[np.ndarray], concurrency: int, hist
+    submit, blocks: Sequence[np.ndarray], concurrency: int, hist,
+    fail_counter=None,
 ) -> float:
     """Closed-loop load: ``concurrency`` client threads, each
     submit→materialize→repeat over its slice of the request trace (the
     classic offered-concurrency protocol). Returns steady-phase wall
-    seconds; per-request END-TO-END latency lands in ``hist``."""
+    seconds; per-request END-TO-END latency lands in ``hist``.
+
+    With ``fail_counter`` (chaos mode) a request failing with a
+    framework fault — injected device error, integrity-gate refusal —
+    is counted and the client moves on (availability is the measured
+    quantity); deadline failures are already counted by the admission
+    gates, and anything non-framework still aborts the run (a bench bug
+    must not read as downtime)."""
     barrier = threading.Barrier(concurrency + 1)
     errors: list[BaseException] = []
 
@@ -285,7 +335,17 @@ def _closed_loop(
             barrier.wait()
             for i in range(tid, len(blocks), concurrency):
                 t0 = time.perf_counter()
-                submit(blocks[i]).result()
+                try:
+                    # An uncoalesced poisoned dispatch raises from
+                    # submit() itself; a coalesced one from result().
+                    submit(blocks[i]).result()
+                except DeadlineExceededError:
+                    continue  # tallied by the gate's deadline counters
+                except MatvecError:
+                    if fail_counter is None:
+                        raise
+                    fail_counter.inc()
+                    continue
                 hist.observe((time.perf_counter() - t0) * 1e3)
         except BaseException as e:  # surface on the driver thread
             errors.append(e)
@@ -308,12 +368,15 @@ def _closed_loop(
 
 def _open_loop(
     submit, blocks: Sequence[np.ndarray], gaps: Sequence[float], hist,
-    flush=None,
+    flush=None, fail_counter=None,
 ) -> float:
     """Open-loop load: requests arrive on the precomputed gap schedule
     regardless of completion (one submitter thread paces arrivals; one
     drainer thread materializes in order and records arrival→result
-    latency). Returns wall seconds from first arrival to last result."""
+    latency). Returns wall seconds from first arrival to last result.
+    ``fail_counter`` as in :func:`_closed_loop` — chaos-mode fault
+    failures are counted, tolerated, and excluded from the latency
+    histogram."""
     results: queue.Queue = queue.Queue()
     errors: list[BaseException] = []
 
@@ -325,6 +388,14 @@ def _open_loop(
             t_arrival, fut = item
             try:
                 fut.result()
+            except DeadlineExceededError:
+                continue  # tallied by the gate's deadline counters
+            except MatvecError as e:
+                if fail_counter is None:
+                    errors.append(e)
+                else:
+                    fail_counter.inc()
+                continue
             except BaseException as e:
                 errors.append(e)
                 continue
@@ -341,7 +412,18 @@ def _open_loop(
             if now >= next_at:
                 break
             time.sleep(min(next_at - now, 5e-4))
-        results.put((time.perf_counter(), submit(x)))
+        try:
+            results.put((time.perf_counter(), submit(x)))
+        except MatvecError as e:
+            # An uncoalesced poisoned dispatch raises at submit() on the
+            # pacing thread; chaos mode counts it and keeps the arrival
+            # schedule, anything else still aborts the run. (Deadline
+            # expiry never raises from submit — it returns a failed
+            # future, handled by the drainer.)
+            if fail_counter is None:
+                errors.append(e)
+            else:
+                fail_counter.inc()
     if flush is not None:
         flush()  # fence the open window so the drain is prompt
     results.put(None)
@@ -378,28 +460,89 @@ def run_serve_load(
     seed: int = 0,
     metrics_out: str | None = None,
     trace_jsonl: str | None = None,
+    fault_spec: str | None = None,
+    fault_seed: int = 0,
+    poison_rate: float = 0.0,
+    integrity_gate: bool = False,
+    resilience: bool | None = None,
+    breaker_reset_s: float = 30.0,
 ) -> ServeResult:
     """Run the load protocol for one (strategy, shape, mesh, traffic)
     config: realistic concurrent/open-loop traffic, optionally coalesced
     through the arrival-window scheduler. The request trace (widths +
     payloads, seeded) is identical for coalesced and uncoalesced runs of
     the same config — the acceptance comparison is same-trace by
-    construction."""
+    construction.
+
+    Chaos mode (module docstring): ``fault_spec`` arms a seeded
+    FaultPlan; ``poison_rate`` marks a seeded fraction of requests with
+    :data:`POISON_SIGNATURE` and appends a persistent poison fault spec;
+    ``resilience`` (default: on whenever faults are armed) enables the
+    engine's retry/breaker/ladder policy with ``breaker_reset_s``
+    cooldowns; ``integrity_gate`` arms the NaN/Inf materialize gate."""
     from ..utils.io import generate_matrix
 
     if widths is None:
         widths = [w for w in LOAD_WIDTH_MIX if w <= max_bucket]
     a = generate_matrix(m, k, seed=seed).astype(dtype)
     registry = MetricsRegistry()
+
+    if not (0.0 <= poison_rate <= 1.0):
+        raise ConfigError(
+            f"poison_rate must be in [0, 1], got {poison_rate}"
+        )
+    chaos = fault_spec is not None or poison_rate > 0
+    plan = None
+    if chaos:
+        specs = (
+            parse_fault_spec(fault_spec, seed=fault_seed).specs
+            if fault_spec is not None else ()
+        )
+        if poison_rate > 0:
+            specs = specs + (FaultSpec(
+                site="dispatch", kind="device_error",
+                poison=POISON_SIGNATURE,
+            ),)
+        plan = FaultPlan(specs, seed=fault_seed)
+    if resilience is None:
+        resilience = chaos
+    policy = (
+        ResiliencePolicy(
+            retry=RetryPolicy(seed=fault_seed),
+            breaker_reset_s=breaker_reset_s,
+        )
+        if resilience else None
+    )
+
     engine = MatvecEngine(
         a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
         stages=stages, dtype=dtype, max_bucket=max_bucket, promote=promote,
         donate=donate, metrics=registry, trace_jsonl=trace_jsonl,
+        fault_plan=plan, resilience=policy, integrity_gate=integrity_gate,
     )
     latency_hist = registry.histogram(
         "serve_e2e_latency_ms",
         "steady-phase submit-entry to materialized-result host time",
         window=max(n_requests, 1),
+    )
+    fail_counter = (
+        registry.counter(
+            "serve_failed_requests_total",
+            "steady-phase requests whose result() raised a fault "
+            "(deadline failures counted separately)",
+        )
+        if chaos else None
+    )
+    # The availability denominator: STEADY-PHASE offered requests. The
+    # obs `resilience` panel divides failures by this — engine_requests_
+    # total would also count warmup submits and overstate availability on
+    # uncoalesced runs.
+    req_counter = (
+        registry.counter(
+            "serve_requests_total",
+            "steady-phase offered requests (the availability denominator)",
+        )
+        if chaos else None
     )
     pool = _request_pool(k, widths, engine.dtype, seed=seed + 1)
     rng = np.random.default_rng(seed + 2)
@@ -408,6 +551,16 @@ def run_serve_load(
         pool[w] if pool[w].shape[1] > 1 else pool[w][:, 0]
         for w in sequence
     ]
+    if poison_rate > 0:
+        # Seeded poison set: copies (the pool blocks are shared across
+        # requests) with the signature planted where the poison fault
+        # spec looks for it — row 0.
+        poison_rng = np.random.default_rng(seed + 4)
+        n_poisoned = max(1, int(round(poison_rate * n_requests)))
+        for i in poison_rng.choice(n_requests, size=n_poisoned, replace=False):
+            block = np.array(blocks[i])
+            block[0] = engine.dtype.type(POISON_SIGNATURE)
+            blocks[i] = block
 
     scheduler = (
         ArrivalWindowScheduler(
@@ -421,9 +574,13 @@ def run_serve_load(
         # ---- warmup: the whole ladder — coalesced widths are emergent,
         # so every bucket a flush could land on must be compiled AND run
         # once (first execution of an AOT program carries one-time costs
-        # a p99 must not absorb) ----
+        # a p99 must not absorb). Chaos spares warmup: the plan is
+        # disarmed here and armed at the steady phase, so fault event
+        # ordinals start at zero at a deterministic point ----
         from ..engine import bucket_ladder
 
+        if plan is not None:
+            plan.disarm()
         engine.warmup()
         _drain([engine.submit(pool[w]) for w in sorted(set(sequence))])
         if engine.b_star is not None:
@@ -436,10 +593,17 @@ def run_serve_load(
             ])
         warm_stats = engine.stats
         compiles_warmup = warm_stats.compiles
+        if plan is not None:
+            plan.arm()
+        if req_counter is not None:
+            req_counter.inc(n_requests)
 
         # ---- steady phase under load ----
         if arrival == "closed":
-            wall = _closed_loop(submit, blocks, concurrency, latency_hist)
+            wall = _closed_loop(
+                submit, blocks, concurrency, latency_hist,
+                fail_counter=fail_counter,
+            )
         else:
             gaps = _arrival_gaps(
                 arrival, n_requests, rate, burst,
@@ -448,6 +612,7 @@ def run_serve_load(
             wall = _open_loop(
                 submit, blocks, gaps, latency_hist,
                 flush=scheduler.flush if scheduler is not None else None,
+                fail_counter=fail_counter,
             )
         steady_stats = engine.stats
         if scheduler is not None:
@@ -459,6 +624,21 @@ def run_serve_load(
     finally:
         if scheduler is not None:
             scheduler.close()
+    if plan is not None:
+        for spec in plan.summary()["specs"]:
+            if spec["site"] == "compile" and spec["matched"] == 0:
+                # Warmup pre-compiles every preferred ExecKey while the
+                # plan is disarmed, so a compile spec aimed at a
+                # preferred config never sees an event — the run would
+                # silently measure nothing at that site.
+                print(
+                    "WARNING: compile fault spec "
+                    f"(key={spec['key']!r}) matched 0 events — warmup "
+                    "pre-compiles preferred configs; compile faults "
+                    "only fire for executables first compiled in the "
+                    "steady phase (fallback tiers, shrunken buckets)",
+                    file=sys.stderr,
+                )
     if trace_jsonl is not None:
         if not engine.flush_traces():
             print(
@@ -466,8 +646,11 @@ def run_serve_load(
                 "the file is missing or incomplete", file=sys.stderr,
             )
         engine.close()
+    snap_counters = registry.snapshot()["counters"]
     if metrics_out is not None:
         _ = engine.stats  # refresh the in_flight gauge before exporting
+        if chaos or resilience:
+            engine.health()  # refresh the breaker gauge the same way
         path = Path(metrics_out)
         path.parent.mkdir(parents=True, exist_ok=True)
         path.write_text(json.dumps(registry.snapshot(), indent=2) + "\n")
@@ -498,6 +681,9 @@ def run_serve_load(
         coalesce=int(coalesce),
         mean_batch_width=mean_batch_width,
         coalesce_ratio=coalesce_ratio,
+        failed_requests=snap_counters.get("serve_failed_requests_total", 0),
+        retries=snap_counters.get("resil_retries_total", 0),
+        downgrades=snap_counters.get("resil_downgrades_total", 0),
     )
 
 
@@ -706,14 +892,20 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
     arrival = getattr(args, "arrival", "closed") or "closed"
     concurrency = getattr(args, "concurrency", None) or [1]
     coalesce_arg = getattr(args, "coalesce", None)
+    fault_spec = getattr(args, "fault_spec", None)
+    poison_rate = getattr(args, "poison_rate", 0.0) or 0.0
     # Load mode engages when the traffic shape asks for it: an open-loop
-    # arrival process, offered concurrency, or an explicit coalesce
-    # request. The bare legacy invocation stays on the sequential
-    # protocol (promotion check included).
+    # arrival process, offered concurrency, an explicit coalesce
+    # request, or chaos mode (faults are a load-protocol feature — the
+    # loops there tolerate per-request failures). The bare legacy
+    # invocation stays on the sequential protocol (promotion check
+    # included).
     load_mode = (
         arrival != "closed"
         or any(c > 1 for c in concurrency)
         or coalesce_arg is not None
+        or fault_spec is not None
+        or poison_rate > 0
     )
     # Uncoalesced first so `--coalesce both` leaves the coalesced run's
     # snapshot in --metrics-out (the batching panel's input).
@@ -789,6 +981,15 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                                 seed=args.seed,
                                 metrics_out=metrics_out,
                                 trace_jsonl=trace_jsonl,
+                                fault_spec=fault_spec,
+                                fault_seed=getattr(args, "fault_seed", 0),
+                                poison_rate=poison_rate,
+                                integrity_gate=getattr(
+                                    args, "integrity_gate", False
+                                ),
+                                breaker_reset_s=getattr(
+                                    args, "breaker_reset_s", 30.0
+                                ),
                             )
                         except MatvecError as e:
                             print(
@@ -802,6 +1003,14 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                             )
                         else:
                             path = None
+                        chaos_suffix = (
+                            f" ok={result.success_rate:.3f} "
+                            f"failed={result.failed_requests} "
+                            f"retries={result.retries} "
+                            f"downgrades={result.downgrades}"
+                            if (fault_spec is not None or poison_rate > 0)
+                            else ""
+                        )
                         print(
                             f"serve-load {name} {m}x{k} p={n_dev} "
                             f"{arrival} c={n_clients} "
@@ -813,6 +1022,7 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                             f"ratio={result.coalesce_ratio:.2f} "
                             f"compiles={result.compiles_warmup}+"
                             f"{result.compiles_steady}"
+                            + chaos_suffix
                         )
                         if path is not None:
                             print(f"CSV: {path}")
@@ -901,6 +1111,40 @@ def build_parser() -> argparse.ArgumentParser:
         "--flush-width", default="auto",
         help="batch width that flushes the window early: 'auto' (the "
         "tuned promotion point b*) or an int",
+    )
+    p.add_argument(
+        "--fault-spec", default=None, metavar="SPEC",
+        help="chaos mode: seeded fault-injection plan, e.g. "
+        "'dispatch:device_error:p=0.05;dispatch:nan:times=2' "
+        "(grammar: resilience/faults.py; engages load mode and, by "
+        "default, the retry/breaker recovery policy — see "
+        "docs/RESILIENCE.md). NOTE compile-site specs only fire for "
+        "executables NOT pre-compiled by warmup (fallback tiers, "
+        "shrunken buckets) — preferred configs are warm by the time "
+        "the plan arms; the bench warns when a compile spec never "
+        "matched",
+    )
+    p.add_argument(
+        "--fault-seed", type=int, default=0,
+        help="seed for the FaultPlan's deterministic injection draws "
+        "(and the retry policy's jitter)",
+    )
+    p.add_argument(
+        "--poison-rate", type=float, default=0.0,
+        help="chaos mode: fraction of requests (seeded choice) marked "
+        "with the poison payload signature — each fails its dispatch "
+        "deterministically, exercising the scheduler's batch bisection",
+    )
+    p.add_argument(
+        "--integrity-gate", action="store_true",
+        help="refuse NaN/Inf results at materialization "
+        "(engine_integrity_failures_total counts refusals; with "
+        "coalescing the gate applies per request slice)",
+    )
+    p.add_argument(
+        "--breaker-reset-s", type=float, default=30.0,
+        help="chaos mode: circuit-breaker open->half-open cooldown "
+        "seconds (lower it so short traces exercise recovery)",
     )
     p.add_argument(
         "--tune", action="store_true",

@@ -2,13 +2,16 @@
 
 The serving-shape subsystem (ROADMAP north star): where ``bench/`` measures
 one matvec at a time, this package serves a *stream* of right-hand sides —
-shape-bucketed, AOT-compiled, buffer-donating, GEMV→GEMM-promoting, and
-(``scheduler.py``) continuously batched: an arrival-window scheduler
-coalesces concurrent requests into one column-stacked multi-RHS dispatch.
-See ``core.py`` for the engine architecture, ``buckets.py`` for the shape
-ladder, ``executables.py`` for the AOT cache, ``scheduler.py`` for
-coalescing, and ``docs/SERVING.md`` for usage. Benchmarked by
-``bench/serve.py`` (``--op serve``).
+shape-bucketed, AOT-compiled, buffer-donating, GEMV→GEMM-promoting,
+(``scheduler.py``) continuously batched — an arrival-window scheduler
+coalesces concurrent requests into one column-stacked multi-RHS dispatch —
+and fault-tolerant (``resilience/``): retry + per-ExecKey circuit
+breakers behind a degradation ladder, coalesced-batch bisection, and an
+optional result-integrity gate. See ``core.py`` for the engine
+architecture, ``buckets.py`` for the shape ladder, ``executables.py`` for
+the AOT cache, ``scheduler.py`` for coalescing, ``docs/SERVING.md`` /
+``docs/RESILIENCE.md`` for usage. Benchmarked by ``bench/serve.py``
+(``--op serve``; chaos mode via ``--fault-spec``).
 """
 
 from .buckets import (

@@ -50,11 +50,32 @@ thread's JSONL file. Recording is lock-free on the dispatch path (list
 mutation + queue put; see ``obs/tracing.py``), and the I/O lint
 (``tests/test_lint.py``) keeps blocking file writes off this module
 entirely.
+
+Fault tolerance (``resilience/``; full doctrine in docs/RESILIENCE.md):
+with a :class:`~..resilience.ResiliencePolicy` the engine stops treating
+a compile/dispatch exception as the request's fate. Each dispatch walks a
+**degradation ladder** of config levels — the preferred (strategy ×
+kernel × combine@S) program first, then the safe un-staged ``xla`` tier,
+and for block requests the per-column GEMV floor — with a per-ExecKey
+**circuit breaker** gating each level (repeated failure of an exotic
+config opens its breaker, so later requests skip straight to the
+fallback; after the cooldown one request probes the preferred config and
+a success restores it). *Retryable* faults get bounded backoff retries
+within a level; RESOURCE_EXHAUSTED on a block dispatch shrinks the
+bucket (two half-width dispatches) instead. Every reroute is counted
+(``resil_*`` metrics) and visible in :meth:`MatvecEngine.health`. A
+seeded :class:`~..resilience.FaultPlan` hooks the compile and dispatch
+sites so all of this is deterministically testable; an optional
+NaN/Inf **integrity gate** at materialization refuses to serve corrupt
+results. All of it is pay-for-what-you-use: with no policy, no plan and
+no gate, the dispatch path is byte-for-byte the old one.
 """
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
 import time
 from collections import deque
 from typing import Callable, Sequence
@@ -67,6 +88,18 @@ from ..models.base import MatvecStrategy, mesh_size
 from ..obs.registry import MetricsRegistry
 from ..obs.sink import JsonlSink
 from ..obs.tracing import ActiveTrace, RequestTracer
+from ..resilience.faults import (
+    FaultPlan,
+    ResultIntegrityError,
+    is_payload_fault,
+    refuse_nonfinite,
+)
+from ..resilience.policy import (
+    BREAKER_CLOSED,
+    CircuitBreaker,
+    ResiliencePolicy,
+    classify_failure,
+)
 from ..utils.errors import ConfigError, DeadlineExceededError
 from .buckets import (
     DEFAULT_MAX_BUCKET,
@@ -76,6 +109,11 @@ from .buckets import (
     split_widths,
 )
 from .executables import ExecKey, ExecStats, ExecutableCache
+
+# The degradation floor's local kernel: the portable tier every backend
+# compiles (the pallas/native tiers are exactly the exotic configs a
+# breaker may be routing around).
+SAFE_KERNEL = "xla"
 
 # Static promotion default on a tuning-cache miss: one GEMM dispatch
 # replaces 4+ GEMV dispatches. Conservative on purpose — at b=4 the block
@@ -96,15 +134,20 @@ class MatvecFuture:
 
     def __init__(
         self,
-        parts: Sequence[tuple[jax.Array, int | None]],
+        parts: Sequence[tuple],
         vector: bool,
         trace: ActiveTrace | None = None,
         materialize_hist=None,
+        integrity_counter=None,
     ):
-        # parts: (device_array, width) — width=None marks a rank-1 single
-        # column; an int marks a rank-2 block whose first `width` columns
-        # are real (the rest is bucket padding).
-        self._parts = list(parts)
+        # parts: (device_array, width[, corrupt]) — width=None marks a
+        # rank-1 single column; an int marks a rank-2 block whose first
+        # `width` columns are real (the rest is bucket padding). corrupt
+        # marks a part an injected "nan" fault poisons at materialization
+        # (resilience/faults.py — simulated silent device corruption).
+        self._parts = [
+            (p[0], p[1], bool(p[2]) if len(p) > 2 else False) for p in parts
+        ]
         self._vector = vector
         self._error: Exception | None = None
         # Request-lifecycle trace: opened by submit, completed here — the
@@ -112,6 +155,9 @@ class MatvecFuture:
         # whichever thread materializes (sequential hand-off; tracing.py).
         self._trace = trace
         self._materialize_hist = materialize_hist
+        # Non-None enables the NaN/Inf integrity gate: result() refuses to
+        # return a non-finite block (ResultIntegrityError), counting here.
+        self._integrity_counter = integrity_counter
 
     @classmethod
     def failed(
@@ -127,20 +173,48 @@ class MatvecFuture:
         """The raw (still padded) device arrays — for callers chaining
         device-side work without materializing (empty for a failed
         future)."""
-        return [arr for arr, _ in self._parts]
+        return [arr for arr, _, _ in self._parts]
 
     def done(self) -> bool:
         """True when every part's device computation has completed (never
         blocks). A failed future is done by definition."""
         return all(
             bool(arr.is_ready()) if hasattr(arr, "is_ready") else True
-            for arr, _ in self._parts
+            for arr, _, _ in self._parts
         )
 
     def exception(self) -> Exception | None:
         """The failure this future carries (DeadlineExceededError), or
         None for a dispatched request."""
         return self._error
+
+    @staticmethod
+    def _host_part(arr, corrupt: bool) -> np.ndarray:
+        """Host copy of one part, with injected NaN corruption applied —
+        the simulated silent device fault lands in element [0] / [0, 0]
+        of the part (one real column), exactly what the integrity gate
+        exists to catch."""
+        host = np.asarray(arr)  # sync-ok: caller-requested materialization
+        if corrupt and np.issubdtype(host.dtype, np.floating):
+            host = np.array(host)  # sync-ok: host-side copy of a host array (corruption needs a writable buffer)
+            host[(0, 0) if host.ndim > 1 else 0] = np.nan
+        return host
+
+    def _gate(self, out: np.ndarray) -> np.ndarray:
+        """The optional NaN/Inf integrity gate: a corrupt result raises
+        instead of being served (silent corruption becomes a loud,
+        retryable failure). The refusal is cached like any other future
+        failure — a second result() raises it again without re-counting,
+        and exception() reports it."""
+        if self._integrity_counter is not None:
+            err = refuse_nonfinite(
+                out, self._integrity_counter,
+                "the materialized result block",
+            )
+            if err is not None:
+                self._error = err
+                raise err
+        return out
 
     def result(self) -> np.ndarray:
         """Materialize on host: ``(m,)`` for a vector request, ``(m, b)``
@@ -156,18 +230,21 @@ class MatvecFuture:
         status = "ok"
         try:
             if self._vector:
-                arr, _ = self._parts[0]
-                return np.asarray(arr)  # sync-ok: caller-requested materialization
+                arr, _, corrupt = self._parts[0]
+                return self._gate(self._host_part(arr, corrupt))
             cols = []
-            for arr, width in self._parts:
-                host = np.asarray(arr)  # sync-ok: caller-requested materialization
+            for arr, width, corrupt in self._parts:
+                host = self._host_part(arr, corrupt)
                 cols.append(
                     host[:, None] if width is None else host[:, :width]
                 )
-            return (
+            return self._gate(
                 cols[0] if len(cols) == 1
                 else np.concatenate(cols, axis=1)
             )
+        except ResultIntegrityError:
+            status = "integrity_failed"
+            raise
         except BaseException:
             # A device error surfacing at the host fetch must not be
             # recorded as a fast successful request.
@@ -255,6 +332,20 @@ class MatvecEngine:
         ``flush_traces()`` fences the file.
     trace_capacity : finished-request records the in-memory ring retains
         (``tracer.traces()``).
+    resilience : a :class:`~..resilience.ResiliencePolicy` enabling the
+        retry + circuit-breaker + degradation-ladder dispatch path (see
+        the module docstring and docs/RESILIENCE.md). None (default):
+        dispatch exceptions propagate raw, exactly as before — the
+        scheduler's batch bisection still isolates them.
+    fault_plan : a seeded :class:`~..resilience.FaultPlan` hooked into
+        the compile and dispatch sites (chaos testing / the serve
+        bench's ``--fault-spec``). Works with or without ``resilience``:
+        without it, injected faults propagate to the caller.
+    integrity_gate : check every materialized result for NaN/Inf and
+        raise :class:`~..resilience.ResultIntegrityError` instead of
+        serving corrupt data (counted in
+        ``engine_integrity_failures_total``). Off by default — the check
+        is one host-side ``isfinite`` scan per materialization.
     """
 
     def __init__(
@@ -275,6 +366,9 @@ class MatvecEngine:
         metrics: MetricsRegistry | None = None,
         trace_jsonl: str | os.PathLike | None = None,
         trace_capacity: int = 256,
+        resilience: ResiliencePolicy | None = None,
+        fault_plan: FaultPlan | None = None,
+        integrity_gate: bool = False,
     ):
         if mesh is None:
             from ..parallel.mesh import make_mesh
@@ -343,6 +437,10 @@ class MatvecEngine:
             "engine_materialize_latency_ms",
             "result() materialization host time (device wait included)",
         )
+        self._c_dispatch_failures = self.metrics.counter(
+            "engine_dispatch_failures_total",
+            "submit() calls that raised at dispatch (post-retry/ladder)",
+        )
         self._cache = ExecutableCache(
             compile_counter=self.metrics.counter(
                 "engine_compiles_total", "AOT executable compiles"
@@ -354,6 +452,59 @@ class MatvecEngine:
         self.tracer = RequestTracer(
             capacity=trace_capacity,
             sink=JsonlSink(trace_jsonl) if trace_jsonl is not None else None,
+        )
+        self._closed = False
+
+        # ---- resilience state (docs/RESILIENCE.md). Counters exist only
+        # when the machinery is configured, so a plain engine's metrics
+        # snapshot (and the obs `resilience` panel trigger) stays clean.
+        self._resilience = resilience
+        self._fault_plan = fault_plan
+        self.integrity_gate = bool(integrity_gate)
+        self._breakers: dict[ExecKey, CircuitBreaker] = {}
+        self._breakers_lock = threading.Lock()
+        self._degraded: dict[str, str] = {}  # preferred label -> serving label
+        # Ladders are pure functions of the (fixed-at-construction) engine
+        # config plus the bucket — memoized off the resilient hot path.
+        self._ladders: dict = {}
+        self._retry_serials = itertools.count()
+        if resilience is not None or fault_plan is not None:
+            self._c_faults = self.metrics.counter(
+                "resil_faults_injected_total",
+                "faults the FaultPlan injected (all kinds)",
+            )
+            self._c_retries = self.metrics.counter(
+                "resil_retries_total",
+                "dispatch retries after a retryable fault",
+            )
+            self._c_downgrades = self.metrics.counter(
+                "resil_downgrades_total",
+                "dispatches served by a degradation-ladder fallback "
+                "(safe combine, shrunken bucket, or GEMV floor)",
+            )
+            self._c_breaker_opens = self.metrics.counter(
+                "resil_breaker_opens_total",
+                "circuit-breaker closed/half-open -> open transitions",
+            )
+            self._c_recoveries = self.metrics.counter(
+                "resil_recoveries_total",
+                "circuit-breaker half-open -> closed recoveries "
+                "(preferred config restored)",
+            )
+            self._g_breakers_open = self.metrics.gauge(
+                "resil_breakers_open",
+                "breakers not in the closed state at last health() call",
+            )
+        else:
+            self._c_faults = self._c_retries = self._c_downgrades = None
+            self._c_breaker_opens = self._c_recoveries = None
+            self._g_breakers_open = None
+        self._c_integrity = (
+            self.metrics.counter(
+                "engine_integrity_failures_total",
+                "materializations the NaN/Inf integrity gate refused",
+            )
+            if self.integrity_gate else None
         )
 
     # ---- construction-time resolution ----
@@ -483,26 +634,36 @@ class MatvecEngine:
             str(self.dtype),
         )
 
-    def _matvec_builder(self):
-        fn = self.strategy.build(
-            self.mesh, kernel=self.kernel,
-            gather_output=self.gather_output,
-            combine=self._matvec_combine, stages=self.stages,
-        )
-        structs = (
-            jax.ShapeDtypeStruct(
-                (self.m, self.k), self.dtype, sharding=self._sh_a
-            ),
-            jax.ShapeDtypeStruct((self.k,), self.dtype, sharding=self._sh_x),
-        )
-        return fn, structs, self._donate
+    def _matvec_builder_for(self, kernel, combine, stages):
+        def builder():
+            fn = self.strategy.build(
+                self.mesh, kernel=kernel,
+                gather_output=self.gather_output,
+                combine=combine, stages=stages,
+            )
+            structs = (
+                jax.ShapeDtypeStruct(
+                    (self.m, self.k), self.dtype, sharding=self._sh_a
+                ),
+                jax.ShapeDtypeStruct(
+                    (self.k,), self.dtype, sharding=self._sh_x
+                ),
+            )
+            return fn, structs, self._donate
 
-    def _gemm_builder(self, bucket: int):
+        return builder
+
+    def _matvec_builder(self):
+        return self._matvec_builder_for(
+            self.kernel, self._matvec_combine, self.stages
+        )()
+
+    def _gemm_builder_for(self, bucket: int, kernel, combine, stages):
         def builder():
             fn = self.strategy.build_batched(
-                self.mesh, kernel=self.kernel,
+                self.mesh, kernel=kernel,
                 gather_output=self.gather_output,
-                combine=self._gemm_combine, stages=self.stages,
+                combine=combine, stages=stages,
             )
             structs = (
                 jax.ShapeDtypeStruct(
@@ -515,6 +676,55 @@ class MatvecEngine:
             return fn, structs, self._donate
 
         return builder
+
+    def _gemm_builder(self, bucket: int):
+        return self._gemm_builder_for(
+            bucket, self.kernel, self._gemm_combine, self.stages
+        )
+
+    # ---- degradation ladders (resilience; docs/RESILIENCE.md) ----
+    #
+    # A ladder is an ordered list of (ExecKey, builder) config levels for
+    # one logical dispatch: the preferred config first, the safe tier
+    # (portable xla kernel, un-staged default combine, no overlap stages)
+    # last. Levels whose key equals an earlier one are dropped, so an
+    # engine already running the safe config has a one-level ladder. The
+    # one blind spot: a strategy *instance* that binds its own combine
+    # (colwise_overlap) keeps that binding under combine=None, so its
+    # "safe" level is the same schedule under a different key — the
+    # ladder still converges, it just cannot un-bind the instance.
+
+    def _matvec_levels(self) -> list[tuple[ExecKey, Callable]]:
+        levels = self._ladders.get("matvec")
+        if levels is not None:
+            return levels
+        levels = [(self._matvec_key(), self._matvec_builder)]
+        safe_key = ExecKey(
+            "matvec", self.strategy.name, SAFE_KERNEL, None, 1,
+            str(self.dtype),
+        )
+        if safe_key != levels[0][0]:
+            safe_builder = self._matvec_builder_for(SAFE_KERNEL, None, None)
+            levels.append((safe_key, safe_builder))
+        self._ladders["matvec"] = levels
+        return levels
+
+    def _gemm_levels(self, bucket: int) -> list[tuple[ExecKey, Callable]]:
+        levels = self._ladders.get(bucket)
+        if levels is not None:
+            return levels
+        levels = [(self._gemm_key(bucket), self._gemm_builder(bucket))]
+        safe_key = ExecKey(
+            "gemm", self.strategy.name, SAFE_KERNEL, None, bucket,
+            str(self.dtype),
+        )
+        if safe_key != levels[0][0]:
+            safe_builder = self._gemm_builder_for(
+                bucket, SAFE_KERNEL, None, None
+            )
+            levels.append((safe_key, safe_builder))
+        self._ladders[bucket] = levels
+        return levels
 
     # ---- dispatch (the hot path: enqueue-only, no host syncs) ----
 
@@ -564,26 +774,204 @@ class MatvecEngine:
             }
         return exe
 
-    def _dispatch_matvec(self, col: np.ndarray, trace: ActiveTrace) -> jax.Array:
-        exe = self._get_traced(
-            trace, self._matvec_key(), self._matvec_builder
-        )
+    # ---- fault sites (no-ops without a FaultPlan) ----
+
+    def _check_faults(self, site: str, key: ExecKey, block=None) -> bool:
+        """Consult the fault plan at one site. Error kinds raise here;
+        latency stalls here; returns True for a "nan" corruption (the
+        caller marks the result part). False = healthy."""
+        plan = self._fault_plan
+        if plan is None:
+            return False
+        action = plan.check(site, key.label(), block=block)
+        if action is None:
+            return False
+        self._c_faults.inc()
+        if action.error is not None:
+            raise action.error
+        if action.latency_ms > 0:
+            # Injected straggler: a deliberate stall, not a host sync.
+            time.sleep(action.latency_ms / 1e3)
+            return False
+        return action.corrupt
+
+    def _exec_matvec(
+        self, col: np.ndarray, trace: ActiveTrace,
+        key: ExecKey | None = None, builder=None,
+    ) -> tuple[jax.Array, bool]:
+        """One single-column dispatch at one config level. Returns the
+        tracked device array plus the injected-corruption flag."""
+        if key is None:
+            key, builder = self._matvec_key(), self._matvec_builder
+        if self._fault_plan is not None and key not in self._cache:
+            self._check_faults("compile", key)
+        exe = self._get_traced(trace, key, builder)
+        corrupt = self._check_faults("dispatch", key, block=col)
         self._c_dispatches.inc()
         with trace.span("dispatch", op="matvec"):
             out = exe(self._a, jax.device_put(col, self._sh_x))
-        return self._track(out)
+        return self._track(out), corrupt
 
-    def _dispatch_gemm(self, padded: np.ndarray, trace: ActiveTrace) -> jax.Array:
+    def _exec_gemm(
+        self, padded: np.ndarray, trace: ActiveTrace,
+        key: ExecKey | None = None, builder=None,
+    ) -> tuple[jax.Array, bool]:
+        """One bucket-padded block dispatch at one config level."""
         bucket = padded.shape[1]
-        exe = self._get_traced(
-            trace, self._gemm_key(bucket), self._gemm_builder(bucket)
-        )
+        if key is None:
+            key, builder = self._gemm_key(bucket), self._gemm_builder(bucket)
+        if self._fault_plan is not None and key not in self._cache:
+            self._check_faults("compile", key)
+        exe = self._get_traced(trace, key, builder)
+        corrupt = self._check_faults("dispatch", key, block=padded)
         self._c_dispatches.inc()
         with trace.span("dispatch", op="gemm", bucket=bucket):
             out = exe(self._a, jax.device_put(padded, self._sh_b))
-        return self._track(out)
+        return self._track(out), corrupt
 
-    def submit(self, x, *, deadline_ms: float | None = None) -> MatvecFuture:
+    # ---- resilient dispatch: retries, breakers, the ladder ----
+
+    def _breaker_for(self, key: ExecKey) -> CircuitBreaker:
+        br = self._breakers.get(key)
+        if br is None:
+            with self._breakers_lock:
+                br = self._breakers.get(key)
+                if br is None:
+                    br = self._resilience.make_breaker(
+                        on_open=self._c_breaker_opens.inc,
+                        on_close=self._c_recoveries.inc,
+                    )
+                    self._breakers[key] = br
+        return br
+
+    def _attempt_with_retry(self, key: ExecKey, builder, attempt_fn):
+        """One ladder level, with bounded backoff retries for retryable
+        faults (transient device errors). Non-retryable faults — compile
+        failures, RESOURCE_EXHAUSTED, poisoned payloads — raise on the
+        first attempt; the ladder (or the bucket shrink) takes over."""
+        retry = self._resilience.retry
+        serial = next(self._retry_serials)
+        attempt = 1
+        while True:
+            try:
+                return attempt_fn(key, builder)
+            except Exception as exc:
+                retryable, _ = classify_failure(exc)
+                if not retryable or attempt >= retry.max_attempts:
+                    raise
+                self._c_retries.inc()
+                self._resilience.sleep(retry.delay_s(serial, attempt))
+                attempt += 1
+
+    def _walk_ladder(self, levels, attempt_fn):
+        """Serve one dispatch from the first ladder level whose breaker
+        admits it and whose attempt succeeds. The floor level is always
+        attempted when reached — an open breaker must degrade a request,
+        never refuse it. RESOURCE_EXHAUSTED propagates immediately (the
+        fix is a smaller program, not a different schedule — the
+        caller's bucket shrink). Payload faults (a poisoned request) are
+        the REQUEST's fault, not the config's: they never feed the
+        breaker (a client sending bad payloads must not degrade healthy
+        traffic at the same key)."""
+        last_exc: Exception | None = None
+        preferred_label = levels[0][0].label()
+        for i, (key, builder) in enumerate(levels):
+            breaker = self._breaker_for(key)
+            floor = i == len(levels) - 1
+            if not breaker.allow() and not floor:
+                continue
+            try:
+                out = self._attempt_with_retry(key, builder, attempt_fn)
+            except Exception as exc:
+                if is_payload_fault(exc):
+                    breaker.record_inconclusive()
+                else:
+                    breaker.record_failure()
+                last_exc = exc
+                _, exhausted = classify_failure(exc)
+                if exhausted:
+                    raise
+                continue
+            breaker.record_success()
+            with self._breakers_lock:  # health() copies _degraded under it
+                if i == 0:
+                    self._degraded.pop(preferred_label, None)
+                else:
+                    self._degraded[preferred_label] = key.label()
+            if i > 0:
+                self._c_downgrades.inc()
+            return out
+        raise last_exc  # every level failed: the request's real fate
+
+    def _dispatch_matvec(self, col: np.ndarray, trace: ActiveTrace) -> tuple:
+        """One column -> one result part ``(array, None, corrupt)``."""
+        if self._resilience is None:
+            arr, corrupt = self._exec_matvec(col, trace)
+            return (arr, None, corrupt)
+
+        def attempt(key, builder):
+            return self._exec_matvec(col, trace, key, builder)
+
+        arr, corrupt = self._walk_ladder(self._matvec_levels(), attempt)
+        return (arr, None, corrupt)
+
+    def _dispatch_block(self, chunk: np.ndarray, trace: ActiveTrace) -> list:
+        """One <= max_bucket-wide chunk of real columns -> its dispatched
+        parts: one bucket-padded GEMM part on the happy path; several
+        under degradation (shrunken buckets on RESOURCE_EXHAUSTED, or the
+        per-column GEMV floor when every GEMM level failed).
+
+        Payload faults walk the same ladder/floor: a fault scoped to the
+        GEMM configs (``key="gemm:*"`` poison) is legitimately SERVED by
+        the GEMV floor — the ISSUE's promotion-GEMM→per-request-GEMV
+        rung — so the walk cannot be short-circuited on
+        ``is_payload_fault`` alone (the error does not say which keys
+        its spec matches). The cost is bounded: an unscoped (``"*"``)
+        poison wastes at most one bucket's per-column dispatches per
+        bisection node, and only under an armed fault plan."""
+        width = chunk.shape[1]
+        bucket = bucket_for(width, self.max_bucket)
+        with trace.span("bucket_pad", width=width, bucket=bucket):
+            padded = pad_columns(chunk, bucket)
+        if self._resilience is None:
+            arr, corrupt = self._exec_gemm(padded, trace)
+            return [(arr, width, corrupt)]
+
+        def attempt(key, builder):
+            return self._exec_gemm(padded, trace, key, builder)
+
+        try:
+            arr, corrupt = self._walk_ladder(self._gemm_levels(bucket), attempt)
+            return [(arr, width, corrupt)]
+        except Exception as exc:
+            _, exhausted = classify_failure(exc)
+            if exhausted and width > 1:
+                # Shrunken bucket ladder: RESOURCE_EXHAUSTED means the
+                # program is too big at this width — halve it and recurse
+                # (each half re-enters the ladder at its own bucket key).
+                self._c_downgrades.inc()
+                mid = (width + 1) // 2
+                return (
+                    self._dispatch_block(chunk[:, :mid], trace)
+                    + self._dispatch_block(chunk[:, mid:], trace)
+                )
+            # The GEMV floor: the promotion decision itself degrades —
+            # serve the chunk per column through the matvec ladder. A
+            # fault that also poisons the matvec path (payload poison,
+            # key="*") still fails loudly here, as it must.
+            self._c_downgrades.inc()
+            return [
+                self._dispatch_matvec(chunk[:, j], trace)
+                for j in range(width)
+            ]
+
+    def submit(
+        self,
+        x,
+        *,
+        deadline_ms: float | None = None,
+        integrity: bool | None = None,
+    ) -> MatvecFuture:
         """Dispatch one request: a ``(k,)`` vector or a ``(k, b)`` block of
         ``b`` right-hand sides (columns). Returns immediately (unless the
         backpressure high-water mark forces a drain); the result future
@@ -600,6 +988,18 @@ class MatvecEngine:
         the call can outlast the deadline by up to one drain before the
         failure is returned. A request that made it to dispatch always
         completes.
+
+        ``integrity``: per-request override of the engine's NaN/Inf
+        integrity gate (None = the engine default). The batching
+        scheduler passes False and gates each coalesced request's own
+        slice instead, so one corrupt column cannot fail its batchmates.
+
+        A dispatch that fails despite the resilience ladder (or with no
+        ladder configured) raises out of this call after finishing the
+        request's trace with ``status=dispatch_failed`` and counting
+        ``engine_dispatch_failures_total`` — callers (the scheduler's
+        bisection, the serve bench's chaos loop) treat that as the
+        request's failure, not the engine's.
         """
         t0 = time.monotonic()
         t0_perf = time.perf_counter()
@@ -637,6 +1037,8 @@ class MatvecEngine:
                 "backpressure gate before dispatch"
             ), trace=trace)
 
+        gate = self.integrity_gate if integrity is None else bool(integrity)
+        integrity_counter = self._integrity_counter() if gate else None
         with trace.span("submit"):
             if deadline_ms is not None and deadline_ms <= 0:
                 # Stale on arrival (upstream queueing): skip even the drain.
@@ -645,39 +1047,45 @@ class MatvecEngine:
                 self._admit()  # may block draining the oldest dispatch
             if _expired():
                 return _fail()
-            if x.ndim == 1:
-                self._c_cols.inc()
-                fut = MatvecFuture(
-                    [(self._dispatch_matvec(x, trace), None)], vector=True,
-                    trace=trace, materialize_hist=self._h_materialize,
-                )
-                self._h_submit.observe(
-                    (time.perf_counter() - t0_perf) * 1e3
-                )
-                return fut
-            b = x.shape[1]
-            self._c_cols.inc(b)
-            parts: list[tuple[jax.Array, int | None]] = []
-            if self.b_star is not None and b >= self.b_star:
-                offset = 0
-                for width in split_widths(b, self.max_bucket):
-                    chunk = x[:, offset:offset + width]
-                    offset += width
-                    bucket = bucket_for(width, self.max_bucket)
-                    with trace.span("bucket_pad", width=width, bucket=bucket):
-                        padded = pad_columns(chunk, bucket)
-                    parts.append((self._dispatch_gemm(padded, trace), width))
-            else:
-                for j in range(b):
-                    parts.append(
-                        (self._dispatch_matvec(x[:, j], trace), None)
+            try:
+                if x.ndim == 1:
+                    self._c_cols.inc()
+                    fut = MatvecFuture(
+                        [self._dispatch_matvec(x, trace)], vector=True,
+                        trace=trace, materialize_hist=self._h_materialize,
+                        integrity_counter=integrity_counter,
                     )
-            fut = MatvecFuture(
-                parts, vector=False,
-                trace=trace, materialize_hist=self._h_materialize,
-            )
-            self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
-            return fut
+                    self._h_submit.observe(
+                        (time.perf_counter() - t0_perf) * 1e3
+                    )
+                    return fut
+                b = x.shape[1]
+                self._c_cols.inc(b)
+                parts: list[tuple] = []
+                if self.b_star is not None and b >= self.b_star:
+                    offset = 0
+                    for width in split_widths(b, self.max_bucket):
+                        chunk = x[:, offset:offset + width]
+                        offset += width
+                        parts.extend(self._dispatch_block(chunk, trace))
+                else:
+                    for j in range(b):
+                        parts.append(self._dispatch_matvec(x[:, j], trace))
+                fut = MatvecFuture(
+                    parts, vector=False,
+                    trace=trace, materialize_hist=self._h_materialize,
+                    integrity_counter=integrity_counter,
+                )
+                self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
+                return fut
+            except BaseException:
+                # The dispatch failed past every configured recovery: the
+                # request's trace must close (status says why) and the
+                # failure must count before it surfaces to the caller.
+                self._c_dispatch_failures.inc()
+                trace.finish(status="dispatch_failed")
+                self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
+                raise
 
     def __call__(self, x) -> np.ndarray:
         """Synchronous convenience: ``submit(x).result()``."""
@@ -712,6 +1120,63 @@ class MatvecEngine:
                 )
         return self._cache.stats.compiles - before
 
+    def _integrity_counter(self):
+        """Get-or-create the integrity-failure counter (lazy so a plain
+        engine's snapshot carries no gate vocabulary, but a per-request
+        ``integrity=True`` override still counts)."""
+        if self._c_integrity is None:
+            self._c_integrity = self.metrics.counter(
+                "engine_integrity_failures_total",
+                "materializations the NaN/Inf integrity gate refused",
+            )
+        return self._c_integrity
+
+    def health(self) -> dict:
+        """Point-in-time resilience snapshot: breaker states per ExecKey,
+        the configs currently serving degraded (preferred label → the
+        fallback label actually dispatching), fault-injection tallies,
+        and the recovery counters. Refreshes the ``resil_breakers_open``
+        gauge, so an obs snapshot taken after ``health()`` agrees with
+        it. Cheap and lock-light — a health endpoint may poll it."""
+        with self._breakers_lock:
+            items = list(self._breakers.items())
+            # _walk_ladder mutates _degraded under the same lock — an
+            # unlocked dict() copy can raise mid-iteration when a config
+            # flips between degraded and recovered on another thread.
+            degraded = dict(self._degraded)
+        breakers = {key.label(): br.snapshot() for key, br in items}
+        if self._g_breakers_open is not None:
+            self._g_breakers_open.set(
+                sum(
+                    1 for snap in breakers.values()
+                    if snap["state"] != BREAKER_CLOSED
+                )
+            )
+
+        def _val(counter) -> int:
+            return counter.value if counter is not None else 0
+
+        return {
+            "resilience": self._resilience is not None,
+            "integrity_gate": self.integrity_gate,
+            "breakers": breakers,
+            "degraded": degraded,
+            "fault_injection": (
+                self._fault_plan.summary()
+                if self._fault_plan is not None else None
+            ),
+            "counters": {
+                "retries": _val(self._c_retries),
+                "downgrades": _val(self._c_downgrades),
+                "breaker_opens": _val(self._c_breaker_opens),
+                "recoveries": _val(self._c_recoveries),
+                "faults_injected": _val(self._c_faults),
+                "dispatch_failures": self._c_dispatch_failures.value,
+                "deadline_failures": self._c_deadline_failures.value,
+                "integrity_failures": _val(self._c_integrity),
+            },
+        }
+
     @property
     def stats(self) -> EngineStats:
         s = self._cache.stats
@@ -740,8 +1205,23 @@ class MatvecEngine:
         """Release the trace sink (writer thread + file handle) after
         draining it. An engine without ``trace_jsonl`` has nothing to
         release; an engine WITH one should be closed when retired —
-        each sink is one daemon thread and one open append handle."""
-        self.tracer.close()
+        each sink is one daemon thread and one open append handle.
+
+        Idempotent and exception-safe: a second ``close()`` is a no-op,
+        and the sink is released even when the drain-fence cannot confirm
+        (dead writer thread) or in-flight futures hold failures — their
+        traces were finished at failure time, so the flush here is what
+        puts them on disk. Outstanding-dispatch references are dropped
+        (the device work itself cannot be cancelled; its results are
+        simply no longer retained by the engine)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._outstanding.clear()
+        try:
+            self.flush_traces()
+        finally:
+            self.tracer.close()
 
     @property
     def n_executables(self) -> int:

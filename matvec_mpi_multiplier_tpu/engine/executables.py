@@ -39,6 +39,17 @@ class ExecKey(NamedTuple):
     bucket: int    # RHS columns (1 for the matvec path)
     dtype: str
 
+    def label(self) -> str:
+        """Canonical ``op:strategy:kernel:combine:bucket:dtype`` string —
+        the identity fault-injection patterns match against
+        (``resilience/faults.py``) and ``engine.health()`` reports under.
+        A None combine reads as ``default`` so patterns can target it."""
+        combine = self.combine if self.combine is not None else "default"
+        return (
+            f"{self.op}:{self.strategy}:{self.kernel}:{combine}:"
+            f"{self.bucket}:{self.dtype}"
+        )
+
 
 @dataclasses.dataclass
 class ExecStats:

@@ -54,6 +54,30 @@ Mechanics:
   so the engine's ``max_in_flight`` gate counts and drains whole
   coalesced batches oldest-first; the scheduler never re-implements the
   gate.
+* **blast-radius isolation (batch bisection)** — coalescing multiplies
+  the cost of one bad request: a flush whose dispatch raises used to
+  fail every waiter in the batch. Now a failed flush is **bisected**:
+  the scheduler splits the live requests in half and re-dispatches each
+  half (recursively, log-depth), so only the requests that fail *alone*
+  fail their callers — everyone else still gets a correct result. The
+  re-dispatches preserve PR 6's bitwise-exactness doctrine: each half is
+  zero-padded back to the ORIGINAL flush's bucket, so a surviving
+  request rides the same executable with the same padded width and its
+  columns are bitwise what the unfaulted batch would have produced
+  (pad-content independence within one bucket). The one exception is a
+  flush wider than ``max_bucket`` (already a multi-dispatch split), whose
+  halves re-enter at natural width. Counted in
+  ``sched_bisect_splits_total`` / ``sched_isolated_failures_total``.
+  Bisection targets *request-caused* failures; when several dispatches
+  of one flush's tree fail with zero successes and the error carries no
+  payload scope (``resilience.is_payload_fault``), the failure is
+  declared **systemic** — the rest of the batch fails at once
+  (``sched_batch_failures_total``) instead of re-dispatching every
+  request O(log n) times against a dead backend.
+  When the engine's NaN/Inf integrity gate is on, the scheduler applies
+  it **per request slice** (the engine-level whole-block check is
+  suppressed for coalesced dispatches), so one corrupt column fails one
+  caller, not the batch.
 
 Threading/locking discipline (lint-enforced:
 ``staticcheck`` rule ``scheduler-lock-across-dispatch``): all pending
@@ -75,8 +99,9 @@ from typing import Callable
 
 import numpy as np
 
+from ..resilience.faults import is_payload_fault, refuse_nonfinite
 from ..utils.errors import ConfigError, DeadlineExceededError
-from .buckets import split_widths
+from .buckets import bucket_for, split_widths
 from .core import DEFAULT_PROMOTE_B, MatvecEngine, MatvecFuture
 
 # QoS tiers, most to least latency-sensitive. interactive: flush the open
@@ -90,6 +115,14 @@ DEFAULT_MAX_WINDOW_MS = 2.0
 
 # Batch-width histogram buckets (requests-per-flush, not milliseconds).
 WIDTH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+# Bisection's systemic-failure escape hatch: once this many dispatches of
+# one flush's bisection tree have failed with ZERO successes — the
+# offered flush plus both halves, three independent programs — and the
+# error is not payload-scoped, the failure is the backend's, not a
+# request's. Bisecting further would re-dispatch every request O(n log n)
+# times against a dead backend; fail the rest of the batch at once.
+SYSTEMIC_FAILURE_THRESHOLD = 3
 
 
 class _SharedResult:
@@ -139,7 +172,7 @@ class CoalescedFuture:
     ``None``/``False`` until resolution, and for adopted futures.
     """
 
-    def __init__(self, vector: bool, width: int):
+    def __init__(self, vector: bool, width: int, integrity_counter=None):
         self._vector = vector
         self.width = width
         self._event = threading.Event()
@@ -149,6 +182,11 @@ class CoalescedFuture:
         self.offset: int | None = None
         self.batch_width: int | None = None
         self.coalesced = False
+        # Non-None: apply the NaN/Inf integrity gate to THIS request's
+        # slice of the shared result (per-request blast radius — the
+        # engine-level whole-block gate is suppressed for coalesced
+        # dispatches). Adopted (bypass) futures gate inside the engine.
+        self._integrity_counter = integrity_counter
 
     # ---- resolution (scheduler-internal) ----
 
@@ -210,8 +248,36 @@ class CoalescedFuture:
             return self._inner.result()
         block = self._shared.value()
         if self._vector:
-            return block[:, self.offset]
-        return block[:, self.offset:self.offset + self.width]
+            out = block[:, self.offset]
+        else:
+            out = block[:, self.offset:self.offset + self.width]
+        if self._integrity_counter is not None:
+            # Per-request integrity gate: this caller's columns are
+            # corrupt; batchmates with finite slices still succeed. The
+            # refusal is cached like any other failure — a second
+            # result() raises it again without re-counting.
+            err = refuse_nonfinite(
+                out, self._integrity_counter,
+                "this request's slice of the coalesced result",
+            )
+            if err is not None:
+                self._error = err
+                raise err
+        return out
+
+
+class _BisectState:
+    """Shared across ONE flush's bisection tree: dispatch outcomes so
+    far, and the systemic short-circuit (an error every sub-batch is
+    failed with once bisection concludes the backend, not a payload, is
+    at fault)."""
+
+    __slots__ = ("failures", "successes", "systemic")
+
+    def __init__(self):
+        self.failures = 0
+        self.successes = 0
+        self.systemic: Exception | None = None
 
 
 class _Pending:
@@ -360,6 +426,31 @@ class ArrivalWindowScheduler:
             "bytes of A re-read traffic coalescing avoided vs per-request "
             "dispatch",
         )
+        self._c_bisects = metrics.counter(
+            "sched_bisect_splits_total",
+            "failed coalesced dispatches split in half for re-dispatch "
+            "(blast-radius isolation)",
+        )
+        self._c_isolated = metrics.counter(
+            "sched_isolated_failures_total",
+            "requests bisection isolated as genuinely failing (failed "
+            "alone after log-depth splits)",
+        )
+        self._c_batch_failed = metrics.counter(
+            "sched_batch_failures_total",
+            "requests failed with their whole (sub-)batch when bisection "
+            "declared the failure systemic (repeated non-payload dispatch "
+            "failures with zero successes)",
+        )
+        # Per-request integrity gating (see CoalescedFuture): same counter
+        # name as the engine's gate — one number for "results refused".
+        self._integrity_counter = (
+            metrics.counter(
+                "engine_integrity_failures_total",
+                "materializations the NaN/Inf integrity gate refused",
+            )
+            if engine.integrity_gate else None
+        )
         self._h_batch_width = metrics.histogram(
             "sched_batch_width", "columns per coalesced flush",
             buckets=WIDTH_BUCKETS,
@@ -477,7 +568,9 @@ class ArrivalWindowScheduler:
         width = block.shape[1]
         self._c_requests.inc()
         self._rate.observe(now=now)
-        fut = CoalescedFuture(vector, width)
+        fut = CoalescedFuture(
+            vector, width, integrity_counter=self._integrity_counter
+        )
         if deadline_ms is not None and deadline_ms <= 0:
             # Stale on arrival (upstream queueing): fail without touching
             # the window or the engine.
@@ -561,9 +654,14 @@ class ArrivalWindowScheduler:
         """Dispatch one swapped-out batch: fail requests whose deadline
         expired while the window was open (before dispatch, without
         poisoning the rest), column-stack the survivors, and hand the
-        stacked block to the engine as ONE request. Runs with no
-        scheduler lock held — the engine's backpressure gate may block
-        here, and new arrivals must keep queueing meanwhile."""
+        stacked block to the engine as ONE request — bisecting on
+        failure (``_submit_batch``) so only genuinely-failing requests
+        fail. Runs with no scheduler lock held — the engine's
+        backpressure gate may block here, and new arrivals must keep
+        queueing meanwhile. The coalescing accounting records the
+        OFFERED flush (bisection re-dispatches are tallied separately in
+        the ``sched_bisect_*`` counters) — except a flush none of whose
+        dispatches ran, which produced no coalescing to account."""
         now = self._clock()
         live: list[_Pending] = []
         for p in batch:
@@ -577,26 +675,20 @@ class ArrivalWindowScheduler:
                 live.append(p)
         if not live:
             return
-        width = sum(p.width for p in live)
-        stacked = (
-            live[0].block if len(live) == 1
-            else np.concatenate([p.block for p in live], axis=1)
-        )
-        try:
-            inner = self.engine.submit(stacked)
-        except Exception as e:
-            # A failed dispatch (engine closed underneath us, backend
-            # error) must fail every future in the batch — never leave a
-            # client hanging in result(), and never kill the flusher
-            # thread with an escaped exception.
-            for p in live:
-                p.future._fail(e)
+        dispatched = self._submit_batch(live, pad_to=None)
+        if not dispatched:
+            # Every dispatch of the flush failed: no device work ran, so
+            # counting it as a coalesced batch (width histogram,
+            # amortized bytes) would overstate savings that never
+            # materialized. Its failures are in the sched_isolated_* /
+            # sched_batch_failures_total counters.
             return
-        shared = _SharedResult(inner)
-        offset = 0
-        for p in live:
-            p.future._resolve(shared, offset, width, len(live))
-            offset += p.width
+        # Accounting AFTER the dispatch: this bookkeeping overlaps the
+        # enqueued device work instead of sitting on the flush's critical
+        # path, where every waiter in the batch (and, on a saturated
+        # host, the whole arrival pattern the NEXT batch coalesces under)
+        # is blocked on it.
+        width = sum(p.width for p in live)
         self._c_batches.inc()
         self._h_batch_width.observe(width)
         if len(live) > 1:
@@ -606,6 +698,108 @@ class ArrivalWindowScheduler:
         ) - self._dispatches_for(width)
         if saved > 0:
             self._c_amortized_bytes.inc(saved * self._a_bytes)
+
+    def _bisect_pad_target(self, width: int) -> int | None:
+        """The bucket a failed flush's halves are zero-padded back to so
+        survivors stay bitwise-exact (same executable, same padded
+        width as the unfaulted batch). None when the original flush did
+        not ride one GEMM bucket — per-column dispatch (below ``b*``) is
+        position-independent anyway, and an over-``max_bucket`` flush was
+        already a multi-dispatch split."""
+        engine = self.engine
+        if (
+            engine.b_star is not None
+            and engine.b_star <= width <= engine.max_bucket
+        ):
+            return bucket_for(width, engine.max_bucket)
+        return None
+
+    def _submit_batch(
+        self, live: list[_Pending], pad_to: int | None,
+        state: _BisectState | None = None,
+    ) -> bool:
+        """Dispatch a batch of live requests as one engine submit; on
+        failure, bisect and re-dispatch (log-depth) until each failing
+        request has failed ALONE — blast-radius isolation. Never raises
+        (a flusher-thread dispatch error must land in futures, not kill
+        the thread); returns True when at least one dispatch of the
+        batch's tree ran, so the caller can skip the coalescing
+        accounting for a flush that never reached the device.
+
+        Bisection is for failures a REQUEST causes (a poisoned payload
+        crashing the kernel); a backend-down outage fails every
+        re-dispatch identically, and splitting would re-dispatch each
+        request O(log n) times — each with the full retry/ladder cost —
+        for nothing. ``state`` tracks the bisection tree's outcomes:
+        once :data:`SYSTEMIC_FAILURE_THRESHOLD` dispatches have failed
+        with zero successes and the error is not payload-scoped
+        (``resilience.is_payload_fault``), the remaining requests fail
+        together (``sched_batch_failures_total``, NOT counted as
+        bisection-isolated — the failure was never theirs)."""
+        engine = self.engine
+        if state is not None and state.systemic is not None:
+            self._c_batch_failed.inc(len(live))
+            for p in live:
+                p.future._fail(state.systemic)
+            return False
+        stacked = (
+            live[0].block if len(live) == 1
+            else np.concatenate([p.block for p in live], axis=1)
+        )
+        width = stacked.shape[1]
+        if pad_to is not None and pad_to > width:
+            stacked = np.concatenate(
+                [stacked, np.zeros((engine.k, pad_to - width), stacked.dtype)],
+                axis=1,
+            )
+        try:
+            if self._integrity_counter is None:
+                inner = engine.submit(stacked)
+            else:
+                # With the gate on, each CoalescedFuture checks its own
+                # slice — the whole-block check would fail batchmates.
+                inner = engine.submit(stacked, integrity=False)
+        except Exception as e:
+            if state is None:
+                state = _BisectState()
+            state.failures += 1
+            if (
+                state.successes == 0
+                and state.failures >= SYSTEMIC_FAILURE_THRESHOLD
+                and not is_payload_fault(e)
+            ):
+                # Every dispatch of this tree failed and nothing points
+                # at a payload: the backend is the problem. This applies
+                # at a leaf too — a request that failed alone under a
+                # systemic outage was not isolated BY bisection.
+                state.systemic = e
+                self._c_batch_failed.inc(len(live))
+                for p in live:
+                    p.future._fail(e)
+                return False
+            if len(live) == 1:
+                # Failed alone: genuinely poisoned — this caller's fate.
+                self._c_isolated.inc()
+                live[0].future._fail(e)
+                return False
+            self._c_bisects.inc()
+            mid = len(live) // 2
+            target = (
+                pad_to if pad_to is not None
+                else self._bisect_pad_target(width)
+            )
+            left = self._submit_batch(live[:mid], target, state)
+            right = self._submit_batch(live[mid:], target, state)
+            return left or right
+        if state is not None:
+            state.successes += 1
+        shared = _SharedResult(inner)
+        batch_width = stacked.shape[1]
+        offset = 0
+        for p in live:
+            p.future._resolve(shared, offset, batch_width, len(live))
+            offset += p.width
+        return True
 
     def _dispatches_for(self, width: int) -> int:
         """How many device programs the engine runs for a block of this

@@ -68,10 +68,77 @@ def render_batching(snapshot: dict) -> str | None:
     return "\n".join(out)
 
 
+def render_resilience(snapshot: dict) -> str | None:
+    """The resilience panel: fault-injection volume, recovery activity
+    (retries, downgrades, breaker opens/recoveries), blast-radius
+    isolation (bisection splits / isolated failures) and integrity-gate
+    refusals, read off the ``resil_*`` / ``sched_bisect_*`` /
+    ``engine_integrity_*`` metrics (engine/core.py, engine/scheduler.py;
+    docs/RESILIENCE.md explains how to read it). None when the snapshot
+    carries no resilience vocabulary (a run without faults, policy, or
+    gate)."""
+    counters = snapshot.get("counters", {})
+    trigger_keys = (
+        "resil_faults_injected_total",
+        "resil_retries_total",
+        "engine_integrity_failures_total",
+    )
+    if not any(k in counters for k in trigger_keys):
+        return None
+    gauges = snapshot.get("gauges", {})
+    failed = counters.get("serve_failed_requests_total")
+    out = ["resilience:"]
+    if failed is not None:
+        # Denominator preference: the serve bench's steady-phase offered
+        # count; then the scheduler's (warmup never routes through it);
+        # engine_requests_total last — it includes warmup submits, so an
+        # old uncoalesced snapshot reads slightly optimistic.
+        requests = counters.get(
+            "serve_requests_total",
+            counters.get(
+                "sched_requests_total",
+                counters.get("engine_requests_total", 0),
+            ),
+        )
+        rate = (
+            (requests - failed) / requests if requests else float("nan")
+        )
+        out.append(
+            f"  availability      {rate:.4f} "
+            f"({failed} fault-failed of {requests})"
+        )
+    out += [
+        f"  faults injected   "
+        f"{counters.get('resil_faults_injected_total', 0)}",
+        f"  retries           {counters.get('resil_retries_total', 0)}",
+        f"  downgrades        {counters.get('resil_downgrades_total', 0)} "
+        "(ladder fallbacks: safe combine / shrunken bucket / GEMV floor)",
+        f"  breaker opens     "
+        f"{counters.get('resil_breaker_opens_total', 0)} "
+        f"(recoveries {counters.get('resil_recoveries_total', 0)}, "
+        f"open now {gauges.get('resil_breakers_open', 0):.0f})",
+        f"  bisect splits     "
+        f"{counters.get('sched_bisect_splits_total', 0)} "
+        f"(isolated failures "
+        f"{counters.get('sched_isolated_failures_total', 0)}, "
+        f"systemic batch failures "
+        f"{counters.get('sched_batch_failures_total', 0)})",
+        f"  integrity refused "
+        f"{counters.get('engine_integrity_failures_total', 0)}",
+        f"  dispatch failures "
+        f"{counters.get('engine_dispatch_failures_total', 0)} "
+        f"(deadline {counters.get('engine_deadline_failures_total', 0)}"
+        f"+{counters.get('sched_deadline_failures_total', 0)} sched)",
+    ]
+    return "\n".join(out)
+
+
 def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     """Human-readable (or Prometheus text) rendering of a snapshot dict.
     Snapshots carrying batching-scheduler metrics get the ``batching``
-    panel appended (:func:`render_batching`)."""
+    panel appended (:func:`render_batching`); snapshots carrying
+    resilience metrics get the ``resilience`` panel
+    (:func:`render_resilience`)."""
     if prometheus:
         from .registry import prometheus_text
 
@@ -103,6 +170,9 @@ def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     batching = render_batching(snapshot)
     if batching is not None:
         out.append(batching)
+    resilience = render_resilience(snapshot)
+    if resilience is not None:
+        out.append(resilience)
     return "\n".join(out) if out else "(empty snapshot)"
 
 

@@ -1,0 +1,70 @@
+"""Fault-tolerant serving: deterministic fault injection, retry/fallback
+policy, and per-config circuit breakers.
+
+At production scale the failure modes — compile OOMs, device errors,
+stragglers — dominate operational cost (the TPU-linalg paper is explicit
+about this, PAPERS.md), and every one of the repo's 17 strategy×combine
+lowering configs is a distinct way a compile or dispatch can fail. This
+package is the serving engine's answer, in three layers:
+
+* ``faults.py`` — the **fault taxonomy** (what can go wrong, and whether
+  it is retryable) plus a seeded, reproducible :class:`FaultPlan` that
+  injects those faults at the engine's compile and dispatch sites —
+  chaos runs are deterministic, so they live in the tier-1 suite, not in
+  a flaky nightly;
+* ``policy.py`` — the **recovery policy**: bounded exponential-backoff
+  retries for retryable dispatch faults, and a per-ExecKey
+  :class:`CircuitBreaker` (closed→open→half-open) that stops hammering a
+  failing config and lets the engine reroute through its degradation
+  ladder, probing back to the preferred config once the breaker's
+  cooldown elapses;
+* the engine/scheduler integration lives in ``engine/core.py``
+  (ladder + breakers + ``health()``) and ``engine/scheduler.py``
+  (coalesced-batch bisection — blast-radius isolation).
+
+See ``docs/RESILIENCE.md`` for the taxonomy, the breaker state machine,
+and the degradation ladder; ``bench/serve.py --fault-spec`` drives the
+whole stack under measured chaos.
+"""
+
+from .faults import (
+    CompileFaultError,
+    DeviceFaultError,
+    FaultAction,
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    ResourceExhaustedError,
+    ResultIntegrityError,
+    is_payload_fault,
+    parse_fault_spec,
+)
+from .policy import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    ResiliencePolicy,
+    RetryPolicy,
+    classify_failure,
+)
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "FaultAction",
+    "parse_fault_spec",
+    "FaultError",
+    "DeviceFaultError",
+    "CompileFaultError",
+    "ResourceExhaustedError",
+    "ResultIntegrityError",
+    "is_payload_fault",
+    "RetryPolicy",
+    "CircuitBreaker",
+    "ResiliencePolicy",
+    "classify_failure",
+    "BREAKER_CLOSED",
+    "BREAKER_OPEN",
+    "BREAKER_HALF_OPEN",
+]

@@ -1,0 +1,413 @@
+"""Fault taxonomy + deterministic, seeded fault injection.
+
+**Taxonomy.** Every serving-path failure the engine handles is one of:
+
+========================  =========  ========================================
+error                     retryable  production analog
+========================  =========  ========================================
+CompileFaultError         no         XLA compile OOM / lowering bug on an
+                                     exotic strategy×combine config
+DeviceFaultError          yes*       transient device error at dispatch
+                                     (preempted core, flaky ICI link)
+ResourceExhaustedError    no         HBM RESOURCE_EXHAUSTED — the *shape* is
+                                     too big, retrying the same bucket loses;
+                                     shrinking the bucket ladder can win
+ResultIntegrityError      no         silent data corruption (NaN/Inf in the
+                                     result block) caught by the engine's
+                                     materialize-time integrity gate
+========================  =========  ========================================
+
+(*) a payload-poisoned DeviceFaultError (see ``poison`` below) is
+persistent by construction, so those are marked non-retryable.
+
+**Injection.** A :class:`FaultPlan` is a seeded list of
+:class:`FaultSpec` rules the engine consults at its two fault sites —
+``compile`` (just before an uncached ExecKey is lowered+compiled) and
+``dispatch`` (just before a compiled executable is invoked). Scoping is
+by ExecKey pattern (``fnmatch`` over the key's ``op:strategy:kernel:
+combine:bucket:dtype`` label), by payload poison signature, by match
+ordinal (``after``/``times``), and by probability. The probability draw
+is a **hash of (seed, spec index, match ordinal)** — not a stateful RNG —
+so a plan replayed over the same sequence of matching events makes
+identical decisions regardless of wall-clock or which thread asks, and a
+chaos test's failure set is reproducible from its seed.
+
+Kinds and what the engine does with the returned :class:`FaultAction`:
+
+* ``compile_error`` / ``device_error`` / ``resource_exhausted`` — raise
+  the matching taxonomy error at the site;
+* ``latency`` — sleep ``latency_ms`` on the dispatch path (a straggler);
+* ``nan`` — mark the dispatch's result part corrupt: materialization
+  plants a NaN in the host copy, which the integrity gate (when enabled)
+  turns into a :class:`ResultIntegrityError` instead of serving garbage.
+
+This module is a leaf: it imports nothing from ``engine/`` (the engine
+imports *it*), so the fault machinery can be unit-tested without a
+device backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from fnmatch import fnmatchcase
+
+import numpy as np
+
+from ..utils.errors import ConfigError, MatvecError
+
+FAULT_SITES = ("compile", "dispatch")
+FAULT_KINDS = (
+    "compile_error", "device_error", "resource_exhausted", "nan", "latency",
+)
+
+
+class FaultError(MatvecError):
+    """Base of the injectable serving-fault taxonomy. ``retryable`` says
+    whether re-running the same dispatch may succeed; ``injected`` marks
+    errors a :class:`FaultPlan` raised (vs. classified real ones);
+    ``payload_fault`` marks failures caused by the REQUEST's payload
+    (a poisoned block) rather than the config or the device — those are
+    exempt from config-health accounting (a bad request must not open a
+    healthy config's breaker) and are exactly what batch bisection
+    exists to isolate."""
+
+    default_retryable = False
+
+    def __init__(self, message: str, *, retryable: bool | None = None,
+                 injected: bool = False, payload_fault: bool = False):
+        super().__init__(message)
+        self.retryable = (
+            self.default_retryable if retryable is None else retryable
+        )
+        self.injected = injected
+        self.payload_fault = payload_fault
+
+
+class DeviceFaultError(FaultError):
+    """A device error surfacing at dispatch — transient by default (the
+    production analogs are preemptions and link flaps), persistent when
+    payload-poisoned."""
+
+    default_retryable = True
+
+
+class CompileFaultError(FaultError):
+    """An executable failed to lower/compile. Deterministic for a given
+    (config, shape): never retried, routed down the degradation ladder."""
+
+
+class ResourceExhaustedError(FaultError):
+    """RESOURCE_EXHAUSTED at compile or dispatch: the program's footprint
+    does not fit. Not retryable at the same shape — the engine's answer
+    is the shrunken bucket ladder (half the RHS width, half the result
+    footprint)."""
+
+
+class ResultIntegrityError(MatvecError):
+    """The materialize-time integrity gate found NaN/Inf in a result
+    block. The dispatch *succeeded* — this is silent corruption caught at
+    the last host boundary before the caller."""
+
+
+def refuse_nonfinite(
+    out: np.ndarray, counter, context: str
+) -> ResultIntegrityError | None:
+    """The integrity gate's ONE implementation (used by the engine's
+    whole-block gate and the scheduler's per-slice gate): None when
+    ``out`` is finite; otherwise count the refusal and return the error
+    for the caller to cache on its future and raise."""
+    if np.all(np.isfinite(out)):
+        return None
+    counter.inc()
+    return ResultIntegrityError(
+        f"non-finite values in {context} (the integrity gate refuses to "
+        "serve corrupt data; re-submit the request)"
+    )
+
+
+def is_payload_fault(exc: BaseException) -> bool:
+    """True when a failure is scoped to the request's PAYLOAD, not the
+    config or the device: a poisoned injected fault, or an
+    integrity-gate refusal (the corruption travels with the result
+    slice). Payload faults never open a config's circuit breaker
+    (``engine/core.py``) and never read as a systemic outage to the
+    scheduler's batch bisection (``engine/scheduler.py``)."""
+    if isinstance(exc, ResultIntegrityError):
+        return True
+    return bool(getattr(exc, "payload_fault", False))
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One injection rule.
+
+    site : ``"compile"`` or ``"dispatch"``.
+    kind : one of :data:`FAULT_KINDS`.
+    key : ``fnmatch`` pattern over the ExecKey label
+        (``op:strategy:kernel:combine:bucket:dtype``); ``"*"`` = all.
+    p : injection probability per matching event (hash-derived, see
+        module docstring).
+    times : stop injecting after this many injections (None = unlimited).
+    after : skip the first ``after`` matching events (lets a plan spare
+        warmup traffic, or stage faults mid-run).
+    latency_ms : for ``kind="latency"``: the injected stall.
+    poison : payload signature — the rule matches only dispatches whose
+        host block carries this exact value in row 0 of any column (a
+        request that deterministically crashes the kernel, the
+        bisection test's "genuinely poisoned request"). Poisoned
+        device errors are persistent, hence non-retryable.
+    retryable : override the kind's default retryability.
+    """
+
+    site: str
+    kind: str
+    key: str = "*"
+    p: float = 1.0
+    times: int | None = None
+    after: int = 0
+    latency_ms: float = 0.0
+    poison: float | None = None
+    retryable: bool | None = None
+
+    def __post_init__(self):
+        if self.site not in FAULT_SITES:
+            raise ConfigError(
+                f"fault site must be one of {FAULT_SITES}, got {self.site!r}"
+            )
+        if self.kind not in FAULT_KINDS:
+            raise ConfigError(
+                f"fault kind must be one of {FAULT_KINDS}, got {self.kind!r}"
+            )
+        if not (0.0 <= self.p <= 1.0):
+            raise ConfigError(f"fault probability must be in [0, 1], got {self.p}")
+        if self.times is not None and self.times < 0:
+            raise ConfigError(f"fault times must be >= 0, got {self.times}")
+        if self.after < 0:
+            raise ConfigError(f"fault after must be >= 0, got {self.after}")
+        if self.kind == "latency" and self.latency_ms <= 0:
+            raise ConfigError(
+                "latency faults need latency_ms > 0, got "
+                f"{self.latency_ms}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultAction:
+    """What the engine should do for one fired spec: raise ``error``,
+    sleep ``latency_ms``, or mark the result part ``corrupt``."""
+
+    kind: str
+    spec_index: int
+    error: FaultError | None = None
+    latency_ms: float = 0.0
+    corrupt: bool = False
+
+
+def _unit_hash(seed: int, spec_index: int, serial: int) -> float:
+    """Deterministic uniform draw in [0, 1) from (seed, spec, ordinal) —
+    stable across processes and thread interleavings of *other* specs."""
+    digest = hashlib.sha256(
+        f"{seed}:{spec_index}:{serial}".encode()
+    ).digest()
+    return int.from_bytes(digest[:8], "big") / 2.0**64
+
+
+class FaultPlan:
+    """A seeded set of injection rules, consulted per fault-site event.
+
+    ``check(site, key_label, block=)`` walks the specs in order; the
+    first spec that matches AND fires wins (one fault per event). The
+    per-spec match/injected tallies (``summary()``) are the ground truth
+    a chaos test asserts against, and ``engine.health()`` exports them.
+
+    Thread-safe: the tallies sit behind one small mutex (the engine may
+    serve from many client threads). Determinism is per matching-event
+    *sequence* — a single-threaded replay of the same traffic makes
+    identical decisions; concurrent submitters can permute which request
+    draws which ordinal, but the injected *count* statistics stay
+    seed-stable.
+    """
+
+    def __init__(self, specs, seed: int = 0):
+        self.specs = tuple(specs)
+        if not self.specs:
+            raise ConfigError("a FaultPlan needs at least one FaultSpec")
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._armed = True
+        self._matched = [0] * len(self.specs)
+        self._injected = [0] * len(self.specs)
+
+    def disarm(self) -> None:
+        """Stop injecting (and tallying) until :meth:`arm`. The serve
+        bench disarms the plan across warmup so the steady phase's event
+        ordinals start at zero — chaos begins at a deterministic point
+        regardless of how many dispatches warmup needed."""
+        with self._lock:
+            self._armed = False
+
+    def arm(self) -> None:
+        with self._lock:
+            self._armed = True
+
+    def _fire(self, i: int, spec: FaultSpec) -> bool:
+        """Tally one matching event for spec ``i`` and decide injection
+        (caller holds the lock)."""
+        serial = self._matched[i]
+        self._matched[i] += 1
+        if serial < spec.after:
+            return False
+        if spec.times is not None and self._injected[i] >= spec.times:
+            return False
+        if spec.p < 1.0 and _unit_hash(self.seed, i, serial) >= spec.p:
+            return False
+        self._injected[i] += 1
+        return True
+
+    def check(
+        self, site: str, key_label: str, block: np.ndarray | None = None
+    ) -> FaultAction | None:
+        """One fault-site event: None (no fault) or the action to apply.
+        ``block`` is the host payload (for poison-scoped dispatch specs;
+        row 0 is the signature row)."""
+        with self._lock:
+            if not self._armed:
+                return None
+            for i, spec in enumerate(self.specs):
+                if spec.site != site:
+                    continue
+                if spec.key != "*" and not fnmatchcase(key_label, spec.key):
+                    continue
+                if spec.poison is not None:
+                    if block is None:
+                        continue
+                    row0 = block[0] if block.ndim > 1 else block[:1]
+                    if not np.any(row0 == block.dtype.type(spec.poison)):
+                        continue
+                if not self._fire(i, spec):
+                    continue
+                return self._action(i, spec)
+        return None
+
+    def _action(self, i: int, spec: FaultSpec) -> FaultAction:
+        if spec.kind == "latency":
+            return FaultAction(
+                "latency", i, latency_ms=spec.latency_ms
+            )
+        if spec.kind == "nan":
+            return FaultAction("nan", i, corrupt=True)
+        where = f"{spec.site} of key matching {spec.key!r}"
+        if spec.kind == "compile_error":
+            err: FaultError = CompileFaultError(
+                f"injected compile failure at {where} (spec {i}, "
+                f"seed {self.seed})",
+                retryable=spec.retryable, injected=True,
+            )
+        elif spec.kind == "resource_exhausted":
+            err = ResourceExhaustedError(
+                f"injected RESOURCE_EXHAUSTED at {where} (spec {i}, "
+                f"seed {self.seed})",
+                retryable=spec.retryable, injected=True,
+            )
+        else:  # device_error
+            retryable = spec.retryable
+            if retryable is None and spec.poison is not None:
+                retryable = False  # payload-poisoned: persistent fault
+            err = DeviceFaultError(
+                f"injected device error at {where} (spec {i}, "
+                f"seed {self.seed})"
+                + (" [poisoned payload]" if spec.poison is not None else ""),
+                retryable=retryable, injected=True,
+                payload_fault=spec.poison is not None,
+            )
+        return FaultAction(spec.kind, i, error=err)
+
+    def summary(self) -> dict:
+        """Per-spec tallies for ``engine.health()`` and chaos asserts."""
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "specs": [
+                    {
+                        "site": s.site,
+                        "kind": s.kind,
+                        "key": s.key,
+                        "p": s.p,
+                        "times": s.times,
+                        "matched": self._matched[i],
+                        "injected": self._injected[i],
+                    }
+                    for i, s in enumerate(self.specs)
+                ],
+            }
+
+    @property
+    def total_injected(self) -> int:
+        with self._lock:
+            return sum(self._injected)
+
+
+_SPEC_FIELD_PARSERS = {
+    "key": str,
+    "p": float,
+    "times": int,
+    "after": int,
+    "latency_ms": float,
+    "poison": float,
+    "retryable": lambda v: bool(int(v)),
+}
+
+
+def parse_fault_spec(text: str, seed: int = 0) -> FaultPlan:
+    """Parse the serve bench's ``--fault-spec`` grammar into a plan.
+
+    Grammar: specs joined by ``;``, each
+    ``site:kind[:field=value[,field=value...]]`` — e.g.::
+
+        dispatch:device_error:p=0.05
+        compile:compile_error:key=*psum_scatter*,times=4
+        dispatch:latency:latency_ms=5,p=0.1;dispatch:nan:times=2
+
+    Fields: ``key`` (fnmatch over the ExecKey label), ``p``, ``times``,
+    ``after``, ``latency_ms``, ``poison``, ``retryable`` (0/1). Raises
+    :class:`ConfigError` on anything malformed — a chaos run with a
+    half-parsed plan would measure the wrong thing.
+    """
+    specs = []
+    for clause in text.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        parts = clause.split(":", 2)
+        if len(parts) < 2:
+            raise ConfigError(
+                f"fault spec clause {clause!r} must be site:kind[:fields]"
+            )
+        site, kind = parts[0].strip(), parts[1].strip()
+        fields: dict = {}
+        if len(parts) == 3 and parts[2].strip():
+            for item in parts[2].split(","):
+                if "=" not in item:
+                    raise ConfigError(
+                        f"fault spec field {item!r} must be name=value "
+                        f"(in clause {clause!r})"
+                    )
+                name, value = (s.strip() for s in item.split("=", 1))
+                parser = _SPEC_FIELD_PARSERS.get(name)
+                if parser is None:
+                    raise ConfigError(
+                        f"unknown fault spec field {name!r}; expected one "
+                        f"of {sorted(_SPEC_FIELD_PARSERS)}"
+                    )
+                try:
+                    fields[name] = parser(value)
+                except ValueError as e:
+                    raise ConfigError(
+                        f"bad value for fault spec field {name!r}: {e}"
+                    ) from e
+        specs.append(FaultSpec(site=site, kind=kind, **fields))
+    if not specs:
+        raise ConfigError(f"fault spec {text!r} contains no clauses")
+    return FaultPlan(specs, seed=seed)

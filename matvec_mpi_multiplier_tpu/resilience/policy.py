@@ -1,0 +1,290 @@
+"""Retry/fallback policy: bounded backoff retries + per-config breakers.
+
+**Retries** (:class:`RetryPolicy`) apply to *retryable* dispatch faults
+only (see the taxonomy in ``faults.py``): exponential backoff with
+deterministic seeded jitter — the delay for (retry ordinal, attempt) is a
+pure function of the seed, so a chaos test's timing behavior replays
+exactly. Compile failures and RESOURCE_EXHAUSTED are never retried at the
+same config: the first is deterministic, the second needs a *smaller*
+program, and both are the degradation ladder's job (``engine/core.py``).
+
+**Circuit breakers** (:class:`CircuitBreaker`) exist because a config
+that failed five times in a row will, with high probability, fail the
+sixth — and every attempt burns a compile or a dispatch slot that a
+healthy fallback could have served. One breaker per ExecKey:
+
+::
+
+            failure_threshold consecutive failures
+    CLOSED ────────────────────────────────────────▶ OPEN
+      ▲                                               │
+      │ probe succeeds                                │ reset_timeout_s
+      │                                               ▼
+      └──────────────────────────────────────── HALF_OPEN
+                         probe fails ▶ OPEN     (one probe at a time)
+
+While a key's breaker is open the engine skips that ladder level
+entirely (no attempt, no wasted work); once the cooldown elapses the
+next request *probes* the preferred config — exactly one in-flight probe,
+so a recovering config is not stampeded — and a success closes the
+breaker and restores the preferred config. Clock injectable for tests.
+
+:func:`classify_failure` is the one place dispatch exceptions are read:
+injected taxonomy errors carry their own flags; real backend errors are
+classified by message (RESOURCE_EXHAUSTED → shrink, UNAVAILABLE/ABORTED
+→ retryable transient).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from ..utils.errors import ConfigError
+from .faults import FaultError, ResourceExhaustedError, _unit_hash
+
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
+# Backend error-message fragments → classification, for real (uninjected)
+# dispatch exceptions. Conservative: only statuses that are transient by
+# XLA/gRPC contract retry; everything unknown fails fast.
+_EXHAUSTED_FRAGMENT = "RESOURCE_EXHAUSTED"
+_TRANSIENT_FRAGMENTS = ("UNAVAILABLE", "ABORTED", "DEADLINE_EXCEEDED")
+
+
+def classify_failure(exc: BaseException) -> tuple[bool, bool]:
+    """``(retryable, resource_exhausted)`` for one dispatch/compile
+    exception — taxonomy errors by their flags, backend errors by
+    message fragment."""
+    if isinstance(exc, ResourceExhaustedError):
+        return False, True
+    if isinstance(exc, FaultError):
+        return exc.retryable, False
+    text = f"{type(exc).__name__}: {exc}"
+    if _EXHAUSTED_FRAGMENT in text:
+        return False, True
+    return any(f in text for f in _TRANSIENT_FRAGMENTS), False
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic seeded jitter.
+
+    ``max_attempts`` counts the first try: 3 means "one try, up to two
+    retries". ``delay_s(serial, attempt)`` is
+    ``backoff_ms · multiplier^(attempt-1) · (1 + jitter·u)`` capped at
+    ``max_backoff_ms``, with ``u`` a hash of (seed, serial, attempt) —
+    two engines with the same seed back off identically, and no retry
+    storm synchronizes across keys (each serial draws its own jitter).
+    """
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        backoff_ms: float = 1.0,
+        multiplier: float = 2.0,
+        max_backoff_ms: float = 50.0,
+        jitter: float = 0.5,
+        seed: int = 0,
+    ):
+        if max_attempts < 1:
+            raise ConfigError(
+                f"retry max_attempts must be >= 1, got {max_attempts}"
+            )
+        if backoff_ms < 0 or max_backoff_ms < 0:
+            raise ConfigError("retry backoff must be >= 0 ms")
+        if not (0.0 <= jitter <= 1.0):
+            raise ConfigError(f"retry jitter must be in [0, 1], got {jitter}")
+        self.max_attempts = int(max_attempts)
+        self.backoff_ms = float(backoff_ms)
+        self.multiplier = float(multiplier)
+        self.max_backoff_ms = float(max_backoff_ms)
+        self.jitter = float(jitter)
+        self.seed = int(seed)
+
+    def delay_s(self, serial: int, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based) of retry-sequence
+        ``serial`` — deterministic in (seed, serial, attempt), drawn from
+        the same seeded unit hash the fault plan uses (one draw scheme =
+        one replay guarantee)."""
+        base = self.backoff_ms * self.multiplier ** max(0, attempt - 1)
+        u = _unit_hash(self.seed, serial, attempt)
+        return min(base * (1.0 + self.jitter * u), self.max_backoff_ms) / 1e3
+
+
+class CircuitBreaker:
+    """Per-config failure gate: closed → open → half-open (one probe).
+
+    ``allow()`` answers "may this request attempt the config now?" —
+    True while closed, False while open (pre-cooldown), and True for
+    exactly one caller at a time once half-open. Outcomes feed back via
+    ``record_success``/``record_failure``; transitions fire the optional
+    ``on_open``/``on_close`` callbacks (counter hooks) outside the lock.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = 3,
+        reset_timeout_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        on_open: Callable[[], None] | None = None,
+        on_close: Callable[[], None] | None = None,
+    ):
+        if failure_threshold < 1:
+            raise ConfigError(
+                f"breaker failure_threshold must be >= 1, got "
+                f"{failure_threshold}"
+            )
+        if reset_timeout_s < 0:
+            raise ConfigError(
+                f"breaker reset_timeout_s must be >= 0, got {reset_timeout_s}"
+            )
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self._clock = clock
+        self._on_open = on_open
+        self._on_close = on_close
+        self._lock = threading.Lock()
+        self._state = BREAKER_CLOSED
+        self._consecutive_failures = 0
+        self._opened_at: float | None = None
+        self._probe_in_flight = False
+        self._failures_total = 0
+        self._successes_total = 0
+        self._opens_total = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._observable_state(self._clock())
+
+    def _observable_state(self, now: float) -> str:
+        """OPEN reads as HALF_OPEN once the cooldown has elapsed (the
+        transition itself happens lazily in ``allow``)."""
+        if (
+            self._state == BREAKER_OPEN
+            and self._opened_at is not None
+            and now - self._opened_at >= self.reset_timeout_s
+        ):
+            return BREAKER_HALF_OPEN
+        return self._state
+
+    def allow(self) -> bool:
+        with self._lock:
+            now = self._clock()
+            if self._state == BREAKER_OPEN:
+                if (
+                    self._opened_at is not None
+                    and now - self._opened_at >= self.reset_timeout_s
+                ):
+                    self._state = BREAKER_HALF_OPEN
+                    self._probe_in_flight = False
+                else:
+                    return False
+            if self._state == BREAKER_HALF_OPEN:
+                if self._probe_in_flight:
+                    return False  # one probe at a time
+                self._probe_in_flight = True
+                return True
+            return True  # closed
+
+    def record_success(self) -> None:
+        closed = False
+        with self._lock:
+            if self._state != BREAKER_CLOSED:
+                self._state = BREAKER_CLOSED
+                closed = True
+            self._consecutive_failures = 0
+            self._probe_in_flight = False
+            self._opened_at = None
+            self._successes_total += 1
+        if closed and self._on_close is not None:
+            self._on_close()
+
+    def record_inconclusive(self) -> None:
+        """The attempt failed for a reason that says nothing about the
+        CONFIG's health — a payload-poisoned request (``faults.py::
+        is_payload_fault``). Releases a half-open probe slot without
+        transitioning (the next request may probe again) and leaves the
+        consecutive-failure count alone: a stream of bad requests must
+        not open a healthy config's breaker."""
+        with self._lock:
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        opened = False
+        with self._lock:
+            self._failures_total += 1
+            self._probe_in_flight = False
+            if self._state == BREAKER_HALF_OPEN:
+                self._state = BREAKER_OPEN  # failed probe: back to cooldown
+                self._opened_at = self._clock()
+                self._opens_total += 1
+                opened = True
+            else:
+                self._consecutive_failures += 1
+                if (
+                    self._state == BREAKER_CLOSED
+                    and self._consecutive_failures >= self.failure_threshold
+                ):
+                    self._state = BREAKER_OPEN
+                    self._opened_at = self._clock()
+                    self._opens_total += 1
+                    opened = True
+        if opened and self._on_open is not None:
+            self._on_open()
+
+    def snapshot(self) -> dict:
+        """State + tallies for ``engine.health()``."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "state": self._observable_state(now),
+                "consecutive_failures": self._consecutive_failures,
+                "failures_total": self._failures_total,
+                "successes_total": self._successes_total,
+                "opens_total": self._opens_total,
+                "open_for_s": (
+                    round(now - self._opened_at, 6)
+                    if self._opened_at is not None else None
+                ),
+            }
+
+
+class ResiliencePolicy:
+    """The engine's recovery configuration: one retry policy plus the
+    breaker parameters every per-ExecKey breaker is minted with.
+
+    ``clock`` and ``sleep`` are injectable so breaker cooldowns and
+    retry backoffs are unit-testable without real waiting; production
+    callers never pass them.
+    """
+
+    def __init__(
+        self,
+        retry: RetryPolicy | None = None,
+        breaker_failure_threshold: int = 3,
+        breaker_reset_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.breaker_failure_threshold = int(breaker_failure_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self.clock = clock
+        self.sleep = sleep
+
+    def make_breaker(
+        self,
+        on_open: Callable[[], None] | None = None,
+        on_close: Callable[[], None] | None = None,
+    ) -> CircuitBreaker:
+        return CircuitBreaker(
+            failure_threshold=self.breaker_failure_threshold,
+            reset_timeout_s=self.breaker_reset_s,
+            clock=self.clock,
+            on_open=on_open,
+            on_close=on_close,
+        )

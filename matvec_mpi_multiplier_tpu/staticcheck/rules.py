@@ -23,6 +23,8 @@ import-time-jnp           import-ok    no jnp work at module import time
 mutable-default-arg       default-ok   no mutable default arguments
 scheduler-lock-across-    lock-ok      no engine dispatch/drain entered
 dispatch                               while holding a scheduler lock
+silent-except             swallow-ok   broad except blocks must re-raise,
+                                       record the failure, or justify
 ========================  ===========  ====================================
 
 The first four are the old grep rules from ``scripts/tier1.sh`` /
@@ -514,6 +516,89 @@ def _check_lock_across_dispatch(sf: SourceFile):
                     "batch out under the lock and dispatch after "
                     "releasing it"
                 )
+
+
+# A broad handler is "silent" unless its body does one of these: re-raise
+# (any Raise node), call something that records the failure — a metrics
+# counter (.inc/.observe), a future/breaker outcome (_fail/fail/
+# set_exception/record_failure), a collection it parks the error in
+# (.append/.put) — or bind the exception to an error-ish name
+# (`self._error = e`, `last_exc = e`). The heuristic is deliberately
+# generous about HOW a failure is recorded and strict about the
+# alternative: a handler that does none of these has made an exception
+# disappear, which in a serving system turns faults into wrong answers.
+_RECORDING_CALLS = frozenset({
+    "inc", "observe", "append", "put", "fail", "_fail", "set_exception",
+    "record", "record_failure", "warning", "error", "exception",
+})
+_ERRORISH_NAME_FRAGMENTS = ("error", "exc", "failure", "fault")
+_BROAD_EXCEPTIONS = ("Exception", "BaseException")
+
+
+def _handler_is_broad(sf: SourceFile, handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True  # bare except:
+    types = (
+        handler.type.elts if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for t in types:
+        if (sf.qualname(t) or "") in _BROAD_EXCEPTIONS:
+            return True
+    return False
+
+
+def _name_of(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _handler_records(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = _name_of(node.func)
+            if name is not None and name in _RECORDING_CALLS:
+                return True
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for target in targets:
+                name = _name_of(target)
+                if name is not None and any(
+                    frag in name.lower()
+                    for frag in _ERRORISH_NAME_FRAGMENTS
+                ):
+                    return True
+    return False
+
+
+@_register(
+    "silent-except", "swallow-ok",
+    "broad `except Exception`/bare except that neither re-raises, records "
+    "the failure (counter/future/error variable), nor carries a "
+    "justification marker",
+    _package,
+)
+def _check_silent_except(sf: SourceFile):
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _handler_is_broad(sf, node):
+            continue
+        if _handler_records(node):
+            continue
+        yield node, (
+            "broad except block swallows the failure: re-raise, record it "
+            "(obs counter, future._fail, an error variable), or mark the "
+            "deliberate swallow with '# swallow-ok: <reason>'"
+        )
 
 
 _MUTABLE_FACTORIES = (

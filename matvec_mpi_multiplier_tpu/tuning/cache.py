@@ -45,6 +45,13 @@ rather than half-parsed.
 ``gemv``/``gemm`` keys use the LOCAL (per-device) shape — the granularity
 the kernel registry's ``auto`` tier dispatches on under shard_map;
 ``combine`` and ``promote`` keys use the GLOBAL shape plus the mesh size.
+
+Corruption doctrine: a file that exists but cannot be used (truncated by
+a crash mid-write outside ``save()``'s atomic path, hand-edited garbage,
+a future schema this build cannot read) loads as **empty-and-quarantined**
+— serving falls back to static defaults, and the next ``save()`` moves
+the unusable file to ``tuning_cache.json.corrupt`` for postmortem rather
+than silently overwriting it (``tests/test_cache_corruption.py``).
 """
 
 from __future__ import annotations
@@ -153,27 +160,53 @@ def overlap_key(
 
 
 class TuningCache:
-    """In-memory view of the JSON cache file, with atomic persistence."""
+    """In-memory view of the JSON cache file, with atomic persistence.
+
+    ``quarantined`` marks a cache whose file EXISTED but could not be
+    used (truncated/garbage JSON, wrong schema, incompatible version):
+    it loads as empty — dispatch falls back to static defaults — and the
+    first :meth:`save` moves the unusable file aside to ``<name>.corrupt``
+    for postmortem instead of silently overwriting the evidence. A
+    *missing* file is not quarantined (nothing to preserve).
+    """
 
     def __init__(self, path: str | os.PathLike | None = None):
         self.path = Path(path) if path is not None else default_cache_path()
         self.entries: dict[str, dict[str, Any]] = {}
+        self.quarantined = False
+
+    @property
+    def corrupt_path(self) -> Path:
+        """Where :meth:`save` parks an unusable cache file (the most
+        recent one wins — each quarantine overwrites the last)."""
+        return self.path.with_name(self.path.name + ".corrupt")
 
     @classmethod
     def load(cls, path: str | os.PathLike | None = None) -> "TuningCache":
         """Read the cache file; a missing, unreadable, unparseable or
         wrong-version file loads as empty (dispatch then falls back to the
-        static defaults — a corrupt cache must never break a sweep)."""
+        static defaults — a corrupt cache must never break a sweep).
+        Existed-but-unusable files additionally mark the cache
+        ``quarantined`` so ``save()`` preserves them (class docstring)."""
         cache = cls(path)
         try:
-            raw = json.loads(Path(cache.path).read_text())
-        except (OSError, json.JSONDecodeError):
+            text = Path(cache.path).read_text()
+        except OSError:
+            return cache  # missing/unreadable: plain empty, no evidence
+        try:
+            raw = json.loads(text)
+        except json.JSONDecodeError:
+            cache.quarantined = True  # truncated or garbage bytes
             return cache
         if (
             not isinstance(raw, dict)
             or raw.get("version") not in COMPATIBLE_VERSIONS
             or not isinstance(raw.get("entries"), dict)
         ):
+            # Parseable but not a usable cache (wrong schema or a version
+            # this build cannot interpret): overwriting it would silently
+            # destroy someone's data — quarantine instead.
+            cache.quarantined = True
             return cache
         cache.entries = {
             str(k): v for k, v in raw["entries"].items() if isinstance(v, dict)
@@ -203,6 +236,15 @@ class TuningCache:
         if not is_main_process():
             return self.path
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        if self.quarantined:
+            # Preserve the unusable file for postmortem before the first
+            # overwrite (load() marked it; see the class docstring). The
+            # file may have vanished meanwhile — nothing to preserve then.
+            try:
+                os.replace(self.path, self.corrupt_path)
+            except OSError:
+                pass  # swallow-ok: the corrupt file disappeared between load and save — there is no evidence left to preserve
+            self.quarantined = False
         payload = {"version": CACHE_VERSION, "entries": self.entries}
         fd, tmp = tempfile.mkstemp(
             dir=self.path.parent, prefix=self.path.name, suffix=".tmp"

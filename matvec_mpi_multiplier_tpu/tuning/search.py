@@ -767,7 +767,7 @@ def local_gemv_shapes(
     elif strategy_name == "blockwise":
         try:
             r, c = mesh_grid_shape(mesh)
-        except Exception:
+        except Exception:  # swallow-ok: a non-grid mesh has no blockwise local shape; no key to tune IS the decision (dispatch falls back to static defaults)
             return shapes
         if m % r == 0 and k % c == 0:
             shapes.add((m // r, k // c))
@@ -819,7 +819,7 @@ def tune_config(
         elif strategy_name == "blockwise":
             try:
                 r, c = mesh_grid_shape(mesh)
-            except Exception:
+            except Exception:  # swallow-ok: a non-grid mesh has no blockwise local GEMM shape; skipping the kernel-tune keys is the correct decision, not a lost error
                 r = c = None
             if r and m % r == 0 and k % c == 0:
                 local.add((m // r, k // c, n))

@@ -24,6 +24,18 @@ cd "$(dirname "$0")/.."
 python -m matvec_mpi_multiplier_tpu.staticcheck --rules
 [ "${1:-}" = "--lint-only" ] && exit 0
 
+# Chaos smoke: one seeded --fault-spec serve trace end-to-end through the
+# real CLI (engine + scheduler + FaultPlan + retry policy + availability
+# columns). Deterministic (hash-derived injection draws) and small — a
+# regression here means the resilience stack cannot even start, which
+# should fail fast before the full suite spends its runtime.
+echo "chaos smoke: seeded fault-injection serve trace"
+python -m matvec_mpi_multiplier_tpu.bench.serve \
+    --strategy rowwise --sizes 64 --devices 8 \
+    --platform cpu --host-devices 8 \
+    --concurrency 4 --coalesce on --n-requests 24 --max-bucket 8 \
+    --fault-spec "dispatch:device_error:p=0.2" --fault-seed 3 --no-csv
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

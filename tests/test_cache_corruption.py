@@ -1,0 +1,107 @@
+"""Tuning-cache persistence-corruption coverage (ISSUE 7 satellite).
+
+Pins the ``cache.py`` load() catch the serving stack leans on — a corrupt
+``tuning_cache.json`` must never break a sweep or an engine construction
+— and the quarantine contract: an existed-but-unusable file loads as
+empty AND is preserved as ``tuning_cache.json.corrupt`` by the next
+``save()`` instead of being silently overwritten (postmortem evidence).
+"""
+
+import json
+
+import pytest
+
+from matvec_mpi_multiplier_tpu.tuning.cache import (
+    CACHE_VERSION,
+    TuningCache,
+)
+
+
+def _valid_payload():
+    return {
+        "version": CACHE_VERSION,
+        "entries": {"fp|gemv|8x8|float32": {"kernel": "xla", "time_s": 1e-5}},
+    }
+
+
+@pytest.fixture()
+def cache_file(tmp_path):
+    return tmp_path / "tuning_cache.json"
+
+
+def test_valid_file_loads_and_is_not_quarantined(cache_file):
+    cache_file.write_text(json.dumps(_valid_payload()))
+    cache = TuningCache.load(cache_file)
+    assert len(cache) == 1
+    assert not cache.quarantined
+    cache.save()
+    assert not cache.corrupt_path.exists()
+
+
+def test_missing_file_is_empty_but_not_quarantined(cache_file):
+    cache = TuningCache.load(cache_file)
+    assert len(cache) == 0
+    assert not cache.quarantined
+    cache.save()  # nothing to preserve
+    assert not cache.corrupt_path.exists()
+    assert cache_file.exists()
+
+
+@pytest.mark.parametrize(
+    "payload",
+    [
+        "",                                   # empty file
+        "{\"version\": 3, \"entr",            # truncated mid-write
+        "not json at all {{{",                # garbage bytes
+        json.dumps([1, 2, 3]),                # parseable, wrong shape
+        json.dumps({"version": 99, "entries": {}}),   # unknown version
+        json.dumps({"version": CACHE_VERSION, "entries": "nope"}),
+    ],
+    ids=["empty", "truncated", "garbage", "non-dict", "future-version",
+         "bad-entries"],
+)
+def test_unusable_file_loads_empty_and_quarantined(cache_file, payload):
+    cache_file.write_text(payload)
+    cache = TuningCache.load(cache_file)
+    assert len(cache) == 0
+    assert cache.quarantined
+    # lookup behaves exactly like a cold cache (static-default fallback)
+    assert cache.lookup("anything") is None
+
+
+def test_save_preserves_corrupt_file_for_postmortem(cache_file):
+    corrupt_bytes = "{\"version\": 3, \"entr"  # the crash-truncated file
+    cache_file.write_text(corrupt_bytes)
+    cache = TuningCache.load(cache_file)
+    assert cache.quarantined
+    cache.record("fp|gemv|4x4|float32", {"kernel": "xla"})
+    cache.save()
+    # the evidence moved aside, byte-identical
+    assert cache.corrupt_path.read_text() == corrupt_bytes
+    # the live file is a fresh, valid cache with the new decision
+    reloaded = TuningCache.load(cache_file)
+    assert not reloaded.quarantined
+    assert reloaded.lookup("fp|gemv|4x4|float32") == {"kernel": "xla"}
+    # a second save neither re-quarantines nor disturbs the evidence
+    cache.save()
+    assert cache.corrupt_path.read_text() == corrupt_bytes
+
+
+def test_repeated_quarantine_keeps_most_recent_evidence(cache_file):
+    cache_file.write_text("first corruption")
+    TuningCache.load(cache_file).save()
+    cache_file.write_text("second corruption")
+    TuningCache.load(cache_file).save()
+    assert TuningCache.load(cache_file).quarantined is False
+    cache = TuningCache(cache_file)
+    assert cache.corrupt_path.read_text() == "second corruption"
+
+
+def test_save_survives_corrupt_file_vanishing(cache_file):
+    cache_file.write_text("garbage {{{")
+    cache = TuningCache.load(cache_file)
+    assert cache.quarantined
+    cache_file.unlink()  # raced away between load and save
+    cache.save()  # must not raise
+    assert not cache.corrupt_path.exists()
+    assert json.loads(cache_file.read_text())["version"] == CACHE_VERSION

@@ -1,0 +1,738 @@
+"""Resilience-subsystem tests (resilience/ + engine/scheduler hardening).
+
+Layers, bottom-up:
+
+* **FaultPlan / parse grammar** — deterministic seeded injection: the
+  same plan over the same event sequence makes identical decisions.
+* **RetryPolicy / CircuitBreaker** — backoff determinism and the
+  closed→open→half-open state machine on a fake clock.
+* **Engine integration** — retries recover transient faults; the
+  degradation ladder + per-ExecKey breaker reroutes a failing config and
+  half-open-probes back; RESOURCE_EXHAUSTED shrinks the bucket ladder;
+  the NaN/Inf integrity gate refuses corrupt results; ``health()`` and
+  the ``resil_*`` obs counters expose all of it.
+* **Chaos acceptance** (``chaos`` marker — deterministic and fast, part
+  of tier-1): the ISSUE 7 criteria — a 200-request coalesced trace with
+  ≥5 %% poisoned dispatches completes with every non-poisoned request
+  bitwise-correct (batch bisection), and an ExecKey-targeted
+  compile-failure plan demonstrably opens and half-open-recovers the
+  breaker with the downgrade visible in ``engine.health()``.
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.engine import (
+    ArrivalWindowScheduler,
+    MatvecEngine,
+)
+from matvec_mpi_multiplier_tpu.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    CircuitBreaker,
+    CompileFaultError,
+    DeviceFaultError,
+    FaultPlan,
+    FaultSpec,
+    ResiliencePolicy,
+    ResourceExhaustedError,
+    RetryPolicy,
+    classify_failure,
+    parse_fault_spec,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def quiet_policy(**kwargs):
+    """A ResiliencePolicy that never really sleeps (tests)."""
+    kwargs.setdefault("retry", RetryPolicy(max_attempts=3))
+    kwargs.setdefault("sleep", lambda s: None)
+    return ResiliencePolicy(**kwargs)
+
+
+def make_engine(rng, m=64, k=64, **kwargs):
+    a = rng.uniform(0, 10, (m, k)).astype("float32")
+    kwargs.setdefault("promote", 2)
+    kwargs.setdefault("max_bucket", 8)
+    return a, MatvecEngine(a, make_mesh(8), strategy="rowwise", **kwargs)
+
+
+# ------------------------------------------------------------- fault plan
+
+
+def test_fault_plan_is_deterministic_per_seed():
+    def run(seed):
+        plan = FaultPlan(
+            [FaultSpec(site="dispatch", kind="device_error", p=0.3)],
+            seed=seed,
+        )
+        fired = []
+        for i in range(100):
+            action = plan.check("dispatch", "matvec:rowwise:xla:default:1:f")
+            fired.append(action is not None)
+        return fired
+
+    first = run(7)
+    assert first == run(7)  # exact replay
+    assert first != run(8)  # and actually seed-dependent
+    assert 10 < sum(first) < 60  # p=0.3ish, not degenerate
+
+
+def test_fault_plan_times_after_and_key_scoping():
+    plan = FaultPlan([
+        FaultSpec(site="dispatch", kind="device_error", key="*gemm*",
+                  times=2, after=1),
+    ])
+    label = "gemm:rowwise:xla:default:8:float32"
+    assert plan.check("dispatch", "matvec:rowwise:xla:default:1:f") is None
+    assert plan.check("compile", label) is None  # wrong site
+    assert plan.check("dispatch", label) is None  # after=1 spares the first
+    assert plan.check("dispatch", label) is not None
+    assert plan.check("dispatch", label) is not None  # times=2 exhausted...
+    assert plan.check("dispatch", label) is None
+    summary = plan.summary()["specs"][0]
+    assert summary["matched"] == 4 and summary["injected"] == 2
+
+
+def test_fault_plan_poison_scoping_matches_payload():
+    poison = 1e30
+    plan = FaultPlan([
+        FaultSpec(site="dispatch", kind="device_error", poison=poison),
+    ])
+    clean = np.ones((4, 2), np.float32)
+    assert plan.check("dispatch", "k", block=clean) is None
+    bad = clean.copy()
+    bad[0, 1] = np.float32(poison)
+    action = plan.check("dispatch", "k", block=bad)
+    assert action is not None
+    assert isinstance(action.error, DeviceFaultError)
+    assert action.error.retryable is False  # poisoned => persistent
+
+
+def test_fault_plan_disarm_spares_events():
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="device_error")])
+    plan.disarm()
+    assert plan.check("dispatch", "k") is None
+    assert plan.summary()["specs"][0]["matched"] == 0  # not even tallied
+    plan.arm()
+    assert plan.check("dispatch", "k") is not None
+
+
+def test_fault_kinds_map_to_taxonomy_and_actions():
+    def one(spec, site="dispatch"):
+        return FaultPlan([spec]).check(site, "k")
+
+    assert isinstance(
+        one(FaultSpec(site="compile", kind="compile_error"),
+            site="compile").error,
+        CompileFaultError,
+    )
+    assert isinstance(
+        one(FaultSpec(site="dispatch", kind="resource_exhausted")).error,
+        ResourceExhaustedError,
+    )
+    nan_action = one(FaultSpec(site="dispatch", kind="nan"))
+    assert nan_action.corrupt and nan_action.error is None
+    lat = one(FaultSpec(site="dispatch", kind="latency", latency_ms=3.0))
+    assert lat.latency_ms == 3.0 and not lat.corrupt and lat.error is None
+
+
+def test_fault_plan_first_matching_spec_wins():
+    plan = FaultPlan([
+        FaultSpec(site="dispatch", kind="resource_exhausted", times=1),
+        FaultSpec(site="dispatch", kind="nan"),
+    ])
+    assert isinstance(plan.check("dispatch", "k").error,
+                      ResourceExhaustedError)
+    # spec 0 exhausted: the nan spec (fresh ordinals) takes over
+    assert plan.check("dispatch", "k").corrupt
+
+
+def test_parse_fault_spec_grammar_round_trip():
+    plan = parse_fault_spec(
+        "dispatch:device_error:p=0.05;"
+        "compile:compile_error:key=*psum_scatter*,times=4;"
+        "dispatch:latency:latency_ms=5,p=0.1,after=2,retryable=0",
+        seed=9,
+    )
+    assert plan.seed == 9
+    d, c, l = plan.specs
+    assert d.p == 0.05 and d.key == "*"
+    assert c.key == "*psum_scatter*" and c.times == 4
+    assert l.latency_ms == 5.0 and l.after == 2 and l.retryable is False
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense",                      # no site:kind
+    "dispatch:explode",              # unknown kind
+    "teleport:device_error",         # unknown site
+    "dispatch:device_error:p=2.0",   # probability out of range
+    "dispatch:device_error:frobnicate=1",  # unknown field
+    "dispatch:latency",              # latency without latency_ms
+    ";;",                            # empty
+])
+def test_parse_fault_spec_rejects_malformed(bad):
+    with pytest.raises(ConfigError):
+        parse_fault_spec(bad)
+
+
+def test_classify_failure_reads_real_backend_messages():
+    assert classify_failure(RuntimeError("RESOURCE_EXHAUSTED: oom")) == (
+        False, True,
+    )
+    assert classify_failure(RuntimeError("UNAVAILABLE: link flap")) == (
+        True, False,
+    )
+    assert classify_failure(ValueError("shape mismatch")) == (False, False)
+
+
+# ---------------------------------------------------------- retry policy
+
+
+def test_retry_delay_deterministic_growing_and_capped():
+    r = RetryPolicy(backoff_ms=1.0, multiplier=2.0, max_backoff_ms=4.0,
+                    jitter=0.5, seed=3)
+    d1, d2, d3 = (r.delay_s(0, a) for a in (1, 2, 3))
+    assert d1 == r.delay_s(0, 1)  # deterministic
+    assert d1 < d2  # growing
+    assert d3 <= 4.0 / 1e3  # capped
+    assert r.delay_s(0, 1) != r.delay_s(1, 1)  # jitter varies per serial
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+def test_breaker_state_machine_and_single_probe():
+    clock = FakeClock()
+    opens, closes = [], []
+    br = CircuitBreaker(
+        failure_threshold=3, reset_timeout_s=10.0, clock=clock,
+        on_open=lambda: opens.append(clock.t),
+        on_close=lambda: closes.append(clock.t),
+    )
+    assert br.state == BREAKER_CLOSED
+    for _ in range(2):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == BREAKER_CLOSED  # below threshold
+    assert br.allow()
+    br.record_failure()
+    assert br.state == BREAKER_OPEN and len(opens) == 1
+    assert not br.allow()  # pre-cooldown: refuse
+    clock.advance(10.0)
+    assert br.state == BREAKER_HALF_OPEN
+    assert br.allow()       # the one probe
+    assert not br.allow()   # a second caller must wait the probe out
+    br.record_failure()     # failed probe: back to open, timer reset
+    assert br.state == BREAKER_OPEN and len(opens) == 2
+    assert not br.allow()
+    clock.advance(10.0)
+    assert br.allow()
+    br.record_success()     # successful probe: recovered
+    assert br.state == BREAKER_CLOSED and len(closes) == 1
+    snap = br.snapshot()
+    assert snap["failures_total"] == 4 and snap["opens_total"] == 2
+
+
+def test_breaker_success_resets_consecutive_failures():
+    br = CircuitBreaker(failure_threshold=2, clock=FakeClock())
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    assert br.state == BREAKER_CLOSED  # never two in a row
+
+
+def test_breaker_inconclusive_releases_probe_without_transition():
+    """A payload-caused failure is inconclusive about the CONFIG: it
+    must not advance the failure count while closed, and a half-open
+    probe that hit one must release the probe slot so the next request
+    can probe again (not transition back to open)."""
+    clock = FakeClock()
+    br = CircuitBreaker(
+        failure_threshold=2, reset_timeout_s=10.0, clock=clock
+    )
+    for _ in range(5):
+        br.record_inconclusive()
+    assert br.state == BREAKER_CLOSED
+    assert br.snapshot()["consecutive_failures"] == 0
+    br.record_failure()
+    br.record_failure()  # real failures still open it
+    assert br.state == BREAKER_OPEN
+    clock.advance(10.0)
+    assert br.allow()        # the one half-open probe
+    br.record_inconclusive()  # probe drew a poisoned request
+    assert br.state == BREAKER_HALF_OPEN  # not re-opened
+    assert br.allow()        # slot released: next caller may probe
+    br.record_success()
+    assert br.state == BREAKER_CLOSED
+
+
+# ------------------------------------------------- engine: fault hooks
+
+
+def test_transient_dispatch_fault_retries_to_success(devices, rng):
+    plan = FaultPlan(
+        [FaultSpec(site="dispatch", kind="device_error", times=2)]
+    )
+    a, eng = make_engine(rng, fault_plan=plan, resilience=quiet_policy())
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    np.testing.assert_allclose(eng(x), a @ x, rtol=1e-5)
+    h = eng.health()
+    assert h["counters"]["retries"] == 2
+    assert h["counters"]["faults_injected"] == 2
+    assert h["counters"]["downgrades"] == 0  # same level recovered
+    assert h["counters"]["dispatch_failures"] == 0
+
+
+def test_retries_exhausted_raises_and_counts_dispatch_failure(devices, rng):
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="device_error")])
+    a, eng = make_engine(
+        rng, fault_plan=plan,
+        resilience=quiet_policy(retry=RetryPolicy(max_attempts=2)),
+    )
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    with pytest.raises(DeviceFaultError):
+        eng.submit(x)
+    h = eng.health()
+    # preferred == safe config for the default engine: a one-level ladder
+    assert h["counters"]["dispatch_failures"] == 1
+    assert eng.tracer.traces()[-1]["status"] == "dispatch_failed"
+
+
+def test_fault_plan_without_policy_propagates_raw(devices, rng):
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="device_error")])
+    a, eng = make_engine(rng, fault_plan=plan)
+    with pytest.raises(DeviceFaultError):
+        eng.submit(rng.uniform(0, 10, (64,)).astype(np.float32))
+    assert eng.health()["counters"]["retries"] == 0
+
+
+def test_latency_fault_stalls_but_serves(devices, rng):
+    plan = FaultPlan(
+        [FaultSpec(site="dispatch", kind="latency", latency_ms=1.0, times=1)]
+    )
+    a, eng = make_engine(rng, fault_plan=plan, resilience=quiet_policy())
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    np.testing.assert_allclose(eng(x), a @ x, rtol=1e-5)
+    assert eng.health()["counters"]["faults_injected"] == 1
+
+
+# ------------------------------------- engine: ladder, breaker, shrink
+
+
+def test_compile_fault_degrades_then_half_open_recovers(devices, rng):
+    """The acceptance breaker story: an ExecKey-targeted compile-failure
+    plan on an exotic combine opens the breaker (requests keep succeeding
+    through the safe fallback — graceful degradation, zero client-visible
+    failures), and once the plan exhausts, the half-open probe restores
+    the preferred config."""
+    clock = FakeClock()
+    plan = FaultPlan([
+        FaultSpec(site="compile", kind="compile_error",
+                  key="*psum_scatter*", times=4),
+    ])
+    pol = quiet_policy(
+        retry=RetryPolicy(max_attempts=2),
+        breaker_failure_threshold=3, breaker_reset_s=5.0, clock=clock,
+    )
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="colwise", combine="psum_scatter",
+        max_bucket=8, promote=None, fault_plan=plan, resilience=pol,
+    )
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+
+    # 3 failures open the breaker; every request still serves (degraded).
+    for _ in range(4):
+        np.testing.assert_allclose(eng(x), a @ x, rtol=1e-5)
+    h = eng.health()
+    pref = [l for l in h["breakers"] if "psum_scatter" in l]
+    assert pref and h["breakers"][pref[0]]["state"] == BREAKER_OPEN
+    assert h["degraded"] == {
+        "matvec:colwise:xla:psum_scatter:1:float32":
+            "matvec:colwise:xla:default:1:float32",
+    }
+    assert h["counters"]["breaker_opens"] == 1
+    assert h["counters"]["downgrades"] == 4
+    assert h["counters"]["dispatch_failures"] == 0  # nobody failed
+
+    # Cooldown -> probe hits injected fault #4 -> reopens.
+    clock.advance(6.0)
+    np.testing.assert_allclose(eng(x), a @ x, rtol=1e-5)
+    h = eng.health()
+    assert h["breakers"][pref[0]]["state"] == BREAKER_OPEN
+    assert h["counters"]["breaker_opens"] == 2
+
+    # Second cooldown -> plan exhausted -> probe compiles -> recovery.
+    clock.advance(6.0)
+    np.testing.assert_allclose(eng(x), a @ x, rtol=1e-5)
+    h = eng.health()
+    assert h["breakers"][pref[0]]["state"] == BREAKER_CLOSED
+    assert h["counters"]["recoveries"] == 1
+    assert h["degraded"] == {}  # preferred config restored
+    # the obs registry carries the same story (one source of truth)
+    counters = eng.metrics.snapshot()["counters"]
+    assert counters["resil_breaker_opens_total"] == 2
+    assert counters["resil_recoveries_total"] == 1
+    assert counters["resil_downgrades_total"] == h["counters"]["downgrades"]
+
+
+def test_open_breaker_skips_preferred_attempts(devices, rng):
+    """While open, the failing config is not even attempted — the fault
+    plan sees no new compile events until the half-open probe."""
+    clock = FakeClock()
+    plan = FaultPlan([
+        FaultSpec(site="compile", kind="compile_error",
+                  key="*psum_scatter*"),
+    ])
+    pol = quiet_policy(
+        retry=RetryPolicy(max_attempts=1),
+        breaker_failure_threshold=2, breaker_reset_s=30.0, clock=clock,
+    )
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="colwise", combine="psum_scatter",
+        max_bucket=8, promote=None, fault_plan=plan, resilience=pol,
+    )
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    for _ in range(6):
+        eng(x)
+    # 2 attempts opened the breaker; the other 4 went straight to safe.
+    assert eng.health()["fault_injection"]["specs"][0]["injected"] == 2
+
+
+def test_resource_exhausted_shrinks_bucket_ladder(devices, rng):
+    plan = FaultPlan([
+        FaultSpec(site="dispatch", kind="resource_exhausted",
+                  key="gemm:*:8:*"),
+    ])
+    a, eng = make_engine(rng, fault_plan=plan, resilience=quiet_policy())
+    blk = rng.uniform(0, 10, (64, 8)).astype(np.float32)
+    np.testing.assert_allclose(eng(blk), a @ blk, rtol=1e-5)
+    h = eng.health()
+    assert h["counters"]["downgrades"] >= 1  # the shrink
+    assert h["counters"]["dispatch_failures"] == 0
+    # the 8-wide bucket is marked failing; the halves served
+    assert any("8" in label for label in h["breakers"])
+
+
+def test_gemm_ladder_falls_to_per_column_gemv(devices, rng):
+    """Every GEMM level failing degrades the promotion decision itself:
+    the block serves as per-column GEMV dispatches."""
+    plan = FaultPlan([
+        FaultSpec(site="dispatch", kind="device_error", key="gemm:*",
+                  retryable=False),
+    ])
+    a, eng = make_engine(rng, fault_plan=plan, resilience=quiet_policy())
+    blk = rng.uniform(0, 10, (64, 4)).astype(np.float32)
+    np.testing.assert_allclose(eng(blk), a @ blk, rtol=1e-5)
+    h = eng.health()
+    assert h["counters"]["downgrades"] >= 1
+    assert h["counters"]["dispatch_failures"] == 0
+    # per-column results must be the matvec path's exact outputs
+    solo = np.stack([eng(blk[:, j]) for j in range(4)], axis=1)
+    np.testing.assert_array_equal(eng(blk), solo)
+
+
+def test_poisoned_payloads_do_not_open_breaker(devices, rng):
+    """A client streaming poisoned requests must not become a
+    performance-degradation vector for everyone else: payload faults are
+    exempt from config-health accounting, so the breaker stays closed
+    and healthy traffic keeps riding the preferred config."""
+    poison = 1e30
+    plan = FaultPlan([
+        FaultSpec(site="dispatch", kind="device_error", poison=poison),
+    ])
+    pol = quiet_policy(breaker_failure_threshold=3)
+    a, eng = make_engine(rng, fault_plan=plan, resilience=pol)
+    bad = rng.uniform(0, 10, (64,)).astype(np.float32)
+    bad[0] = np.float32(poison)
+    for _ in range(5):  # well past the 3-failure threshold
+        with pytest.raises(DeviceFaultError):
+            eng(bad)
+    h = eng.health()
+    for label, snap in h["breakers"].items():
+        assert snap["state"] == BREAKER_CLOSED, label
+        assert snap["consecutive_failures"] == 0, label
+    # Healthy traffic is untouched: preferred config, no downgrade.
+    good = rng.uniform(0, 10, (64,)).astype(np.float32)
+    np.testing.assert_allclose(eng(good), a @ good, rtol=1e-5)
+    assert h["degraded"] == {}
+    assert eng.health()["counters"]["downgrades"] == 0
+
+
+def test_health_is_safe_under_degradation_churn(devices, rng):
+    """health() snapshots the degraded map while dispatch threads flip
+    configs between degraded and recovered — the copy must be taken
+    under the same lock the ladder mutates under (a bare dict() copy
+    can raise RuntimeError mid-iteration)."""
+    import threading
+
+    plan = FaultPlan([
+        # Scoped to the preferred (ring-gather) config only, 50/50: each
+        # request either degrades to the safe tier (map insert) or serves
+        # preferred (map pop) — sustained churn on _degraded.
+        FaultSpec(site="dispatch", kind="device_error", key="*:ring:*",
+                  p=0.5, retryable=False),
+    ])
+    pol = quiet_policy(
+        retry=RetryPolicy(max_attempts=1),
+        breaker_failure_threshold=10_000,  # keep the preferred level live
+    )
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    eng = MatvecEngine(
+        a, make_mesh(8), strategy="rowwise", combine="ring",
+        max_bucket=8, promote=None, fault_plan=plan, resilience=pol,
+    )
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def poll():
+        try:
+            while not stop.is_set():
+                eng.health()
+        except BaseException as e:
+            errors.append(e)
+
+    t = threading.Thread(target=poll)
+    t.start()
+    try:
+        x = rng.uniform(0, 10, (64,)).astype(np.float32)
+        for _ in range(60):
+            np.testing.assert_allclose(eng(x), a @ x, rtol=1e-5)
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+    assert not errors, errors
+    assert eng.health()["counters"]["downgrades"] > 0  # churn was real
+
+
+# ------------------------------------------- integrity gate & corruption
+
+
+def test_nan_fault_with_gate_refuses_then_recovers(devices, rng):
+    from matvec_mpi_multiplier_tpu.resilience import ResultIntegrityError
+
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="nan", times=1)])
+    a, eng = make_engine(rng, fault_plan=plan, integrity_gate=True)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    with pytest.raises(ResultIntegrityError):
+        eng(x)
+    assert eng.tracer.traces()[-1]["status"] == "integrity_failed"
+    np.testing.assert_allclose(eng(x), a @ x, rtol=1e-5)
+    assert eng.health()["counters"]["integrity_failures"] == 1
+
+
+def test_integrity_refusal_is_cached_on_the_future(devices, rng):
+    """A gate refusal behaves like any other future failure: repeated
+    result() raises the SAME error without re-counting the refusal, and
+    exception() reports it — on both the engine future and the
+    scheduler's per-slice gate."""
+    from matvec_mpi_multiplier_tpu.resilience import ResultIntegrityError
+
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="nan", times=1)])
+    a, eng = make_engine(rng, fault_plan=plan, integrity_gate=True)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    fut = eng.submit(x)
+    with pytest.raises(ResultIntegrityError):
+        fut.result()
+    with pytest.raises(ResultIntegrityError):
+        fut.result()
+    assert isinstance(fut.exception(), ResultIntegrityError)
+    assert eng.health()["counters"]["integrity_failures"] == 1
+    eng.close()
+
+    # Per-slice gate on a coalesced future: same caching contract.
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="nan", times=1)])
+    a, eng = make_engine(rng, fault_plan=plan, integrity_gate=True)
+    sched = ArrivalWindowScheduler(eng, auto_flush=False, window_ms=50.0)
+    futs = [sched.submit(x) for _ in range(2)]
+    sched.flush()
+    with pytest.raises(ResultIntegrityError):
+        futs[0].result(timeout=10)
+    with pytest.raises(ResultIntegrityError):
+        futs[0].result(timeout=10)
+    assert isinstance(futs[0].exception(), ResultIntegrityError)
+    np.testing.assert_allclose(
+        futs[1].result(timeout=10), a @ x, rtol=1e-5
+    )
+    assert eng.health()["counters"]["integrity_failures"] == 1
+    sched.close()
+    eng.close()
+
+
+def test_nan_fault_without_gate_serves_corrupt_data(devices, rng):
+    """The gate is what stands between corruption and the caller: off,
+    the NaN goes through — the documented trade the flag controls."""
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="nan", times=1)])
+    a, eng = make_engine(rng, fault_plan=plan)
+    out = eng(rng.uniform(0, 10, (64,)).astype(np.float32))
+    assert np.isnan(out[0])
+
+
+def test_per_request_integrity_override(devices, rng):
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="nan")])
+    a, eng = make_engine(rng, fault_plan=plan)  # engine default: gate off
+    from matvec_mpi_multiplier_tpu.resilience import ResultIntegrityError
+
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    with pytest.raises(ResultIntegrityError):
+        eng.submit(x, integrity=True).result()
+
+
+# ------------------------------------------------------- close semantics
+
+
+def test_close_is_idempotent_and_flushes_failed_traces(devices, rng, tmp_path):
+    """ISSUE 7 small fix: close() must be idempotent and exception-safe —
+    traces flush even when in-flight futures hold failures."""
+    import json
+
+    trace_path = tmp_path / "trace.jsonl"
+    plan = FaultPlan(
+        [FaultSpec(site="dispatch", kind="device_error", after=1)]
+    )
+    a, eng = make_engine(
+        rng, fault_plan=plan, trace_jsonl=str(trace_path)
+    )
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    ok = eng.submit(x)  # healthy (after=1 spares it)
+    with pytest.raises(DeviceFaultError):
+        eng.submit(x)  # the failure an in-flight stream would hold
+    eng.close()
+    eng.close()  # idempotent: second close is a no-op, not an error
+    records = [
+        json.loads(line)
+        for line in trace_path.read_text().splitlines() if line
+    ]
+    assert any(r["status"] == "dispatch_failed" for r in records)
+    # the healthy future still materializes after close (device work done)
+    np.testing.assert_allclose(ok.result(), a @ x, rtol=1e-5)
+
+
+def test_close_without_sink_is_safe(devices, rng):
+    _, eng = make_engine(rng)
+    eng.close()
+    eng.close()
+
+
+# --------------------------------------------------- chaos acceptance
+
+
+@pytest.mark.chaos
+def test_chaos_200_request_coalesced_trace_bisection_exactness(devices, rng):
+    """ISSUE 7 acceptance: a 200-request coalesced serve trace with ≥5 %
+    poisoned dispatch faults completes with every non-poisoned request
+    returning a BITWISE-correct result — batch bisection isolates exactly
+    the poisoned requests, and the bucket-preserving re-pad keeps
+    survivors on the same executable with the same padded width as the
+    unfaulted batch."""
+    m = k = 64
+    n_requests, batch = 200, 8
+    a = rng.uniform(0, 10, (m, k)).astype(np.float32)
+    mesh = make_mesh(8)
+    poison = 1e30
+
+    cols = [
+        rng.uniform(0, 10, (k,)).astype(np.float32)
+        for _ in range(n_requests)
+    ]
+    poison_rng = np.random.default_rng(11)
+    poisoned = set(
+        int(i) for i in poison_rng.choice(n_requests, size=11, replace=False)
+    )
+    assert len(poisoned) / n_requests >= 0.05
+    for i in poisoned:
+        cols[i][0] = np.float32(poison)
+
+    def run(fault):
+        plan = (
+            FaultPlan([FaultSpec(
+                site="dispatch", kind="device_error", poison=poison,
+            )])
+            if fault else None
+        )
+        eng = MatvecEngine(
+            a, mesh, strategy="rowwise", max_bucket=batch, promote=1,
+            fault_plan=plan,
+        )
+        # width == max_bucket triggers the inline flush: deterministic
+        # batches of 8 in submission order, no flusher thread involved.
+        sched = ArrivalWindowScheduler(
+            eng, window_ms=1000.0, auto_flush=False, flush_width=batch,
+        )
+        futs = [sched.submit(c) for c in cols]
+        sched.flush()
+        outs = []
+        for f in futs:
+            try:
+                outs.append(f.result(timeout=10))
+            except DeviceFaultError:
+                outs.append(None)
+        sched.close()
+        return outs, eng
+
+    reference, _ = run(fault=False)
+    assert all(r is not None for r in reference)
+    chaotic, eng = run(fault=True)
+
+    for i in range(n_requests):
+        if i in poisoned:
+            assert chaotic[i] is None, f"poisoned request {i} served"
+        else:
+            assert chaotic[i] is not None, f"healthy request {i} failed"
+            np.testing.assert_array_equal(
+                chaotic[i], reference[i],
+                err_msg=f"request {i} not bitwise vs the unfaulted run",
+            )
+
+    counters = eng.metrics.snapshot()["counters"]
+    assert counters["sched_isolated_failures_total"] == len(poisoned)
+    assert counters["sched_bisect_splits_total"] >= len(poisoned)
+    assert counters["engine_dispatch_failures_total"] >= len(poisoned)
+    assert counters["resil_faults_injected_total"] >= len(poisoned)
+
+
+@pytest.mark.chaos
+def test_chaos_scheduler_integrity_gate_isolates_corrupt_column(devices, rng):
+    """One corrupt column in a coalesced batch fails ONE caller; the
+    batchmates' slices are finite and serve."""
+    from matvec_mpi_multiplier_tpu.resilience import ResultIntegrityError
+
+    plan = FaultPlan([FaultSpec(site="dispatch", kind="nan", times=1)])
+    a, eng = make_engine(
+        rng, promote=1, fault_plan=plan, integrity_gate=True
+    )
+    sched = ArrivalWindowScheduler(
+        eng, window_ms=1000.0, auto_flush=False, flush_width=8
+    )
+    cols = [
+        rng.uniform(0, 10, (64,)).astype(np.float32) for _ in range(8)
+    ]
+    futs = [sched.submit(c) for c in cols]
+    sched.flush()
+    outcomes = []
+    for c, f in zip(cols, futs):
+        try:
+            np.testing.assert_allclose(f.result(timeout=10), a @ c, rtol=1e-5)
+            outcomes.append("ok")
+        except ResultIntegrityError:
+            outcomes.append("refused")
+    assert outcomes.count("refused") == 1
+    assert eng.metrics.snapshot()["counters"][
+        "engine_integrity_failures_total"
+    ] == 1
+    sched.close()

@@ -604,6 +604,98 @@ def test_close_flushes_pending_and_refuses_new(devices, rng):
     sched.close()  # idempotent
 
 
+def test_bisection_isolates_poisoned_request(devices, rng):
+    """A failed coalesced dispatch bisects: only the request that fails
+    ALONE fails its caller; batchmates get bitwise-correct results
+    (bucket-preserving re-pad — same executable, same padded width as
+    the unfaulted batch would have used)."""
+    from matvec_mpi_multiplier_tpu.resilience import (
+        DeviceFaultError,
+        FaultPlan,
+        FaultSpec,
+    )
+
+    poison = 1e30
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    mesh = make_mesh(8)
+
+    cols = [rng.uniform(0, 10, (64,)).astype(np.float32) for _ in range(8)]
+    cols[5][0] = np.float32(poison)
+
+    def run(fault):
+        plan = (
+            FaultPlan([FaultSpec(
+                site="dispatch", kind="device_error", poison=poison,
+            )])
+            if fault else None
+        )
+        eng = MatvecEngine(
+            a, mesh, strategy="rowwise", max_bucket=8, promote=1,
+            fault_plan=plan,
+        )
+        sched = make_sched(eng, flush_width=8)
+        futs = [sched.submit(c) for c in cols]  # 8th submit flushes inline
+        outs = []
+        for f in futs:
+            try:
+                outs.append(f.result(timeout=10))
+            except DeviceFaultError:
+                outs.append(None)
+        sched.close()
+        return outs, eng
+
+    reference, _ = run(fault=False)
+    chaotic, eng = run(fault=True)
+    for i in range(8):
+        if i == 5:
+            assert chaotic[i] is None
+        else:
+            np.testing.assert_array_equal(chaotic[i], reference[i])
+    counters = eng.metrics.snapshot()["counters"]
+    assert counters["sched_isolated_failures_total"] == 1
+    # 8 -> 4 -> 2 -> 1: three splits along the poisoned path
+    assert counters["sched_bisect_splits_total"] == 3
+    # bisection never recompiled: every re-pad rode the original bucket
+    assert eng.stats.compiles == 1
+
+
+def test_bisection_below_promotion_keeps_per_column_exactness(devices, rng):
+    """A sub-b* flush rides the per-column path; bisection re-dispatches
+    halves at natural width (no re-pad) and per-column results stay
+    bitwise equal to solo vector submits — the PR 6 doctrine."""
+    from matvec_mpi_multiplier_tpu.resilience import (
+        DeviceFaultError,
+        FaultPlan,
+        FaultSpec,
+    )
+
+    poison = 1e30
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    mesh = make_mesh(8)
+    plan = FaultPlan([FaultSpec(
+        site="dispatch", kind="device_error", poison=poison,
+    )])
+    eng = MatvecEngine(
+        a, mesh, strategy="rowwise", max_bucket=8, promote=None,
+        fault_plan=plan,
+    )
+    solo_eng = MatvecEngine(
+        a, mesh, strategy="rowwise", max_bucket=8, promote=None
+    )
+    sched = make_sched(eng, flush_width=8)
+    cols = [rng.uniform(0, 10, (64,)).astype(np.float32) for _ in range(3)]
+    cols[1][0] = np.float32(poison)
+    futs = [sched.submit(c) for c in cols]
+    sched.flush()
+    with pytest.raises(DeviceFaultError):
+        futs[1].result(timeout=10)
+    for i in (0, 2):
+        np.testing.assert_array_equal(
+            futs[i].result(timeout=10), solo_eng(cols[i])
+        )
+    sched.close()
+
+
 def test_failed_dispatch_fails_every_future_in_batch(devices, rng):
     """engine.submit raising at flush time must fail the whole batch's
     futures (no client hangs in result()) and leave the scheduler
@@ -627,6 +719,48 @@ def test_failed_dispatch_fails_every_future_in_batch(devices, rng):
     f3 = sched.submit(x)
     sched.flush()
     np.testing.assert_allclose(f3.result(), a @ x, rtol=1e-5)
+
+
+def test_bisection_declares_systemic_failure_and_stops_splitting(
+    devices, rng
+):
+    """A batch-independent outage (every dispatch fails, error carries no
+    payload scope) must NOT bisect to the leaves: after the offered flush
+    and its two halves all fail with zero successes, the rest of the
+    batch fails at once — bounded dispatch attempts instead of
+    O(n log n) futile re-dispatches, counted as batch failures, not as
+    bisection-isolated poison."""
+    a, eng = make_engine(rng)
+    sched = make_sched(eng, flush_width=8)
+    x = rng.uniform(0, 10, (64,)).astype(np.float32)
+    futs = [sched.submit(x) for _ in range(7)]
+    boom = RuntimeError("backend down")
+    attempts = []
+    real_submit = eng.submit
+
+    def down(*args, **kwargs):
+        attempts.append(args[0].shape)
+        raise boom
+
+    eng.submit = down
+    try:
+        sched.flush()
+    finally:
+        eng.submit = real_submit
+    for f in futs:
+        with pytest.raises(RuntimeError, match="backend down"):
+            f.result()
+    # Offered flush + two halves = the systemic threshold; nothing below
+    # the halves was ever dispatched.
+    assert len(attempts) == 3
+    counters = eng.metrics.snapshot()["counters"]
+    assert counters["sched_isolated_failures_total"] == 0
+    assert counters["sched_batch_failures_total"] == 7
+    assert counters["sched_bisect_splits_total"] == 2
+    # A flush that never reached the device is not counted as
+    # coalescing: no batch, no width observation, no amortized bytes.
+    assert counters["sched_batches_total"] == 0
+    assert counters.get("sched_amortized_bytes_total", 0) == 0
 
 
 def test_context_manager(devices, rng):

@@ -331,6 +331,114 @@ def test_serve_cli_load_mode(devices, capsys):
     assert "2 serve configs measured" in out
 
 
+@pytest.mark.chaos
+def test_serve_load_chaos_poison_counts_failures_exactly(devices, tmp_path):
+    """Chaos mode end-to-end: a seeded --poison-rate trace through the
+    coalescing scheduler fails EXACTLY the poisoned requests (bisection
+    isolates them), and the availability columns + resilience counters
+    land in the CSV row and the metrics snapshot."""
+    import json
+
+    metrics_path = tmp_path / "m.json"
+    mesh = make_mesh(8)
+    result = run_serve_load(
+        "rowwise", mesh, 64, 64, n_requests=40, max_bucket=8,
+        promote=1, concurrency=4, coalesce=True, seed=0,
+        poison_rate=0.1, fault_seed=3,
+        metrics_out=str(metrics_path),
+    )
+    n_poisoned = 4  # round(0.1 * 40), seeded choice
+    assert result.failed_requests == n_poisoned
+    assert result.success_rate == pytest.approx(1 - n_poisoned / 40)
+    snap = json.loads(metrics_path.read_text())
+    c = snap["counters"]
+    assert c["serve_failed_requests_total"] == n_poisoned
+    assert c["sched_isolated_failures_total"] == n_poisoned
+    assert c["resil_faults_injected_total"] >= n_poisoned
+    # chaos engages the recovery policy by default: counters exist
+    assert "resil_retries_total" in c
+    # the CSV row round-trips the availability columns
+    path = append_serve_result(result, tmp_path)
+    row = read_csv(path)[0]
+    assert row["failed_requests"] == n_poisoned
+    assert 0.0 < row["success_rate"] < 1.0
+    assert row["retries"] >= 0 and row["downgrades"] >= 0
+
+
+@pytest.mark.chaos
+def test_serve_load_chaos_uncoalesced_counts_submit_failures(
+    devices, tmp_path
+):
+    """Without coalescing a poisoned dispatch raises from submit()
+    itself (no batch to bisect) — the load loop must count it as a fault
+    failure, not crash the run. The obs panel's availability must agree
+    with the CSV success_rate: its denominator is the steady-phase
+    offered count (serve_requests_total), NOT engine_requests_total,
+    which also counts warmup submits."""
+    import json
+
+    from matvec_mpi_multiplier_tpu.obs.__main__ import render_metrics
+
+    metrics_path = tmp_path / "m.json"
+    mesh = make_mesh(8)
+    result = run_serve_load(
+        "rowwise", mesh, 64, 64, n_requests=20, max_bucket=8,
+        promote=1, concurrency=2, coalesce=False, seed=0,
+        poison_rate=0.1, fault_seed=3,
+        metrics_out=str(metrics_path),
+    )
+    assert result.failed_requests == 2  # round(0.1 * 20), seeded
+    assert result.success_rate == pytest.approx(0.9)
+    snap = json.loads(metrics_path.read_text())
+    c = snap["counters"]
+    assert c["serve_requests_total"] == 20
+    assert c["engine_requests_total"] > 20  # warmup submits included
+    panel = render_metrics(snap)
+    assert f"availability      {result.success_rate:.4f}" in panel
+    # same property on the open-loop pacing thread
+    result = run_serve_load(
+        "rowwise", mesh, 64, 64, n_requests=20, max_bucket=8,
+        promote=1, coalesce=False, arrival="poisson", rate=2000.0,
+        seed=0, poison_rate=0.1, fault_seed=3,
+    )
+    assert result.failed_requests == 2
+    assert result.success_rate == pytest.approx(0.9)
+
+
+def test_serve_load_rejects_bad_poison_rate(devices):
+    """A malformed chaos input fails up front with ConfigError, like the
+    fault-spec grammar does — not with a numpy traceback mid-run."""
+    from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+    mesh = make_mesh(8)
+    for bad in (-0.1, 1.5):
+        with pytest.raises(ConfigError, match="poison_rate"):
+            run_serve_load(
+                "rowwise", mesh, 64, 64, n_requests=8, max_bucket=8,
+                promote=1, coalesce=False, poison_rate=bad,
+            )
+
+
+@pytest.mark.chaos
+def test_serve_load_chaos_transient_faults_fully_recover(devices):
+    """Retryable transient dispatch faults cost retries, not
+    availability: success rate stays 1.0."""
+    mesh = make_mesh(8)
+    # One client, so fault-event ordinals are strictly sequential, and
+    # seed 19 @ p=0.2: the deterministic draw sequence has no run of 3
+    # consecutive fires in its first 600 events — the 3-attempt retry
+    # budget cannot be exhausted. Recovery is guaranteed, not
+    # probabilistic.
+    result = run_serve_load(
+        "rowwise", mesh, 64, 64, n_requests=30, max_bucket=8,
+        promote=1, concurrency=1, coalesce=True, seed=0,
+        fault_spec="dispatch:device_error:p=0.2", fault_seed=19,
+    )
+    assert result.failed_requests == 0
+    assert result.success_rate == 1.0
+    assert result.retries > 0  # the faults were real, recovery paid
+
+
 @pytest.mark.slow
 def test_serve_load_coalescing_speedup_acceptance(devices):
     """The PR-6 acceptance criterion: at offered concurrency >= 8,

@@ -105,6 +105,20 @@ RULE_FIXTURES = {
         "    acc.append(x)\n"
         "    return acc\n",
     ),
+    "silent-except": (
+        f"{PKG}/tuning/seeded.py",
+        # swallowed wholesale: no re-raise, no recording, no marker
+        "def load(path):\n"
+        "    try:\n"
+        "        return int(path)\n"
+        "    except Exception:\n"
+        "        return None\n",
+        "def load(path):\n"
+        "    try:\n"
+        "        return int(path)\n"
+        "    except Exception:  # swallow-ok: seeded deliberate fallback\n"
+        "        return None\n",
+    ),
     "scheduler-lock-across-dispatch": (
         f"{PKG}/engine/scheduler.py",
         # dispatch under the held admission lock: a backpressure stall
