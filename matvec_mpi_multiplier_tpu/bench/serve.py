@@ -125,7 +125,8 @@ SERVE_CSV_HEADER = (
     "p50_dispatch_ms, p99_dispatch_ms, compiles_warmup, compiles_steady, "
     "hits_steady, promo_b, promo_gemm_s, promo_seq_s, promo_speedup, "
     "arrival, rate_req_s, concurrency, coalesce, mean_batch_width, "
-    "coalesce_ratio, success_rate, failed_requests, retries, downgrades"
+    "coalesce_ratio, success_rate, failed_requests, retries, downgrades, "
+    "dtype_storage, resident_bytes"
 )
 
 
@@ -174,6 +175,11 @@ class ServeResult:
     failed_requests: int = 0
     retries: int = 0
     downgrades: int = 0
+    # Quantized-storage columns (ops/quantize.py): the resident-A format
+    # the engine actually served from (``auto`` rows record the resolved
+    # winner, not the request) and its HBM payload bytes.
+    dtype_storage: str = "native"
+    resident_bytes: int = 0
 
     @property
     def success_rate(self) -> float:
@@ -228,7 +234,8 @@ def append_serve_result(result: ServeResult, root=None):
         f"{result.rate_req_s:.2f}, {result.concurrency}, "
         f"{result.coalesce}, {result.mean_batch_width:.3f}, "
         f"{result.coalesce_ratio:.3f}, {result.success_rate:.4f}, "
-        f"{result.failed_requests}, {result.retries}, {result.downgrades}"
+        f"{result.failed_requests}, {result.retries}, {result.downgrades}, "
+        f"{result.dtype_storage}, {result.resident_bytes}"
     )
     _append_row(path, SERVE_CSV_HEADER, row)
     return path
@@ -444,6 +451,7 @@ def run_serve_load(
     kernel: str = "xla",
     combine: str | None = None,
     stages: int | None = None,
+    dtype_storage: str | None = None,
     n_requests: int = 200,
     max_bucket: int = 32,
     widths: Sequence[int] | None = None,
@@ -516,7 +524,8 @@ def run_serve_load(
 
     engine = MatvecEngine(
         a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
-        stages=stages, dtype=dtype, max_bucket=max_bucket, promote=promote,
+        stages=stages, dtype_storage=dtype_storage, dtype=dtype,
+        max_bucket=max_bucket, promote=promote,
         donate=donate, metrics=registry, trace_jsonl=trace_jsonl,
         fault_plan=plan, resilience=policy, integrity_gate=integrity_gate,
     )
@@ -684,6 +693,8 @@ def run_serve_load(
         failed_requests=snap_counters.get("serve_failed_requests_total", 0),
         retries=snap_counters.get("resil_retries_total", 0),
         downgrades=snap_counters.get("resil_downgrades_total", 0),
+        dtype_storage=engine.storage,
+        resident_bytes=engine.resident_bytes,
     )
 
 
@@ -697,6 +708,7 @@ def run_serve(
     kernel: str = "xla",
     combine: str | None = None,
     stages: int | None = None,
+    dtype_storage: str | None = None,
     n_requests: int = 200,
     max_bucket: int = 32,
     widths: Sequence[int] | None = None,
@@ -724,7 +736,8 @@ def run_serve(
     registry = MetricsRegistry()
     engine = MatvecEngine(
         a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
-        stages=stages, dtype=dtype, max_bucket=max_bucket, promote=promote,
+        stages=stages, dtype_storage=dtype_storage, dtype=dtype,
+        max_bucket=max_bucket, promote=promote,
         donate=donate, metrics=registry, trace_jsonl=trace_jsonl,
     )
     latency_hist = registry.histogram(
@@ -796,6 +809,8 @@ def run_serve(
         promo_b=promo_b,
         promo_gemm_s=promo_gemm,
         promo_seq_s=promo_seq,
+        dtype_storage=engine.storage,
+        resident_bytes=engine.resident_bytes,
     )
 
 
@@ -930,6 +945,9 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                             name, mesh, m, k, dtype=args.dtype,
                             kernel=args.kernel, combine=args.combine,
                             stages=getattr(args, "stages", None),
+                            dtype_storage=getattr(
+                                args, "dtype_storage", None
+                            ),
                             n_requests=args.n_requests,
                             max_bucket=args.max_bucket, promote=promote,
                             seed=args.seed,
@@ -943,6 +961,11 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                         path = append_serve_result(result, args.data_root)
                     else:
                         path = None
+                    storage_suffix = (
+                        f" storage={result.dtype_storage} "
+                        f"resident={result.resident_bytes / 1e6:.2f}MB"
+                        if result.dtype_storage != "native" else ""
+                    )
                     print(
                         f"serve {name} {m}x{k} p={n_dev} "
                         f"b*={result.b_star} {result.rps:.1f} req/s "
@@ -953,6 +976,7 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                         f"{result.compiles_steady} "
                         f"promo x{result.promo_speedup:.2f} "
                         f"@b={result.promo_b}"
+                        + storage_suffix
                     )
                     if path is not None:
                         print(f"CSV: {path}")
@@ -965,6 +989,9 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                                 name, mesh, m, k, dtype=args.dtype,
                                 kernel=args.kernel, combine=args.combine,
                                 stages=getattr(args, "stages", None),
+                                dtype_storage=getattr(
+                                    args, "dtype_storage", None
+                                ),
                                 n_requests=args.n_requests,
                                 max_bucket=args.max_bucket,
                                 promote=promote,
@@ -1060,6 +1087,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--stages", type=int, default=None,
         help="with --combine overlap: pin the staged schedule's stage "
         "count S (default: the tuned fifth axis, clamped per shape)",
+    )
+    p.add_argument(
+        "--dtype-storage", dest="dtype_storage", default=None,
+        choices=["native", "int8", "int8c", "fp8", "auto"],
+        help="resident-A storage format (ops/quantize.py): quantize A "
+        "once at residency and serve from the low-bit payload; 'auto' "
+        "consults the tuned sixth axis (native on a miss). CSV rows "
+        "record the resolved format + resident bytes",
     )
     p.add_argument(
         "--n-requests", type=int, default=200,

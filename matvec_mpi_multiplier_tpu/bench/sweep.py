@@ -184,6 +184,16 @@ def build_parser() -> argparse.ArgumentParser:
         "per-device chunk",
     )
     p.add_argument(
+        "--dtype-storage", dest="dtype_storage", default=None,
+        choices=["native", "int8", "int8c", "fp8", "auto"],
+        help="resident-A storage format (ops/quantize.py): quantize A "
+        "per config and measure the strategy against the low-bit "
+        "payload (un-staged combine family only). Rows are labeled "
+        "<strategy>_<format> so native and quantized measurements of "
+        "the same config coexist in the CSVs. --op serve forwards it "
+        "to the engine; 'auto' is serve-only (the tuned sixth axis)",
+    )
+    p.add_argument(
         "--tune",
         action="store_true",
         help="pre-pass: measure kernel/tile/combine candidates for every "
@@ -407,6 +417,13 @@ def _run_sweep(args: argparse.Namespace) -> int:
             "(--op serve); matvec/gemm sweeps have no request stream to "
             "trace (use --profile-dir for a device trace)"
         )
+    if getattr(args, "dtype_storage", None) == "auto":
+        raise SystemExit(
+            "--dtype-storage auto is serve-only (the engine consults the "
+            "tuned sixth axis at construction); a matvec/gemm sweep "
+            "measures ONE format per run — name it (int8/int8c/fp8), or "
+            "run --tune to record the measured decision"
+        )
     # Fail fast on an unknown kernel: get_*_kernel raises the same KeyError,
     # but only deep inside the loop, after earlier configs already ran.
     from ..ops import available_gemm_kernels, available_kernels
@@ -486,7 +503,10 @@ def _run_sweep(args: argparse.Namespace) -> int:
     n_ok, n_skip, n_unmeasurable, n_failed = counters
     if not args.no_csv:
         for name in strategies:
-            csv_name = csv_label(name, args.op, args.label_suffix)
+            csv_name = csv_label(
+                name, args.op, args.label_suffix,
+                storage=getattr(args, "dtype_storage", None),
+            )
             for mode in modes:
                 print(f"CSV: {csv_path(csv_name, args.data_root, mode=mode)}")
     if args.profile_dir is not None:
@@ -523,7 +543,10 @@ def _run_sweep(args: argparse.Namespace) -> int:
     return 3 if n_unmeasurable else 0
 
 
-def csv_label(name: str, op: str, label_suffix: str | None) -> str:
+def csv_label(
+    name: str, op: str, label_suffix: str | None,
+    storage: str | None = None,
+) -> str:
     """The strategy label exactly as CSV rows record it: gemm rows land as
     ``gemm_<name>`` (timing.py::benchmark_gemm sets ``strategy_name``) and
     ``--label-suffix`` appends after that. Single source for the CSV-path
@@ -531,6 +554,10 @@ def csv_label(name: str, op: str, label_suffix: str | None) -> str:
     apart, resumed sweeps would silently re-run (and duplicate) every
     config."""
     label = f"gemm_{name}" if op == "gemm" else name
+    if storage not in (None, "native"):
+        # Quantized-storage rows append the format first, then any
+        # user suffix — the same order the sweep loop writes rows in.
+        label = f"{label}_{storage}"
     return f"{label}_{label_suffix}" if label_suffix else label
 
 
@@ -594,7 +621,10 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                 )
                 counters[1] += 1
                 continue
-            label_name = csv_label(name, args.op, args.label_suffix)
+            label_name = csv_label(
+                name, args.op, args.label_suffix,
+                storage=getattr(args, "dtype_storage", None),
+            )
             for n_dev in counts:
                 mesh = meshes[n_dev]
                 try:
@@ -637,6 +667,8 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                         bench_kwargs["combine"] = args.combine
                     if args.stages is not None:
                         bench_kwargs["stages"] = args.stages
+                    if args.dtype_storage not in (None, "native"):
+                        bench_kwargs["dtype_storage"] = args.dtype_storage
                     if args.chain_samples is not None:
                         bench_kwargs["chain_samples"] = args.chain_samples
                     try:
@@ -674,12 +706,22 @@ def _sweep_loop(args, strategies, counts, sizes, modes, meshes, counters):
                         )
                         counters[3] += 1
                         continue
-                    if args.label_suffix:
+                    suffixes = [
+                        s for s in (
+                            bench_kwargs.get("dtype_storage"),
+                            args.label_suffix,
+                        ) if s
+                    ]
+                    if suffixes:
+                        # Quantized rows land as <strategy>_<format> so
+                        # native and quantized measurements of the same
+                        # config coexist in the per-strategy CSVs (the
+                        # --label-suffix convention).
                         import dataclasses
 
                         result = dataclasses.replace(
                             result,
-                            strategy=f"{result.strategy}_{args.label_suffix}",
+                            strategy="_".join([result.strategy] + suffixes),
                         )
                     if not args.no_csv:
                         append_result(result, args.data_root)

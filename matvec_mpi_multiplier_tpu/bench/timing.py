@@ -482,7 +482,9 @@ def time_matvec(
         if mode == "reference":
             # Host→device distribution inside the timed region (quirk Q5).
             # Delete device copies first so device_put really transfers.
-            a_dev.delete()
+            # (Leaf-wise: a quantized-storage A is a pytree of buffers.)
+            for leaf in jax.tree_util.tree_leaves(a_dev):
+                leaf.delete()
             x_dev.delete()
             start = time.perf_counter()
             a_dev = place(a, sh_a)
@@ -574,6 +576,7 @@ def benchmark_strategy(
     chain_samples: int = DEFAULT_CHAIN_SAMPLES,
     combine: str | None = None,
     stages: int | str | None = None,
+    dtype_storage: str | None = None,
 ) -> TimingResult:
     """Benchmark one (strategy, mesh, size) configuration — the body of the
     reference's per-config run (``src/multiplier_rowwise.c:54-176``) minus the
@@ -581,18 +584,35 @@ def benchmark_strategy(
 
     ``combine`` selects the combine schedule by name (``"auto"`` consults
     the tuning cache) and ``stages`` pins the staged ``overlap`` schedules'
-    stage count — see ``MatvecStrategy.build``."""
+    stage count — see ``MatvecStrategy.build``. ``dtype_storage`` measures
+    the quantized-residency path: A is quantized host-side (outside the
+    timed region, like any operand prep) and the strategy runs against the
+    payload pytree."""
     measure = resolve_measure(mode, measure)
     a, x = _prepare_operands(a, x, dtype)
     strategy.validate(a.shape[0], a.shape[1], mesh)
     fn = strategy.build(
         mesh, kernel=kernel, gather_output=gather_output, combine=combine,
-        stages=stages,
+        stages=stages, dtype_storage=dtype_storage,
     )
+    a = _maybe_quantize(a, dtype_storage, strategy, mesh)
     return _run_benchmark(
         fn=fn, a=a, rhs=x, shardings=strategy.shardings(mesh), mesh=mesh,
         strategy_name=strategy.name, n_rhs=1, n_reps=n_reps, mode=mode,
         measure=measure, chain_samples=chain_samples,
+    )
+
+
+def _maybe_quantize(a, dtype_storage, strategy, mesh):
+    """Quantize the benchmark operand when a storage format is requested
+    (ops/quantize.py; the once-at-residency step, here once-per-config)."""
+    from ..ops.quantize import NATIVE, normalize_storage, quantize_matrix
+
+    if normalize_storage(dtype_storage) == NATIVE:
+        return a
+    return quantize_matrix(
+        a, dtype_storage,
+        contraction_shards=strategy.contraction_shards(mesh),
     )
 
 
@@ -611,6 +631,7 @@ def benchmark_gemm(
     chain_samples: int = DEFAULT_CHAIN_SAMPLES,
     combine: str | None = None,
     stages: int | str | None = None,
+    dtype_storage: str | None = None,
 ) -> TimingResult:
     """Benchmark one GEMM (strategy, mesh, size) configuration.
 
@@ -620,9 +641,11 @@ def benchmark_gemm(
     column to tell matvec and GEMM apart).
 
     ``combine`` selects the combine schedule by name (``"auto"`` consults
-    the tuning cache under ``op="gemm"``) and ``stages`` the staged
-    ``overlap`` stage count — see ``build_gemm``.
+    the tuning cache under ``op="gemm"``), ``stages`` the staged
+    ``overlap`` stage count, and ``dtype_storage`` the quantized-residency
+    path — see ``build_gemm`` / :func:`benchmark_strategy`.
     """
+    from ..models import get_strategy
     from ..models.gemm import build_gemm, gemm_shardings, validate_gemm
 
     measure = resolve_measure(mode, measure)
@@ -630,8 +653,9 @@ def benchmark_gemm(
     validate_gemm(name, a.shape[0], a.shape[1], b.shape[1], mesh)
     fn = build_gemm(
         name, mesh, kernel=kernel, gather_output=gather_output,
-        combine=combine, stages=stages,
+        combine=combine, stages=stages, dtype_storage=dtype_storage,
     )
+    a = _maybe_quantize(a, dtype_storage, get_strategy(name), mesh)
     return _run_benchmark(
         fn=fn, a=a, rhs=b, shardings=gemm_shardings(name, mesh), mesh=mesh,
         strategy_name=f"gemm_{name}", n_rhs=b.shape[1], n_reps=n_reps,

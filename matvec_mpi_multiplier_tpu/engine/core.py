@@ -84,8 +84,19 @@ import jax
 import numpy as np
 
 from ..models import get_strategy
-from ..models.base import MatvecStrategy, mesh_size
+from ..models.base import (
+    STORAGE_INCOMPATIBLE_COMBINES,
+    MatvecStrategy,
+    mesh_size,
+)
 from ..obs.registry import MetricsRegistry
+from ..ops.quantize import (
+    NATIVE,
+    fp8_supported,
+    normalize_storage,
+    quantize_matrix,
+    quantized_like,
+)
 from ..obs.sink import JsonlSink
 from ..obs.tracing import ActiveTrace, RequestTracer
 from ..resilience.faults import (
@@ -309,6 +320,21 @@ class MatvecEngine:
         default on a miss). Resolved ONCE at construction (the engine's
         shapes are fixed) and baked into the executable keys; ignored by
         every non-overlap schedule.
+    dtype_storage : resident-A storage format (``ops/quantize.py``):
+        None/``"native"`` keeps the plain array residency;
+        ``"int8"``/``"int8c"``/``"fp8"`` quantize ``A`` ONCE here at
+        residency time (payload + per-block scales placed in the
+        strategy's own A-sharding) and every dispatch consumes the
+        quantized operand through the tile-upcasting kernels — the HBM
+        bytes the resident stream moves shrink to the payload's
+        (``engine_resident_bytes`` gauge). ``"auto"`` consults the tuned
+        sixth axis (``tuning.lookup_storage``; native on a miss, on an
+        unsupported winner, or for a strategy instance bound to an
+        A-tiling combine). The storage format is part of every
+        :class:`ExecKey`; the degradation ladder treats NATIVE storage as
+        the safe tier — under a resilience policy the original ``A`` is
+        kept host-side and placed lazily the first time a breaker routes
+        around the quantized config.
     dtype : operand dtype (default: ``a``'s).
     max_bucket : widest bucket in the ladder; wider requests split.
     promote : the GEMV→GEMM crossover ``b*``: ``"auto"`` (tuned decision,
@@ -357,6 +383,7 @@ class MatvecEngine:
         kernel: str | Callable = "xla",
         combine: str | None = None,
         stages: int | str | None = None,
+        dtype_storage: str | None = None,
         dtype=None,
         max_bucket: int = DEFAULT_MAX_BUCKET,
         promote: str | int | None = "auto",
@@ -395,10 +422,44 @@ class MatvecEngine:
         self._donate = (1,) if donate else ()
         self._sh_a, self._sh_x = self.strategy.shardings(mesh)
         _, self._sh_b = self.strategy.batched_shardings(mesh)
-        self._a = jax.device_put(a, self._sh_a)  # resident for engine life
+        self.storage = self._resolve_storage(dtype_storage)
+        self._a_native = None  # lazy native residency (the ladder's safe tier)
+        if self.storage != NATIVE:
+            # Quantize ONCE at residency: payload + per-block scales (+ the
+            # compensated pair) placed as one pytree in A's own sharding.
+            qa = quantize_matrix(
+                a, self.storage,
+                contraction_shards=self.strategy.contraction_shards(mesh),
+            )
+            self._a = jax.device_put(qa, self._sh_a)
+            # Struct-only template (NOT the host arrays: a large A's
+            # quantized copy is 26-52% of its bytes, and the builders
+            # only ever need leaf shapes/dtypes).
+            self._qa_template = quantized_like(
+                qa,
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+            )
+            self._a_host = a  # retained for the native safe tier
+            self.storage_block = qa.block
+            self.resident_bytes = qa.nbytes
+        else:
+            self._a = jax.device_put(a, self._sh_a)  # resident for engine life
+            self._qa_template = None
+            self._a_host = None
+            self.storage_block = None
+            self.resident_bytes = int(a.nbytes)
         self._matvec_combine, self._gemm_combine = self._resolve_combine(
             combine
         )
+        if self.storage != NATIVE:
+            # Auto-resolved combine winners from the A-tiling family cannot
+            # consume the payload pytree: drop to the static default (the
+            # same filter the build layer's auto tier applies). Explicit
+            # incompatible names already failed in _resolve_combine.
+            if self._matvec_combine in STORAGE_INCOMPATIBLE_COMBINES:
+                self._matvec_combine = None
+            if self._gemm_combine in STORAGE_INCOMPATIBLE_COMBINES:
+                self._gemm_combine = None
         self.stages = self._resolve_stages(stages)
         self.b_star = self._resolve_promotion(promote)
         if max_in_flight is not None and max_in_flight < 1:
@@ -430,6 +491,19 @@ class MatvecEngine:
         self._g_in_flight = self.metrics.gauge(
             "engine_in_flight", "outstanding dispatches at last snapshot"
         )
+        self._g_resident = self.metrics.gauge(
+            "engine_resident_bytes",
+            "HBM bytes of the resident A operand (payload + scales for "
+            "quantized storage)",
+        )
+        self._g_resident.set(self.resident_bytes)
+        # Info metric, Prometheus-style: the label set carries the fact,
+        # the value is always 1 (the obs `storage` panel reads it).
+        self.metrics.gauge(
+            f'engine_storage_format{{format="{self.storage}",'
+            f'dtype="{self.dtype}"}}',
+            "resident-A storage format (info metric; value is always 1)",
+        ).set(1)
         self._h_submit = self.metrics.histogram(
             "engine_submit_latency_ms", "submit() entry-to-return host time"
         )
@@ -509,6 +583,41 @@ class MatvecEngine:
 
     # ---- construction-time resolution ----
 
+    def _resolve_storage(self, dtype_storage: str | None) -> str:
+        """Pin the resident-A storage format at construction (the quantize
+        step is once-at-residency by doctrine). ``"auto"`` consults the
+        tuned sixth axis and degrades to native on a miss, an
+        unknown/unsupported winner (a foreign cache's fp8 on a backend
+        without the dtype), or a strategy instance bound to an A-tiling
+        combine — auto must never be worse-informed than native. An
+        EXPLICIT format fails loudly instead: a serve config that asked
+        for quantized storage must not silently serve full-width bytes."""
+        if dtype_storage == "auto":
+            from ..tuning import lookup_storage
+
+            decision = lookup_storage(
+                strategy=self.strategy.name, m=self.m, k=self.k,
+                p=mesh_size(self.mesh), dtype=str(self.dtype),
+            )
+            fmt = (decision or {}).get("storage") or NATIVE
+            try:
+                fmt = normalize_storage(fmt)
+            except ConfigError:
+                return NATIVE  # foreign cache, unknown format name
+            if fmt == "fp8" and not fp8_supported():
+                return NATIVE
+            if fmt != NATIVE and not self.strategy.storage_combine_ok(None):
+                return NATIVE
+            return fmt
+        fmt = normalize_storage(dtype_storage)
+        if fmt != NATIVE and not self.strategy.storage_combine_ok(None):
+            raise ConfigError(
+                f"strategy {self.strategy.name!r} binds an A-tiling "
+                "combine schedule, which cannot compose with quantized "
+                f"dtype_storage={fmt!r} (docs/QUANTIZATION.md)"
+            )
+        return fmt
+
     def _resolve_combine(
         self, combine: str | None
     ) -> tuple[str | None, str | None]:
@@ -531,6 +640,16 @@ class MatvecEngine:
             raise ConfigError(
                 f"strategy {self.strategy.name!r} has no combine schedule "
                 f"{combine!r}"
+            )
+        if (
+            self.storage != NATIVE
+            and combine not in (None, "auto")
+            and not self.strategy.storage_combine_ok(combine)
+        ):
+            raise ConfigError(
+                f"combine {combine!r} tiles A inside its schedule body and "
+                f"cannot compose with quantized dtype_storage="
+                f"{self.storage!r} (docs/QUANTIZATION.md)"
             )
         if combine == "auto":
             from ..tuning import lookup_combine
@@ -625,26 +744,43 @@ class MatvecEngine:
         return ExecKey(
             "matvec", self.strategy.name, self._kernel_label(),
             self._combine_label(self._matvec_combine), 1, str(self.dtype),
+            self.storage,
         )
 
     def _gemm_key(self, bucket: int) -> ExecKey:
         return ExecKey(
             "gemm", self.strategy.name, self._kernel_label(),
             self._combine_label(self._gemm_combine), bucket,
-            str(self.dtype),
+            str(self.dtype), self.storage,
         )
 
-    def _matvec_builder_for(self, kernel, combine, stages):
+    def _a_struct(self, storage: str):
+        """The A-side argument struct for one storage format: the plain
+        (m, k) array, or the quantized pytree's leaf structs — all carrying
+        A's own sharding (the scales shard alongside their blocks)."""
+        if storage == NATIVE:
+            return jax.ShapeDtypeStruct(
+                (self.m, self.k), self.dtype, sharding=self._sh_a
+            )
+        return quantized_like(
+            self._qa_template,
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=self._sh_a
+            ),
+        )
+
+    def _matvec_builder_for(self, kernel, combine, stages, storage=None):
+        storage = self.storage if storage is None else storage
+
         def builder():
             fn = self.strategy.build(
                 self.mesh, kernel=kernel,
                 gather_output=self.gather_output,
                 combine=combine, stages=stages,
+                dtype_storage=None if storage == NATIVE else storage,
             )
             structs = (
-                jax.ShapeDtypeStruct(
-                    (self.m, self.k), self.dtype, sharding=self._sh_a
-                ),
+                self._a_struct(storage),
                 jax.ShapeDtypeStruct(
                     (self.k,), self.dtype, sharding=self._sh_x
                 ),
@@ -658,17 +794,19 @@ class MatvecEngine:
             self.kernel, self._matvec_combine, self.stages
         )()
 
-    def _gemm_builder_for(self, bucket: int, kernel, combine, stages):
+    def _gemm_builder_for(self, bucket: int, kernel, combine, stages,
+                          storage=None):
+        storage = self.storage if storage is None else storage
+
         def builder():
             fn = self.strategy.build_batched(
                 self.mesh, kernel=kernel,
                 gather_output=self.gather_output,
                 combine=combine, stages=stages,
+                dtype_storage=None if storage == NATIVE else storage,
             )
             structs = (
-                jax.ShapeDtypeStruct(
-                    (self.m, self.k), self.dtype, sharding=self._sh_a
-                ),
+                self._a_struct(storage),
                 jax.ShapeDtypeStruct(
                     (self.k, bucket), self.dtype, sharding=self._sh_b
                 ),
@@ -699,12 +837,18 @@ class MatvecEngine:
         if levels is not None:
             return levels
         levels = [(self._matvec_key(), self._matvec_builder)]
+        # The safe tier is NATIVE storage by doctrine: a quantized config
+        # that keeps failing should not be retried through another
+        # quantized program — the unquantized original A (placed lazily,
+        # _a_for) is the known-good floor.
         safe_key = ExecKey(
             "matvec", self.strategy.name, SAFE_KERNEL, None, 1,
-            str(self.dtype),
+            str(self.dtype), NATIVE,
         )
         if safe_key != levels[0][0]:
-            safe_builder = self._matvec_builder_for(SAFE_KERNEL, None, None)
+            safe_builder = self._matvec_builder_for(
+                SAFE_KERNEL, None, None, storage=NATIVE
+            )
             levels.append((safe_key, safe_builder))
         self._ladders["matvec"] = levels
         return levels
@@ -716,11 +860,11 @@ class MatvecEngine:
         levels = [(self._gemm_key(bucket), self._gemm_builder(bucket))]
         safe_key = ExecKey(
             "gemm", self.strategy.name, SAFE_KERNEL, None, bucket,
-            str(self.dtype),
+            str(self.dtype), NATIVE,
         )
         if safe_key != levels[0][0]:
             safe_builder = self._gemm_builder_for(
-                bucket, SAFE_KERNEL, None, None
+                bucket, SAFE_KERNEL, None, None, storage=NATIVE
             )
             levels.append((safe_key, safe_builder))
         self._ladders[bucket] = levels
@@ -759,6 +903,19 @@ class MatvecEngine:
         if self.max_in_flight is not None:
             self._outstanding.append(arr)
         return arr
+
+    def _a_for(self, key: ExecKey):
+        """The resident A operand matching one config level's storage
+        format. Under quantized residency the native safe tier places the
+        retained host A lazily on its FIRST degraded dispatch and keeps
+        it — the extra HBM is spent only once a breaker actually routes
+        around the quantized config, never up front."""
+        if key.storage == self.storage:
+            return self._a
+        if self._a_native is None:
+            # Enqueue-only placement (device_put is async), not a sync.
+            self._a_native = jax.device_put(self._a_host, self._sh_a)
+        return self._a_native
 
     def _get_traced(self, trace: ActiveTrace, key, builder):
         """Executable-cache lookup under its span, the hit|compile outcome
@@ -809,7 +966,7 @@ class MatvecEngine:
         corrupt = self._check_faults("dispatch", key, block=col)
         self._c_dispatches.inc()
         with trace.span("dispatch", op="matvec"):
-            out = exe(self._a, jax.device_put(col, self._sh_x))
+            out = exe(self._a_for(key), jax.device_put(col, self._sh_x))
         return self._track(out), corrupt
 
     def _exec_gemm(
@@ -826,7 +983,7 @@ class MatvecEngine:
         corrupt = self._check_faults("dispatch", key, block=padded)
         self._c_dispatches.inc()
         with trace.span("dispatch", op="gemm", bucket=bucket):
-            out = exe(self._a, jax.device_put(padded, self._sh_b))
+            out = exe(self._a_for(key), jax.device_put(padded, self._sh_b))
         return self._track(out), corrupt
 
     # ---- resilient dispatch: retries, breakers, the ladder ----
@@ -1159,6 +1316,15 @@ class MatvecEngine:
         return {
             "resilience": self._resilience is not None,
             "integrity_gate": self.integrity_gate,
+            "storage": {
+                "format": self.storage,
+                "resident_bytes": self.resident_bytes,
+                "block": self.storage_block,
+                # True once the native safe tier has been placed (HBM is
+                # then holding BOTH residencies — a degraded quantized
+                # engine costs more than either alone).
+                "native_fallback_resident": self._a_native is not None,
+            },
             "breakers": breakers,
             "degraded": degraded,
             "fault_injection": (

@@ -38,17 +38,26 @@ class ExecKey(NamedTuple):
     combine: str | None
     bucket: int    # RHS columns (1 for the matvec path)
     dtype: str
+    # Resident-A storage format (ops/quantize.py): "native" for the plain
+    # array path, "int8"/"int8c"/"fp8" for quantized residency. A field
+    # with a default so every pre-quantization construction site (and
+    # pickled/pinned key literal) keeps meaning what it meant.
+    storage: str = "native"
 
     def label(self) -> str:
-        """Canonical ``op:strategy:kernel:combine:bucket:dtype`` string —
-        the identity fault-injection patterns match against
+        """Canonical ``op:strategy:kernel:combine:bucket:dtype[:storage]``
+        string — the identity fault-injection patterns match against
         (``resilience/faults.py``) and ``engine.health()`` reports under.
-        A None combine reads as ``default`` so patterns can target it."""
+        A None combine reads as ``default`` so patterns can target it;
+        the storage suffix appears only for NON-native storage, so every
+        existing pattern and pinned label keeps matching the configs it
+        always matched (and ``*:int8`` targets quantized configs)."""
         combine = self.combine if self.combine is not None else "default"
-        return (
+        base = (
             f"{self.op}:{self.strategy}:{self.kernel}:{combine}:"
             f"{self.bucket}:{self.dtype}"
         )
+        return base if self.storage == "native" else f"{base}:{self.storage}"
 
 
 @dataclasses.dataclass

@@ -29,13 +29,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..obs.annotations import named_span
 from ..ops.gemv import get_kernel
 from ..utils.compat import shard_map
-from ..utils.errors import ShardingError
+from ..utils.errors import ConfigError, ShardingError
 
 # Static stage-count default for the staged `overlap` schedules on a
 # tuning-cache miss: the minimal genuinely-pipelined split (S=1 is the
 # degenerate un-overlapped schedule; deeper ladders are the tuner's call —
 # more stages shrink each collective but multiply dispatch overhead).
 DEFAULT_OVERLAP_STAGES = 2
+
+# Combine schedules that tile/slice the A operand inside their own bodies
+# (staged row-pipelines, the ring-resident GEMV, the fused pallas ring).
+# Quantized storage hands the body ONE opaque payload pytree, so these
+# schedules cannot compose with a non-native ``dtype_storage`` — the
+# storage axis is restricted to the un-staged combine family
+# (docs/QUANTIZATION.md; the tuner filters the same way).
+STORAGE_INCOMPATIBLE_COMBINES = frozenset(
+    ("overlap", "overlap_ring", "ring_overlap", "pallas_ring")
+)
 
 
 class MatvecStrategy(abc.ABC):
@@ -118,6 +128,48 @@ class MatvecStrategy(abc.ABC):
         None for strategies whose local block is already an exact y
         slice)."""
         return None
+
+    # ---- quantized-storage machinery (the autotuner's sixth axis) ----
+
+    def contraction_shards(self, mesh: Mesh) -> int:
+        """Devices A's contraction (column) axis is sharded across — the
+        denominator of the quantization block choice
+        (``ops.quantize.default_block``: every shard must hold whole scale
+        groups, so the scale plane shards with exactly A's own spec)."""
+        spec_a = self.specs(mesh)[0]
+        k_axes = spec_a[1] if len(spec_a) > 1 else None
+        if k_axes is None:
+            return 1
+        names = (k_axes,) if isinstance(k_axes, str) else tuple(k_axes)
+        shards = 1
+        for name in names:
+            shards *= mesh.shape[name]
+        return shards
+
+    def storage_combine_ok(self, combine: str | None) -> bool:
+        """True when ``combine`` composes with quantized storage: the
+        un-staged family only (schedules that slice A inside their bodies
+        cannot consume the payload pytree —
+        :data:`STORAGE_INCOMPATIBLE_COMBINES`). None/"auto" are fine:
+        the plain default is always compatible and the auto tier filters
+        its candidates."""
+        if combine in (None, "auto"):
+            combine = getattr(self, "combine", None)
+        return combine not in STORAGE_INCOMPATIBLE_COMBINES
+
+    def _check_storage_combine(self, combine: str | None) -> None:
+        if not self.storage_combine_ok(combine):
+            effective = combine if combine not in (None, "auto") else getattr(
+                self, "combine", None
+            )
+            # ConfigError, not ValueError: the sweep loop re-raises
+            # MatvecError (config bugs fail loudly) but treats foreign
+            # exceptions as transient backend faults under --keep-going.
+            raise ConfigError(
+                f"combine {effective!r} tiles A inside its schedule body "
+                "and cannot compose with quantized dtype_storage; use the "
+                "un-staged family (docs/QUANTIZATION.md) or native storage"
+            )
 
     def default_combine(self, mesh: Mesh) -> str:
         """The static default the ``auto`` tier falls back to on a tuning-
@@ -326,20 +378,29 @@ class MatvecStrategy(abc.ABC):
         return matvec
 
     def _build_auto_combine(
-        self, mesh: Mesh, *, batched: bool = False, **build_kwargs
+        self, mesh: Mesh, *, batched: bool = False, storage: bool = False,
+        **build_kwargs
     ) -> Callable[[Array, Array], Array]:
         """``combine="auto"``: consult the tuning cache per operand shape at
         trace time and dispatch to the measured winner, falling back to the
         static default on a miss. Each resolved schedule is built (and
         compiled) lazily, at most once. The batched face keys its lookups
         under ``op="gemm"`` — a matvec combine crossover need not hold for a
-        block of right-hand sides."""
+        block of right-hand sides. ``storage`` marks a quantized-storage
+        build: cached winners from the A-tiling family are filtered out
+        (they cannot consume the payload pytree) so a native-storage
+        tuning decision can never crash a quantized build."""
         from ..tuning import lookup_combine
 
         candidates = (
             self.combine_candidates_batched(mesh) if batched
             else self.combine_candidates(mesh)
         )
+        if storage:
+            candidates = tuple(
+                c for c in candidates
+                if c not in STORAGE_INCOMPATIBLE_COMBINES
+            )
         built: dict[str, Callable] = {}
 
         @jax.jit
@@ -372,6 +433,7 @@ class MatvecStrategy(abc.ABC):
         check_vma: bool | None = None,
         combine: str | None = None,
         stages: int | str | None = None,
+        dtype_storage: str | None = None,
     ) -> Callable[[Array, Array], Array]:
         """Return jitted ``matvec(a, x) -> y`` for this strategy on ``mesh``.
 
@@ -404,13 +466,33 @@ class MatvecStrategy(abc.ABC):
         fifth axis (``tune_overlap``; static default on a miss), an int is
         clamped down to the largest valid ladder entry for the shape — see
         :meth:`resolve_stages`.
+
+        ``dtype_storage`` selects the storage format of ``A``
+        (``ops/quantize.py``): None/``"native"`` is the plain array path;
+        ``"int8"``/``"int8c"``/``"fp8"`` make the built function take a
+        :class:`~..ops.quantize.QuantizedMatrix` in ``a``'s place — the
+        payload/scale leaves all carry ``A``'s own PartitionSpec (spec-
+        prefix semantics), and the local kernel becomes the tile-wise
+        upcasting quantized kernel (``kernel="pallas"`` selects the fused
+        scale-and-multiply tile; every other tier the scan kernel).
+        Combine schedules that slice ``A`` inside their bodies
+        (:data:`STORAGE_INCOMPATIBLE_COMBINES`) are rejected; the auto
+        tier filters them from its candidates.
         """
+        from ..ops.quantize import NATIVE, get_storage_kernel, \
+            normalize_storage
+
+        storage = normalize_storage(dtype_storage)
         if combine is None:
             combine = self.requested_combine
+        if storage != NATIVE:
+            self._check_storage_combine(combine)
+            kernel = get_storage_kernel(kernel)
         if combine == "auto":
             return self._build_auto_combine(
                 mesh, kernel=kernel, gather_output=gather_output,
                 check_vma=check_vma, stages=stages,
+                storage=storage != NATIVE,
             )
         if combine is not None:
             return self._build_combine(
@@ -509,6 +591,7 @@ class MatvecStrategy(abc.ABC):
         check_vma: bool | None = None,
         combine: str | None = None,
         stages: int | str | None = None,
+        dtype_storage: str | None = None,
     ) -> Callable[[Array, Array], Array]:
         """Return jitted ``matmul(a, b) -> c`` for a BLOCK of right-hand
         sides: ``b`` is ``(k, n_rhs)`` — one column per request — and the
@@ -526,15 +609,24 @@ class MatvecStrategy(abc.ABC):
         output gathers and the rank-1 ``"pallas_ring"`` kernel (colwise's
         in-body ``"overlap"`` is rank-agnostic and batches fine);
         ``combine="auto"`` consults the tuning cache under ``op="gemm"``,
-        and ``stages`` follows :meth:`build`.
+        ``stages`` follows :meth:`build`, and ``dtype_storage`` follows
+        :meth:`build` (the quantized kernel is rank-agnostic in the
+        right-hand side, so the GEMM promotion keeps the storage format).
         """
+        from ..ops.quantize import NATIVE, get_storage_kernel, \
+            normalize_storage
+
+        storage = normalize_storage(dtype_storage)
         if combine is None:
             combine = self.requested_combine
+        if storage != NATIVE:
+            self._check_storage_combine(combine)
+            kernel = get_storage_kernel(kernel)
         if combine == "auto":
             return self._build_auto_combine(
                 mesh, batched=True, kernel=kernel,
                 gather_output=gather_output, check_vma=check_vma,
-                stages=stages,
+                stages=stages, storage=storage != NATIVE,
             )
         if combine is not None:
             return self._build_combine(

@@ -140,6 +140,7 @@ def build_gemm(
     check_vma: bool | None = None,
     combine: str | None = None,
     stages: int | str | None = None,
+    dtype_storage: str | None = None,
 ) -> Callable[[Array, Array], Array]:
     """Return jitted ``matmul(a, b) -> c`` for one strategy on ``mesh``.
 
@@ -176,6 +177,7 @@ def build_gemm(
     return strat.build_batched(
         mesh, kernel=kernel, gather_output=gather_output,
         check_vma=check_vma, combine=combine, stages=stages,
+        dtype_storage=dtype_storage,
     )
 
 

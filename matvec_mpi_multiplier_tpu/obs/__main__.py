@@ -68,6 +68,35 @@ def render_batching(snapshot: dict) -> str | None:
     return "\n".join(out)
 
 
+def render_storage(snapshot: dict) -> str | None:
+    """The storage panel: the resident-A format and its HBM payload read
+    off ``engine_resident_bytes`` and the ``engine_storage_format{...}``
+    info gauge (engine/core.py; docs/QUANTIZATION.md). None when the
+    snapshot predates the storage axis (no resident-bytes gauge)."""
+    gauges = snapshot.get("gauges", {})
+    if "engine_resident_bytes" not in gauges:
+        return None
+    resident = gauges["engine_resident_bytes"]
+    fmt, dtype = "native", "?"
+    for name in gauges:
+        if name.startswith("engine_storage_format{"):
+            # Prometheus-style info metric: the label set carries the fact.
+            labels = dict(
+                part.split("=", 1)
+                for part in name[name.index("{") + 1:name.rindex("}")].split(",")
+            )
+            fmt = labels.get("format", "native").strip('"')
+            dtype = labels.get("dtype", "?").strip('"')
+    out = [
+        "storage:",
+        f"  format          {fmt} (operand dtype {dtype})",
+        f"  resident bytes  {resident:.3e} "
+        + ("(quantized payload + per-block scales)" if fmt != "native"
+           else "(full-width A)"),
+    ]
+    return "\n".join(out)
+
+
 def render_resilience(snapshot: dict) -> str | None:
     """The resilience panel: fault-injection volume, recovery activity
     (retries, downgrades, breaker opens/recoveries), blast-radius
@@ -167,6 +196,9 @@ def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
                 f"p95={_fmt_ms(summ.get('p95'))} "
                 f"p99={_fmt_ms(summ.get('p99'))}"
             )
+    storage = render_storage(snapshot)
+    if storage is not None:
+        out.append(storage)
     batching = render_batching(snapshot)
     if batching is not None:
         out.append(batching)

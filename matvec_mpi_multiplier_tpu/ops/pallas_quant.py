@@ -1,0 +1,130 @@
+"""Pallas fused scale-and-multiply tile for quantized-storage GEMV.
+
+The quantized scan kernel (``ops/quantize.py::matvec_quantized``) leaves
+the per-tile upcast and the scale multiply to XLA's fusion; this kernel
+makes the contract explicit on TPU: the grid walks (row-block, k-block)
+tiles of the int8/fp8 payload, and each grid step loads ONE low-bit
+``A``-tile into VMEM, upcasts it in-register, multiplies by the matching
+scale column and ``x`` segment, and accumulates the per-row partials —
+the dequantized values exist only tile-at-a-time in VMEM, never as an
+HBM array (the early-dequant doctrine, docs/QUANTIZATION.md). HBM traffic
+is the payload's own bytes: ~¼ of the native fp32 stream for int8/fp8.
+
+Grid/tiling: ``bk`` must be a multiple of the quantization block so each
+grid step covers whole scale groups (``bk // block`` scale columns per
+step); the int8 min tile is (32, 128) (pallas_guide), which
+``DEFAULT_BLOCK = 128`` already satisfies on the lane axis.
+
+Falls back to interpret mode off-TPU (the CPU test path) exactly like
+``ops/pallas_gemv.py``, and to the scan kernel for shapes that admit no
+aligned tiling. The compensated pair (int8c) runs the same kernel twice.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..utils.compat import align_vma, shape_dtype_struct, vma_of
+from .pallas_gemv import _largest_divisor_leq, _on_tpu
+from .quantize import QuantizedMatrix, matvec_quantized
+
+# Tile defaults: the quantized A-tile is 1 byte/element, so the same VMEM
+# byte budget as the fp32 GEMV tile admits 4x the elements; keep the
+# tuned (512, 4096) footprint in BYTES (pallas_gemv.TILE_BYTE_BUDGET).
+DEFAULT_BM = 512
+DEFAULT_BK = 4096
+
+
+def _quant_gemv_kernel(block: int, q_ref, s_ref, x_ref, o_ref):
+    """One (bm, bk) payload tile: upcast in VMEM, scale per k-group,
+    accumulate row partials. ``s_ref`` holds this step's (bm, bk/block)
+    scale columns; the multiply runs on the grouped (bm, nb, block) view
+    so each element meets exactly its own block scale."""
+    bm, bk = q_ref.shape
+    nb = bk // block
+    tile = q_ref[...].astype(o_ref.dtype).reshape(bm, nb, block)
+    x_tile = x_ref[...].astype(o_ref.dtype).reshape(1, nb, block)
+    scales = s_ref[...].astype(o_ref.dtype)  # (bm, nb)
+    partial = jnp.sum(
+        scales * jnp.sum(tile * x_tile, axis=2), axis=1, keepdims=True
+    )  # (bm, 1)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += partial
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "bm", "bk", "interpret", "acc")
+)
+def _pallas_quant_gemv(q, scales, x, *, block, bm, bk, interpret, acc):
+    m, k = q.shape
+    grid = (m // bm, k // bk)
+    vma = vma_of(q) | vma_of(scales) | vma_of(x)
+    q, scales, x = align_vma(q, scales, x)
+    out = pl.pallas_call(
+        functools.partial(_quant_gemv_kernel, block),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, bk // block), lambda i, j: (i, j)),
+            pl.BlockSpec((1, bk), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+        out_shape=shape_dtype_struct((m, 1), acc, vma=vma),
+        interpret=interpret,
+    )(q, scales, x[None, :])
+    return out[:, 0]
+
+
+def quant_tiles(m: int, k: int, block: int) -> tuple[int, int] | None:
+    """Aligned (bm, bk) for the quantized tile: bm a 16-multiple divisor
+    of m, bk a ``block``-multiple divisor of k no larger than the byte
+    budget (1 byte/element payload). None when the shape admits no
+    aligned tiling (callers fall back to the scan kernel)."""
+    bm = _largest_divisor_leq(m, DEFAULT_BM, 16)
+    if bm is None:
+        return None
+    bk = _largest_divisor_leq(k, DEFAULT_BK, block)
+    if bk is None or bk % 128:
+        return None
+    return bm, bk
+
+
+def matvec_quantized_pallas(qa: QuantizedMatrix, x):
+    """The fused tile as a storage kernel: payload (+ compensated pair)
+    through the Pallas grid; scan-kernel fallback for unaligned shapes
+    and for block right-hand sides (the fused tile is rank-1, like
+    ``pallas_ring``)."""
+    if x.ndim != 1:
+        return matvec_quantized(qa, x)
+    m, k = qa.q.shape
+    tiles = quant_tiles(m, k, qa.block)
+    if tiles is None:
+        return matvec_quantized(qa, x)
+    bm, bk = tiles
+    interpret = not _on_tpu()
+    # Same accumulator contract as the scan kernel: f64 operands keep
+    # f64 accumulation (the error budget is stated vs an fp64 oracle).
+    acc = jnp.promote_types(qa.out_dtype, jnp.float32)
+    y = _pallas_quant_gemv(
+        qa.q, qa.scales, x, block=qa.block, bm=bm, bk=bk,
+        interpret=interpret, acc=acc,
+    )
+    if qa.q2 is not None:
+        y = y + _pallas_quant_gemv(
+            qa.q2, qa.scales2, x, block=qa.block, bm=bm, bk=bk,
+            interpret=interpret, acc=acc,
+        )
+    return y
+
+
+# Interpret-mode pallas defeats the shard_map vma tracker the same way the
+# fp32 tile kernel does (ops/pallas_gemv.py).
+matvec_quantized_pallas.relax_vma_check = True  # type: ignore[attr-defined]

@@ -1,0 +1,413 @@
+"""Quantized-storage formats: per-block-scaled low-precision resident ``A``.
+
+Distributed matvec is HBM-bandwidth-bound (ROADMAP; the paper's regime),
+so after overlap (PR 3) and continuous batching (PR 6) the one remaining
+raw-speed multiplier is shrinking the bytes of the resident ``A`` itself.
+This module adds a **storage axis** orthogonal to the compute dtype: ``A``
+is quantized ONCE at residency time into a low-bit payload plus per-block
+scales, and the matvec/GEMM bodies consume that payload directly — each
+kernel upcasts one (m, block) tile at a time inside its contraction loop,
+so no dequantized full-width ``A`` ever exists (the staticcheck HLO
+auditor's early-dequant census gate makes that a compile-time error;
+docs/QUANTIZATION.md).
+
+Formats (:data:`STORAGE_FORMATS`):
+
+* ``int8``  — symmetric round-to-nearest int8 against a per-(row, k-block)
+  power-free scale ``s = max|a_block| / 127`` (the GPTQ/AWQ-style groupwise
+  layout; block size from :func:`default_block`). Payload: ~0.25× the fp32
+  bytes (+ scales, ``4/block`` per element). Round-trip error ≤ s/2 per
+  element — ~8 bits relative to each block max.
+* ``int8c`` — ``int8`` plus a **compensated correction**: the residual
+  ``A − Q(A)`` (computed in f64 host precision, so it is the true
+  quantization error) is itself quantized into a SECOND int8 operand with
+  its own per-block scales — the Ozaki-style split of ``ops/ozaki.py``
+  truncated to two addends. The kernel contracts both operands against the
+  same ``x`` and adds, recovering ~16 bits relative to each block max;
+  on well-scaled data the matvec residual lands at the fp32 accumulation
+  level (the error-budget gate in ``tests/test_quantized.py``, budget in
+  docs/QUANTIZATION.md). Payload: ~0.5× (+ 2 scale planes).
+* ``fp8``  — ``float8_e4m3fn`` storage against per-block scales
+  ``max|a_block| / 448``: 3 mantissa bits with a per-ELEMENT exponent, so
+  small elements inside a wide-range block keep relative precision int8
+  loses. Payload: ~0.25× (+ scales). Backend-permitting
+  (:func:`fp8_supported`): where the dtype is unavailable the tuner skips
+  it and an explicit request fails loudly at quantize time.
+
+``native`` (or None) everywhere means the unquantized path — the safe
+tier the engine's degradation ladder falls back to (docs/RESILIENCE.md).
+
+The quantized operand travels as ONE pytree (:class:`QuantizedMatrix`):
+payload, scales, and the optional correction pair flatten to leaves that
+all carry ``A``'s own PartitionSpec — the scales shard alongside their
+blocks on every strategy (spec-prefix semantics, models/base.py), which is
+what makes the storage axis orthogonal to the sharding axis (GSPMD's
+annotate-and-compose doctrine, arxiv 2105.04663).
+
+Numerics doctrine: scales are ALWAYS float32 — host-side scale math that
+silently promoted to float64 would both lie about the error budget and
+double the scale-plane bytes. The staticcheck ``quant-fp64-scale`` rule
+(marker ``quant-ok``) pins this at the AST layer; the one deliberate f64
+use (the int8c residual) is marked where it happens.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.errors import ConfigError
+
+# The storage-format ladder the tuner races (tuning/search.py::tune_storage)
+# next to "native". Order is the documentation order, not a preference.
+STORAGE_FORMATS = ("int8", "int8c", "fp8")
+NATIVE = "native"
+
+# Default per-(row, block) group length along the contraction axis, before
+# the divisibility clamp (default_block): 128 matches the TPU lane width
+# and keeps the scale-plane overhead at 4/128 = 3% of the payload.
+DEFAULT_BLOCK = 128
+
+_INT8_MAX = 127.0
+_FP8_MAX = 448.0  # float8_e4m3fn finite max
+
+# The error budget (docs/QUANTIZATION.md derives these; the acceptance
+# gate in tests/test_quantized.py pins them). Per-element representation
+# error relative to the element's own BLOCK max:
+#   int8  : |a - s*q|           <= s/2       = amax/(2*127)
+#   int8c : |a - s1*q1 - s2*q2| <= s2/2     <= amax/(2*127^2)
+# (the second level quantizes the first's residual, whose block max is
+# itself <= s1/2). The matvec gate composes the element bound through the
+# contraction: |Δy_i| <= k * eps * amax_i * max|x| — a worst-case bound,
+# checked exactly. FP32_LEVEL_RELERR is the normwise "fp32-level" seat the
+# compensated format must clear on well-scaled data: ~2 bits above the
+# int8c element bound, an order below fp32's ~1e-5 at matvec-sum scale.
+INT8_EPS = 1.0 / (2.0 * _INT8_MAX)
+INT8C_EPS = 1.0 / (2.0 * _INT8_MAX * _INT8_MAX)
+FP32_LEVEL_RELERR = 1e-4
+
+
+def normalize_storage(fmt: str | None) -> str:
+    """Canonical storage-format name: None and "native" both mean the
+    unquantized path; anything else must be a known format."""
+    if fmt is None or fmt == NATIVE:
+        return NATIVE
+    if fmt not in STORAGE_FORMATS:
+        raise ConfigError(
+            f"unknown dtype_storage {fmt!r}; available: "
+            f"{(NATIVE,) + STORAGE_FORMATS} (or 'auto' where a tuner-backed "
+            "caller resolves it)"
+        )
+    return fmt
+
+
+def fp8_supported() -> bool:
+    """True when the installed JAX/ml_dtypes stack carries float8_e4m3fn.
+    The CPU/GPU interpret paths upcast per tile exactly like int8, so
+    availability of the dtype is the whole gate (speed is the tuner's
+    question, not this one's)."""
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def default_block(k: int, contraction_shards: int = 1) -> int:
+    """The per-(row, block) group length for a (·, k) matrix whose
+    contraction axis is sharded ``contraction_shards`` ways.
+
+    Largest power of two ≤ :data:`DEFAULT_BLOCK` such that (a) every shard
+    holds a whole number of blocks (``k % (block · shards) == 0`` — the
+    scales then shard with exactly ``A``'s PartitionSpec) and (b) each
+    shard holds at least TWO blocks, so the tile-wise upcast never touches
+    a full local ``A`` at once (the early-dequant doctrine; single-block
+    shards would make the sanctioned kernel indistinguishable from a full
+    dequant). Falls back to a single block per shard only when the local
+    width admits nothing smaller (k_local < 2).
+    """
+    if k <= 0 or contraction_shards <= 0 or k % contraction_shards:
+        raise ConfigError(
+            f"quantized storage needs k divisible by the contraction "
+            f"shards; got k={k}, shards={contraction_shards}"
+        )
+    k_local = k // contraction_shards
+    block = DEFAULT_BLOCK
+    while block > 1:
+        if k_local % block == 0 and k_local // block >= 2:
+            return block
+        block //= 2
+    return 1
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedMatrix:
+    """One quantized resident ``A``: payload + per-block scales (+ the
+    optional compensated-correction pair), as a single pytree whose leaves
+    all shard with ``A``'s own PartitionSpec.
+
+    ``q``       — (m, k) low-bit payload (int8 or float8_e4m3fn).
+    ``scales``  — (m, k/block) float32 per-(row, block) scales.
+    ``q2``/``scales2`` — the quantized residual operand (int8c) or None.
+
+    ``shape``/``ndim``/``dtype`` present the LOGICAL matrix (so strategy
+    bodies — ``validate``, ``.astype(a.dtype)`` — run unchanged); the
+    leaves' own shapes/dtypes are the storage truth. ``dtype`` is the
+    original operand dtype the matvec result is cast back to.
+    """
+
+    def __init__(self, q, scales, q2=None, scales2=None, *, fmt, block,
+                 out_dtype):
+        self.q = q
+        self.scales = scales
+        self.q2 = q2
+        self.scales2 = scales2
+        self.fmt = fmt
+        self.block = int(block)
+        self.out_dtype = np.dtype(out_dtype)
+
+    def tree_flatten(self):
+        return (
+            (self.q, self.scales, self.q2, self.scales2),
+            (self.fmt, self.block, str(self.out_dtype)),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        fmt, block, out_dtype = aux
+        q, scales, q2, scales2 = children
+        return cls(q, scales, q2, scales2, fmt=fmt, block=block,
+                   out_dtype=out_dtype)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return 2
+
+    @property
+    def dtype(self):
+        return self.out_dtype
+
+    @property
+    def nbytes(self) -> int:
+        """Resident payload bytes — the engine's HBM-resident-bytes gauge
+        and the demo's bytes-moved numerator."""
+        total = 0
+        for leaf in (self.q, self.scales, self.q2, self.scales2):
+            if leaf is not None:
+                total += leaf.size * np.dtype(leaf.dtype).itemsize
+        return int(total)
+
+
+def _block_quantize_int8(a64: np.ndarray, block: int):
+    """One int8 quantization level over (m, nb, block)-grouped data.
+    Returns (q int8 (m, k), scales f32 (m, nb), residual f64 (m, k))."""
+    m, k = a64.shape
+    nb = k // block
+    grouped = a64.reshape(m, nb, block)
+    amax = np.max(np.abs(grouped), axis=2)
+    scales = np.asarray(amax / _INT8_MAX, dtype=np.float32)
+    # Zero blocks: scale 0 with an all-zero payload round-trips exactly;
+    # divide by a stand-in 1 to keep the quotient finite.
+    safe = np.where(scales == 0.0, np.float32(1.0), scales)
+    q = np.clip(
+        np.rint(grouped / safe[:, :, None]), -_INT8_MAX, _INT8_MAX
+    ).astype(np.int8)
+    residual = grouped - q.astype(np.float64) * safe[:, :, None].astype(np.float64)  # quant-ok: the residual is the true quantization error only in f64; it is re-quantized to int8 before storage
+    return q.reshape(m, k), scales, residual.reshape(m, k)
+
+
+def quantize_matrix(
+    a, fmt: str, block: int | None = None,
+    contraction_shards: int = 1,
+) -> QuantizedMatrix:
+    """Quantize a host (m, k) matrix into ``fmt`` storage — the
+    once-at-residency step (engine construction / tuner candidate setup).
+
+    ``block`` defaults to :func:`default_block` for the given contraction
+    sharding, so the scale plane is evenly shardable wherever ``A`` is.
+    """
+    fmt = normalize_storage(fmt)
+    if fmt == NATIVE:
+        raise ConfigError("quantize_matrix needs a quantized format; "
+                          "'native' storage is the unquantized path")
+    a = np.asarray(a)  # quant-ok: dtype passthrough — A keeps the caller's own storage dtype here
+    if a.ndim != 2:
+        raise ConfigError(f"A must be rank 2, got shape {a.shape}")
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        # jnp, not np: the ml_dtypes floats (bfloat16, float16 siblings)
+        # are not np.floating subtypes but quantize fine through the f64
+        # staging below.
+        raise ConfigError(f"quantized storage needs float A, got {a.dtype}")
+    m, k = a.shape
+    if block is None:
+        block = default_block(k, contraction_shards)
+    if k == 0 or block <= 0 or k % block:
+        raise ConfigError(
+            f"block {block} must evenly divide k={k} (and k > 0)"
+        )
+    out_dtype = a.dtype
+    a64 = a.astype(np.float64)  # quant-ok: exact staging for the residual computation; nothing f64 is stored
+    if fmt == "fp8":
+        if not fp8_supported():
+            raise ConfigError(
+                "dtype_storage='fp8' needs jax.numpy.float8_e4m3fn, which "
+                "this backend build does not provide (docs/QUANTIZATION.md "
+                "has the support matrix); use 'int8'/'int8c' or 'native'"
+            )
+        nb = k // block
+        grouped = a64.reshape(m, nb, block)
+        amax = np.max(np.abs(grouped), axis=2)
+        scales = np.asarray(amax / _FP8_MAX, dtype=np.float32)
+        safe = np.where(scales == 0.0, np.float32(1.0), scales)
+        q = np.asarray(
+            (grouped / safe[:, :, None]).astype(np.float32),
+            dtype=jnp.float8_e4m3fn,
+        ).reshape(m, k)
+        return QuantizedMatrix(q, scales, fmt=fmt, block=block,
+                               out_dtype=out_dtype)
+    q, scales, residual = _block_quantize_int8(a64, block)
+    if fmt == "int8":
+        return QuantizedMatrix(q, scales, fmt=fmt, block=block,
+                               out_dtype=out_dtype)
+    q2, scales2, _ = _block_quantize_int8(residual, block)
+    return QuantizedMatrix(q, scales, q2, scales2, fmt=fmt, block=block,
+                           out_dtype=out_dtype)
+
+
+def dequantize(qa: QuantizedMatrix) -> np.ndarray:
+    """Materialize the full dequantized matrix on host — a TEST/reference
+    helper only. Production kernels never do this (the early-dequant
+    census gate exists to prove it); round-trip property tests and the
+    dequant-first known-bad fixture are its callers."""
+    m, k = qa.q.shape
+    nb = k // qa.block
+
+    def level(q, scales):
+        grouped = np.asarray(q, dtype=np.float32).reshape(m, nb, qa.block)
+        s = np.asarray(scales, dtype=np.float32)
+        return (grouped * s[:, :, None]).reshape(m, k)
+
+    out = level(qa.q, qa.scales)
+    if qa.q2 is not None:
+        out = out + level(qa.q2, qa.scales2)
+    return out.astype(qa.out_dtype)
+
+
+# ----------------------------------------------------------------- kernels
+
+
+def _contract_level(q, scales, x, block: int, acc):
+    """One storage level's contraction: ``sum_j scales[:, j] * (q_j @ x_j)``
+    over k-blocks, upcasting ONE (m, block) tile per step inside a scan —
+    the lowering holds tile-sized converts only, never a full-width
+    dequantized ``A`` (the census-gate doctrine). Rank-agnostic in ``x``
+    ((k,) vector or (k, n) block of right-hand sides)."""
+    m, k = q.shape
+    nb = k // block
+    q3 = jnp.swapaxes(q.reshape(m, nb, block), 0, 1)      # (nb, m, B)
+    x3 = x.reshape((nb, block) + x.shape[1:])             # (nb, B[, n])
+    s3 = jnp.swapaxes(scales, 0, 1)                       # (nb, m)
+    out_shape = (m,) + x.shape[1:]
+
+    def step(y, operands):
+        q_tile, x_tile, s_tile = operands
+        p = jnp.matmul(
+            q_tile.astype(acc), x_tile.astype(acc),
+            preferred_element_type=acc,
+        )
+        s = s_tile.astype(acc)
+        return y + (s if p.ndim == 1 else s[:, None]) * p, None
+
+    y, _ = jax.lax.scan(
+        step, jnp.zeros(out_shape, acc), (q3, x3, s3)
+    )
+    return y
+
+
+def matvec_quantized(qa: QuantizedMatrix, x):
+    """The quantized local kernel (GEMV and GEMM faces in one): contract
+    the payload tile-by-tile against ``x``, then the compensated residual
+    operand when present, in the accumulator dtype. Returns the
+    accumulator dtype per the kernel contract (ops/gemv.py)."""
+    acc = jnp.promote_types(qa.out_dtype, jnp.float32)
+    if qa.q.shape[1] == 0:
+        return jnp.zeros((qa.q.shape[0],) + x.shape[1:], acc)
+    y = _contract_level(qa.q, qa.scales, x, qa.block, acc)
+    if qa.q2 is not None:
+        y = y + _contract_level(qa.q2, qa.scales2, x, qa.block, acc)
+    return y
+
+
+def matvec_quantized_dequant_first(qa: QuantizedMatrix, x):
+    """The ANTI-PATTERN reference: materialize the dequantized full ``A``
+    and contract it — numerically identical to :func:`matvec_quantized`,
+    but it moves full-width float bytes, defeating the storage format.
+    Exists so the staticcheck early-dequant census gate has a known-bad
+    lowering to flag (tests/test_staticcheck.py); never dispatched."""
+    acc = jnp.promote_types(qa.out_dtype, jnp.float32)
+    m, k = qa.q.shape
+    nb = k // qa.block
+
+    def level(q, scales):
+        full = q.astype(acc).reshape(m, nb, qa.block)  # the full dequant
+        return (full * scales.astype(acc)[:, :, None]).reshape(m, k)
+
+    a = level(qa.q, qa.scales)
+    if qa.q2 is not None:
+        a = a + level(qa.q2, qa.scales2)
+    return jnp.matmul(a, x.astype(acc), preferred_element_type=acc)
+
+
+def get_storage_kernel(kernel: str | Callable) -> Callable:
+    """Resolve the local kernel for quantized storage. A callable passes
+    through (the census-gate fixture injects the dequant-first reference
+    this way); the ``pallas`` tier name selects the fused
+    scale-and-multiply tile (ops/pallas_quant.py); every other tier name
+    — including ``auto``, whose tuned winners are native-storage kernels
+    by construction — resolves to the scan kernel."""
+    if callable(kernel):
+        return kernel
+    if kernel == "pallas":
+        from .pallas_quant import matvec_quantized_pallas
+
+        return matvec_quantized_pallas
+    return matvec_quantized
+
+
+def quantized_struct(
+    m: int, k: int, fmt: str, out_dtype, block: int
+) -> QuantizedMatrix:
+    """A :class:`QuantizedMatrix` of ``jax.ShapeDtypeStruct`` leaves — the
+    trace-only operand the staticcheck HLO auditor lowers quantized
+    configs against (no data is quantized; only the storage layout
+    matters to a lowering)."""
+    fmt = normalize_storage(fmt)
+    if fmt == NATIVE:
+        raise ConfigError("quantized_struct needs a quantized format")
+    if fmt == "fp8" and not fp8_supported():
+        raise ConfigError("fp8 storage unsupported on this backend build")
+    nb = k // block
+    payload_dtype = jnp.float8_e4m3fn if fmt == "fp8" else jnp.int8
+    q = jax.ShapeDtypeStruct((m, k), payload_dtype)
+    scales = jax.ShapeDtypeStruct((m, nb), jnp.float32)
+    if fmt == "int8c":
+        return QuantizedMatrix(
+            q, scales,
+            jax.ShapeDtypeStruct((m, k), jnp.int8),
+            jax.ShapeDtypeStruct((m, nb), jnp.float32),
+            fmt=fmt, block=block, out_dtype=out_dtype,
+        )
+    return QuantizedMatrix(q, scales, fmt=fmt, block=block,
+                           out_dtype=out_dtype)
+
+
+def quantized_like(qa: QuantizedMatrix, fn: Callable) -> QuantizedMatrix:
+    """Map ``fn`` over the present leaves (q/scales[/q2/scales2]) keeping
+    the format metadata — how the engine builds its ShapeDtypeStruct
+    template and places the residency pytree. (The quantized kernel is
+    selected by the storage axis in models/base.py, not by the GEMV
+    kernel registry, because its operand is a pytree.)"""
+    return jax.tree_util.tree_map(fn, qa)

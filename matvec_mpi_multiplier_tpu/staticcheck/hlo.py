@@ -27,6 +27,28 @@ no compile — ~1 s for the whole table), and audited four ways:
   must produce byte-identical lowerings (same sha256). A nondeterministic
   lowering would make the engine's AOT executable cache silently recompile
   (or worse, serve divergent programs) across restarts.
+* **A-operand byte accounting** — per config, the bytes of the lowered
+  program's resident-A input parameters (everything but the trailing
+  ``x``), pinned as ``a_bytes``/``a_bytes_ratio`` in the golden table.
+  The quantized-storage configs (``dtype_storage`` — ops/quantize.py)
+  must actually shrink the resident stream: ratio ≤ 0.30× for the
+  single-payload formats (int8, fp8 + scale plane), ≤ 0.55× for the
+  compensated pair (int8c) — the structural pin behind the PR's
+  bandwidth claim.
+* **Early-dequant census gate** — a quantized config's lowering must
+  never ``convert`` a full-width (local or global) A-shaped low-bit
+  tensor to float before the contraction: that is the "silently
+  dequantized A" failure mode, where the program stores ¼ the bytes but
+  MOVES all of them (the tile-wise scan kernel converts (m, block)
+  tiles only). The dequant-first anti-pattern kernel
+  (``ops.quantize.matvec_quantized_dequant_first``) exists as the
+  known-bad lowering this gate is tested against.
+
+The quantized configs' collective census equals their native
+counterpart's by construction — the combine operates on the fp32
+accumulator partials, never on the payload — so the storage axis is
+invisible to the schedule pins and visible only in the A-byte accounting
+(the orthogonality GSPMD predicts for per-operand dtype choices).
 
 Census caveat, documented because it WILL surprise: ``rowwise|gather``
 shows an empty census. Its final gather is a ``with_sharding_constraint``,
@@ -48,13 +70,27 @@ from .corpus import repo_root
 from .findings import Finding, dedup
 
 # The audit operand: one shape/dtype exercises every schedule (divisible by
-# the 8-device mesh, its 2x4 grid, and the S∈{2,4} stage ladder).
+# the 8-device mesh, its 2x4 grid, and the S∈{2,4} stage ladder). The
+# contraction axis is wide enough that every strategy's shard holds ≥ 2
+# full-size quantization groups (ops.quantize.DEFAULT_BLOCK = 128 at 8
+# contraction shards needs k ≥ 2048), so the storage configs audit at the
+# production block size instead of a clamped one whose scale-plane
+# overhead would dominate the byte ratios. The collective payloads are
+# functions of m and p only, so the census pins are k-independent.
 AUDIT_DEVICES = 8
 AUDIT_M = 64
-AUDIT_K = 64
+AUDIT_K = 2048
 AUDIT_DTYPE = "float32"
 GOLDEN_REL = "data/staticcheck/golden_schedule.json"
-GOLDEN_SCHEMA = 1
+# Schema 2 over 1: every entry additionally pins the A-operand byte
+# accounting (a_bytes / a_bytes_ratio) and the table includes the
+# quantized-storage configs.
+GOLDEN_SCHEMA = 2
+
+# Resident-A byte-ratio ceilings the quantized configs must meet
+# (acceptance pins; docs/QUANTIZATION.md derives them: 1-byte payload +
+# fp32 scale plane at 1/block density, ×2 for the compensated pair).
+STORAGE_BYTE_CEILING = {"int8": 0.30, "fp8": 0.30, "int8c": 0.55}
 
 # StableHLO op → the census name (the HLO spelling the paper's tables use).
 _KINDS = {
@@ -65,24 +101,49 @@ _KINDS = {
     "all_to_all": "all-to-all",
 }
 
-_ITEMSIZE = {"float32": 4, "float64": 8, "bfloat16": 2, "float16": 2}
-_TENSOR_RE = re.compile(r"tensor<(?:([0-9x]+)x)?([a-z][a-z0-9]*)>")
+_ITEMSIZE = {
+    "float32": 4, "float64": 8, "bfloat16": 2, "float16": 2,
+    "int8": 1, "float8": 1,
+}
+_TENSOR_RE = re.compile(r"tensor<(?:([0-9x]+)x)?([A-Za-z][A-Za-z0-9_]*)>")
+# StableHLO element-type spelling → the census name above. f8 variants all
+# read as "float8" (1 byte); i8/si8/ui8 as int8.
+_ELEM_NAMES = {
+    "f32": "float32", "f64": "float64", "bf16": "bfloat16", "f16": "float16",
+    "i8": "int8", "si8": "int8", "ui8": "int8",
+}
+
+_FLOAT_ELEMS = ("f32", "f64", "bf16", "f16")
+_LOWBIT_ELEMS = ("i8", "si8", "ui8")
+
+
+def _elem_name(elem: str) -> str:
+    if elem.startswith("f8"):
+        return "float8"
+    return _ELEM_NAMES.get(elem, elem)
 
 
 class AuditConfig(NamedTuple):
-    """One audited lowering: a strategy × combine(@stages) × kernel cell."""
+    """One audited lowering: a strategy × combine(@stages) × kernel ×
+    storage cell."""
 
     strategy: str
     combine: str
     stages: int | None = None
     kernel: str = "xla"
+    # Resident-A storage format (ops/quantize.py): "native" audits the
+    # plain array path; "int8"/"int8c"/"fp8" audit the quantized
+    # residency. Native keys keep their historical spelling (no suffix)
+    # so the pre-quantization golden entries survive the schema bump.
+    storage: str = "native"
 
     @property
     def key(self) -> str:
         combine = self.combine + (
             f"@{self.stages}" if self.stages is not None else ""
         )
-        return f"{self.strategy}|{combine}|{self.kernel}"
+        base = f"{self.strategy}|{combine}|{self.kernel}"
+        return base if self.storage == "native" else f"{base}|{self.storage}"
 
 
 # The audited table: all three paper strategies across their combine
@@ -111,7 +172,34 @@ AUDIT_CONFIGS: tuple[AuditConfig, ...] = (
     AuditConfig("blockwise", "ring"),
     AuditConfig("blockwise", "overlap", 2),
     AuditConfig("blockwise", "overlap", 4),
+    # Quantized-storage cells: one per strategy's default schedule plus
+    # the format ladder on rowwise (the simplest A-byte story: no
+    # in-body collective, so every parameter byte is the payload's).
+    # Their census must EQUAL the native counterpart's; their a_bytes
+    # must meet STORAGE_BYTE_CEILING; their lowerings must pass the
+    # early-dequant gate. fp8 cells are filtered out at audit time on
+    # backends whose build lacks the dtype (ops.quantize.fp8_supported).
+    AuditConfig("rowwise", "gather", storage="int8"),
+    AuditConfig("rowwise", "gather", storage="int8c"),
+    AuditConfig("rowwise", "gather", storage="fp8"),
+    AuditConfig("colwise", "psum_scatter", storage="int8"),
+    AuditConfig("colwise", "psum_scatter", storage="int8c"),
+    AuditConfig("blockwise", "gather", storage="int8"),
 )
+
+
+def _supported_configs(
+    configs: Iterable[AuditConfig],
+) -> tuple[AuditConfig, ...]:
+    """Filter configs this backend build can lower (fp8 cells need the
+    float8 dtype). The stale-key check uses the same filter so a golden
+    blessed on an fp8-capable build does not read as stale elsewhere."""
+    from ..ops.quantize import fp8_supported
+
+    return tuple(
+        cfg for cfg in configs
+        if cfg.storage != "fp8" or fp8_supported()
+    )
 
 
 def _audit_mesh():
@@ -130,19 +218,46 @@ def _audit_mesh():
     return make_mesh(AUDIT_DEVICES, devices=devices)
 
 
-def lower_config(cfg: AuditConfig, mesh):
-    """Build and lower one config against the audit operand (trace-only)."""
+def audit_block(cfg: AuditConfig, mesh) -> int | None:
+    """The quantization block the audit uses for one quantized config —
+    the same derivation the engine's residency step makes
+    (``ops.quantize.default_block`` against the strategy's contraction
+    sharding). None for native storage."""
+    if cfg.storage == "native":
+        return None
+    from ..models import get_strategy
+    from ..ops.quantize import default_block
+
+    strat = get_strategy(cfg.strategy)
+    return default_block(AUDIT_K, strat.contraction_shards(mesh))
+
+
+def lower_config(cfg: AuditConfig, mesh, kernel=None):
+    """Build and lower one config against the audit operand (trace-only).
+    ``kernel`` overrides the local kernel callable — the early-dequant
+    gate's mutation tests inject the dequant-first anti-pattern here."""
     import jax
     import numpy as np
 
     from ..models import get_strategy
 
-    kwargs: dict = {"combine": cfg.combine, "kernel": cfg.kernel}
+    kwargs: dict = {
+        "combine": cfg.combine,
+        "kernel": kernel if kernel is not None else cfg.kernel,
+    }
     if cfg.stages is not None:
         kwargs["stages"] = cfg.stages
-    fn = get_strategy(cfg.strategy).build(mesh, **kwargs)
     dtype = np.dtype(AUDIT_DTYPE)
-    a = jax.ShapeDtypeStruct((AUDIT_M, AUDIT_K), dtype)
+    if cfg.storage != "native":
+        from ..ops.quantize import quantized_struct
+
+        kwargs["dtype_storage"] = cfg.storage
+        a = quantized_struct(
+            AUDIT_M, AUDIT_K, cfg.storage, dtype, audit_block(cfg, mesh)
+        )
+    else:
+        a = jax.ShapeDtypeStruct((AUDIT_M, AUDIT_K), dtype)
+    fn = get_strategy(cfg.strategy).build(mesh, **kwargs)
     x = jax.ShapeDtypeStruct((AUDIT_K,), dtype)
     return fn.lower(a, x)
 
@@ -156,11 +271,7 @@ def _tensor_bytes(type_str: str) -> int:
     for d in (dims or "").split("x"):
         if d:
             count *= int(d)
-    return count * _ITEMSIZE.get(
-        {"f32": "float32", "f64": "float64", "bf16": "bfloat16",
-         "f16": "float16"}.get(elem, elem),
-        0,
-    )
+    return count * _ITEMSIZE.get(_elem_name(elem), 0)
 
 
 def collective_census(lowered) -> tuple[dict[str, int], dict[str, int]]:
@@ -187,6 +298,109 @@ def collective_census(lowered) -> tuple[dict[str, int], dict[str, int]]:
 
     walk(lowered.compiler_ir(dialect="stablehlo").operation)
     return census, payload
+
+
+def a_operand_bytes(lowered) -> int:
+    """Bytes of the lowered program's resident-A input parameters: every
+    ``@main`` argument except the trailing ``x`` — for native storage the
+    one (m, k) array, for quantized storage the payload + scale (+
+    correction) leaves. Read off the ARTIFACT (the module's entry
+    signature), not the builder's intent — that is the whole point of
+    auditing."""
+    module = lowered.compiler_ir(dialect="stablehlo")
+    for op in module.body.operations:
+        if op.operation.name != "func.func":
+            continue
+        if "main" not in str(op.attributes["sym_name"]):
+            continue
+        args = op.regions[0].blocks[0].arguments
+        types = [str(a.type) for a in args]
+        if not types:
+            return 0
+        return sum(_tensor_bytes(t) for t in types[:-1])
+    raise RuntimeError("lowered module has no @main function to audit")
+
+
+def _local_a_shape(cfg: AuditConfig, mesh) -> tuple[int, int]:
+    """The per-device shard shape of A for one strategy on the audit mesh
+    (the shape a full-shard dequantizing convert would produce)."""
+    from ..models import get_strategy
+
+    strat = get_strategy(cfg.strategy)
+    spec_a = strat.specs(mesh)[0]
+
+    def axis_devices(entry) -> int:
+        if entry is None:
+            return 1
+        names = (entry,) if isinstance(entry, str) else tuple(entry)
+        n = 1
+        for name in names:
+            n *= mesh.shape[name]
+        return n
+
+    return (
+        AUDIT_M // axis_devices(spec_a[0]),
+        AUDIT_K // axis_devices(spec_a[1] if len(spec_a) > 1 else None),
+    )
+
+
+def early_dequant_findings(
+    cfg: AuditConfig, lowered, mesh
+) -> list[Finding]:
+    """The early-dequant census gate: a quantized config's lowering must
+    not contain a ``stablehlo.convert`` whose low-bit operand is a
+    full-width A — the global (m, k) or the per-device shard shape.
+    The sanctioned kernel upcasts (m, block) tiles (block strictly
+    smaller than the local width — ``ops.quantize.default_block``), so
+    any full-shard convert means the program dequantized A before the
+    contraction and moves full-width float bytes while claiming the
+    payload's."""
+    if cfg.storage == "native":
+        return []
+    local = _local_a_shape(cfg, mesh)
+    full_shapes = {(AUDIT_M, AUDIT_K), local}
+    findings: list[Finding] = []
+
+    def walk(op):
+        for region in op.regions:
+            for block in region.blocks:
+                for child in block.operations:
+                    name = child.operation.name
+                    if name == "stablehlo.convert":
+                        operand = str(child.operands[0].type)
+                        result = str(child.results[0].type)
+                        om = _TENSOR_RE.match(operand)
+                        rm = _TENSOR_RE.match(result)
+                        if om and rm:
+                            odims, oelem = om.groups()
+                            _, relem = rm.groups()
+                            lowbit = (
+                                oelem in _LOWBIT_ELEMS
+                                or oelem.startswith("f8")
+                            )
+                            shape = tuple(
+                                int(d) for d in (odims or "").split("x") if d
+                            )
+                            if (
+                                lowbit
+                                and relem in _FLOAT_ELEMS
+                                and shape in full_shapes
+                            ):
+                                findings.append(Finding(
+                                    f"<hlo:{cfg.key}>", 0,
+                                    "hlo-early-dequant",
+                                    f"lowering converts a full-width "
+                                    f"{operand} A shard to {result} before "
+                                    "the contraction: the quantized config "
+                                    "stores the payload's bytes but MOVES "
+                                    "full-width float bytes (upcast per "
+                                    "(m, block) tile instead — "
+                                    "ops/quantize.py, docs/QUANTIZATION.md)",
+                                ))
+                    walk(child.operation)
+
+    walk(lowered.compiler_ir(dialect="stablehlo").operation)
+    return findings
 
 
 def expected_schedule(
@@ -270,20 +484,27 @@ def exec_key(cfg: AuditConfig):
     )
     return ExecKey(
         op="matvec", strategy=cfg.strategy, kernel=cfg.kernel,
-        combine=combine, bucket=1, dtype=AUDIT_DTYPE,
+        combine=combine, bucket=1, dtype=AUDIT_DTYPE, storage=cfg.storage,
     )
 
 
 def audit_entry(cfg: AuditConfig, mesh, lowered=None) -> dict:
     """Package one config's observed schedule (lowering it unless the
-    caller already has the lowered artifact in hand)."""
+    caller already has the lowered artifact in hand). ``a_bytes`` is the
+    resident-A parameter footprint read off the module's entry signature;
+    ``a_bytes_ratio`` normalizes it by the native (m · k · itemsize)
+    stream the format replaces."""
     if lowered is None:
         lowered = lower_config(cfg, mesh)
     census, payload = collective_census(lowered)
+    a_bytes = a_operand_bytes(lowered)
+    native_bytes = AUDIT_M * AUDIT_K * _ITEMSIZE[AUDIT_DTYPE]
     return {
         "census": dict(sorted(census.items())),
         "payload_bytes": dict(sorted(payload.items())),
         "payload_total_bytes": sum(payload.values()),
+        "a_bytes": a_bytes,
+        "a_bytes_ratio": round(a_bytes / native_bytes, 6),
     }
 
 
@@ -294,7 +515,7 @@ def build_schedule_table(configs: Iterable[AuditConfig] | None = None) -> dict:
     mesh = _audit_mesh()
     entries = {
         cfg.key: audit_entry(cfg, mesh)
-        for cfg in (configs or AUDIT_CONFIGS)
+        for cfg in _supported_configs(configs or AUDIT_CONFIGS)
     }
     return {
         "schema": GOLDEN_SCHEMA,
@@ -331,7 +552,7 @@ def run_hlo_audit(
     golden_path = (
         Path(golden_path) if golden_path is not None else root / GOLDEN_REL
     )
-    configs = tuple(configs or AUDIT_CONFIGS)
+    configs = _supported_configs(configs or AUDIT_CONFIGS)
     findings: list[Finding] = []
 
     golden_cfgs: dict = {}
@@ -380,6 +601,18 @@ def run_hlo_audit(
                 f"structural expectation "
                 f"{dict(sorted(exp_payload.items()))}{overlap_hint}",
             ))
+
+        ceiling = STORAGE_BYTE_CEILING.get(cfg.storage)
+        if ceiling is not None and observed["a_bytes_ratio"] > ceiling:
+            findings.append(Finding(
+                f"<hlo:{cfg.key}>", 0, "hlo-storage-bytes",
+                f"resident-A parameter bytes are "
+                f"{observed['a_bytes_ratio']:.3f}x the native stream, over "
+                f"the {cfg.storage} ceiling of {ceiling}x — the storage "
+                "format is not actually shrinking the bytes it exists to "
+                "shrink",
+            ))
+        findings.extend(early_dequant_findings(cfg, lowered, mesh))
 
         if have_golden:
             # Empty/absent "configs" must read as every pin missing, not

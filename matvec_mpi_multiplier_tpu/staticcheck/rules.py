@@ -25,6 +25,8 @@ scheduler-lock-across-    lock-ok      no engine dispatch/drain entered
 dispatch                               while holding a scheduler lock
 silent-except             swallow-ok   broad except blocks must re-raise,
                                        record the failure, or justify
+quant-fp64-scale          quant-ok     no float64 in quantization scale
+                                       math (quantized-storage helpers)
 ========================  ===========  ====================================
 
 The first four are the old grep rules from ``scripts/tier1.sh`` /
@@ -599,6 +601,73 @@ def _check_silent_except(sf: SourceFile):
             "(obs counter, future._fail, an error variable), or mark the "
             "deliberate swallow with '# swallow-ok: <reason>'"
         )
+
+
+# The fp64-implicit-promotion family, extended over the quantized-storage
+# helpers (ops/quantize.py, ops/pallas_quant.py): scale math there runs on
+# HOST numpy, whose default float IS float64 — a dtype-less constructor or
+# an astype/dtype to f64 silently (a) doubles the scale-plane bytes the
+# format's ratio pins assume are fp32 and (b) lies about the error budget
+# the scales define. The package-wide fp64 rule only sees jnp
+# constructors; this one covers the numpy side, in the quant scope only.
+# Marker `quant-ok:` documents the deliberate exceptions (the int8c
+# residual is COMPUTED in f64 for exactness, then stored f32).
+
+
+def _quant_scope(rel: str) -> bool:
+    return rel in (
+        f"{_PKG}/ops/quantize.py", f"{_PKG}/ops/pallas_quant.py",
+    )
+
+
+_NP_F64_NAMES = ("numpy.float64", "jax.numpy.float64", "float")
+# Host constructors whose dtype defaults to float64 for float input.
+_NP_DTYPELESS_CTORS = (
+    "numpy.asarray", "numpy.array", "numpy.zeros", "numpy.ones",
+    "numpy.empty", "numpy.full",
+)
+
+
+def _is_f64_dtype_expr(sf: SourceFile, node: ast.AST) -> bool:
+    if (sf.qualname(node) or "") in _NP_F64_NAMES:
+        return True
+    return isinstance(node, ast.Constant) and node.value == "float64"
+
+
+@_register(
+    "quant-fp64-scale", "quant-ok",
+    "float64 in quantization scale math (astype/dtype to f64, or a "
+    "dtype-less host constructor defaulting to it) — scales are fp32 by "
+    "doctrine",
+    _quant_scope,
+)
+def _check_quant_fp64(sf: SourceFile):
+    for call in _calls(sf.tree):
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype" and any(
+            _is_f64_dtype_expr(sf, arg) for arg in call.args
+        ):
+            yield call, (
+                ".astype(float64) in the quant scope: scales and staged "
+                "values are fp32 by doctrine (mark the deliberate "
+                "exception with '# quant-ok: <reason>')"
+            )
+            continue
+        for kw in call.keywords:
+            if kw.arg == "dtype" and _is_f64_dtype_expr(sf, kw.value):
+                yield call, (
+                    "dtype=float64 in the quant scope: scales are fp32 by "
+                    "doctrine"
+                )
+        q = sf.qualname(fn) or ""
+        if q in _NP_DTYPELESS_CTORS:
+            has_dtype = any(kw.arg == "dtype" for kw in call.keywords)
+            if not has_dtype:
+                yield call, (
+                    f"{q}() without a dtype in the quant scope defaults "
+                    "to float64 for float input; name the width (or mark "
+                    "a deliberate dtype passthrough)"
+                )
 
 
 _MUTABLE_FACTORIES = (

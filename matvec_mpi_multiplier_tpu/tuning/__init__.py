@@ -37,6 +37,7 @@ from .cache import (
     overlap_key,
     platform_fingerprint,
     promote_key,
+    storage_key,
 )
 
 __all__ = [
@@ -51,6 +52,7 @@ __all__ = [
     "overlap_key",
     "platform_fingerprint",
     "promote_key",
+    "storage_key",
     "get_cache",
     "reset_cache",
     "lookup_gemv",
@@ -58,6 +60,7 @@ __all__ = [
     "lookup_combine",
     "lookup_promotion",
     "lookup_overlap",
+    "lookup_storage",
 ]
 
 # The dispatch-side singleton: loaded lazily on first lookup so importing
@@ -132,6 +135,18 @@ def lookup_promotion(
     width at which one sharded GEMM measured faster than ``b`` sequential
     single-RHS dispatches (null when promotion never won)."""
     return get_cache().lookup(promote_key(strategy, m, k, p, dtype))
+
+
+def lookup_storage(
+    *, strategy: str, m: int, k: int, p: int, dtype: str
+) -> dict[str, Any] | None:
+    """The recorded resident-A storage-format decision for this (GLOBAL
+    shape, mesh size), or None — the serving engine's
+    ``dtype_storage="auto"`` question (``engine/core.py``; a miss keeps
+    native storage, the never-worse-informed default). The decision's
+    ``storage`` names the measured winner; ``resident_bytes`` and
+    ``bandwidth_gbps`` record why."""
+    return get_cache().lookup(storage_key(strategy, m, k, p, dtype))
 
 
 def lookup_overlap(

@@ -11,10 +11,10 @@ harmless on another (its entries simply never match, so dispatch falls back
 to the static defaults and a ``--tune`` run re-measures), and a single file
 can carry tunings for several platforms side by side.
 
-Schema (version 3)::
+Schema (version 4)::
 
     {
-      "version": 3,
+      "version": 4,
       "entries": {
         "<fingerprint>|gemv|<m>x<k>|<dtype>":
             {"kernel": "pallas", "bm": 512, "bk": 2048,
@@ -26,21 +26,31 @@ Schema (version 3)::
         "<fingerprint>|promote|<strategy>|<m>x<k>|p<p>|<dtype>":
             {"b_star": 4, "seq_time_s": ..., "gemm_times": {"4": ...}},
         "<fingerprint>|overlap|<strategy>|<m>x<k>|p<p>|<dtype>":
-            {"stages": 4, "time_s": ..., "candidates": {"1": ..., "2": ...}}
+            {"stages": 4, "time_s": ..., "candidates": {"1": ..., "2": ...}},
+        "<fingerprint>|storage|<strategy>|<m>x<k>|p<p>|<dtype>":
+            {"storage": "int8", "time_s": ..., "candidates": {...},
+             "resident_bytes": {"native": ..., "int8": ...},
+             "bandwidth_gbps": {...}}
       }
     }
 
-Version 3 over 2: the ``overlap`` kind records the measured stage count S
-of the staged compute/communication-overlap schedules
-(``combine="overlap"`` — the fifth tuned axis, ``search.tune_overlap``,
-ladder {1,2,4,8} filtered per shape). Version 2 over 1: GEMM decisions
-carry measured (bm, bn, bk) tile sizes, ``combine`` keys exist for
-``op="gemm"`` as well as ``"matvec"``, and the ``promote`` kind records
-the GEMV→GEMM batch-promotion crossover ``b*`` (the serving engine's
-fourth tuned axis — ``engine/``). Version-1 and version-2 files are
-forward-compatible (their entries are strict subsets) and load as-is; a
-file with any other ``version`` is ignored wholesale (treated as empty)
-rather than half-parsed.
+Version 4 over 3: the ``storage`` kind records the measured resident-A
+storage format (``native`` / ``int8`` / ``int8c`` / ``fp8`` — the sixth
+tuned axis, ``search.tune_storage``, raced by wall clock with each
+candidate's resident bytes and achieved bandwidth recorded alongside;
+the engine's ``dtype_storage="auto"`` consults it). Version 3 over 2:
+the ``overlap`` kind records the measured stage count S of the staged
+compute/communication-overlap schedules (``combine="overlap"`` — the
+fifth tuned axis, ``search.tune_overlap``, ladder {1,2,4,8} filtered per
+shape). Version 2 over 1: GEMM decisions carry measured (bm, bn, bk)
+tile sizes, ``combine`` keys exist for ``op="gemm"`` as well as
+``"matvec"``, and the ``promote`` kind records the GEMV→GEMM
+batch-promotion crossover ``b*`` (the serving engine's fourth tuned axis
+— ``engine/``). Version-1 through version-3 files are forward-compatible
+(their entries are strict subsets) and load as-is; a file with any other
+``version`` — including a FUTURE schema this build cannot read — is
+ignored wholesale (treated as empty) rather than half-parsed, and the
+quarantine path below preserves its bytes.
 
 ``gemv``/``gemm`` keys use the LOCAL (per-device) shape — the granularity
 the kernel registry's ``auto`` tier dispatches on under shard_map;
@@ -62,12 +72,12 @@ import tempfile
 from pathlib import Path
 from typing import Any
 
-CACHE_VERSION = 3
-# Versions load() accepts: v1/v2 entries are strict subsets of v3's (no
-# overlap kind; v1 also no promote kind or gemm tile fields), so an old
-# cache keeps serving its decisions after the upgrade instead of forcing a
-# silent full re-tune.
-COMPATIBLE_VERSIONS = (1, 2, CACHE_VERSION)
+CACHE_VERSION = 4
+# Versions load() accepts: v1-v3 entries are strict subsets of v4's (no
+# storage kind; v1/v2 also no overlap/promote kinds or gemm tile fields),
+# so an old cache keeps serving its decisions after the upgrade instead of
+# forcing a silent full re-tune.
+COMPATIBLE_VERSIONS = (1, 2, 3, CACHE_VERSION)
 CACHE_ENV = "MATVEC_TUNING_CACHE"
 CACHE_FILENAME = "tuning_cache.json"
 
@@ -159,6 +169,24 @@ def overlap_key(
     return f"{fp}|overlap|{strategy}|{m}x{k}|p{p}|{dtype}"
 
 
+def storage_key(
+    strategy: str,
+    m: int,
+    k: int,
+    p: int,
+    dtype: str,
+    fingerprint: str | None = None,
+) -> str:
+    """Key for a resident-A storage-format decision (GLOBAL shape + mesh
+    size — the sixth tuned axis; the engine's ``dtype_storage="auto"``
+    consults it at construction). Like ``promote``/``overlap`` the key
+    carries no op: the format is a property of the resident matrix, and
+    the engine serves both its matvec and GEMM paths from the one
+    residency."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|storage|{strategy}|{m}x{k}|p{p}|{dtype}"
+
+
 class TuningCache:
     """In-memory view of the JSON cache file, with atomic persistence.
 
@@ -168,17 +196,35 @@ class TuningCache:
     first :meth:`save` moves the unusable file aside to ``<name>.corrupt``
     for postmortem instead of silently overwriting the evidence. A
     *missing* file is not quarantined (nothing to preserve).
+
+    An UNKNOWN-version file that is otherwise shape-valid (a FUTURE
+    schema written by a newer build — not damage, someone's data) parks
+    under a version-suffixed name (``<name>.v<N>.corrupt``) instead:
+    the generic ``.corrupt`` slot is most-recent-wins, and letting the
+    next truncated write clobber a future build's tunings would destroy
+    exactly the file this path exists to preserve (ISSUE 8 ride-along).
     """
 
     def __init__(self, path: str | os.PathLike | None = None):
         self.path = Path(path) if path is not None else default_cache_path()
         self.entries: dict[str, dict[str, Any]] = {}
         self.quarantined = False
+        # Set when the quarantined file is a shape-valid FUTURE schema:
+        # its version number, routing save()'s preserve to the
+        # version-suffixed slot.
+        self._quarantine_version: int | None = None
 
     @property
     def corrupt_path(self) -> Path:
-        """Where :meth:`save` parks an unusable cache file (the most
-        recent one wins — each quarantine overwrites the last)."""
+        """Where :meth:`save` parks an unusable cache file: the generic
+        ``.corrupt`` slot for damage (most recent wins — each quarantine
+        overwrites the last), a ``.v<N>.corrupt`` slot per unknown
+        version for future-schema files (never clobbered by later
+        damage)."""
+        if self._quarantine_version is not None:
+            return self.path.with_name(
+                f"{self.path.name}.v{self._quarantine_version}.corrupt"
+            )
         return self.path.with_name(self.path.name + ".corrupt")
 
     @classmethod
@@ -207,6 +253,16 @@ class TuningCache:
             # this build cannot interpret): overwriting it would silently
             # destroy someone's data — quarantine instead.
             cache.quarantined = True
+            version = raw.get("version") if isinstance(raw, dict) else None
+            if (
+                isinstance(version, int)
+                and not isinstance(version, bool)
+                and isinstance(raw.get("entries"), dict)
+            ):
+                # Shape-valid with an unknown version: a FUTURE build's
+                # cache, preserved under its own versioned slot so later
+                # garbage quarantines cannot clobber it.
+                cache._quarantine_version = version
             return cache
         cache.entries = {
             str(k): v for k, v in raw["entries"].items() if isinstance(v, dict)

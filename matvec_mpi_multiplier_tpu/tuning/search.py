@@ -1,6 +1,6 @@
 """Candidate enumeration + measurement for the autotuner.
 
-Five measured axes, mirroring the repo's static perf choices:
+Six measured axes, mirroring the repo's static perf choices:
 
 * **local kernel** — ``xla`` / ``pallas`` / ``native`` (when its .so is
   built), measured as the bare per-device kernel on one device;
@@ -16,7 +16,11 @@ Five measured axes, mirroring the repo's static perf choices:
   serving engine's axis);
 * **overlap stage count** — the staged schedules' software-pipeline depth
   S over the {1,2,4,8} ladder (``tune_overlap``), consulted by
-  ``build(combine="overlap", stages=None)``.
+  ``build(combine="overlap", stages=None)``;
+* **resident storage format** — the quantized-storage ladder
+  ``native`` / ``int8`` / ``int8c`` / ``fp8`` (``tune_storage``), raced as
+  full distributed matvecs with resident bytes + achieved bandwidth
+  recorded; the serving engine's ``dtype_storage="auto"`` consults it.
 
 All measurements ride the existing benchmark protocol (``bench.timing``):
 device-looped slope timing with median-of-samples, the same numbers the
@@ -43,6 +47,7 @@ from .cache import (
     gemv_key,
     overlap_key,
     promote_key,
+    storage_key,
 )
 
 # Tuning measures many candidates per config; the full 100-rep protocol
@@ -750,6 +755,156 @@ def tune_overlap(
     return best
 
 
+# ------------------------------------------------------------- storage
+
+
+def storage_format_candidates(dtype: str) -> list[str]:
+    """Storage-format candidates the tuner races next to ``native``: the
+    quantized ladder (``ops.quantize.STORAGE_FORMATS``), with ``fp8``
+    gated on backend dtype support — an unraceable candidate must never
+    become a recorded winner a foreign lookup then fails to build."""
+    from ..ops.quantize import STORAGE_FORMATS, fp8_supported
+
+    cands = ["native"]
+    for fmt in STORAGE_FORMATS:
+        if fmt == "fp8" and not fp8_supported():
+            continue
+        cands.append(fmt)
+    return cands
+
+
+def tune_storage(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    kernel: str = "xla",
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """The sixth autotuner axis: the resident-A storage format.
+
+    For one GLOBAL (strategy, m, k, mesh, dtype) config, quantize ``A``
+    into each candidate format (``native`` / ``int8`` / ``int8c`` /
+    ``fp8`` where supported), place it in the strategy's sharding, and
+    race the full distributed matvec under the device-looped slope
+    protocol. The race is decided by wall clock with the ``native``
+    hysteresis seat (a format that cannot beat the unquantized path by
+    the margin must not degrade accuracy for nothing); each candidate's
+    resident bytes and achieved bandwidth (resident A bytes / measured
+    time — the HBM-stream utilization the format exists to improve) are
+    recorded alongside, so a cache reader can see WHY the winner won.
+    The engine's ``dtype_storage="auto"`` consults the decision at
+    construction (``tuning.lookup_storage``).
+
+    Note the honest expectation (docs/QUANTIZATION.md): backends whose
+    low-bit upcast path is slow (XLA CPU converts int8 scalar-wise)
+    measure ``native`` fastest and the tuner records exactly that; the
+    quantized formats win where the convert fuses into the contraction's
+    operand stream (the TPU MXU path) — the same measured-not-assumed
+    doctrine as every other axis.
+    """
+    from ..ops.quantize import quantize_matrix
+    from ..utils.io import generate_matrix, generate_vector
+
+    p = int(mesh.devices.size)
+    key = storage_key(strategy_name, m, k, p, dtype)
+    existing = cache.lookup(key)
+    if existing is not None and not force:
+        return existing
+    strat = get_strategy(strategy_name)
+    try:
+        strat.validate(m, k, mesh)
+    except MatvecError:
+        return None
+    if not strat.storage_combine_ok(None):
+        # A strategy instance bound to an A-tiling combine (colwise_overlap
+        # & co.) has no quantized face to race.
+        return None
+    a = np.asarray(generate_matrix(m, k, seed=seed), dtype=dtype)
+    x = np.asarray(generate_vector(k, seed=seed + 1), dtype=dtype)
+    sh_a, sh_x = strat.shardings(mesh)
+    x_dev = jax.device_put(x, sh_x)
+    shards = strat.contraction_shards(mesh)
+    measured: dict[str, float] = {}
+    resident: dict[str, int] = {}
+    bandwidth: dict[str, float] = {}
+    native_bytes = a.size * a.itemsize
+    warmed = False
+    for fmt in storage_format_candidates(dtype):
+        if fmt == "native":
+            operand = jax.device_put(a, sh_a)
+            nbytes = native_bytes
+            fn = strat.build(mesh, kernel=kernel)
+        else:
+            try:
+                qa = quantize_matrix(a, fmt, contraction_shards=shards)
+            except MatvecError as e:
+                log(f"  storage {strategy_name} {m}x{k} p={p} {fmt}: "
+                    f"skip ({e})")
+                continue
+            operand = jax.device_put(qa, sh_a)
+            nbytes = qa.nbytes
+            fn = strat.build(mesh, kernel=kernel, dtype_storage=fmt)
+        if not warmed:
+            # Discarded cold-process warmup (same rationale as tune_gemv).
+            _measure_fn(
+                fn, (operand, x_dev), n_reps=max(1, n_reps // 4), samples=1
+            )
+            warmed = True
+        t = _measure_fn(fn, (operand, x_dev), n_reps=n_reps, samples=samples)
+        _record_candidate("storage", t)
+        if t is None:
+            log(f"  storage {strategy_name} {m}x{k} p={p} {fmt}: "
+                "unmeasurable")
+            continue
+        measured[fmt] = t
+        resident[fmt] = int(nbytes)
+        bandwidth[fmt] = nbytes / t / 1e9
+        log(f"  storage {strategy_name} {m}x{k} p={p} {fmt}: "
+            f"{t * 1e6:.1f} us ({nbytes / 1e6:.2f} MB resident, "
+            f"{bandwidth[fmt]:.2f} GB/s)")
+    winner = _pick_winner(measured, default="native", min_gain=min_gain)
+    if winner is None:
+        return None
+    if winner != "native" and "native" in measured:
+        # Confirmation pass (same rationale as tune_gemv): re-measure the
+        # contending pair adjacent and fully warm before committing a
+        # lossy format over the native seat.
+        for fmt in ("native", winner):
+            if fmt == "native":
+                fn = strat.build(mesh, kernel=kernel)
+                operand = jax.device_put(a, sh_a)
+            else:
+                fn = strat.build(mesh, kernel=kernel, dtype_storage=fmt)
+                operand = jax.device_put(
+                    quantize_matrix(a, fmt, contraction_shards=shards),
+                    sh_a,
+                )
+            t = _measure_fn(
+                fn, (operand, x_dev), n_reps=n_reps, samples=samples
+            )
+            if t is not None:
+                measured[fmt] = t
+                bandwidth[fmt] = resident[fmt] / t / 1e9
+        winner = _pick_winner(measured, default="native", min_gain=min_gain)
+        log(f"  storage {strategy_name} {m}x{k} p={p} confirm -> {winner}")
+    best = {
+        "storage": winner, "time_s": measured[winner],
+        "candidates": measured, "resident_bytes": resident,
+        "bandwidth_gbps": bandwidth,
+    }
+    cache.record(key, best)
+    return best
+
+
 # ------------------------------------------------------------ sweep-level
 
 
@@ -843,6 +998,14 @@ def tune_config(
             seed=seed, min_gain=min_gain, log=log,
             stages=(ov or {}).get("stages"),
         )
+        # The storage decision is op-agnostic like promote (one residency
+        # serves both paths): tune it here too so a gemm-only pass still
+        # records it for the engine.
+        tune_storage(
+            strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
+            n_reps=n_reps, samples=samples, force=force, seed=seed,
+            min_gain=min_gain, log=log,
+        )
         return
     for lm, lk in sorted(local_gemv_shapes(strategy_name, m, k, mesh)):
         tune_gemv(
@@ -863,6 +1026,11 @@ def tune_config(
         measure=measure, n_reps=n_reps, samples=samples, force=force,
         seed=seed, min_gain=min_gain, memo=memo, log=log,
         stages=(ov or {}).get("stages"),
+    )
+    tune_storage(
+        strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
+        n_reps=n_reps, samples=samples, force=force, seed=seed,
+        min_gain=min_gain, log=log,
     )
 
 

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Quantized-storage evidence: the tuner's sixth-axis race + the error
+budget, measured (docs/QUANTIZATION.md; ISSUE 8 acceptance).
+
+Two artifacts, on whatever backend is active:
+
+* **The storage race** — ``tuning.search.tune_storage`` for each
+  requested (strategy, m, k) config: every supported format quantized,
+  placed, and raced as the full distributed matvec, winners + per-
+  candidate resident bytes and achieved bandwidth persisted to a v4
+  cache in ``--out``. The race is honest by construction: on the CPU
+  mesh XLA converts int8 scalar-wise and ``native`` wins (recorded
+  exactly so — the same "measure, don't assume" outcome as the overlap
+  demo's S=1); the quantized formats win where the upcast fuses into
+  the MXU operand stream.
+* **Error-budget compliance** — per format, the distributed matvec vs
+  the numpy fp64 oracle: normwise residual against the budget seats
+  (``ops.quantize.FP32_LEVEL_RELERR`` for int8c; the one-level bound
+  for int8/fp8) plus the resident-bytes ratio, written to
+  ``errors.json`` and gated by ``tests/test_data_quality.py``.
+
+Usage::
+
+    python scripts/quantized_study.py --platform cpu --host-devices 8 \
+        --out data/quantized_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# (strategy, m, k) cells raced by default: one output-sharded and one
+# contraction-sharded strategy at a bandwidth-relevant size.
+DEFAULT_CONFIGS = (("rowwise", 512, 4096), ("colwise", 512, 4096))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="data/quantized_demo",
+                   help="output directory (cache + errors.json)")
+    p.add_argument("--platform", default=None,
+                   help="JAX_PLATFORMS override (e.g. cpu)")
+    p.add_argument("--host-devices", type=int, default=None,
+                   help="virtual CPU device count (XLA host platform)")
+    p.add_argument("--strategy", nargs="+", default=None,
+                   help="strategies to race (default: rowwise colwise)")
+    p.add_argument("--sizes", nargs="+", type=int, default=None,
+                   help="square sizes overriding the default config cells")
+    p.add_argument("--n-reps", type=int, default=30,
+                   help="timing reps per candidate")
+    p.add_argument("--samples", type=int, default=3,
+                   help="slope samples per candidate")
+    p.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def error_study(configs, seed: int) -> dict:
+    """Normwise residual vs the fp64 oracle per (config, format), with
+    the budget seat each format must clear."""
+    import jax
+    import numpy as np
+
+    from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+    from matvec_mpi_multiplier_tpu.ops.quantize import (
+        FP32_LEVEL_RELERR,
+        INT8_EPS,
+        quantize_matrix,
+    )
+    from matvec_mpi_multiplier_tpu.tuning.search import (
+        storage_format_candidates,
+    )
+    from matvec_mpi_multiplier_tpu.utils.io import (
+        generate_matrix,
+        generate_vector,
+    )
+
+    mesh = make_mesh(len(jax.devices()))
+    # Budget seats (docs/QUANTIZATION.md): int8c must reach the fp32-level
+    # seat; the single-level formats carry the one-level bound scaled by
+    # the contraction's cancellation-free worst case — in practice they
+    # land near INT8_EPS itself on random data; pin 4x slack.
+    budgets = {
+        "int8": 4 * INT8_EPS, "fp8": 4 * INT8_EPS,
+        "int8c": FP32_LEVEL_RELERR,
+    }
+    out: dict = {"budgets": budgets, "configs": {}}
+    for name, m, k in configs:
+        strat = get_strategy(name)
+        a = np.asarray(generate_matrix(m, k, seed=seed), np.float32)
+        x = np.asarray(generate_vector(k, seed=seed + 1), np.float32)
+        oracle = a.astype(np.float64) @ x.astype(np.float64)
+        scale = np.abs(oracle).max()
+        sh_a, sh_x = strat.shardings(mesh)
+        x_dev = jax.device_put(x, sh_x)
+        shards = strat.contraction_shards(mesh)
+        entry: dict = {}
+        for fmt in storage_format_candidates("float32"):
+            if fmt == "native":
+                fn = strat.build(mesh)
+                operand, nbytes = jax.device_put(a, sh_a), a.nbytes
+            else:
+                qa = quantize_matrix(a, fmt, contraction_shards=shards)
+                fn = strat.build(mesh, dtype_storage=fmt)
+                operand, nbytes = jax.device_put(qa, sh_a), qa.nbytes
+            y = np.asarray(fn(operand, x_dev)).astype(np.float64)
+            relerr = float(np.abs(y - oracle).max() / scale)
+            entry[fmt] = {
+                "max_relerr_vs_fp64": relerr,
+                "bytes_ratio": round(nbytes / a.nbytes, 6),
+                "budget": budgets.get(fmt),
+                "within_budget": (
+                    True if fmt == "native" else relerr <= budgets[fmt]
+                ),
+            }
+        out["configs"][f"{name}|{m}x{k}"] = entry
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    if args.host_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.host_devices}"
+            ).strip()
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from matvec_mpi_multiplier_tpu import make_mesh
+    from matvec_mpi_multiplier_tpu.tuning.cache import TuningCache
+    from matvec_mpi_multiplier_tpu.tuning.search import tune_storage
+
+    out_dir = REPO / args.out
+    out_dir.mkdir(parents=True, exist_ok=True)
+    strategies = args.strategy or sorted({c[0] for c in DEFAULT_CONFIGS})
+    if args.sizes:
+        configs = [(s, n, n) for s in strategies for n in args.sizes]
+    else:
+        configs = [c for c in DEFAULT_CONFIGS if c[0] in strategies]
+
+    mesh = make_mesh(len(jax.devices()))
+    # load(), not a fresh cache: repeated study runs (new sizes, new
+    # strategies) accumulate into one demo cache instead of clobbering
+    # the earlier races.
+    cache = TuningCache.load(out_dir / "tuning_cache.json")
+    print(f"storage race on {mesh.devices.size} devices "
+          f"({jax.devices()[0].platform}):")
+    for name, m, k in configs:
+        decision = tune_storage(
+            name, mesh, m, k, "float32", cache,
+            n_reps=args.n_reps, samples=args.samples, seed=args.seed,
+            force=True,
+        )
+        if decision is not None:
+            print(f"  -> {name} {m}x{k}: {decision['storage']}")
+    cache.save()
+    print(f"cache: {cache.path}")
+
+    errors = error_study(configs, args.seed)
+    # Merge-preserve earlier runs' configs (same doctrine as the cache).
+    errors_path = out_dir / "errors.json"
+    if errors_path.exists():
+        try:
+            prior = json.loads(errors_path.read_text())
+            merged = dict(prior.get("configs", {}))
+            merged.update(errors["configs"])
+            errors["configs"] = merged
+        except (json.JSONDecodeError, AttributeError):
+            pass  # swallow-ok: a hand-damaged errors.json is simply rewritten from this run's measurements
+    bad = [
+        (cfg, fmt)
+        for cfg, entry in errors["configs"].items()
+        for fmt, row in entry.items()
+        if not row["within_budget"]
+    ]
+    errors_path.write_text(
+        json.dumps(errors, indent=1, sort_keys=True) + "\n"
+    )
+    print(f"errors: {errors_path}")
+    for cfg, entry in errors["configs"].items():
+        for fmt, row in entry.items():
+            mark = "ok" if row["within_budget"] else "OVER BUDGET"
+            print(f"  {cfg} {fmt}: relerr {row['max_relerr_vs_fp64']:.2e} "
+                  f"bytes {row['bytes_ratio']:.3f}x [{mark}]")
+    if bad:
+        print(f"ERROR-BUDGET FAILURES: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,46 @@ python -m matvec_mpi_multiplier_tpu.bench.serve \
     --concurrency 4 --coalesce on --n-requests 24 --max-bucket 8 \
     --fault-spec "dispatch:device_error:p=0.2" --fault-seed 3 --no-csv
 
+# Quantized smoke: small-shape compensated-int8 vs native through a real
+# distributed build — the storage axis must clear its own fp32-level
+# error budget (ops/quantize.py constants; docs/QUANTIZATION.md) before
+# the suite spends runtime on the full gate in tests/test_quantized.py.
+echo "quantized smoke: int8c residual within the fp32-level budget"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import numpy as np, jax
+from matvec_mpi_multiplier_tpu import get_strategy, make_mesh
+from matvec_mpi_multiplier_tpu.ops.quantize import (
+    FP32_LEVEL_RELERR, quantize_matrix,
+)
+
+mesh = make_mesh(8)
+strat = get_strategy("colwise")
+rng = np.random.default_rng(0)
+a = rng.standard_normal((32, 1024)).astype(np.float32)
+x = rng.standard_normal(1024).astype(np.float32)
+sh_a, sh_x = strat.shardings(mesh)
+x_dev = jax.device_put(x, sh_x)
+y_native = np.asarray(
+    strat.build(mesh)(jax.device_put(a, sh_a), x_dev)
+)
+qa = quantize_matrix(
+    a, "int8c", contraction_shards=strat.contraction_shards(mesh)
+)
+y_quant = np.asarray(
+    strat.build(mesh, dtype_storage="int8c")(
+        jax.device_put(qa, sh_a), x_dev
+    )
+)
+rel = np.abs(y_quant - y_native).max() / np.abs(y_native).max()
+assert rel <= FP32_LEVEL_RELERR, (
+    f"int8c vs native relerr {rel:.3e} over {FP32_LEVEL_RELERR:.0e}"
+)
+assert qa.nbytes <= 0.55 * a.nbytes
+print(f"quantized smoke ok: relerr {rel:.2e}, "
+      f"bytes {qa.nbytes / a.nbytes:.3f}x")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

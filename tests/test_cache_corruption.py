@@ -105,3 +105,64 @@ def test_save_survives_corrupt_file_vanishing(cache_file):
     cache.save()  # must not raise
     assert not cache.corrupt_path.exists()
     assert json.loads(cache_file.read_text())["version"] == CACHE_VERSION
+
+
+def test_v1_through_v3_caches_still_load_under_v4(cache_file):
+    """Schema-bump back-compat (ISSUE 8): every historical version's
+    entries are strict subsets of v4's — an old cache keeps serving its
+    decisions instead of forcing a silent full re-tune."""
+    old_entries = {
+        1: {"fp|gemv|8x8|float32": {"kernel": "xla", "time_s": 1e-5}},
+        2: {"fp|promote|rowwise|8x8|p2|float32": {"b_star": 4}},
+        3: {"fp|overlap|rowwise|8x8|p2|float32": {"stages": 2}},
+    }
+    assert CACHE_VERSION == 4
+    for version, entries in old_entries.items():
+        cache_file.write_text(
+            json.dumps({"version": version, "entries": entries})
+        )
+        cache = TuningCache.load(cache_file)
+        assert not cache.quarantined, f"v{version} wrongly quarantined"
+        for key, decision in entries.items():
+            assert cache.lookup(key) == decision
+
+
+def test_future_version_preserved_in_versioned_slot(cache_file):
+    """A shape-valid FUTURE-schema file is someone's data, not damage: it
+    must park under its own ``.v<N>.corrupt`` slot, where a later
+    truncated-write quarantine (generic ``.corrupt``) cannot clobber it."""
+    future = json.dumps({
+        "version": 99,
+        "entries": {"fp|holo|8x8|float32": {"kernel": "quantum"}},
+    })
+    cache_file.write_text(future)
+    cache = TuningCache.load(cache_file)
+    assert cache.quarantined and len(cache) == 0
+    cache.save()
+    versioned = cache_file.with_name(cache_file.name + ".v99.corrupt")
+    assert versioned.read_text() == future
+    # The live file is a fresh v4 cache.
+    assert json.loads(cache_file.read_text())["version"] == CACHE_VERSION
+
+    # Now ordinary corruption arrives and gets quarantined too — into the
+    # GENERIC slot; the future build's bytes survive untouched.
+    cache_file.write_text("{\"version\": 4, \"entr")
+    TuningCache.load(cache_file).save()
+    generic = cache_file.with_name(cache_file.name + ".corrupt")
+    assert generic.read_text() == "{\"version\": 4, \"entr"
+    assert versioned.read_text() == future
+
+
+def test_nonsense_version_stays_in_generic_slot(cache_file):
+    """A version field that is not an int (or entries that are not a
+    dict) is damage, not a future schema — generic slot."""
+    for payload in (
+        json.dumps({"version": "banana", "entries": {}}),
+        json.dumps({"version": 99, "entries": "nope"}),
+    ):
+        cache_file.write_text(payload)
+        cache = TuningCache.load(cache_file)
+        assert cache.quarantined
+        assert cache.corrupt_path == cache_file.with_name(
+            cache_file.name + ".corrupt"
+        )

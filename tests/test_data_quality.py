@@ -577,10 +577,20 @@ def test_golden_schedule_schema():
     itemsize = {"float32": 4, "float64": 8, "bfloat16": 2}[operand["dtype"]]
 
     configs = payload["configs"]
-    # Exactly the audited table: no missing pins, no stale ones.
+    # Exactly the audited table: no missing pins, no stale ones. (The
+    # golden is blessed on an fp8-capable build; a build without the
+    # dtype audits the subset and the stale-key filter matches — here we
+    # gate the committed file itself against the full table.)
     assert set(configs) == {cfg.key for cfg in AUDIT_CONFIGS}
+    native_a_bytes = operand["m"] * operand["k"] * itemsize
     for key, entry in configs.items():
-        strategy, combine, kernel = key.split("|")
+        parts = key.split("|")
+        # schema 2: native keys keep the historical 3-part spelling;
+        # quantized-storage keys append a 4th |<format> part.
+        strategy, combine, kernel = parts[:3]
+        storage = parts[3] if len(parts) > 3 else "native"
+        assert len(parts) <= 4, key
+        assert storage in ("native", "int8", "int8c", "fp8"), key
         assert strategy in STRATEGIES, key
         assert kernel == "xla", key
         if "@" in combine:
@@ -597,6 +607,13 @@ def test_golden_schedule_schema():
                 key, kind,
             )
         assert entry["payload_total_bytes"] == sum(bytes_.values()), key
+        # schema 2: every entry pins the resident-A parameter bytes.
+        assert entry["a_bytes"] > 0, key
+        assert entry["a_bytes_ratio"] == pytest.approx(
+            entry["a_bytes"] / native_a_bytes, abs=1e-6
+        ), key
+        if storage == "native":
+            assert entry["a_bytes"] == native_a_bytes, key
 
 
 def test_golden_schedule_pins_staged_overlap_chunking():
@@ -624,3 +641,134 @@ def test_golden_schedule_pins_staged_overlap_chunking():
             "collective-permute"
         ]
         assert staged["payload_total_bytes"] == ring["payload_total_bytes"]
+
+
+def test_golden_schedule_pins_quantized_byte_accounting():
+    """The acceptance pins (ISSUE 8): quantized configs move ≤ 0.30×
+    (int8/fp8) / ≤ 0.55× (int8c) the native resident-A bytes for the
+    same strategy×combine, and their collective census EQUALS the native
+    counterpart's — the storage axis is visible only in the byte
+    accounting (per-operand dtype choices compose orthogonally with the
+    schedule, the GSPMD doctrine)."""
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        STORAGE_BYTE_CEILING,
+    )
+
+    configs = _golden()["configs"]
+    quantized = {k: v for k, v in configs.items() if k.count("|") == 3}
+    assert quantized, "golden lost its quantized-storage pins"
+    for key, entry in quantized.items():
+        native_key, storage = key.rsplit("|", 1)
+        # The pre-quantization spelling survives the schema bump: every
+        # quantized pin has its native counterpart under the old key.
+        assert native_key in configs, key
+        native = configs[native_key]
+        assert entry["a_bytes_ratio"] <= STORAGE_BYTE_CEILING[storage], key
+        assert entry["a_bytes"] < native["a_bytes"], key
+        assert entry["census"] == native["census"], key
+        assert entry["payload_bytes"] == native["payload_bytes"], key
+
+
+# ---- quantized_demo: the committed storage-axis capture (ISSUE 8) ----
+#
+# Artifacts: tuning_cache.json (the v4 sixth-axis race: winners +
+# resident bytes + achieved bandwidth per candidate), errors.json (the
+# error-budget compliance study vs the fp64 oracle), out/serve_*.csv
+# (auto-resolved and explicit-int8c serve rows, compiles_steady pinned),
+# metrics.json (the storage gauges). Capture commands in
+# data/quantized_demo/README.md.
+
+QUANTIZED_DEMO = REPO / "data" / "quantized_demo"
+
+
+def _quantized_demo(name: str):
+    import json
+
+    path = QUANTIZED_DEMO / name
+    assert path.exists(), (
+        f"missing {path} — recapture per data/quantized_demo/README.md"
+    )
+    return json.loads(path.read_text())
+
+
+def test_quantized_demo_cache_records_the_race():
+    from matvec_mpi_multiplier_tpu.tuning.cache import COMPATIBLE_VERSIONS
+
+    payload = _quantized_demo("tuning_cache.json")
+    assert payload["version"] in COMPATIBLE_VERSIONS
+    storage_entries = {
+        k: v for k, v in payload["entries"].items() if "|storage|" in k
+    }
+    assert len(storage_entries) >= 2, "demo cache lost its storage races"
+    for key, entry in storage_entries.items():
+        cands = entry["candidates"]
+        # The race is real: native plus at least the two int8 formats
+        # measured, with bytes + bandwidth recorded for each.
+        assert {"native", "int8", "int8c"} <= set(cands), key
+        assert set(entry["resident_bytes"]) == set(cands), key
+        assert set(entry["bandwidth_gbps"]) == set(cands), key
+        rb = entry["resident_bytes"]
+        # 0.57, not the golden's 0.55 ceiling: the 512² cell's clamped
+        # block (32 at 8 contraction shards) carries 12.5% scale-plane
+        # overhead; the 0.55 pin is a production-block (128) number and
+        # is gated where it belongs, on the HLO audit's k=2048 operand.
+        assert rb["int8"] <= 0.31 * rb["native"], key
+        assert rb["int8c"] <= 0.57 * rb["native"], key
+        # The tuner selected the measured-fastest format (modulo the
+        # native hysteresis seat: a non-native winner must actually beat
+        # native; native may win a near-tie).
+        winner = entry["storage"]
+        fastest = min(cands, key=cands.get)
+        if winner != fastest:
+            assert winner == "native", (key, winner, fastest)
+            assert cands[fastest] >= 0.8 * cands["native"], key
+        if winner != "native":
+            assert cands[winner] < cands["native"], key
+
+
+def test_quantized_demo_errors_within_budget():
+    payload = _quantized_demo("errors.json")
+    assert payload["configs"], "errors.json lost its configs"
+    for cfg, entry in payload["configs"].items():
+        assert "int8c" in entry, cfg
+        for fmt, row in entry.items():
+            assert row["within_budget"] is True, (cfg, fmt)
+            if fmt == "native":
+                assert row["bytes_ratio"] == 1.0, cfg
+            elif fmt == "int8c":
+                assert row["bytes_ratio"] <= 0.57, cfg
+            else:
+                assert row["bytes_ratio"] <= 0.30, cfg
+            if row["budget"] is not None:
+                assert row["max_relerr_vs_fp64"] <= row["budget"], (cfg, fmt)
+
+
+def test_quantized_demo_serve_rows_compile_free():
+    rows = read_csv(QUANTIZED_DEMO / "out" / "serve_colwise.csv")
+    by_storage = {r["dtype_storage"]: r for r in rows}
+    assert {"native", "int8c"} <= set(by_storage), by_storage.keys()
+    native, quant = by_storage["native"], by_storage["int8c"]
+    for row in (native, quant):
+        # The engine stays compile-free through the steady phase under
+        # BOTH residencies — the storage axis rides the ExecKey.
+        assert int(row["compiles_steady"]) == 0, row
+        assert float(row["success_rate"]) == 1.0, row
+    assert int(quant["resident_bytes"]) <= 0.57 * int(
+        native["resident_bytes"]
+    )
+
+
+def test_quantized_demo_metrics_pin_the_storage_gauges():
+    snap = _quantized_demo("metrics.json")
+    gauges = snap["gauges"]
+    assert gauges["engine_resident_bytes"] > 0
+    fmt_gauges = [
+        g for g in gauges if g.startswith("engine_storage_format{")
+    ]
+    assert any('format="int8c"' in g for g in fmt_gauges), fmt_gauges
+    # The gauge agrees with the serve row's column.
+    rows = read_csv(QUANTIZED_DEMO / "out" / "serve_colwise.csv")
+    quant = [r for r in rows if r["dtype_storage"] == "int8c"]
+    assert quant and int(quant[-1]["resident_bytes"]) == int(
+        gauges["engine_resident_bytes"]
+    )

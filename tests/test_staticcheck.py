@@ -119,6 +119,22 @@ RULE_FIXTURES = {
         "    except Exception:  # swallow-ok: seeded deliberate fallback\n"
         "        return None\n",
     ),
+    "quant-fp64-scale": (
+        f"{PKG}/ops/quantize.py",
+        # host numpy's default float IS float64: a dtype-less asarray in
+        # the quant scope silently doubles the scale plane and lies about
+        # the error budget
+        "import numpy as np\n"
+        "def scales_for(amax):\n"
+        "    return np.asarray(amax / 127.0)\n"
+        "def widen(scales):\n"
+        "    return scales.astype(np.float64)\n",
+        "import numpy as np\n"
+        "def scales_for(amax):\n"
+        "    return np.asarray(amax / 127.0, dtype=np.float32)\n"
+        "def widen(scales):\n"
+        "    return scales.astype(np.float64)  # quant-ok: seeded deliberate f64 staging\n",
+    ),
     "scheduler-lock-across-dispatch": (
         f"{PKG}/engine/scheduler.py",
         # dispatch under the held admission lock: a backpressure stall
@@ -378,6 +394,65 @@ def test_mutation_unchunked_scatter_fails_audit(devices, monkeypatch):
     assert any(
         f.rule == "hlo-schedule" and "S=4" in f.message for f in findings
     ), findings
+
+
+def test_audit_table_covers_storage_formats():
+    """The quantized-storage cells (ISSUE 8): the rowwise format ladder
+    plus the compensated pair on colwise and an int8 blockwise cell —
+    and every native key keeps its historical no-suffix spelling, so the
+    pre-quantization golden entries survive the schema bump."""
+    storage_keys = {c.key for c in AUDIT_CONFIGS if c.storage != "native"}
+    assert {
+        "rowwise|gather|xla|int8", "rowwise|gather|xla|int8c",
+        "rowwise|gather|xla|fp8", "colwise|psum_scatter|xla|int8",
+        "colwise|psum_scatter|xla|int8c", "blockwise|gather|xla|int8",
+    } == storage_keys
+    for cfg in AUDIT_CONFIGS:
+        if cfg.storage == "native":
+            assert "|int8" not in cfg.key and "|fp8" not in cfg.key
+
+
+def test_mutation_dequant_first_fails_census_gate(devices):
+    """The 'silent early-dequant' failure mode: a quantized config whose
+    kernel materializes the full dequantized A before the contraction
+    stores ¼ the bytes but MOVES all of them. The census gate must flag
+    its lowering, and pass the sanctioned tile-wise kernel."""
+    from matvec_mpi_multiplier_tpu.ops.quantize import (
+        matvec_quantized_dequant_first,
+    )
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        early_dequant_findings,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    for cfg in (
+        AuditConfig("rowwise", "gather", storage="int8"),
+        AuditConfig("colwise", "psum_scatter", storage="int8c"),
+    ):
+        bad = lower_config(
+            cfg, mesh, kernel=matvec_quantized_dequant_first
+        )
+        findings = early_dequant_findings(cfg, bad, mesh)
+        assert any(f.rule == "hlo-early-dequant" for f in findings), (
+            f"{cfg.key}: dequant-first lowering not flagged"
+        )
+        clean = lower_config(cfg, mesh)
+        assert early_dequant_findings(cfg, clean, mesh) == []
+
+
+def test_storage_byte_ceiling_gate_wiring(devices, monkeypatch):
+    """An absurdly tight ceiling must surface as hlo-storage-bytes — the
+    gate reads the lowered module's parameter bytes, not the builder's
+    intent."""
+    from matvec_mpi_multiplier_tpu.staticcheck import hlo
+
+    monkeypatch.setitem(hlo.STORAGE_BYTE_CEILING, "int8", 0.01)
+    findings = run_hlo_audit(
+        configs=[AuditConfig("rowwise", "gather", storage="int8")],
+        check_fingerprints=False,
+    )
+    assert any(f.rule == "hlo-storage-bytes" for f in findings), findings
 
 
 def test_fingerprint_stability_gate(devices):
