@@ -21,7 +21,12 @@ from .models import (
     available_strategies,
     get_strategy,
 )
-from .engine import ArrivalWindowScheduler, MatvecEngine
+from .engine import (
+    ArrivalWindowScheduler,
+    MatrixRegistry,
+    MatvecEngine,
+    TenantQuota,
+)
 from .models.gemm import available_gemm_strategies, build_gemm
 from .parallel.mesh import make_1d_mesh, make_mesh, mesh_grid_shape, most_square_factors
 from .utils import io
@@ -41,6 +46,8 @@ __all__ = [
     "available_gemm_strategies",
     "MatvecEngine",
     "ArrivalWindowScheduler",
+    "MatrixRegistry",
+    "TenantQuota",
     "make_mesh",
     "make_1d_mesh",
     "mesh_grid_shape",

@@ -45,6 +45,19 @@ distinguishable) plus the ``retries`` / ``downgrades`` recovery tallies.
 signature a poison fault spec matches — the deterministic "bad request"
 whose blast radius the scheduler's batch bisection must contain.
 
+**Multi-tenant trace mode** (``--tenants``; docs/MULTITENANT.md) drives
+the matrix registry (``engine/registry.py``) instead of a single
+engine: N seeded tenant matrices against an ``--hbm-budget``, a
+Zipf-popularity request trace (``--zipf-a``), optional warm-pinning
+(``--pin-hot``) and per-tenant admission quotas (``--tenant-quota``).
+Rows land in ``serve_tenants_<strategy>.csv`` — one per tenant with
+availability/hit-rate/eviction columns plus an ``ALL`` summary — and
+``lru_floor`` replays the same trace through plain LRU so the eviction
+policy is measured against its expectation. The chaos overlay composes:
+``--fault-spec 'dispatch:device_error:key=tenant-0/*'`` targets exactly
+one tenant (labels are tenant-prefixed), and the isolation acceptance
+asserts every OTHER tenant's availability column stays at 1.0.
+
 Rows land in ``data/out/serve_<strategy>.csv`` (``--data-root`` to
 redirect; the committed demos live under ``data/engine_demo/``,
 ``data/batching_demo/`` and ``data/resilience_demo/``).
@@ -88,7 +101,9 @@ import numpy as np
 from ..engine import (
     ArrivalWindowScheduler,
     DEFAULT_MAX_WINDOW_MS,
+    MatrixRegistry,
     MatvecEngine,
+    TenantQuota,
     bucket_for,
     split_widths,
 )
@@ -698,6 +713,431 @@ def run_serve_load(
     )
 
 
+# ---------------------------------------------------------- multi-tenant
+#
+# The trace mode for the matrix registry (engine/registry.py;
+# docs/MULTITENANT.md): N tenants' matrices served against one HBM
+# budget under Zipf-distributed tenant popularity — the skew real
+# multi-tenant traffic has, so eviction policy is measured under the
+# distribution it must win on, not assumed. One CSV row per tenant (plus
+# an ALL summary row) carries the per-tenant availability, hit-rate and
+# eviction columns; `lru_floor` is the same trace replayed through a
+# plain-LRU simulation (pin-aware), the floor the registry's cost-aware
+# policy must meet — for homogeneous tenants the two are exactly equal.
+
+MULTITENANT_CSV_HEADER = (
+    "n_rows, n_cols, n_devices, strategy, dtype, n_tenants, zipf_a, "
+    "hbm_budget, budget_tenants, n_requests, wall_s, rps, hit_rate, "
+    "lru_floor, tenant, requests, hits, tenant_hit_rate, evictions, "
+    "evictions_caused, quota_rejections, failed_requests, availability, "
+    "resident_bytes, pinned"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantRow:
+    """Per-tenant outcome of one multi-tenant trace (one CSV row)."""
+
+    tenant: str
+    requests: int
+    hits: int
+    evictions: int
+    evictions_caused: int
+    quota_rejections: int
+    failed_requests: int
+    resident_bytes: int
+    pinned: int
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.requests if self.requests else float("nan")
+
+    @property
+    def availability(self) -> float:
+        """Fraction of this tenant's offered requests that returned a
+        result (quota rejections and fault failures both count against
+        it — the tenant-visible success rate)."""
+        if self.requests == 0:
+            return float("nan")
+        return (self.requests - self.failed_requests) / self.requests
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiTenantResult:
+    """One multi-tenant trace: run-level fields plus the per-tenant rows
+    (``rows`` ends with the aggregate ``ALL`` row)."""
+
+    n_rows: int
+    n_cols: int
+    n_devices: int
+    strategy: str
+    dtype: str
+    n_tenants: int
+    zipf_a: float
+    hbm_budget: int           # 0 = unlimited
+    budget_tenants: int       # payloads that fit (meaningful when
+                              # hbm_budget > 0; a sub-payload budget is 0)
+    n_requests: int
+    wall_s: float
+    hit_rate: float           # registry-wide: hits / submits
+    lru_floor: float          # plain-LRU replay of the same trace
+    rows: tuple[TenantRow, ...]
+
+    @property
+    def rps(self) -> float:
+        return self.n_requests / self.wall_s if self.wall_s > 0 else float("nan")
+
+
+def multitenant_csv_path(strategy: str, root=None):
+    from .metrics import out_dir
+
+    return out_dir(root) / f"serve_tenants_{strategy}.csv"
+
+
+def append_multitenant_result(result: MultiTenantResult, root=None):
+    from ..parallel.distributed import is_main_process
+    from .metrics import _append_row
+
+    path = multitenant_csv_path(result.strategy, root)
+    if not is_main_process():
+        return path
+    prefix = (
+        f"{result.n_rows}, {result.n_cols}, {result.n_devices}, "
+        f"{result.strategy}, {result.dtype}, {result.n_tenants}, "
+        f"{result.zipf_a:.3f}, {result.hbm_budget}, "
+        f"{result.budget_tenants}, {result.n_requests}, "
+        f"{result.wall_s:.6f}, {result.rps:.2f}, {result.hit_rate:.4f}, "
+        f"{result.lru_floor:.4f}"
+    )
+    for row in result.rows:
+        _append_row(
+            path, MULTITENANT_CSV_HEADER,
+            f"{prefix}, {row.tenant}, {row.requests}, {row.hits}, "
+            f"{row.hit_rate:.4f}, {row.evictions}, {row.evictions_caused}, "
+            f"{row.quota_rejections}, {row.failed_requests}, "
+            f"{row.availability:.4f}, {row.resident_bytes}, {row.pinned}",
+        )
+    return path
+
+
+def parse_hbm_budget(text: str | None, payload_bytes: int) -> int | None:
+    """``--hbm-budget`` grammar: plain bytes (``2097152``), or a payload
+    multiple (``2.5x`` = room for 2.5 tenants of this run's shape — the
+    shape-independent spelling the tier-1 smoke and demo use). None/0 =
+    unlimited."""
+    if text is None:
+        return None
+    text = str(text).strip()
+    if text.endswith(("x", "X")):
+        mult = float(text[:-1])
+        budget = int(mult * payload_bytes)
+    else:
+        budget = int(float(text))
+    if budget < 0:
+        raise ConfigError(f"hbm budget must be >= 0, got {text!r}")
+    return budget or None
+
+
+def parse_tenant_quota(text: str | None) -> dict[str, int] | int | None:
+    """``--tenant-quota`` grammar: a bare int (every tenant's
+    ``max_in_flight``) or ``tenant-0=4,tenant-3=8`` (named tenants only —
+    the chaos overlay's quota-pressure-on-one-tenant spelling)."""
+    if text is None:
+        return None
+    text = text.strip()
+    if "=" not in text:
+        return int(text)
+    quotas: dict[str, int] = {}
+    for item in text.split(","):
+        if "=" not in item:
+            raise ConfigError(
+                f"tenant quota item {item!r} must be tenant=max_in_flight"
+            )
+        tid, value = (s.strip() for s in item.split("=", 1))
+        quotas[tid] = int(value)
+    return quotas
+
+
+def _zipf_probs(n_tenants: int, zipf_a: float) -> np.ndarray:
+    """Bounded Zipf over tenant ranks: ``p(i) ∝ (i+1)^-a`` — rank 0 is
+    the hottest tenant."""
+    ranks = np.arange(1, n_tenants + 1, dtype=np.float64)
+    probs = ranks ** -float(zipf_a)
+    return probs / probs.sum()
+
+
+def lru_hit_floor(
+    tenant_seq: Sequence[int], capacity: int | None,
+    pinned: Sequence[int] = (),
+) -> float:
+    """Replay the tenant sequence through plain LRU with ``capacity``
+    resident slots (None = unlimited; 0 = a real budget too small for
+    one payload — every unpinned access misses) and a pre-admitted pinned set
+    (pins consume slots and always hit) — the hit-rate floor the
+    registry's cost-aware policy must meet on the same trace. For
+    homogeneous tenants the registry's score reduces to exactly LRU, so
+    measured == floor there; a cost-aware win on heterogeneous fleets
+    shows up as measured > floor."""
+    if not len(tenant_seq):
+        return float("nan")
+    pinned_set = set(pinned)
+    slots = (
+        None if capacity is None else max(0, capacity - len(pinned_set))
+    )
+    resident: list[int] = []  # LRU order: least-recent first
+    hits = 0
+    for t in tenant_seq:
+        if t in pinned_set:
+            hits += 1
+            continue
+        if t in resident:
+            hits += 1
+            resident.remove(t)
+        elif slots is not None and slots == 0:
+            continue  # every slot pinned: perpetual (counted) overshoot
+        elif slots is not None and len(resident) >= slots:
+            resident.pop(0)
+        resident.append(t)
+    return hits / len(tenant_seq)
+
+
+def run_serve_multitenant(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    *,
+    dtype: str = "float32",
+    kernel: str = "xla",
+    combine: str | None = None,
+    stages: int | None = None,
+    dtype_storage: str | None = None,
+    n_tenants: int = 8,
+    zipf_a: float = 1.1,
+    hbm_budget: str | int | None = None,
+    pin_hot: int = 0,
+    tenant_quota: str | int | dict | None = None,
+    n_requests: int = 200,
+    max_bucket: int = 32,
+    promote: str | int | None = None,
+    donate: bool = True,
+    seed: int = 0,
+    metrics_out: str | None = None,
+    fault_spec: str | None = None,
+    fault_seed: int = 0,
+    poison_rate: float = 0.0,
+    poison_tenant: str | None = None,
+    integrity_gate: bool = False,
+    resilience: bool | None = None,
+    breaker_reset_s: float = 30.0,
+) -> MultiTenantResult:
+    """Run the multi-tenant trace protocol for one (strategy, shape,
+    mesh) config: ``n_tenants`` seeded matrices registered against
+    ``hbm_budget``, driven by a Zipf(``zipf_a``) tenant-popularity trace
+    of ``n_requests`` vector requests. Submits are issued in trace
+    order and materialized at the end — outstanding futures are what the
+    ``max_in_flight`` quotas meter, and eviction under in-flight work is
+    exactly the hazard the refcounted-residency doctrine covers.
+
+    Chaos overlay: ``fault_spec`` patterns may target one tenant
+    (``key=tenant-0/*``), ``tenant_quota`` may throttle one tenant, and
+    ``poison_rate``/``poison_tenant`` plant the persistent poison
+    payload signature on a seeded fraction of one tenant's requests
+    (every tenant's when ``poison_tenant`` is None) — the isolation
+    acceptance asserts the OTHER tenants' availability columns stay at
+    1.0."""
+    from ..utils.io import generate_matrix
+
+    if n_tenants < 1:
+        raise ConfigError(f"n_tenants must be >= 1, got {n_tenants}")
+    if not (0 <= pin_hot <= n_tenants):
+        raise ConfigError(
+            f"pin_hot must be in [0, {n_tenants}], got {pin_hot}"
+        )
+    if not (0.0 <= poison_rate <= 1.0):
+        raise ConfigError(
+            f"poison_rate must be in [0, 1], got {poison_rate}"
+        )
+    registry_metrics = MetricsRegistry()
+    chaos = fault_spec is not None or poison_rate > 0
+    specs = (
+        parse_fault_spec(fault_spec, seed=fault_seed).specs
+        if fault_spec is not None else ()
+    )
+    if poison_rate > 0:
+        # Poison faults stay payload-scoped (never open breakers); the
+        # key narrows the blast radius to the targeted tenant's labels.
+        specs = specs + (FaultSpec(
+            site="dispatch", kind="device_error",
+            poison=POISON_SIGNATURE,
+            key=f"{poison_tenant}/*" if poison_tenant else "*",
+        ),)
+    plan = FaultPlan(specs, seed=fault_seed) if specs else None
+    if resilience is None:
+        resilience = chaos
+    policy = (
+        ResiliencePolicy(
+            retry=RetryPolicy(seed=fault_seed),
+            breaker_reset_s=breaker_reset_s,
+        )
+        if resilience else None
+    )
+    payload_probe = generate_matrix(m, k, seed=seed).astype(dtype)
+    budget = parse_hbm_budget(
+        hbm_budget,
+        # Budget multiples are in NATIVE payloads; quantized tenants'
+        # real payload bytes land in the accountant either way.
+        int(payload_probe.nbytes),
+    )
+    quotas = parse_tenant_quota(tenant_quota) if isinstance(
+        tenant_quota, str
+    ) else tenant_quota
+
+    registry = MatrixRegistry(
+        mesh,
+        hbm_budget=budget,
+        metrics=registry_metrics,
+        fault_plan=plan,
+        resilience=policy,
+        integrity_gate=integrity_gate,
+        strategy=strategy_name, kernel=kernel, combine=combine,
+        stages=stages, dtype_storage=dtype_storage, dtype=dtype,
+        max_bucket=max_bucket, promote=promote, donate=donate,
+    )
+    tenant_ids = [f"tenant-{i}" for i in range(n_tenants)]
+    payload_bytes = 0
+    try:
+        for i, tid in enumerate(tenant_ids):
+            if isinstance(quotas, dict):
+                q = quotas.get(tid)
+            else:
+                q = quotas
+            registry.register(
+                tid,
+                generate_matrix(m, k, seed=seed + i).astype(dtype),
+                quota=TenantQuota(max_in_flight=q) if q else None,
+            )
+            if i == 0:
+                payload_bytes = registry.health()["tenants"][tid][
+                    "payload_bytes"
+                ]
+
+        # ---- warmup: compile the shared executable set once (no
+        # residency needed), spare it from the chaos plan ----
+        if plan is not None:
+            plan.disarm()
+        registry.warmup(widths=[1])
+        if plan is not None:
+            plan.arm()
+        for i in range(pin_hot):
+            registry.pin(tenant_ids[i])
+
+        # ---- the Zipf trace ----
+        rng = np.random.default_rng(seed + 2)
+        tenant_seq = rng.choice(
+            n_tenants, size=n_requests, p=_zipf_probs(n_tenants, zipf_a)
+        )
+        xpool = [
+            rng.standard_normal(k).astype(dtype) for _ in range(4)
+        ]
+        poison_idx: set[int] = set()
+        if poison_rate > 0:
+            if poison_tenant is not None and poison_tenant not in tenant_ids:
+                raise ConfigError(
+                    f"poison_tenant {poison_tenant!r} is not one of the "
+                    f"{n_tenants} registered tenants"
+                )
+            target = [
+                j for j, t in enumerate(tenant_seq)
+                if poison_tenant is None or tenant_ids[t] == poison_tenant
+            ]
+            if target:
+                prng = np.random.default_rng(seed + 4)
+                n_poison = min(
+                    len(target), max(1, round(poison_rate * len(target)))
+                )
+                poison_idx = set(
+                    int(j) for j in
+                    prng.choice(target, size=n_poison, replace=False)
+                )
+        failed = [0] * n_tenants
+        futures: list[tuple[int, object]] = []
+        start = time.perf_counter()
+        for j, t in enumerate(tenant_seq):
+            x = xpool[j % len(xpool)]
+            if j in poison_idx:
+                x = np.array(x)
+                x[0] = x.dtype.type(POISON_SIGNATURE)
+            try:
+                futures.append((int(t), registry.submit(tenant_ids[t], x)))
+            except MatvecError:
+                # Uncoalesced dispatch faults surface at submit; the
+                # trace keeps going — availability is the measurement.
+                failed[t] += 1
+        for t, fut in futures:
+            try:
+                fut.result()
+            except MatvecError:
+                failed[t] += 1
+        wall = time.perf_counter() - start
+
+        health = registry.health()
+        if metrics_out is not None:
+            path = Path(metrics_out)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(
+                json.dumps(registry_metrics.snapshot(), indent=2) + "\n"
+            )
+    finally:
+        registry.close()
+
+    # capacity 0 with a budget set is a REAL (sub-payload) budget, not
+    # unlimited — the floor sim and the summary line keep the two apart.
+    capacity = (budget // payload_bytes) if budget else 0
+    floor = lru_hit_floor(
+        tenant_seq, capacity if budget else None, pinned=range(pin_hot)
+    )
+    offered = np.bincount(tenant_seq, minlength=n_tenants)
+    rows = []
+    for i, tid in enumerate(tenant_ids):
+        stat = health["tenants"][tid]
+        rows.append(TenantRow(
+            tenant=tid,
+            requests=int(offered[i]),
+            hits=stat["hits"],
+            evictions=stat["evictions"],
+            evictions_caused=stat["evictions_caused"],
+            quota_rejections=stat["quota_rejections"],
+            failed_requests=failed[i],
+            resident_bytes=stat["resident_bytes"],
+            pinned=int(stat["pinned"]),
+        ))
+    rows.append(TenantRow(
+        tenant="ALL",
+        requests=n_requests,
+        hits=sum(r.hits for r in rows),
+        evictions=sum(r.evictions for r in rows),
+        evictions_caused=sum(r.evictions_caused for r in rows),
+        quota_rejections=sum(r.quota_rejections for r in rows),
+        failed_requests=sum(r.failed_requests for r in rows),
+        resident_bytes=health["hbm"]["charged_bytes"],
+        pinned=pin_hot,
+    ))
+    all_row = rows[-1]
+    return MultiTenantResult(
+        n_rows=m, n_cols=k, n_devices=int(mesh.devices.size),
+        strategy=strategy_name, dtype=dtype,
+        n_tenants=n_tenants, zipf_a=float(zipf_a),
+        hbm_budget=budget or 0, budget_tenants=capacity,
+        n_requests=n_requests, wall_s=wall,
+        hit_rate=(
+            all_row.hits / n_requests if n_requests else float("nan")
+        ),
+        lru_floor=floor,
+        rows=tuple(rows),
+    )
+
+
 def run_serve(
     strategy_name: str,
     mesh,
@@ -904,6 +1344,7 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
         promote = int(promote)
     metrics_out = getattr(args, "metrics_out", None)
     trace_jsonl = getattr(args, "trace_jsonl", None)
+    n_tenants = getattr(args, "tenants", None)
     arrival = getattr(args, "arrival", "closed") or "closed"
     concurrency = getattr(args, "concurrency", None) or [1]
     coalesce_arg = getattr(args, "coalesce", None)
@@ -939,6 +1380,68 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
         for name in strategies:
             for n_dev in counts:
                 mesh = meshes[n_dev]
+                if n_tenants:
+                    # Multi-tenant trace mode (engine/registry.py): takes
+                    # precedence over the load/sequential protocols.
+                    try:
+                        result = run_serve_multitenant(
+                            name, mesh, m, k, dtype=args.dtype,
+                            kernel=args.kernel, combine=args.combine,
+                            stages=getattr(args, "stages", None),
+                            dtype_storage=getattr(
+                                args, "dtype_storage", None
+                            ),
+                            n_tenants=n_tenants,
+                            zipf_a=getattr(args, "zipf_a", 1.1),
+                            hbm_budget=getattr(args, "hbm_budget", None),
+                            pin_hot=getattr(args, "pin_hot", 0),
+                            tenant_quota=getattr(
+                                args, "tenant_quota", None
+                            ),
+                            n_requests=args.n_requests,
+                            max_bucket=args.max_bucket,
+                            promote=promote, seed=args.seed,
+                            metrics_out=metrics_out,
+                            fault_spec=fault_spec,
+                            fault_seed=getattr(args, "fault_seed", 0),
+                            poison_rate=poison_rate,
+                            poison_tenant=getattr(
+                                args, "poison_tenant", None
+                            ),
+                            integrity_gate=getattr(
+                                args, "integrity_gate", False
+                            ),
+                            breaker_reset_s=getattr(
+                                args, "breaker_reset_s", 30.0
+                            ),
+                        )
+                    except MatvecError as e:
+                        print(f"skip {name} {m}x{k} p={n_dev}: {e}")
+                        continue
+                    if not args.no_csv:
+                        path = append_multitenant_result(
+                            result, args.data_root
+                        )
+                    else:
+                        path = None
+                    all_row = result.rows[-1]
+                    print(
+                        f"serve-tenants {name} {m}x{k} p={n_dev} "
+                        f"tenants={result.n_tenants} "
+                        f"zipf_a={result.zipf_a} "
+                        "budget="
+                        f"{result.budget_tenants if result.hbm_budget else 'inf'} "
+                        f"{result.rps:.1f} req/s "
+                        f"hit={result.hit_rate:.3f} "
+                        f"(lru floor {result.lru_floor:.3f}) "
+                        f"evictions={all_row.evictions} "
+                        f"quota_rej={all_row.quota_rejections} "
+                        f"ok={all_row.availability:.3f}"
+                    )
+                    if path is not None:
+                        print(f"CSV: {path}")
+                    n_done += 1
+                    continue
                 if not load_mode:
                     try:
                         result = run_serve(
@@ -1148,6 +1651,37 @@ def build_parser() -> argparse.ArgumentParser:
         "tuned promotion point b*) or an int",
     )
     p.add_argument(
+        "--tenants", type=int, default=None,
+        help="multi-tenant trace mode (engine/registry.py): register N "
+        "seeded tenant matrices in a matrix registry and drive a Zipf-"
+        "popularity trace against --hbm-budget; one CSV row per tenant "
+        "(availability/hit-rate/eviction columns) plus an ALL summary "
+        "row in serve_tenants_<strategy>.csv. Takes precedence over the "
+        "load/sequential protocols",
+    )
+    p.add_argument(
+        "--zipf-a", type=float, default=1.1,
+        help="with --tenants: Zipf popularity exponent (p(rank) ∝ "
+        "rank^-a; higher = more skew toward hot tenants)",
+    )
+    p.add_argument(
+        "--hbm-budget", default=None, metavar="BYTES|Nx",
+        help="with --tenants: resident-payload budget — plain bytes, or "
+        "a payload multiple like '2.5x' (room for 2.5 tenants of this "
+        "shape). Omit for unlimited (accounting still runs)",
+    )
+    p.add_argument(
+        "--pin-hot", type=int, default=0,
+        help="with --tenants: warm-pin the K most popular tenants "
+        "(eviction-exempt) before the trace",
+    )
+    p.add_argument(
+        "--tenant-quota", default=None, metavar="N|tenant-i=N,...",
+        help="with --tenants: max_in_flight admission quota — a bare "
+        "int for every tenant, or 'tenant-0=4' to throttle named "
+        "tenants only (the chaos overlay's quota-pressure knob)",
+    )
+    p.add_argument(
         "--fault-spec", default=None, metavar="SPEC",
         help="chaos mode: seeded fault-injection plan, e.g. "
         "'dispatch:device_error:p=0.05;dispatch:nan:times=2' "
@@ -1169,6 +1703,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="chaos mode: fraction of requests (seeded choice) marked "
         "with the poison payload signature — each fails its dispatch "
         "deterministically, exercising the scheduler's batch bisection",
+    )
+    p.add_argument(
+        "--poison-tenant", default=None, metavar="TENANT",
+        help="with --tenants and --poison-rate: plant the poison "
+        "signature only in this tenant's requests (the isolation "
+        "overlay's per-tenant blast radius)",
     )
     p.add_argument(
         "--integrity-gate", action="store_true",

@@ -7,11 +7,15 @@ shape-bucketed, AOT-compiled, buffer-donating, GEMV→GEMM-promoting,
 coalesces concurrent requests into one column-stacked multi-RHS dispatch —
 and fault-tolerant (``resilience/``): retry + per-ExecKey circuit
 breakers behind a degradation ladder, coalesced-batch bisection, and an
-optional result-integrity gate. See ``core.py`` for the engine
-architecture, ``buckets.py`` for the shape ladder, ``executables.py`` for
-the AOT cache, ``scheduler.py`` for coalescing, ``docs/SERVING.md`` /
-``docs/RESILIENCE.md`` for usage. Benchmarked by ``bench/serve.py``
-(``--op serve``; chaos mode via ``--fault-spec``).
+optional result-integrity gate — and multi-tenant (``registry.py``): a
+matrix registry holds many tenants' ``A`` matrices against one HBM
+budget with cost-aware LRU eviction, async swap, warm-pinning and
+per-tenant quotas. See ``core.py`` for the engine architecture,
+``buckets.py`` for the shape ladder, ``executables.py`` for the AOT
+cache, ``scheduler.py`` for coalescing, ``registry.py`` for tenancy,
+``docs/SERVING.md`` / ``docs/RESILIENCE.md`` / ``docs/MULTITENANT.md``
+for usage. Benchmarked by ``bench/serve.py`` (``--op serve``; chaos mode
+via ``--fault-spec``; multi-tenant trace mode via ``--tenants``).
 """
 
 from .buckets import (
@@ -23,6 +27,12 @@ from .buckets import (
 )
 from .core import DEFAULT_PROMOTE_B, EngineStats, MatvecEngine, MatvecFuture
 from .executables import ExecKey, ExecStats, ExecutableCache
+from .registry import (
+    HbmAccountant,
+    MatrixRegistry,
+    TenantHandle,
+    TenantQuota,
+)
 from .scheduler import (
     DEFAULT_MAX_WINDOW_MS,
     QOS_TIERS,
@@ -35,6 +45,10 @@ __all__ = [
     "MatvecEngine",
     "MatvecFuture",
     "EngineStats",
+    "MatrixRegistry",
+    "TenantHandle",
+    "TenantQuota",
+    "HbmAccountant",
     "ArrivalWindowScheduler",
     "CoalescedFuture",
     "SchedulerStats",

@@ -69,6 +69,31 @@ sites so all of this is deterministically testable; an optional
 NaN/Inf **integrity gate** at materialization refuses to serve corrupt
 results. All of it is pay-for-what-you-use: with no policy, no plan and
 no gate, the dispatch path is byte-for-byte the old one.
+
+Multi-tenant residency (``registry.py``; docs/MULTITENANT.md): a
+registry-managed engine is ONE tenant's serving instance. Three hooks
+make that composition work without touching the dispatch doctrine:
+
+* **releasable residency** — ``retain_host=True`` keeps the host payload
+  (the original ``A``, plus the quantized pytree under quantized
+  storage), so :meth:`release_residency` can drop the device arrays (a
+  pure reference drop: in-flight dispatches hold their own references,
+  so eviction never syncs and never corrupts outstanding work) and
+  :meth:`ensure_resident` can re-place them — ``device_put`` is
+  enqueue-only, so a swap-in overlaps under other tenants' dispatches
+  exactly like the staged transfers in ``parallel/ring.py`` overlap
+  under the next stage's compute. Re-placement of the SAME host bytes
+  through the SAME executable is bitwise-identical by construction.
+* **residency accounting** — every change to the engine's device-A
+  footprint (placement, release, and the degradation ladder's lazily
+  placed native safe tier, which used to allocate outside any
+  accounting) reports through ``residency_listener(delta_bytes,
+  reason)`` — the registry's HBM accountant charges through it.
+* **tenant-scoped identity** — ``label_prefix="tenant-7/"`` prefixes
+  every fault-site label, so a chaos plan can target one tenant
+  (``--fault-spec 'dispatch:device_error:key=tenant-7/*'``) while
+  breakers, degradation state and the integrity gate are per-engine and
+  therefore per-tenant already.
 """
 
 from __future__ import annotations
@@ -111,7 +136,7 @@ from ..resilience.policy import (
     ResiliencePolicy,
     classify_failure,
 )
-from ..utils.errors import ConfigError, DeadlineExceededError
+from ..utils.errors import ConfigError, DeadlineExceededError, ResidencyError
 from .buckets import (
     DEFAULT_MAX_BUCKET,
     bucket_for,
@@ -161,6 +186,11 @@ class MatvecFuture:
         ]
         self._vector = vector
         self._error: Exception | None = None
+        # Set once result() has returned (or raised): the caller has
+        # consumed this future, so it no longer holds un-materialized
+        # result buffers — the registry's per-tenant max_in_flight quota
+        # counts futures with retired=False (engine/registry.py).
+        self.retired = False
         # Request-lifecycle trace: opened by submit, completed here — the
         # materialize span and the finish that emits the record both run on
         # whichever thread materializes (sequential hand-off; tracing.py).
@@ -234,6 +264,7 @@ class MatvecFuture:
         finishes the request's trace (idempotent — a second call
         re-materializes but never re-emits)."""
         if self._error is not None:
+            self.retired = True
             raise self._error
         trace = self._trace
         t0 = time.perf_counter()
@@ -262,6 +293,7 @@ class MatvecFuture:
             status = "materialize_error"
             raise
         finally:
+            self.retired = True
             if span is not None:
                 span.__exit__(None, None, None)
                 trace.finish(status=status)
@@ -372,6 +404,34 @@ class MatvecEngine:
         serving corrupt data (counted in
         ``engine_integrity_failures_total``). Off by default — the check
         is one host-side ``isfinite`` scan per materialization.
+    retain_host : keep the host payload (``A`` itself, plus the quantized
+        pytree under quantized storage) for the engine's lifetime, so
+        residency is releasable (:meth:`release_residency`) and
+        restorable (:meth:`ensure_resident`) — the matrix registry's
+        swap contract. Off by default: a plain engine keeps the old
+        place-once-at-construction footprint.
+    defer_placement : skip the construction-time ``device_put`` — the
+        first :meth:`ensure_resident` (or the dispatch path's transparent
+        re-placement) places ``A``. Requires ``retain_host``; registry
+        tenants start evicted so registration of a thousand tenants
+        costs no HBM.
+    label_prefix : prefix every fault-site label with this string
+        (``"tenant-7/"``), making :class:`~..resilience.FaultSpec`
+        ``key`` patterns tenant-addressable. Un-prefixed patterns keep
+        matching via the base label (``resilience/faults.py``).
+    exec_cache : adopt a shared :class:`ExecutableCache` instead of a
+        private one. Executables depend on shapes/shardings/config, never
+        on ``A``'s values, so registry tenants with equal
+        :meth:`exec_signature` share one compiled-program set (N tenants,
+        one compile per ExecKey).
+    residency_listener : ``callable(delta_bytes, reason)`` invoked after
+        every device-A footprint change — ``reason`` is ``"resident"``
+        (payload placed), ``"released"`` (residency dropped), or
+        ``"native_fallback"`` (the degradation ladder's lazy native
+        safe-tier placement under quantized storage). The registry's HBM
+        accountant charges through this; exactly-once per transition
+        (concurrent placements account once). Never invoked while the
+        engine's residency bookkeeping lock is held.
     """
 
     def __init__(
@@ -396,6 +456,11 @@ class MatvecEngine:
         resilience: ResiliencePolicy | None = None,
         fault_plan: FaultPlan | None = None,
         integrity_gate: bool = False,
+        retain_host: bool = False,
+        defer_placement: bool = False,
+        label_prefix: str = "",
+        exec_cache: ExecutableCache | None = None,
+        residency_listener: Callable[[int, str], None] | None = None,
     ):
         if mesh is None:
             from ..parallel.mesh import make_mesh
@@ -424,14 +489,33 @@ class MatvecEngine:
         _, self._sh_b = self.strategy.batched_shardings(mesh)
         self.storage = self._resolve_storage(dtype_storage)
         self._a_native = None  # lazy native residency (the ladder's safe tier)
+        self.retain_host = bool(retain_host)
+        if defer_placement and not self.retain_host:
+            raise ConfigError(
+                "defer_placement needs retain_host=True — a deferred "
+                "engine has only the host payload to place from"
+            )
+        self._label_prefix = str(label_prefix)
+        self._residency_listener = residency_listener
+        # Residency bookkeeping mutex: guards WHICH placed array wins a
+        # concurrent-placement race and the exactly-once listener
+        # decision. Never held across a transfer or a sync, and the
+        # listener is never invoked under it (it may take the registry's
+        # lock) — the device-transfer-under-registry-lock rule's
+        # discipline.
+        self._residency_lock = threading.Lock()
+        self._a = None  # device residency; placed below unless deferred
         if self.storage != NATIVE:
             # Quantize ONCE at residency: payload + per-block scales (+ the
             # compensated pair) placed as one pytree in A's own sharding.
+            # The host-side pytree survives as the swap-in source when the
+            # engine is registry-managed (retain_host) — re-placement of
+            # the same host bytes is bitwise-identical, no re-quantize.
             qa = quantize_matrix(
                 a, self.storage,
                 contraction_shards=self.strategy.contraction_shards(mesh),
             )
-            self._a = jax.device_put(qa, self._sh_a)
+            self._qa_host = qa
             # Struct-only template (NOT the host arrays: a large A's
             # quantized copy is 26-52% of its bytes, and the builders
             # only ever need leaf shapes/dtypes).
@@ -443,9 +527,11 @@ class MatvecEngine:
             self.storage_block = qa.block
             self.resident_bytes = qa.nbytes
         else:
-            self._a = jax.device_put(a, self._sh_a)  # resident for engine life
+            self._qa_host = None
             self._qa_template = None
-            self._a_host = None
+            # Placement source; dropped after the construction-time
+            # placement unless retain_host keeps residency releasable.
+            self._a_host = a
             self.storage_block = None
             self.resident_bytes = int(a.nbytes)
         self._matvec_combine, self._gemm_combine = self._resolve_combine(
@@ -494,9 +580,9 @@ class MatvecEngine:
         self._g_resident = self.metrics.gauge(
             "engine_resident_bytes",
             "HBM bytes of the resident A operand (payload + scales for "
-            "quantized storage)",
+            "quantized storage; plus the native safe tier once placed)",
         )
-        self._g_resident.set(self.resident_bytes)
+        self._g_resident.set(0)
         # Info metric, Prometheus-style: the label set carries the fact,
         # the value is always 1 (the obs `storage` panel reads it).
         self.metrics.gauge(
@@ -515,13 +601,15 @@ class MatvecEngine:
             "engine_dispatch_failures_total",
             "submit() calls that raised at dispatch (post-retry/ladder)",
         )
-        self._cache = ExecutableCache(
-            compile_counter=self.metrics.counter(
-                "engine_compiles_total", "AOT executable compiles"
-            ),
-            hit_counter=self.metrics.counter(
-                "engine_hits_total", "executable-cache hits"
-            ),
+        self._cache = exec_cache if exec_cache is not None else (
+            ExecutableCache(
+                compile_counter=self.metrics.counter(
+                    "engine_compiles_total", "AOT executable compiles"
+                ),
+                hit_counter=self.metrics.counter(
+                    "engine_hits_total", "executable-cache hits"
+                ),
+            )
         )
         self.tracer = RequestTracer(
             capacity=trace_capacity,
@@ -579,6 +667,113 @@ class MatvecEngine:
                 "materializations the NaN/Inf integrity gate refused",
             )
             if self.integrity_gate else None
+        )
+        if not defer_placement:
+            self.ensure_resident()  # the classic resident-for-engine-life path
+            if not self.retain_host:
+                # PR 8 doctrine: a plain quantized engine keeps the
+                # struct-only template (plus the original A for the
+                # native safe tier), never the host payload copy; a plain
+                # native engine keeps no host copy at all.
+                self._qa_host = None
+                if self.storage == NATIVE:
+                    self._a_host = None
+
+    # ---- residency lifecycle (registry.py; docs/MULTITENANT.md) ----
+
+    @property
+    def resident(self) -> bool:
+        """True while the payload ``A`` operand is device-resident."""
+        return self._a is not None
+
+    @property
+    def device_resident_bytes(self) -> int:
+        """HBM bytes this engine's A residencies currently hold: the
+        payload when resident, plus the native safe tier once the
+        degradation ladder has placed it."""
+        total = self.resident_bytes if self._a is not None else 0
+        if self._a_native is not None:
+            total += int(self._a_host.nbytes)
+        return total
+
+    def _notify_residency(self, delta: int, reason: str) -> None:
+        self._g_resident.set(self.device_resident_bytes)
+        if self._residency_listener is not None and delta:
+            self._residency_listener(delta, reason)
+
+    def ensure_resident(self) -> bool:
+        """Place the payload ``A`` operand if it is not device-resident;
+        True when this call placed it. Enqueue-only (``device_put`` is
+        async — the swap-in overlaps under other tenants' in-flight
+        dispatches) and race-safe: concurrent callers may both stage a
+        placement, but exactly one wins the bookkeeping and the listener
+        fires once (the loser's buffer is dropped, freed by refcount).
+        Raises :class:`ResidencyError` when the engine was evicted
+        without ``retain_host`` (no payload to place from)."""
+        if self._a is not None:
+            return False
+        payload = self._qa_host if self.storage != NATIVE else self._a_host
+        if payload is None:
+            raise ResidencyError(
+                "resident A was released and the engine retains no host "
+                "payload (construct with retain_host=True for releasable "
+                "residency)"
+            )
+        placed = jax.device_put(payload, self._sh_a)
+        with self._residency_lock:
+            if self._a is not None:
+                return False  # lost a concurrent placement race
+            self._a = placed
+        self._notify_residency(self.resident_bytes, "resident")
+        return True
+
+    def release_residency(self) -> int:
+        """Drop the device residency (payload AND any placed native safe
+        tier), keeping the host payload for a later
+        :meth:`ensure_resident`. Returns the HBM bytes released. A pure
+        reference drop — no device sync: in-flight dispatches hold their
+        own references to the arrays, so their results are unaffected and
+        the buffers free when the last reference drops (refcounted
+        residency). Safe to call under the registry lock by the same
+        discipline."""
+        if not self.retain_host:
+            raise ResidencyError(
+                "release_residency needs retain_host=True — without the "
+                "host payload the engine could never serve again"
+            )
+        with self._residency_lock:
+            released = self.resident_bytes if self._a is not None else 0
+            if self._a_native is not None:
+                released += int(self._a_host.nbytes)
+                self._a_native = None
+            self._a = None
+        self._notify_residency(-released, "released")
+        return released
+
+    def exec_signature(self) -> tuple:
+        """Identity of this engine's compiled-program space. Executables
+        depend on shapes, shardings and config — never on ``A``'s values
+        — so two engines with equal signatures may share one
+        :class:`ExecutableCache` (``exec_cache=``): the registry compiles
+        each ExecKey once across N same-shaped tenants."""
+        return (
+            self.mesh,
+            self.strategy.name,
+            # The kernel OBJECT for callables (two different callables
+            # that share a __name__ must not share compiled programs);
+            # strings compare by value as before.
+            self.kernel,
+            self._combine_label(self._matvec_combine),
+            self._combine_label(self._gemm_combine),
+            self.stages,
+            self.m,
+            self.k,
+            str(self.dtype),
+            self.storage,
+            self.storage_block,
+            self.gather_output,
+            self.max_bucket,
+            self._donate,
         )
 
     # ---- construction-time resolution ----
@@ -909,12 +1104,28 @@ class MatvecEngine:
         format. Under quantized residency the native safe tier places the
         retained host A lazily on its FIRST degraded dispatch and keeps
         it — the extra HBM is spent only once a breaker actually routes
-        around the quantized config, never up front."""
+        around the quantized config, never up front. The placement is
+        accounted like any other residency change (``native_fallback``
+        listener reason): a degraded dispatch must not silently double a
+        tenant's footprint. An evicted registry-managed engine re-places
+        transparently here (a scheduler flush racing an eviction lands on
+        a healed residency, not a crash)."""
         if key.storage == self.storage:
+            if self._a is None:
+                # Transparent re-admission: enqueue-only, accounted, and
+                # bitwise-identical to the pre-eviction residency.
+                self.ensure_resident()
             return self._a
         if self._a_native is None:
             # Enqueue-only placement (device_put is async), not a sync.
-            self._a_native = jax.device_put(self._a_host, self._sh_a)
+            placed = jax.device_put(self._a_host, self._sh_a)
+            with self._residency_lock:
+                if self._a_native is not None:
+                    return self._a_native  # lost a concurrent race
+                self._a_native = placed
+            self._notify_residency(
+                int(self._a_host.nbytes), "native_fallback"
+            )
         return self._a_native
 
     def _get_traced(self, trace: ActiveTrace, key, builder):
@@ -936,11 +1147,18 @@ class MatvecEngine:
     def _check_faults(self, site: str, key: ExecKey, block=None) -> bool:
         """Consult the fault plan at one site. Error kinds raise here;
         latency stalls here; returns True for a "nan" corruption (the
-        caller marks the result part). False = healthy."""
+        caller marks the result part). False = healthy. A tenant-scoped
+        engine presents its prefixed label (``tenant-7/op:...``) so specs
+        can target one tenant; un-prefixed patterns still match via the
+        base label (``FaultPlan.check``)."""
         plan = self._fault_plan
         if plan is None:
             return False
-        action = plan.check(site, key.label(), block=block)
+        label = key.label()
+        action = plan.check(
+            site, self._label_prefix + label, block=block,
+            base_label=label if self._label_prefix else None,
+        )
         if action is None:
             return False
         self._c_faults.inc()
@@ -1318,7 +1536,9 @@ class MatvecEngine:
             "integrity_gate": self.integrity_gate,
             "storage": {
                 "format": self.storage,
+                "resident": self.resident,
                 "resident_bytes": self.resident_bytes,
+                "device_resident_bytes": self.device_resident_bytes,
                 "block": self.storage_block,
                 # True once the native safe tier has been placed (HBM is
                 # then holding BOTH residencies — a degraded quantized

@@ -9,6 +9,15 @@ the sharded program once" discipline, PAPERS.md) and held under an explicit
 key (strategy × kernel × combine × bucket × dtype), with compile and hit
 counters the bench reports as first-class metrics.
 
+Executables are a pure function of shapes, shardings and config — never
+of ``A``'s values — so one cache may be SHARED across engines with equal
+``MatvecEngine.exec_signature()``: the multi-tenant registry
+(``registry.py``) hands N same-shaped tenants one cache and compiles
+each ExecKey once for the fleet. Concurrent misses on the same key may
+both compile (a benign race — identical programs; last write wins), but
+a compiled entry is never invalidated, so tenants can never observe
+divergent executables for one key.
+
 Buffer donation: the RHS block argument is donated (``donate_argnums``) so
 XLA may reuse its HBM for the output — every request allocates a fresh
 padded RHS, so after dispatch its buffer is garbage by construction, and

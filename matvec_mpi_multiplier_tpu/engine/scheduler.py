@@ -89,6 +89,14 @@ width-threshold and interactive flushes dispatch on the submitting
 caller's thread, so backpressure lands on the thread that caused it.
 The host-sync and blocking-I/O lints cover this module like the rest of
 ``engine/`` (host staging is marked, no file I/O).
+
+Multi-tenant note (``registry.py``): a scheduler wraps ONE engine, so
+under the matrix registry coalescing is per-tenant by construction
+(batches never mix tenants' matrices). A flush racing that tenant's
+eviction is safe: a registry-managed engine re-places its retained host
+payload transparently inside the dispatch (``MatvecEngine._a_for``),
+accounted through the residency listener — the flusher thread needs no
+registry coordination.
 """
 
 from __future__ import annotations

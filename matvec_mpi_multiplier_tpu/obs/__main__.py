@@ -97,6 +97,84 @@ def render_storage(snapshot: dict) -> str | None:
     return "\n".join(out)
 
 
+def _labeled(metrics: dict, prefix: str) -> dict[str, dict[str, float]]:
+    """Parse ``<prefix><what>{tenant="X"}`` metric names into
+    ``{tenant: {what: value}}`` (Prometheus-style labeled names — the
+    registry's per-tenant vocabulary, engine/registry.py)."""
+    out: dict[str, dict[str, float]] = {}
+    for name, value in metrics.items():
+        if not name.startswith(prefix) or "{" not in name:
+            continue
+        what = name[len(prefix):name.index("{")]
+        labels = dict(
+            part.split("=", 1)
+            for part in name[name.index("{") + 1:name.rindex("}")].split(",")
+        )
+        tenant = labels.get("tenant", "?").strip('"')
+        out.setdefault(tenant, {})[what] = value
+    return out
+
+
+def render_tenants(snapshot: dict) -> str | None:
+    """The tenants panel: the multi-tenant registry's HBM ledger and
+    per-tenant residency/hit/evict/quota table, read off the
+    ``registry_*`` and ``tenant_*{tenant="..."}`` metrics
+    (engine/registry.py; docs/MULTITENANT.md). Mirrors
+    ``MatrixRegistry.health()``. None when the snapshot carries no
+    registry vocabulary (a single-tenant run)."""
+    gauges = snapshot.get("gauges", {})
+    if "registry_tenants" not in gauges:
+        return None
+    counters = snapshot.get("counters", {})
+    budget = gauges.get("registry_hbm_budget_bytes", 0)
+    requests = counters.get("registry_requests_total", 0)
+    hits = counters.get("registry_hits_total", 0)
+    out = [
+        "tenants:",
+        f"  registered        {gauges.get('registry_tenants', 0):.0f} "
+        f"({gauges.get('registry_tenants_resident', 0):.0f} resident)",
+        f"  hbm               "
+        f"{gauges.get('registry_hbm_charged_bytes', 0):.3e} of "
+        + (f"{budget:.3e} budget" if budget else "unlimited budget")
+        + f" ({counters.get('registry_budget_overshoots_total', 0)} "
+        "overshoots)",
+        f"  hit rate          "
+        f"{(hits / requests) if requests else float('nan'):.3f} "
+        f"({hits} of {requests} submits found A resident)",
+        f"  swap-ins          "
+        f"{counters.get('registry_swap_ins_total', 0)} "
+        f"(evictions {counters.get('registry_evictions_total', 0)}, "
+        f"pins {counters.get('registry_pins_total', 0)})",
+        f"  quota rejections  "
+        f"{counters.get('registry_quota_rejections_total', 0)}",
+        f"  native fallbacks  "
+        f"{counters.get('registry_native_fallback_charges_total', 0)} "
+        "(degraded-tier placements charged to their tenant)",
+    ]
+    per = _labeled(counters, "tenant_")
+    for tenant, vals in _labeled(gauges, "tenant_").items():
+        per.setdefault(tenant, {}).update(vals)
+    if per:
+        width = max(len(t) for t in per)
+        out.append(
+            f"  {'tenant':<{width}}  resident_bytes  requests  hits  "
+            "evicted  caused  quota_rej  pinned"
+        )
+        for tenant in sorted(per):
+            v = per[tenant]
+            out.append(
+                f"  {tenant:<{width}}  "
+                f"{v.get('resident_bytes', 0):>14.3e}  "
+                f"{v.get('requests_total', 0):>8.0f}  "
+                f"{v.get('hits_total', 0):>4.0f}  "
+                f"{v.get('evictions_total', 0):>7.0f}  "
+                f"{v.get('evictions_caused_total', 0):>6.0f}  "
+                f"{v.get('quota_rejections_total', 0):>9.0f}  "
+                f"{v.get('pinned', 0):>6.0f}"
+            )
+    return "\n".join(out)
+
+
 def render_resilience(snapshot: dict) -> str | None:
     """The resilience panel: fault-injection volume, recovery activity
     (retries, downgrades, breaker opens/recoveries), blast-radius
@@ -199,6 +277,9 @@ def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     storage = render_storage(snapshot)
     if storage is not None:
         out.append(storage)
+    tenants = render_tenants(snapshot)
+    if tenants is not None:
+        out.append(tenants)
     batching = render_batching(snapshot)
     if batching is not None:
         out.append(batching)

@@ -147,6 +147,10 @@ class FaultSpec:
     kind : one of :data:`FAULT_KINDS`.
     key : ``fnmatch`` pattern over the ExecKey label
         (``op:strategy:kernel:combine:bucket:dtype``); ``"*"`` = all.
+        Tenant-scoped engines (``engine/registry.py``) present
+        ``<tenant>/op:...`` labels, so ``"tenant-7/*"`` targets one
+        tenant; un-prefixed patterns match every tenant via the base
+        label (see :meth:`FaultPlan.check`).
     p : injection probability per matching event (hash-derived, see
         module docstring).
     times : stop injecting after this many injections (None = unlimited).
@@ -267,18 +271,31 @@ class FaultPlan:
         return True
 
     def check(
-        self, site: str, key_label: str, block: np.ndarray | None = None
+        self, site: str, key_label: str, block: np.ndarray | None = None,
+        base_label: str | None = None,
     ) -> FaultAction | None:
         """One fault-site event: None (no fault) or the action to apply.
         ``block`` is the host payload (for poison-scoped dispatch specs;
-        row 0 is the signature row)."""
+        row 0 is the signature row). ``base_label`` is the un-prefixed
+        ExecKey label a TENANT-scoped engine also answers to: the multi-
+        tenant registry prefixes ``key_label`` with ``"<tenant>/"`` so a
+        spec can target one tenant (``key="tenant-7/*"``), while a spec
+        written against the classic label grammar (``key="*psum*"``,
+        ``key="gemm:*"``) keeps matching every tenant via the base label
+        — scoping is additive, never a silent pattern break."""
         with self._lock:
             if not self._armed:
                 return None
             for i, spec in enumerate(self.specs):
                 if spec.site != site:
                     continue
-                if spec.key != "*" and not fnmatchcase(key_label, spec.key):
+                if spec.key != "*" and not (
+                    fnmatchcase(key_label, spec.key)
+                    or (
+                        base_label is not None
+                        and fnmatchcase(base_label, spec.key)
+                    )
+                ):
                     continue
                 if spec.poison is not None:
                     if block is None:

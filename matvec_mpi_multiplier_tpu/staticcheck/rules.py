@@ -27,6 +27,9 @@ silent-except             swallow-ok   broad except blocks must re-raise,
                                        record the failure, or justify
 quant-fp64-scale          quant-ok     no float64 in quantization scale
                                        math (quantized-storage helpers)
+device-transfer-under-    registry-ok  no device transfer, dispatch, or
+registry-lock                          sync while holding a registry/
+                                       residency mutex in engine/
 ========================  ===========  ====================================
 
 The first four are the old grep rules from ``scripts/tier1.sh`` /
@@ -667,6 +670,54 @@ def _check_quant_fp64(sf: SourceFile):
                     f"{q}() without a dtype in the quant scope defaults "
                     "to float64 for float input; name the width (or mark "
                     "a deliberate dtype passthrough)"
+                )
+
+
+# The multi-tenant registry's lock discipline (engine/registry.py;
+# docs/MULTITENANT.md): the registry mutex serializes ADMISSION
+# BOOKKEEPING for every tenant, so holding it across a device transfer
+# (`device_put` — the swap-in), a dispatch (`submit`/`warmup` can compile
+# or block in the backpressure drain), or a host sync
+# (`block_until_ready`/`device_get`) turns one tenant's swap into a
+# fleet-wide admission freeze. Victim RELEASE under the lock is legal by
+# design — dropping references transfers nothing — so `release_residency`
+# is deliberately absent from the call set. Scoped to all of engine/ (the
+# acceptance bar: no transfer under a registry/residency mutex anywhere
+# in the serving subsystem); rule #8 remains the scheduler-specific
+# flush-loop discipline. Marker `registry-ok:` documents a sanctioned
+# exception.
+_REGISTRY_LOCK_CALLS = (
+    "device_put", "device_get", "block_until_ready", "ensure_resident",
+    "submit", "warmup",
+)
+
+
+@_register(
+    "device-transfer-under-registry-lock", "registry-ok",
+    "device transfer (device_put), dispatch (submit/warmup/"
+    "ensure_resident) or host sync entered while holding a registry/"
+    "residency mutex: plan under the lock, place and dispatch after "
+    "releasing it",
+    _engine,
+)
+def _check_registry_lock(sf: SourceFile):
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.With) or not _lockish_with(node):
+            continue
+        for inner in _walk_excluding_deferred(node.body):
+            if not isinstance(inner, ast.Call):
+                continue
+            fn = inner.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if attr in _REGISTRY_LOCK_CALLS:
+                yield inner, (
+                    f"{attr}() under a held registry/residency mutex: a "
+                    "transfer or dispatch here freezes every tenant's "
+                    "admission behind one tenant's swap — plan victims "
+                    "under the lock, device_put/dispatch after releasing "
+                    "it (docs/MULTITENANT.md)"
                 )
 
 

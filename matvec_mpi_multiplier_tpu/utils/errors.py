@@ -50,6 +50,28 @@ class DeadlineExceededError(MatvecError):
     can be retried."""
 
 
+class TenantQuotaError(MatvecError):
+    """A tenant's admission quota refused a request before dispatch.
+
+    Raised by ``MatvecFuture.result()`` when the matrix registry's
+    per-tenant admission gate (``engine/registry.py``) found the tenant
+    at its ``max_in_flight`` quota: the request was never dispatched (no
+    device work, no eviction pressure on other tenants) and can be
+    retried once the tenant's outstanding work drains. Quota refusal is
+    the isolation mechanism — one tenant's burst must fail ITS requests,
+    not evict or degrade its neighbors'."""
+
+
+class ResidencyError(MatvecError):
+    """A dispatch needed the resident ``A`` operand while it was evicted
+    and the engine holds no host copy to restore it from.
+
+    Registry-managed engines (``retain_host=True``) never raise this —
+    they re-place the retained host payload transparently; it marks a
+    caller evicting a plain engine's residency without having opted into
+    host retention."""
+
+
 class TimingError(MatvecError):
     """A timing measurement failed to produce a usable number.
 

@@ -76,6 +76,43 @@ print(f"quantized smoke ok: relerr {rel:.2e}, "
       f"bytes {qa.nbytes / a.nbytes:.3f}x")
 PY
 
+# Multi-tenant eviction smoke: 3 tenants against a budget that holds 2 —
+# the registry must swap (evictions observed), keep the ledger inside the
+# budget, and re-admit evicted tenants BITWISE-identically
+# (engine/registry.py; docs/MULTITENANT.md). Seconds, not minutes: a
+# regression here means multi-tenant serving cannot even start.
+echo "multi-tenant smoke: eviction + bitwise re-admission under budget"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import numpy as np
+from matvec_mpi_multiplier_tpu import MatrixRegistry, make_mesh
+
+mesh = make_mesh(8)
+rng = np.random.default_rng(0)
+mats = {f"t{i}": rng.standard_normal((64, 64)).astype(np.float32)
+        for i in range(3)}
+payload = 64 * 64 * 4
+x = rng.standard_normal(64).astype(np.float32)
+
+reg = MatrixRegistry(mesh, hbm_budget=2 * payload, strategy="rowwise",
+                     promote=None)
+handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+reg.warmup(widths=[1])
+first = {tid: handles[tid](x) for tid in mats}   # third admission evicts
+h = reg.health()
+assert h["hbm"]["charged_bytes"] <= 2 * payload, h["hbm"]
+evicted = [t for t, s in h["tenants"].items() if not s["resident"]]
+assert len(evicted) == 1, h["tenants"]
+again = handles[evicted[0]](x)                   # swap back in
+assert np.array_equal(again, first[evicted[0]]), "re-admit not bitwise"
+total_evictions = sum(s["evictions"] for s in h["tenants"].values())
+assert total_evictions >= 1
+reg.close()
+print(f"multi-tenant smoke ok: {total_evictions} eviction(s), "
+      f"re-admit bitwise, ledger {h['hbm']['charged_bytes']} <= "
+      f"{2 * payload}")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

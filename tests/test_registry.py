@@ -1,0 +1,699 @@
+"""Multi-tenant matrix registry (engine/registry.py; docs/MULTITENANT.md).
+
+The doctrine under test, in order of importance:
+
+* **eviction correctness** — under an HBM budget forcing continuous
+  eviction on a Zipf trace, every tenant's results are BITWISE equal to
+  an unconstrained single-tenant run (same host bytes, same executable:
+  re-admission cannot drift), and the measured hit statistics equal the
+  plain-LRU replay of the same trace (homogeneous tenants: cost-aware
+  score == LRU);
+* **isolation** — a chaos spec + quota pressure targeting one tenant
+  leaves every other tenant at 100% availability with zero evictions
+  attributable to the faulty tenant's retries (eviction count equals the
+  admission-sequence LRU replay — retries never re-admit);
+* **accounting** — every resident payload is charged, INCLUDING the
+  degradation ladder's lazily placed native safe tier (the PR 8 blind
+  spot): a degraded quantized tenant's footprint visibly doubles in the
+  accountant, and eviction releases both residencies;
+* **lifecycle edges** — eviction racing in-flight work (refcounted
+  residency), bitwise re-registration, idempotent close with failed
+  in-flight futures, typed quota failure BEFORE dispatch.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import (
+    MatrixRegistry,
+    TenantQuota,
+    make_mesh,
+)
+from matvec_mpi_multiplier_tpu.bench.serve import (
+    lru_hit_floor,
+    parse_hbm_budget,
+    parse_tenant_quota,
+)
+from matvec_mpi_multiplier_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    ResiliencePolicy,
+    RetryPolicy,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import (
+    ConfigError,
+    TenantQuotaError,
+)
+
+M = K = 64
+PAYLOAD = M * K * 4  # float32
+
+
+@pytest.fixture(scope="module")
+def mesh(request):
+    import jax
+
+    assert len(jax.devices()) == 8
+    return make_mesh(8)
+
+
+def _mats(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        f"t{i}": rng.standard_normal((M, K)).astype(np.float32)
+        for i in range(n)
+    }
+
+
+def _registry(mesh, budget_tenants=None, **kw):
+    kw.setdefault("strategy", "rowwise")
+    kw.setdefault("promote", None)
+    budget = budget_tenants * PAYLOAD if budget_tenants else None
+    return MatrixRegistry(mesh, hbm_budget=budget, **kw)
+
+
+def _x(seed=7):
+    return np.random.default_rng(seed).standard_normal(K).astype(np.float32)
+
+
+# ------------------------------------------------------- eviction correctness
+
+
+def test_eviction_under_zipf_trace_is_bitwise_exact(mesh):
+    """The eviction correctness gate: budget for 2 of 4 tenants, a Zipf
+    trace forcing continuous eviction — every result bitwise-equals the
+    unconstrained single-tenant run, and the hit/eviction statistics
+    equal the plain-LRU replay of the same trace."""
+    mats = _mats(4)
+    xs = [_x(i) for i in range(3)]
+
+    # Unconstrained references: one tenant alone, no budget.
+    solo = _registry(mesh)
+    ref = {}
+    for tid, a in mats.items():
+        handle = solo.register(tid, a)
+        ref[tid] = [handle(x) for x in xs]
+    solo.close()
+
+    reg = _registry(mesh, budget_tenants=2)
+    handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+    reg.warmup(widths=[1])
+    rng = np.random.default_rng(42)
+    probs = np.array([1.0, 0.5, 0.25, 0.125])
+    seq = rng.choice(4, size=80, p=probs / probs.sum())
+    for j, t in enumerate(seq):
+        tid = f"t{t}"
+        y = handles[tid](xs[j % len(xs)])
+        assert np.array_equal(y, ref[tid][j % len(xs)]), (
+            f"request {j} (tenant {tid}) drifted from the unconstrained "
+            "single-tenant result"
+        )
+    h = reg.health()
+    hits = sum(s["hits"] for s in h["tenants"].values())
+    evictions = sum(s["evictions"] for s in h["tenants"].values())
+    floor = lru_hit_floor(seq, capacity=2)
+    assert hits / len(seq) == pytest.approx(floor), (
+        "cost-aware policy on homogeneous tenants must equal plain LRU"
+    )
+    assert evictions > 0, "budget for 2 of 4 tenants must actually evict"
+    # The accountant never exceeded its budget on this trace.
+    assert h["hbm"]["charged_bytes"] <= 2 * PAYLOAD
+    assert h["hbm"]["overshoots"] == 0
+    reg.close()
+
+
+def test_eviction_racing_in_flight_dispatch_is_safe(mesh):
+    """Refcounted residency: futures dispatched BEFORE an eviction
+    materialize bitwise-correct results AFTER it — the dispatch holds
+    its own references; the registry dropping its own never syncs."""
+    mats = _mats(3)
+    reg = _registry(mesh, budget_tenants=1)
+    handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+    x = _x()
+    expected = {tid: None for tid in mats}
+    futures = {}
+    for tid in mats:  # each admission evicts the previous tenant
+        futures[tid] = handles[tid].submit(x)
+    h = reg.health()
+    assert sum(s["resident"] for s in h["tenants"].values()) == 1
+    for tid, a in mats.items():
+        y = futures[tid].result()  # two of three tenants evicted by now
+        solo = _registry(mesh)
+        expected[tid] = solo.register(tid, a)(x)
+        solo.close()
+        assert np.array_equal(y, expected[tid])
+    reg.close()
+
+
+def test_concurrent_submit_hammer_under_eviction(mesh):
+    """4 threads × 3 tenants against a budget of 2: the admission lock,
+    active-window protection and benign placement races must serve every
+    request bitwise-correctly with no torn bookkeeping."""
+    mats = _mats(3)
+    x = _x()
+    solo = _registry(mesh)
+    ref = {}
+    for tid, a in mats.items():
+        ref[tid] = solo.register(tid, a)(x)
+    solo.close()
+
+    reg = _registry(mesh, budget_tenants=2)
+    handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+    reg.warmup(widths=[1])
+    errors = []
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            for _ in range(25):
+                tid = f"t{rng.integers(3)}"
+                if not np.array_equal(handles[tid](x), ref[tid]):
+                    errors.append(f"{tid} drifted")
+        except Exception as e:  # surface on the main thread
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(s,), daemon=True)
+        for s in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    h = reg.health()
+    assert h["hbm"]["charged_bytes"] <= 2 * PAYLOAD + PAYLOAD, (
+        "ledger exceeded budget by more than one benign overshoot"
+    )
+    assert sum(s["requests"] for s in h["tenants"].values()) == 100
+    reg.close()
+
+
+def test_re_registration_after_unregister_is_bitwise_exact(mesh):
+    mats = _mats(1)
+    x = _x()
+    reg = _registry(mesh)
+    y0 = reg.register("t0", mats["t0"])(x)
+    reg.unregister("t0")
+    assert "t0" not in reg.tenant_ids()
+    with pytest.raises(ConfigError):
+        reg.submit("t0", x)
+    y1 = reg.register("t0", mats["t0"])(x)
+    assert np.array_equal(y0, y1)
+    reg.close()
+
+
+def test_cost_aware_eviction_protects_expensive_tenants(mesh):
+    """Heterogeneous payloads: with a high cost weight, the policy
+    evicts the CHEAP-to-restore tenant even when the expensive one is
+    less recent — the cost-aware half of cost-aware LRU (plain LRU
+    would evict the big one here)."""
+    rng = np.random.default_rng(0)
+    big = rng.standard_normal((4 * M, K)).astype(np.float32)   # 4 payloads
+    small = rng.standard_normal((M, K)).astype(np.float32)     # 1 payload
+    other = rng.standard_normal((M, K)).astype(np.float32)
+    reg = _registry(mesh, cost_weight=10.0)
+    reg.accountant.budget = 5 * PAYLOAD  # big + small fit; +other does not
+    h_big = reg.register("big", big)
+    h_small = reg.register("small", small)
+    h_other = reg.register("other", other)
+    x = _x()
+    h_big(x)    # big is LEAST recent...
+    h_small(x)
+    h_other(x)  # needs a victim: LRU says big; cost-aware says small
+    tenants = reg.health()["tenants"]
+    assert tenants["big"]["resident"], "cost-aware policy evicted the 4x payload"
+    assert not tenants["small"]["resident"]
+    assert tenants["small"]["evictions"] == 1
+    reg.close()
+
+
+def test_pinned_tenant_never_evicted(mesh):
+    mats = _mats(3)
+    reg = _registry(mesh, budget_tenants=1)
+    handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+    reg.pin("t0")
+    x = _x()
+    y0 = handles["t0"](x)
+    handles["t1"](x)  # soft overshoot: the only resident tenant is pinned
+    handles["t2"](x)
+    h = reg.health()
+    assert h["tenants"]["t0"]["resident"] and h["tenants"]["t0"]["pinned"]
+    assert h["tenants"]["t0"]["evictions"] == 0
+    assert h["hbm"]["overshoots"] > 0, (
+        "a full budget of pinned tenants must admit as a COUNTED "
+        "overshoot, not refuse or deadlock"
+    )
+    reg.unpin("t0")
+    handles["t1"](x)
+    handles["t2"](x)
+    assert reg.health()["tenants"]["t0"]["evictions"] >= 1, (
+        "unpinning must return the tenant to the eviction pool"
+    )
+    assert np.array_equal(handles["t0"](x), y0)
+    reg.close()
+
+
+# ------------------------------------------------------------------ quotas
+
+
+def test_quota_exceeded_fails_future_typed_and_before_dispatch(mesh):
+    mats = _mats(1)
+    reg = _registry(mesh)
+    handle = reg.register(
+        "t0", mats["t0"], quota=TenantQuota(max_in_flight=2)
+    )
+    x = _x()
+    dispatches_counter = reg.metrics.counter("engine_dispatches_total")
+    f1, f2 = handle.submit(x), handle.submit(x)
+    before = dispatches_counter.value
+    f3 = handle.submit(x)
+    err = f3.exception()
+    assert isinstance(err, TenantQuotaError)
+    with pytest.raises(TenantQuotaError):
+        f3.result()
+    assert dispatches_counter.value == before, (
+        "quota refusal must fail the future BEFORE any dispatch"
+    )
+    stats = reg.tenant_stats("t0")
+    assert stats["quota_rejections"] == 1
+    # Materializing drains the outstanding window: admission reopens.
+    f1.result(), f2.result()
+    assert isinstance(handle(x), np.ndarray)
+    reg.close()
+
+
+def test_quota_burst_cannot_evict_neighbors(mesh):
+    """The admission-control isolation claim: a tenant hammering its
+    quota generates rejections, not eviction pressure — the resident
+    neighbor set is untouched."""
+    mats = _mats(3)
+    reg = _registry(mesh, budget_tenants=2)
+    handles = {
+        tid: reg.register(
+            tid, a,
+            quota=TenantQuota(max_in_flight=1) if tid == "t0" else None,
+        )
+        for tid, a in mats.items()
+    }
+    x = _x()
+    handles["t1"](x)
+    handles["t2"](x)  # budget now full with t1, t2
+    held = handles["t0"].submit(x)  # t0 admitted: evicts one neighbor
+    evictions_after_admit = reg.metrics.counter(
+        "registry_evictions_total"
+    ).value
+    rejected = [handles["t0"].submit(x) for _ in range(5)]
+    assert all(
+        isinstance(f.exception(), TenantQuotaError) for f in rejected
+    )
+    assert reg.metrics.counter(
+        "registry_evictions_total"
+    ).value == evictions_after_admit, (
+        "quota-rejected submits must exert zero eviction pressure"
+    )
+    held.result()
+    reg.close()
+
+
+def test_register_refuses_payload_over_quota(mesh):
+    reg = _registry(mesh)
+    with pytest.raises(TenantQuotaError):
+        reg.register(
+            "t0", _mats(1)["t0"],
+            quota=TenantQuota(max_resident_bytes=PAYLOAD // 2),
+        )
+    assert reg.tenant_ids() == []
+    reg.close()
+
+
+# ---------------------------------------------------------------- isolation
+
+
+def test_chaos_on_one_tenant_leaves_neighbors_at_full_availability(mesh):
+    """The isolation gate: persistent retryable faults on tenant t0
+    (every config level, so the ladder cannot save it) under a binding
+    budget. Neighbors: 100% availability, bitwise-exact results; and
+    the eviction count equals the admission-sequence LRU replay —
+    t0's retries re-admitted nothing."""
+    mats = _mats(4)
+    x = _x()
+    solo = _registry(mesh)
+    ref = {tid: solo.register(tid, a)(x) for tid, a in mats.items()}
+    solo.close()
+
+    plan = FaultPlan(
+        [FaultSpec(site="dispatch", kind="device_error", key="t0/*")],
+        seed=3,
+    )
+    policy = ResiliencePolicy(retry=RetryPolicy(max_attempts=3, seed=3))
+    reg = _registry(
+        mesh, budget_tenants=2, fault_plan=plan, resilience=policy,
+    )
+    handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+    reg.warmup(widths=[1])
+    rng = np.random.default_rng(5)
+    seq = rng.choice(4, size=60, p=[0.4, 0.3, 0.2, 0.1])
+    failed = {tid: 0 for tid in mats}
+    served = {tid: 0 for tid in mats}
+    for t in seq:
+        tid = f"t{t}"
+        try:
+            y = handles[tid](x)
+        except Exception:
+            failed[tid] += 1
+            continue
+        served[tid] += 1
+        assert np.array_equal(y, ref[tid]), f"{tid} drifted under chaos"
+    assert failed["t0"] == served["t0"] == 0 or failed["t0"] > 0
+    assert failed["t0"] == int(np.sum(seq == 0)), (
+        "every t0 request must fail (faults on every ladder level)"
+    )
+    for tid in ("t1", "t2", "t3"):
+        assert failed[tid] == 0, (
+            f"{tid} lost availability to t0's chaos: isolation broken"
+        )
+    h = reg.health()
+    retries = reg.metrics.counter("resil_retries_total").value
+    assert retries > 0, "retryable faults must actually retry"
+    evictions = sum(s["evictions"] for s in h["tenants"].values())
+    # LRU replay of the same ADMISSION sequence (t0's submits still
+    # admit residency before their dispatch fails): equality proves the
+    # retries and ladder walks forced zero additional evictions.
+    sim_capacity = 2
+    resident, sim_evictions = [], 0
+    for t in seq:
+        if t in resident:
+            resident.remove(t)
+        elif len(resident) >= sim_capacity:
+            resident.pop(0)
+            sim_evictions += 1
+        resident.append(t)
+    assert evictions == sim_evictions, (
+        "evictions attributable to the faulty tenant's retries"
+    )
+    # Fault targeting was tenant-scoped: only t0's labels matched.
+    matched = plan.summary()["specs"][0]["matched"]
+    assert matched >= failed["t0"]
+    reg.close()
+
+
+def test_fault_patterns_tenant_scoped_and_base_compat(mesh):
+    """`tenant/...` patterns target one tenant; classic un-prefixed
+    patterns keep matching EVERY tenant via the base label."""
+    mats = _mats(2)
+    x = _x()
+    scoped = FaultPlan(
+        [FaultSpec(site="dispatch", kind="device_error", key="t1/*")],
+        seed=0,
+    )
+    reg = _registry(mesh, fault_plan=scoped)
+    h0 = reg.register("t0", mats["t0"])
+    h1 = reg.register("t1", mats["t1"])
+    assert isinstance(h0(x), np.ndarray)
+    with pytest.raises(Exception):
+        h1(x)
+    assert scoped.summary()["specs"][0]["matched"] == 1
+    reg.close()
+
+    base = FaultPlan(
+        [FaultSpec(
+            site="dispatch", kind="device_error", key="matvec:rowwise:*",
+        )],
+        seed=0,
+    )
+    reg2 = _registry(mesh, fault_plan=base)
+    g0 = reg2.register("t0", mats["t0"])
+    g1 = reg2.register("t1", mats["t1"])
+    for g in (g0, g1):
+        with pytest.raises(Exception):
+            g(x)
+    assert base.summary()["specs"][0]["matched"] == 2, (
+        "un-prefixed patterns must keep matching tenant-scoped labels"
+    )
+    reg2.close()
+
+
+# --------------------------------------------------- accounting (satellite)
+
+
+def test_degraded_dispatch_footprint_is_accounted(mesh):
+    """The PR 8 blind spot, closed: the degradation ladder's lazy
+    native-A placement must be charged to its tenant — a degraded
+    quantized tenant visibly holds payload + native bytes, and eviction
+    releases BOTH."""
+    a = _mats(1)["t0"]
+    x = _x()
+    plan = FaultPlan(
+        [FaultSpec(
+            site="dispatch", kind="device_error", key="*int8c",
+            retryable=False, times=1,
+        )],
+        seed=0,
+    )
+    policy = ResiliencePolicy(retry=RetryPolicy(max_attempts=1, seed=0))
+    reg = _registry(
+        mesh, fault_plan=plan, resilience=policy, dtype_storage="int8c",
+    )
+    handle = reg.register("t0", a)
+    y = handle(x)  # quantized config faults once -> native safe tier
+    assert np.isfinite(y).all()
+    stats = reg.tenant_stats("t0")
+    payload = stats["payload_bytes"]
+    assert payload < a.nbytes  # quantized residency really is smaller
+    assert stats["resident_bytes"] == payload + a.nbytes, (
+        "the ladder's native safe tier allocated device memory outside "
+        "the accountant: a degraded dispatch silently doubled the "
+        "tenant's footprint"
+    )
+    assert reg.health()["tenants"]["t0"]["native_fallback_resident"]
+    assert reg.metrics.counter(
+        "registry_native_fallback_charges_total"
+    ).value == 1
+    # Eviction releases the WHOLE footprint (payload + fallback).
+    reg._entry("t0").engine.release_residency()
+    assert reg.tenant_stats("t0")["resident_bytes"] == 0
+    assert reg.health()["hbm"]["charged_bytes"] == 0
+    # Re-admission serves through the healthy quantized config again
+    # (the fault spec was times=1) — bitwise equal to an unconstrained
+    # quantized run, NOT to `y` (which the native tier served).
+    solo = _registry(mesh, dtype_storage="int8c")
+    ref = solo.register("t0", a)(x)
+    solo.close()
+    assert np.array_equal(handle(x), ref)
+    reg.close()
+
+
+def test_hbm_ledger_follows_actual_placements(mesh):
+    mats = _mats(2)
+    reg = _registry(mesh)
+    reg.register("t0", mats["t0"])
+    reg.register("t1", mats["t1"])
+    assert reg.health()["hbm"]["charged_bytes"] == 0, (
+        "registration must not spend HBM (lazy admission)"
+    )
+    x = _x()
+    reg.submit("t0", x).result()
+    assert reg.health()["hbm"]["charged_bytes"] == PAYLOAD
+    reg.submit("t1", x).result()
+    assert reg.health()["hbm"]["charged_bytes"] == 2 * PAYLOAD
+    assert reg.health()["hbm"]["per_tenant"] == {
+        "t0": PAYLOAD, "t1": PAYLOAD,
+    }
+    reg.unregister("t0")
+    assert reg.health()["hbm"]["charged_bytes"] == PAYLOAD
+    reg.close()
+
+
+# ----------------------------------------------------------------- lifecycle
+
+
+def test_close_idempotent_with_failed_in_flight_futures(mesh):
+    mats = _mats(3)
+    plan = FaultPlan(
+        [FaultSpec(site="dispatch", kind="device_error", key="t1/*")],
+        seed=0,
+    )
+    reg = _registry(mesh, budget_tenants=2, fault_plan=plan)
+    handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+    x = _x()
+    ok = handles["t0"].submit(x)
+    with pytest.raises(Exception):
+        handles["t1"].submit(x)  # injected dispatch failure in flight
+    held = handles["t2"].submit(x)  # never materialized
+    reg.close()
+    reg.close()  # idempotent
+    with pytest.raises(ConfigError):
+        reg.submit("t0", x)
+    with pytest.raises(ConfigError):
+        reg.register("t9", mats["t0"])
+    # Futures dispatched before close still materialize (refcounts).
+    assert np.isfinite(ok.result()).all()
+    assert np.isfinite(held.result()).all()
+
+
+def test_shared_executables_compile_once_across_tenants(mesh):
+    mats = _mats(3)
+    reg = _registry(mesh)
+    for tid, a in mats.items():
+        reg.register(tid, a)
+    assert reg.warmup(widths=[1]) == 1, (
+        "same-signature tenants must share one compiled executable set"
+    )
+    compiles = reg.metrics.counter("engine_compiles_total")
+    x = _x()
+    for tid in mats:
+        reg.submit(tid, x).result()
+    assert compiles.value == 1
+    reg.close()
+
+
+def test_exec_signature_distinguishes_callable_kernels(mesh):
+    """Two DIFFERENT custom-kernel callables (which share a __name__)
+    must not collide on one shared executable cache — a tenant must
+    never serve another tenant's compiled program."""
+    a = _mats(1)["t0"]
+
+    def make_kernel(scale):
+        def kernel(a_blk, x_loc):
+            return (a_blk * scale) @ x_loc
+        return kernel
+
+    reg = _registry(mesh)
+    e1 = reg.register("t1", a, kernel=make_kernel(1.0)).engine
+    e2 = reg.register("t2", a, kernel=make_kernel(2.0)).engine
+    assert e1.exec_signature() != e2.exec_signature()
+    assert e1._cache is not e2._cache
+    # Same STRING kernel still shares.
+    e3 = reg.register("t3", a).engine
+    e4 = reg.register("t4", a).engine
+    assert e3.exec_signature() == e4.exec_signature()
+    assert e3._cache is e4._cache
+    reg.close()
+
+
+def test_registration_validation(mesh):
+    reg = _registry(mesh)
+    a = _mats(1)["t0"]
+    for bad in ("", "a/b", "a:b", "a,b", "a b", 'a"b', "a*"):
+        with pytest.raises(ConfigError):
+            reg.register(bad, a)
+    reg.register("ok-tenant.1_x", a)
+    with pytest.raises(ConfigError):
+        reg.register("ok-tenant.1_x", a)  # duplicate
+    with pytest.raises(ConfigError):
+        reg.register("t2", a, metrics=None)  # registry-owned kwarg
+    with pytest.raises(ConfigError):
+        MatrixRegistry(mesh, retain_host=True)  # reserved default
+    with pytest.raises(ConfigError):
+        reg.submit("nope", _x())
+    reg.close()
+
+
+def test_quota_and_budget_validation():
+    with pytest.raises(ConfigError):
+        TenantQuota(max_in_flight=0)
+    with pytest.raises(ConfigError):
+        TenantQuota(max_resident_bytes=0)
+    assert parse_hbm_budget(None, 100) is None
+    assert parse_hbm_budget("2.5x", 100) == 250
+    assert parse_hbm_budget("4096", 100) == 4096
+    assert parse_hbm_budget("0", 100) is None
+    with pytest.raises(ConfigError):
+        parse_hbm_budget("-1x", 100)
+    assert parse_tenant_quota(None) is None
+    assert parse_tenant_quota("4") == 4
+    assert parse_tenant_quota("tenant-0=4,tenant-2=8") == {
+        "tenant-0": 4, "tenant-2": 8,
+    }
+    with pytest.raises(ConfigError):
+        parse_tenant_quota("tenant-0=4,oops")
+
+
+def test_lru_floor_simulation():
+    # hits: t0 miss, t0 hit, t1 miss, t0 hit, t2 miss evicts t1,
+    # t1 miss evicts t0, t0 miss.
+    seq = [0, 0, 1, 0, 2, 1, 0]
+    assert lru_hit_floor(seq, capacity=2) == pytest.approx(2 / 7)
+    assert lru_hit_floor(seq, capacity=None) == pytest.approx(4 / 7)
+    # A pinned tenant always hits and consumes one slot.
+    assert lru_hit_floor([0, 1, 2, 1], capacity=2, pinned=[0]) == (
+        pytest.approx(1 / 4)
+    )
+    # capacity 0 is a REAL sub-payload budget (every unpinned access
+    # misses), distinct from None (unlimited).
+    assert lru_hit_floor([0, 1, 0], capacity=0) == 0.0
+    assert lru_hit_floor([0, 1, 0], capacity=0, pinned=[0]) == (
+        pytest.approx(2 / 3)
+    )
+
+
+def test_scheduler_flush_racing_eviction_self_heals(mesh):
+    """A coalescing scheduler stacked on one tenant's engine bypasses
+    the registry's admission path; a flush landing after that tenant's
+    eviction must re-place the residency transparently (the dispatch-
+    path self-heal) with the accounting intact — bitwise results, the
+    re-placement charged to the tenant."""
+    from matvec_mpi_multiplier_tpu import ArrivalWindowScheduler
+
+    mats = _mats(2)
+    reg = _registry(mesh, budget_tenants=1, promote=4)
+    h0 = reg.register("t0", mats["t0"])
+    h1 = reg.register("t1", mats["t1"])
+    x = _x()
+    ref0 = h0(x)
+    sched = ArrivalWindowScheduler(h0.engine, window_ms=5.0)
+    try:
+        h1(x)  # evicts t0
+        assert not reg.health()["tenants"]["t0"]["resident"]
+        futs = [sched.submit(x) for _ in range(3)]
+        assert all(np.array_equal(f.result(), ref0) for f in futs)
+        h = reg.health()
+        assert h["tenants"]["t0"]["resident"]
+        # The self-healed placement was charged (a counted overshoot —
+        # the scheduler path cannot evict on the registry's behalf).
+        assert h["hbm"]["charged_bytes"] == 2 * PAYLOAD
+        assert h["hbm"]["overshoots"] >= 1
+    finally:
+        sched.close()
+        reg.close()
+
+
+# --------------------------------------------------------------------- obs
+
+
+def test_tenants_panel_renders_registry_metrics(mesh):
+    from matvec_mpi_multiplier_tpu.obs.__main__ import (
+        render_metrics,
+        render_tenants,
+    )
+
+    mats = _mats(3)
+    reg = _registry(mesh, budget_tenants=2)
+    handles = {tid: reg.register(tid, a) for tid, a in mats.items()}
+    reg.pin("t0")
+    x = _x()
+    for tid in ("t0", "t1", "t2", "t1", "t0"):
+        handles[tid](x)
+    snap = reg.metrics.snapshot()
+    panel = render_tenants(snap)
+    assert panel is not None and panel.startswith("tenants:")
+    for tid in mats:
+        assert tid in panel
+    assert "hit rate" in panel and "quota rejections" in panel
+    assert panel in render_metrics(snap)
+    # Health mirrors the same vocabulary.
+    h = reg.health()
+    assert set(h["tenants"]) == set(mats)
+    for stat in h["tenants"].values():
+        for key in (
+            "resident", "resident_bytes", "pinned", "requests", "hits",
+            "evictions", "evictions_caused", "quota_rejections",
+            "breakers_open", "degraded",
+        ):
+            assert key in stat
+    reg.close()
+    # A single-tenant snapshot has no registry vocabulary: panel absent.
+    assert render_tenants({"counters": {}, "gauges": {}}) is None

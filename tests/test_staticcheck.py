@@ -135,6 +135,24 @@ RULE_FIXTURES = {
         "def widen(scales):\n"
         "    return scales.astype(np.float64)  # quant-ok: seeded deliberate f64 staging\n",
     ),
+    "device-transfer-under-registry-lock": (
+        f"{PKG}/engine/registry.py",
+        # the swap-in under the held registry mutex: one tenant's
+        # device_put freezes every other tenant's admission
+        "import jax\n"
+        "class Registry:\n"
+        "    def admit(self, entry, payload, sharding):\n"
+        "        with self._lock:\n"
+        "            self._plan(entry)\n"
+        "            entry.a = jax.device_put(payload, sharding)\n",
+        # the discipline: plan victims under the lock, place after release
+        "import jax\n"
+        "class Registry:\n"
+        "    def admit(self, entry, payload, sharding):\n"
+        "        with self._lock:\n"
+        "            self._plan(entry)\n"
+        "        entry.a = jax.device_put(payload, sharding)\n",
+    ),
     "scheduler-lock-across-dispatch": (
         f"{PKG}/engine/scheduler.py",
         # dispatch under the held admission lock: a backpressure stall
