@@ -1531,8 +1531,16 @@ class MatvecEngine:
         def _val(counter) -> int:
             return counter.value if counter is not None else 0
 
+        # Sustained predicted-vs-measured divergence of the tuning cost
+        # model (tuning/cost_model.py): a regression signal — either the
+        # machine drifted from its calibration or a schedule's real cost
+        # changed. Read off the process default registry (the tuner's
+        # emitter), not this engine's: tuning races run process-wide.
+        from ..tuning.cost_model import divergence_health
+
         return {
             "resilience": self._resilience is not None,
+            "cost_model": divergence_health(),
             "integrity_gate": self.integrity_gate,
             "storage": {
                 "format": self.storage,

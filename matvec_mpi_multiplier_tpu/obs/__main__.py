@@ -240,6 +240,48 @@ def render_resilience(snapshot: dict) -> str | None:
     return "\n".join(out)
 
 
+def render_cost_model(snapshot: dict) -> str | None:
+    """The cost model panel: predicted-vs-measured agreement of the
+    tuning cost model (``tuning/cost_model.py``; docs/COST_MODEL.md),
+    read off the ``tuning_predicted_vs_measured_ratio`` histogram, the
+    divergence gauge, and the pruning/stale counters. None when the
+    snapshot carries no prediction vocabulary (an uncalibrated run)."""
+    hists = snapshot.get("histograms", {})
+    ratio = hists.get("tuning_predicted_vs_measured_ratio")
+    if ratio is None:
+        return None
+    counters = snapshot.get("counters", {})
+    gauges = snapshot.get("gauges", {})
+    divergence = gauges.get("tuning_cost_model_divergence", float("nan"))
+    # Threshold and min-sample gate mirror cost_model.DIVERGENCE_LOG10 /
+    # DIVERGENCE_MIN_SAMPLES (not imported: this CLI renders snapshots
+    # from other runs; the numbers are the contract). The sample gate
+    # keeps this panel's verdict consistent with health() — one noisy
+    # candidate is not a regression.
+    n_samples = ratio.get("count", 0)
+    if n_samples < 8:
+        verdict = "warming"
+    elif divergence > 1.0:
+        verdict = "DIVERGENT"
+    else:
+        verdict = "ok"
+    out = [
+        "cost model:",
+        f"  predictions       {ratio.get('count', 0)} candidates "
+        "(predicted/measured ratio)",
+        f"  ratio p50         {ratio.get('p50', float('nan')):.3f} "
+        f"(p95 {ratio.get('p95', float('nan')):.3f})",
+        f"  divergence        {divergence:.3f} median |log10 ratio| "
+        f"[{verdict}, threshold 1.0]",
+        f"  pruned            "
+        f"{counters.get('tuning_pruned_candidates_total', 0)} candidates "
+        "skipped by prediction (each one logged)",
+        f"  stale re-measures "
+        f"{counters.get('tuning_cache_stale_total', 0)}",
+    ]
+    return "\n".join(out)
+
+
 def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     """Human-readable (or Prometheus text) rendering of a snapshot dict.
     Snapshots carrying batching-scheduler metrics get the ``batching``
@@ -277,6 +319,9 @@ def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     storage = render_storage(snapshot)
     if storage is not None:
         out.append(storage)
+    cost_model = render_cost_model(snapshot)
+    if cost_model is not None:
+        out.append(cost_model)
     tenants = render_tenants(snapshot)
     if tenants is not None:
         out.append(tenants)

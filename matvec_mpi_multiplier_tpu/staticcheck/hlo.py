@@ -403,20 +403,64 @@ def early_dequant_findings(
     return findings
 
 
-def expected_schedule(
-    cfg: AuditConfig, mesh
-) -> tuple[dict[str, int], dict[str, int]]:
-    """The structural formula: what each schedule must issue, derived from
-    the mesh (p devices, (r, c) grid) and the audit operand — the second,
-    golden-independent pin on the census. An ``overlap@S`` entry is by
-    construction S chunked collectives at 1/S of the un-staged bytes."""
-    from ..parallel.mesh import mesh_grid_shape
+def dtype_itemsize(dtype: str) -> int:
+    """Bytes per element for the census dtype names (the same table the
+    byte accounting uses) — shared with the cost model so both sides size
+    payloads identically."""
+    return _ITEMSIZE[dtype]
 
-    p = int(mesh.devices.size)
-    r, _c = mesh_grid_shape(mesh)
-    m = AUDIT_M
-    itemsize = _ITEMSIZE[AUDIT_DTYPE]
-    s = cfg.stages or 1
+
+def storage_bytes_ratio(
+    storage: str, itemsize: int, block: int = 128
+) -> float:
+    """Structural resident-A byte ratio of a storage format against the
+    native ``itemsize``-per-element stream: one payload byte plus one fp32
+    scale per ``block``-element group (docs/QUANTIZATION.md derives it),
+    doubled for the compensated pair. This is the symbolic face of the
+    audit's artifact-read ``a_bytes_ratio`` — the two agree on the
+    committed golden table within rounding (pinned in
+    tests/test_cost_model.py), and the analytic cost model
+    (``tuning/cost_model.py``) sizes quantized residencies from it."""
+    if storage == "native":
+        return 1.0
+    if storage not in ("int8", "int8c", "fp8"):
+        raise KeyError(f"no storage byte formula for {storage!r}")
+    per_elem = 1.0 + 4.0 / block
+    if storage == "int8c":
+        per_elem *= 2.0
+    return per_elem / itemsize
+
+
+def schedule_formula(
+    strategy: str,
+    combine: str,
+    stages: int | None,
+    *,
+    m: int,
+    p: int,
+    r: int,
+    itemsize: int,
+) -> tuple[dict[str, int], dict[str, int]]:
+    """The per-config collective census and per-device payload bytes as a
+    SYMBOLIC function of the operand and mesh — ``(census, payload_bytes)``
+    keyed by collective kind.
+
+    This is the single source of truth for what each schedule issues:
+    :func:`expected_schedule` evaluates it at the audit operand to pin the
+    golden table, and the analytic cost model
+    (``tuning/cost_model.py``) evaluates it over arbitrary (m, p, dtype)
+    to predict combine crossovers — so a formula perturbation reddens both
+    (the mutation test in tests/test_cost_model.py). Payloads are the
+    operand bytes each op presents per device (the census's accounting);
+    the wire factor — e.g. 2(p−1)/p for a ring all-reduce — is the cost
+    model's to apply, not the schedule's. An ``overlap@S`` entry is by
+    construction S chunked collectives at 1/S of the un-staged bytes
+    (same total — the staging invariant the audit enforces).
+
+    ``r`` is the blockwise grid's row count (``mesh_grid_shape``); the 1-D
+    strategies ignore it. Raises ``KeyError`` for a (strategy, combine)
+    pair no formula covers."""
+    s = stages or 1
 
     def entry(**kinds: tuple[int, int]):
         # each kind: (op count, elements per op)
@@ -424,7 +468,7 @@ def expected_schedule(
         payload = {k: n * e * itemsize for k, (n, e) in kinds.items()}
         return census, payload
 
-    strat, comb = cfg.strategy, cfg.combine
+    strat, comb = strategy, combine
     if strat in ("rowwise", "colwise"):
         if comb == "gather":
             # with_sharding_constraint: GSPMD's all-gather, invisible to
@@ -466,7 +510,28 @@ def expected_schedule(
                 "all-reduce": (s, m // (r * s)),
                 "collective-permute": (s * (r - 1), m // (r * s)),
             })
-    raise KeyError(f"no expected-schedule formula for {cfg.key}")
+    staged = f"@{stages}" if stages is not None else ""
+    raise KeyError(
+        f"no schedule formula for {strategy}|{combine}{staged}"
+    )
+
+
+def expected_schedule(
+    cfg: AuditConfig, mesh
+) -> tuple[dict[str, int], dict[str, int]]:
+    """The structural formula evaluated at the audit operand: what each
+    audited config must issue, derived from the mesh (p devices, (r, c)
+    grid) — the second, golden-independent pin on the census. Thin
+    adapter over :func:`schedule_formula` (the symbolic single source of
+    truth the cost model shares)."""
+    from ..parallel.mesh import mesh_grid_shape
+
+    p = int(mesh.devices.size)
+    r, _c = mesh_grid_shape(mesh)
+    return schedule_formula(
+        cfg.strategy, cfg.combine, cfg.stages,
+        m=AUDIT_M, p=p, r=r, itemsize=_ITEMSIZE[AUDIT_DTYPE],
+    )
 
 
 def lowering_fingerprint(lowered) -> str:

@@ -30,6 +30,7 @@ from .cache import (
     CACHE_VERSION,
     TuningCache,
     broadcast_decisions,
+    calibration_key,
     combine_key,
     default_cache_path,
     gemm_key,
@@ -45,6 +46,7 @@ __all__ = [
     "CACHE_VERSION",
     "TuningCache",
     "broadcast_decisions",
+    "calibration_key",
     "combine_key",
     "default_cache_path",
     "gemm_key",
@@ -61,6 +63,7 @@ __all__ = [
     "lookup_promotion",
     "lookup_overlap",
     "lookup_storage",
+    "lookup_calibration",
 ]
 
 # The dispatch-side singleton: loaded lazily on first lookup so importing
@@ -147,6 +150,14 @@ def lookup_storage(
     ``storage`` names the measured winner; ``resident_bytes`` and
     ``bandwidth_gbps`` record why."""
     return get_cache().lookup(storage_key(strategy, m, k, p, dtype))
+
+
+def lookup_calibration(*, p: int) -> dict[str, Any] | None:
+    """The recorded cost-model calibration for a ``p``-device mesh of
+    this platform, or None — the tuner's ``prune_margin`` question
+    (``cost_model.model_from_cache`` wraps it into a :class:`CostModel`;
+    a miss means every axis measures exhaustively)."""
+    return get_cache().lookup(calibration_key(p))
 
 
 def lookup_overlap(

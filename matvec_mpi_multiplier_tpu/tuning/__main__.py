@@ -61,6 +61,22 @@ def build_parser() -> argparse.ArgumentParser:
         "winner (default 0.05; raise it on noisy shared hosts so "
         "measurement noise can't unseat the default)",
     )
+    p.add_argument(
+        "--prune-margin",
+        type=float,
+        default=None,
+        help="cost-model pruning: measure only candidates predicted "
+        "within this relative margin of the predicted winner "
+        "(docs/COST_MODEL.md; needs a calibration record — see "
+        "--calibrate — else falls back to exhaustive measurement)",
+    )
+    p.add_argument(
+        "--calibrate",
+        choices=["full", "quick"],
+        default=None,
+        help="run the cost-model probe protocol on each mesh first and "
+        "persist the calibration records (cache schema v5)",
+    )
     p.add_argument("--cache", default=None, help="cache file path override")
     p.add_argument("--platform", default=None)
     p.add_argument("--host-devices", type=int, default=None)
@@ -105,6 +121,16 @@ def main(argv: list[str] | None = None) -> int:
     cache = TuningCache.load(args.cache)
     print(f"tuning cache: {cache.path} ({len(cache)} entries)")
     print(f"platform fingerprint: {platform_fingerprint()}")
+    if args.calibrate is not None:
+        from .cache import calibration_key
+        from .cost_model import calibrate
+
+        for mesh in meshes:
+            cal = calibrate(mesh, level=args.calibrate)
+            cache.record(
+                calibration_key(int(mesh.devices.size)), cal.to_record()
+            )
+        cache.save()
     tune_sweep(
         strategies, sizes, meshes, args.dtype, cache,
         op=args.op, n_rhs=args.n_rhs, measure=args.measure,
@@ -112,6 +138,7 @@ def main(argv: list[str] | None = None) -> int:
         samples=args.samples or TUNE_SAMPLES,
         force=args.force, seed=args.seed,
         min_gain=args.min_gain if args.min_gain is not None else TUNE_MIN_GAIN,
+        prune_margin=args.prune_margin,
     )
     path = cache.save()
     reset_cache()  # same-process callers re-read the fresh decisions

@@ -11,10 +11,10 @@ harmless on another (its entries simply never match, so dispatch falls back
 to the static defaults and a ``--tune`` run re-measures), and a single file
 can carry tunings for several platforms side by side.
 
-Schema (version 4)::
+Schema (version 5)::
 
     {
-      "version": 4,
+      "version": 5,
       "entries": {
         "<fingerprint>|gemv|<m>x<k>|<dtype>":
             {"kernel": "pallas", "bm": 512, "bk": 2048,
@@ -30,10 +30,22 @@ Schema (version 4)::
         "<fingerprint>|storage|<strategy>|<m>x<k>|p<p>|<dtype>":
             {"storage": "int8", "time_s": ..., "candidates": {...},
              "resident_bytes": {"native": ..., "int8": ...},
-             "bandwidth_gbps": {...}}
+             "bandwidth_gbps": {...}},
+        "<fingerprint>|calibration|p<p>":
+            {"flops": 1.2e10, "mem_bps": 8.5e9,
+             "alpha_s": {"collective": ..., "permute": ...},
+             "beta_bps": {"collective": ..., "permute": ...},
+             "p": 8, "level": "full", "probes": {...}}
       }
     }
 
+Version 5 over 4: the ``calibration`` kind records the analytic cost
+model's machine constants — achievable FLOP/s, local resident-stream
+bandwidth, and the per-collective α (launch latency) / β (link
+bandwidth) pair — measured by ``cost_model.calibrate``'s probe protocol
+and consulted by the tuner's ``prune_margin`` mode and the prediction
+CLI (``tuning/cost_model.py``; docs/COST_MODEL.md). The raw probe
+times ride along so a reader can see where the constants came from.
 Version 4 over 3: the ``storage`` kind records the measured resident-A
 storage format (``native`` / ``int8`` / ``int8c`` / ``fp8`` — the sixth
 tuned axis, ``search.tune_storage``, raced by wall clock with each
@@ -72,12 +84,12 @@ import tempfile
 from pathlib import Path
 from typing import Any
 
-CACHE_VERSION = 4
-# Versions load() accepts: v1-v3 entries are strict subsets of v4's (no
-# storage kind; v1/v2 also no overlap/promote kinds or gemm tile fields),
-# so an old cache keeps serving its decisions after the upgrade instead of
-# forcing a silent full re-tune.
-COMPATIBLE_VERSIONS = (1, 2, 3, CACHE_VERSION)
+CACHE_VERSION = 5
+# Versions load() accepts: v1-v4 entries are strict subsets of v5's (no
+# calibration kind; v1-v3 also no storage kind; v1/v2 no overlap/promote
+# kinds or gemm tile fields), so an old cache keeps serving its decisions
+# after the upgrade instead of forcing a silent full re-tune.
+COMPATIBLE_VERSIONS = (1, 2, 3, 4, CACHE_VERSION)
 CACHE_ENV = "MATVEC_TUNING_CACHE"
 CACHE_FILENAME = "tuning_cache.json"
 
@@ -185,6 +197,18 @@ def storage_key(
     residency."""
     fp = fingerprint if fingerprint is not None else platform_fingerprint()
     return f"{fp}|storage|{strategy}|{m}x{k}|p{p}|{dtype}"
+
+
+def calibration_key(p: int, fingerprint: str | None = None) -> str:
+    """Key for a cost-model calibration record (the seventh cache kind —
+    schema v5): the machine constants ``cost_model.calibrate`` measured on
+    a ``p``-device mesh of this platform. Keyed by mesh size because the
+    collective α/β constants are measured against a concrete device
+    topology (a 2-device probe says nothing about 8-device rendezvous
+    cost); the fingerprint carries platform + device kind + JAX version
+    like every other kind."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|calibration|p{p}"
 
 
 class TuningCache:

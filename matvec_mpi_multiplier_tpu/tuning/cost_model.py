@@ -1,0 +1,753 @@
+"""Analytic per-config cost model over the HLO auditor's byte census.
+
+The repo's tuner rediscovers the paper's combine crossovers by brute
+force: every ``tune_*`` axis races every candidate at dispatch time. But
+the collective cost of a schedule is well-predicted by an α–β model over
+payload bytes × hops and link bandwidth (the redistribution paper arXiv
+2112.01075 and GSPMD, arXiv 2105.04663 — PAPERS.md), and the staticcheck
+auditor already derives every config's exact per-device transfer bytes.
+This module turns that census into a calibrated time model:
+
+    T(cfg, m, k, b, p, dtype) = max(T_compute, T_wire) + T_latency
+
+* **T_compute** — the per-device kernel body: ``2·m·k·b/p`` FLOPs against
+  the calibrated achievable FLOP/s, or the resident-A stream
+  (``a_bytes_ratio × m·k·itemsize / p`` — quantized formats inherit their
+  structural byte ratio, ``staticcheck.hlo.storage_bytes_ratio``) against
+  the calibrated local bandwidth, whichever binds.
+* **T_wire** — the collective payload each kind moves
+  (``staticcheck.hlo.schedule_formula`` — the SAME symbolic formula the
+  golden-table audit pins, evaluated at the caller's (m, p, dtype)
+  instead of the audit operand), scaled by the standard α–β wire factor
+  (2(p−1)/p for all-reduce, (p−1)/p for gather/scatter/all-to-all, 1 for
+  a neighbor permute hop) over the calibrated per-link bandwidth β.
+* **T_latency** — op count × the calibrated per-collective launch
+  latency α. A staged ``overlap@S`` schedule therefore predicts the SAME
+  total wire bytes as its un-staged form (S chunks at 1/S bytes — the
+  audit's chunking invariant, property-tested) but S× the latency term:
+  exactly the trade the stage ladder measures.
+
+One census caveat inherited deliberately (staticcheck/hlo.py module
+docstring): ``gather`` combines lower their final all-gather at GSPMD
+compile time, invisibly to the census. The model adds that implicit
+gather explicitly (:func:`implicit_schedule`) so ``gather`` vs ``ring``
+rankings stay physical.
+
+**Calibration** (:func:`calibrate`): ~6 probe measurements under the
+repo's benchmark protocol (``bench.timing``) — a local GEMV (resident
+bandwidth), a local GEMM (FLOP/s), and small/large psum + ppermute pairs
+(per-family α from the small probe, β from the large pair's difference).
+The constants persist into the tuning cache as a ``calibration`` record
+(schema v5 — ``cache.calibration_key``), so predictions survive process
+restarts and travel with the measured decisions they explain. The
+``quick`` level (2 probes) is the tier-1 smoke's budget: crude absolute
+numbers, same candidate ranking.
+
+**Consumers**: the tuner's ``prune_margin`` mode (``search.py`` measures
+only candidates predicted within the ambiguity margin of the predicted
+winner, logging every pruned candidate); the prediction CLI (``python -m
+matvec_mpi_multiplier_tpu.tuning.cost_model`` emits the predicted
+combine-crossover surface over (m, k, p, dtype) as CSV —
+``data/cost_model_demo/``); and obs (every measured candidate records
+its prediction; :func:`record_prediction` feeds the
+``tuning_predicted_vs_measured_ratio`` histogram and the divergence
+gauge, :func:`divergence_health` surfaces sustained divergence as a
+regression signal in ``engine.health()``). docs/COST_MODEL.md is the
+operator's guide.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import math
+import sys
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from .cache import TuningCache, calibration_key
+
+# Candidate kept for measurement iff predicted within this relative margin
+# of the predicted winner (see search.py's prune_margin plumbing). 0.5 is
+# deliberately wide: the model's job is to rule out order-of-magnitude
+# losers (a 7-hop ring at m=64, an 8-stage pipeline of tiny chunks), not
+# to adjudicate near-ties — those stay measured, and the hysteresis
+# default seat is never pruned at all.
+PRUNE_MARGIN = 0.5
+
+# Sustained-divergence regression signal (divergence_health): median
+# |log10(predicted/measured)| over the observation window beyond this,
+# with at least MIN_SAMPLES observations, marks the model divergent —
+# either the machine changed (recalibrate) or a schedule regressed
+# (docs/COST_MODEL.md: reading a divergence alert).
+DIVERGENCE_LOG10 = 1.0
+DIVERGENCE_MIN_SAMPLES = 8
+
+# Metric names (the obs `cost model` panel and divergence_health read
+# these; search._record_candidate writes them).
+RATIO_HISTOGRAM = "tuning_predicted_vs_measured_ratio"
+DIVERGENCE_HISTOGRAM = "tuning_cost_model_abs_log10_ratio"
+DIVERGENCE_GAUGE = "tuning_cost_model_divergence"
+PRUNED_COUNTER = "tuning_pruned_candidates_total"
+
+_PERMUTE = "collective-permute"
+
+# Probe shapes (full calibration = 6 probes). Local probes sized to
+# dominate per-dispatch overhead without stretching a 1-core CI host;
+# collective probes small/large pairs so α and β separate.
+_GEMV_SHAPE = (1024, 4096)     # 16 MB fp32 resident stream
+_GEMM_SHAPE = (384, 384, 384)  # 113 MFLOP
+_COLL_SMALL = 256              # elements: latency-dominated
+_COLL_LARGE = 1 << 20          # elements: bandwidth-dominated
+_PERM_LARGE = 1 << 18
+
+
+@dataclasses.dataclass(frozen=True)
+class Calibration:
+    """The machine constants one probe pass measured (cache schema v5).
+
+    ``alpha_s``/``beta_bps`` are per collective *family*: ``"permute"``
+    (single neighbor hop — the ring schedules' primitive) vs
+    ``"collective"`` (the rendezvous kinds: all-reduce, all-gather,
+    reduce-scatter, all-to-all). ``probes`` keeps the raw measurements
+    the constants were derived from, so a cache reader can see why."""
+
+    flops: float                 # achievable FLOP/s per device
+    mem_bps: float               # local resident-stream bytes/s per device
+    alpha_s: dict[str, float]    # per-op launch latency by family
+    beta_bps: dict[str, float]   # per-link bandwidth by family
+    p: int                       # mesh size the collectives were probed on
+    level: str = "full"          # "full" (6 probes) | "quick" (2)
+    probes: dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def to_record(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_record(cls, record: dict[str, Any] | None) -> "Calibration | None":
+        """Rebuild from a cache record; None for a missing/malformed one
+        (an uncalibrated cache must read as 'no model', never crash)."""
+        if not isinstance(record, dict):
+            return None
+        try:
+            cal = cls(**{
+                f.name: record[f.name]
+                for f in dataclasses.fields(cls)
+                if f.name in record
+            })
+            # Validate INSIDE the try: a hand-edited record with, say, a
+            # string "flops" passes construction and would raise
+            # TypeError on the comparisons — which must read as
+            # no-model, not crash the tuning run.
+            if not (cal.flops > 0 and cal.mem_bps > 0):
+                return None
+            for fam in ("collective", "permute"):
+                if not (cal.alpha_s[fam] >= 0 and cal.beta_bps[fam] > 0):
+                    return None
+        except (TypeError, KeyError):
+            return None
+        return cal
+
+    @classmethod
+    def synthetic(cls, p: int = 8) -> "Calibration":
+        """Hardware-independent preview constants (a TPU-class device:
+        ~100 TFLOP/s MXU, ~1 TB/s HBM, ~50 GB/s ICI links, ~1 µs
+        collective launch). For exploring the predicted crossover surface
+        before any chip visit — the CLI's ``--synthetic-calibration``.
+        Never persisted to the cache: measured calibrations only."""
+        return cls(
+            flops=1.0e14, mem_bps=1.0e12,
+            alpha_s={"collective": 1.0e-6, "permute": 1.0e-6},
+            beta_bps={"collective": 5.0e10, "permute": 5.0e10},
+            p=p, level="synthetic", probes={},
+        )
+
+
+def family(kind: str) -> str:
+    """Census kind → calibration family (module docstring)."""
+    return "permute" if kind == _PERMUTE else "collective"
+
+
+def wire_factor(kind: str, p: int) -> float:
+    """The standard α–β wire-traffic factor: census payload bytes →
+    bytes actually crossing a link per device (ring algorithms — the
+    2112.01075 model). The census deliberately records operand bytes
+    and leaves this factor to the topology; here is where it lands."""
+    if p <= 1:
+        return 0.0
+    if kind == _PERMUTE:
+        return 1.0
+    if kind == "all-reduce":
+        return 2.0 * (p - 1) / p
+    # all-gather / reduce-scatter / all-to-all
+    return (p - 1) / p
+
+
+def implicit_schedule(
+    strategy: str, combine: str, *, m: int, itemsize: int
+) -> tuple[dict[str, int], dict[str, int]]:
+    """The GSPMD compile-time collective the census cannot see: ``gather``
+    combines end in a ``with_sharding_constraint`` that becomes an
+    all-gather of the sharded y only at compile time (staticcheck/hlo.py
+    census caveat). The model adds it back so gather-family predictions
+    carry their real communication instead of reading as free."""
+    if combine == "gather":
+        return {"all-gather": 1}, {"all-gather": m * itemsize}
+    return {}, {}
+
+
+@dataclasses.dataclass(frozen=True)
+class Prediction:
+    """One predicted config time, decomposed the way the model computed
+    it (the CLI's CSV columns; docs/COST_MODEL.md explains reading it)."""
+
+    total_s: float
+    compute_s: float
+    wire_s: float
+    latency_s: float
+    flops: float
+    a_bytes: int
+    wire_bytes: float
+
+
+class CostModel:
+    """Predict per-config dispatch time from one :class:`Calibration`.
+
+    Predictions are analytic in (m, k, b, p, dtype, storage): the mesh
+    size generalizes symbolically (the calibration's α/β were measured at
+    one p, the hop counts and wire factors come from the formula), which
+    is what makes the predicted crossover surface hardware-independent —
+    a chip visit then only validates the constants (ROADMAP)."""
+
+    def __init__(self, calibration: Calibration):
+        self.calibration = calibration
+
+    def predict_local(
+        self, m: int, k: int, dtype: str, *, b: int = 1,
+        storage: str = "native",
+    ) -> Prediction:
+        """The compute-only face: one device's GEMV/GEMM body (the
+        kernel axes' question — no mesh, no collectives)."""
+        return self.predict(
+            None, None, m=m, k=k, p=1, dtype=dtype, b=b, storage=storage
+        )
+
+    def predict(
+        self,
+        strategy: str | None,
+        combine: str | None,
+        *,
+        m: int,
+        k: int,
+        p: int,
+        dtype: str,
+        stages: int | None = None,
+        b: int = 1,
+        storage: str = "native",
+        r: int | None = None,
+    ) -> Prediction:
+        """``T(cfg, m, k, b, p, dtype)`` per the module-docstring model.
+        ``strategy=None`` (or p=1) predicts the bare local kernel.
+        ``r`` is the blockwise grid's row count; derived most-square from
+        p when omitted (``parallel.mesh.most_square_factors``)."""
+        # Imported at call time ON PURPOSE: the mutation test patches
+        # hlo.schedule_formula and must redden the model and the audit
+        # through the one shared symbol.
+        from ..staticcheck import hlo
+
+        cal = self.calibration
+        itemsize = hlo.dtype_itemsize(dtype)
+        census: dict[str, int] = {}
+        payload: dict[str, int] = {}
+        if strategy is not None and combine is not None and p > 1:
+            if r is None:
+                from ..parallel.mesh import most_square_factors
+
+                r, _c = most_square_factors(p)
+            census, payload = hlo.schedule_formula(
+                strategy, combine, stages, m=m, p=p, r=r, itemsize=itemsize
+            )
+            icensus, ipayload = implicit_schedule(
+                strategy, combine, m=m, itemsize=itemsize
+            )
+            census = {**census, **icensus}
+            payload = {**payload, **ipayload}
+
+        latency_s = sum(
+            n * cal.alpha_s[family(kind)] for kind, n in census.items()
+        )
+        wire_bytes = 0.0
+        wire_s = 0.0
+        for kind, bytes_ in payload.items():
+            # A batched (multi-RHS) dispatch moves the combine's payload
+            # once per RHS column: y is (m, b).
+            wb = float(bytes_) * b * wire_factor(kind, p)
+            wire_bytes += wb
+            wire_s += wb / cal.beta_bps[family(kind)]
+
+        a_bytes = int(round(
+            m * k * itemsize * hlo.storage_bytes_ratio(storage, itemsize)
+        ))
+        flops = 2.0 * m * k * b
+        compute_s = max(
+            (flops / p) / cal.flops,
+            (a_bytes / p) / cal.mem_bps,
+        )
+        total_s = max(compute_s, wire_s) + latency_s
+        return Prediction(
+            total_s=total_s, compute_s=compute_s, wire_s=wire_s,
+            latency_s=latency_s, flops=flops, a_bytes=a_bytes,
+            wire_bytes=wire_bytes,
+        )
+
+
+def model_from_cache(
+    cache: TuningCache, p: int, fingerprint: str | None = None
+) -> CostModel | None:
+    """The cached calibration for a p-device mesh of this platform, as a
+    model — or None (uncalibrated: pruning callers fall back to full
+    measurement, docs/COST_MODEL.md)."""
+    cal = Calibration.from_record(
+        cache.lookup(calibration_key(p, fingerprint))
+    )
+    return CostModel(cal) if cal is not None else None
+
+
+def any_model_from_cache(
+    cache: TuningCache, fingerprint: str | None = None
+) -> CostModel | None:
+    """Any calibration record for this platform (largest probed mesh
+    wins) — the local kernel axes' lookup, which has no mesh of its own:
+    the compute constants (FLOP/s, local bandwidth) are per-device and
+    mesh-independent."""
+    from .cache import platform_fingerprint
+
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    prefix = f"{fp}|calibration|"
+    best: Calibration | None = None
+    for key in sorted(cache.entries):
+        if key.startswith(prefix):
+            cal = Calibration.from_record(cache.entries[key])
+            if cal is not None and (best is None or cal.p > best.p):
+                best = cal
+    return CostModel(best) if best is not None else None
+
+
+# ------------------------------------------------------------ calibration
+
+
+def _probe_local(fn, a, x, *, n_reps: int, measure: str) -> float:
+    """Minimum observed per-execution time of one probe under the bench
+    protocol (``bench.timing.time_matvec`` — the same code path every
+    tuner measurement rides). Min, not mean: calibration wants the
+    machine's capability, not its contention."""
+    from ..bench.timing import time_matvec
+
+    times = time_matvec(
+        fn, a, x, n_reps=n_reps, mode="amortized", measure=measure,
+        chain_samples=3,
+    )
+    return float(min(times))
+
+
+def _collective_probes(mesh, dtype: str):
+    """Build the psum / ppermute probe programs on ``mesh``: each device
+    presents an n-element operand to one collective — the census's
+    payload semantics, so the constants calibrate exactly the quantity
+    the formula predicts."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+
+    axes = tuple(mesh.axis_names)
+    p = int(mesh.devices.size)
+
+    def psum_body(_a, x):
+        return jax.lax.psum(x, axes)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def permute_body(_a, x):
+        return jax.lax.ppermute(x, axes, perm)
+
+    def build(body):
+        return jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P(), P(axes)), out_specs=P(axes),
+        ))
+
+    sharding = NamedSharding(mesh, P(axes))
+    rep = NamedSharding(mesh, P())
+    return build(psum_body), build(permute_body), sharding, rep
+
+
+def calibrate(
+    mesh,
+    *,
+    dtype: str = "float32",
+    level: str = "full",
+    n_reps: int = 10,
+    measure: str = "sync",
+    log: Callable[[str], None] = print,
+) -> Calibration:
+    """Measure the machine constants (~6 probes, ``level="full"``; 2 for
+    ``"quick"`` — the tier-1 smoke budget) under the bench protocol and
+    return the :class:`Calibration`. Persisting is the caller's move
+    (``cache.record(calibration_key(p), cal.to_record())``) so tests and
+    CLIs control where it lands.
+
+    ``measure="sync"`` by default: the per-rep protocol includes dispatch
+    cost in α — which is honest, because that is exactly what the tuner's
+    sync-mode races pay per collective — and it cannot stall on
+    oversubscribed virtual meshes the way the loop protocol's rep-spread
+    search can (the PR 5 crossover-study finding). On real hardware pass
+    ``measure="loop"`` for dispatch-free constants."""
+    import jax
+
+    from ..staticcheck.hlo import dtype_itemsize
+
+    if level not in ("full", "quick"):
+        raise ValueError(f"calibration level must be full|quick, got {level!r}")
+    p = int(mesh.devices.size)
+    itemsize = dtype_itemsize(dtype)
+    rng = np.random.default_rng(0)
+    probes: dict[str, float] = {}
+
+    # Probe 1 — local GEMV: the resident-A stream (memory-bound).
+    gm, gk = _GEMV_SHAPE
+    a = rng.uniform(-1, 1, _GEMV_SHAPE).astype(dtype)
+    x = rng.uniform(-1, 1, (gk,)).astype(dtype)
+    gemv = jax.jit(lambda a_, x_: a_ @ x_)
+    t_gemv = _probe_local(gemv, a, x, n_reps=n_reps, measure=measure)
+    probes["gemv_s"] = t_gemv
+    mem_bps = gm * gk * itemsize / t_gemv
+    log(f"  calibrate: gemv {gm}x{gk} {t_gemv * 1e6:.0f} us "
+        f"-> {mem_bps / 1e9:.2f} GB/s local stream")
+
+    if level == "full":
+        # Probe 2 — local GEMM: achievable FLOP/s (compute-bound).
+        mm, mk, mn = _GEMM_SHAPE
+        ga = rng.uniform(-1, 1, (mm, mk)).astype(dtype)
+        gb = rng.uniform(-1, 1, (mk, mn)).astype(dtype)
+        gemm = jax.jit(lambda a_, b_: a_ @ b_)
+        t_gemm = _probe_local(gemm, ga, gb, n_reps=n_reps, measure=measure)
+        probes["gemm_s"] = t_gemm
+        flops = 2.0 * mm * mk * mn / t_gemm
+        log(f"  calibrate: gemm {mm}^3 {t_gemm * 1e6:.0f} us "
+            f"-> {flops / 1e9:.2f} GFLOP/s")
+    else:
+        # Quick: the GEMV probe bounds FLOP/s too (2 FLOPs per element
+        # streamed — an underestimate, consistently applied).
+        flops = 2.0 * gm * gk / t_gemv
+
+    psum, permute, sharding, rep = _collective_probes(mesh, dtype)
+    dummy = np.zeros((1,), np.float32).astype(dtype)
+
+    def run_collective(fn, n: int) -> float:
+        xs = rng.uniform(-1, 1, (p, n)).astype(dtype)
+        from ..bench.timing import time_matvec
+
+        times = time_matvec(
+            fn, dummy, xs, shardings=(rep, sharding), n_reps=n_reps,
+            mode="amortized", measure=measure, chain_samples=3,
+        )
+        return float(min(times))
+
+    if level == "full":
+        # Probes 3-6 — psum and ppermute, small (α) and large (β).
+        t_ps = run_collective(psum, _COLL_SMALL)
+        t_pl = run_collective(psum, _COLL_LARGE)
+        t_qs = run_collective(permute, _COLL_SMALL)
+        t_ql = run_collective(permute, _PERM_LARGE)
+        probes.update(psum_small_s=t_ps, psum_large_s=t_pl,
+                      permute_small_s=t_qs, permute_large_s=t_ql)
+        wire_coll = _COLL_LARGE * itemsize * wire_factor("all-reduce", p)
+        wire_perm = _PERM_LARGE * itemsize  # one hop moves the chunk once
+        beta_coll = wire_coll / max(t_pl - t_ps, t_pl * 0.1)
+        beta_perm = wire_perm / max(t_ql - t_qs, t_ql * 0.1)
+        alpha = {"collective": t_ps, "permute": t_qs}
+        beta = {"collective": beta_coll, "permute": beta_perm}
+        log(f"  calibrate: psum alpha {t_ps * 1e6:.0f} us, "
+            f"beta {beta_coll / 1e9:.2f} GB/s; permute alpha "
+            f"{t_qs * 1e6:.0f} us, beta {beta_perm / 1e9:.2f} GB/s")
+    else:
+        # Quick (probe 2 of 2): one bandwidth-dominated psum; split its
+        # time evenly between launch latency and wire. Crude absolutes,
+        # adequate ranking — documented in docs/COST_MODEL.md.
+        t_pl = run_collective(psum, _COLL_LARGE)
+        probes["psum_large_s"] = t_pl
+        wire_coll = _COLL_LARGE * itemsize * wire_factor("all-reduce", p)
+        alpha = {"collective": t_pl / 2, "permute": t_pl / 2}
+        beta = {
+            "collective": wire_coll / (t_pl / 2),
+            "permute": wire_coll / (t_pl / 2),
+        }
+        log(f"  calibrate(quick): psum {t_pl * 1e6:.0f} us -> alpha "
+            f"{t_pl / 2 * 1e6:.0f} us, beta "
+            f"{beta['collective'] / 1e9:.2f} GB/s")
+
+    return Calibration(
+        flops=flops, mem_bps=mem_bps, alpha_s=alpha, beta_bps=beta,
+        p=p, level=level, probes=probes,
+    )
+
+
+# ------------------------------------------------------- obs / divergence
+
+
+def record_prediction(
+    predicted_s: float, measured_s: float, registry=None
+) -> None:
+    """One (predicted, measured) candidate pair into the obs registry:
+    the ratio histogram the `cost model` panel renders, the
+    |log10 ratio| histogram behind the divergence stat, and the
+    divergence gauge (windowed median |log10 ratio|). Called by the
+    tuner for every measured candidate once a calibration exists."""
+    if predicted_s <= 0 or measured_s <= 0:
+        return
+    from ..obs.registry import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    ratio = predicted_s / measured_s
+    reg.histogram(
+        RATIO_HISTOGRAM,
+        "predicted / measured time per tuning candidate",
+    ).observe(ratio)
+    div = reg.histogram(
+        DIVERGENCE_HISTOGRAM,
+        "|log10(predicted/measured)| per tuning candidate",
+    )
+    div.observe(abs(math.log10(ratio)))
+    reg.gauge(
+        DIVERGENCE_GAUGE,
+        "windowed median |log10(predicted/measured)| — sustained "
+        f"divergence beyond {DIVERGENCE_LOG10} is a regression signal",
+    ).set(div.percentile(50))
+
+
+def divergence_health(registry=None) -> dict[str, Any]:
+    """The sustained-divergence regression signal (``engine.health()``'s
+    ``cost_model`` section and the obs panel): the windowed median
+    |log10(predicted/measured)| against :data:`DIVERGENCE_LOG10`, marked
+    ``divergent`` only past :data:`DIVERGENCE_MIN_SAMPLES` observations
+    (a single noisy candidate is not a regression)."""
+    from ..obs.registry import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    div = reg.histogram(
+        DIVERGENCE_HISTOGRAM,
+        "|log10(predicted/measured)| per tuning candidate",
+    )
+    n = div.count
+    median = div.percentile(50) if n else float("nan")
+    return {
+        "samples": n,
+        "median_abs_log10_ratio": median,
+        "threshold_log10": DIVERGENCE_LOG10,
+        "min_samples": DIVERGENCE_MIN_SAMPLES,
+        "divergent": bool(
+            n >= DIVERGENCE_MIN_SAMPLES and median > DIVERGENCE_LOG10
+        ),
+    }
+
+
+# -------------------------------------------------------------- surfaces
+
+# The combine families the crossover surface predicts per strategy — the
+# audited table's families (staticcheck.hlo.AUDIT_CONFIGS) with the
+# staged pair carried at the ladder's S values so the surface shows the
+# latency-vs-overlap trade explicitly.
+SURFACE_COMBINES: dict[str, tuple[tuple[str, int | None], ...]] = {
+    "rowwise": (
+        ("gather", None), ("ring", None),
+        ("overlap", 1), ("overlap", 2), ("overlap", 4),
+    ),
+    "colwise": (
+        ("psum", None), ("psum_scatter", None), ("ring", None),
+        ("ring_overlap", None), ("a2a", None),
+        ("overlap", 1), ("overlap", 2), ("overlap", 4),
+        ("overlap_ring", 2), ("overlap_ring", 4),
+    ),
+    "blockwise": (
+        ("gather", None), ("ring", None),
+        ("overlap", 1), ("overlap", 2), ("overlap", 4),
+    ),
+}
+
+SURFACE_COLUMNS = (
+    "m", "k", "p", "dtype", "strategy", "combine", "stages",
+    "predicted_s", "compute_s", "wire_s", "latency_s", "wire_bytes",
+    "winner",
+)
+
+
+def _stage_valid(strategy: str, stages: int | None, m: int, p: int, r: int) -> bool:
+    """Keep a surface row only when its chunking divides (the same
+    whole-chunk constraints the builders enforce)."""
+    s = stages or 1
+    if strategy == "blockwise":
+        return r > 1 and m % (r * s) == 0
+    return m % (p * s) == 0
+
+
+def crossover_surface(
+    model: CostModel,
+    *,
+    ms: Iterable[int],
+    ks: Iterable[int] | None = None,
+    ps: Iterable[int] = (2, 4, 8, 16, 64),
+    dtypes: Iterable[str] = ("float32", "bfloat16"),
+    b: int = 1,
+) -> list[dict[str, Any]]:
+    """The predicted combine-crossover surface: for every (m, k, p,
+    dtype, strategy) cell, each combine family's predicted time with the
+    per-cell winner flagged — the CSV the CLI emits and
+    ``data/cost_model_demo/crossover.csv`` commits."""
+    from ..parallel.mesh import most_square_factors
+
+    rows: list[dict[str, Any]] = []
+    ms = list(ms)
+    ks = list(ks) if ks is not None else None
+    if ks is not None and len(ks) != len(ms):
+        raise ValueError(
+            f"ks pairs with ms positionally: got {len(ks)} k values for "
+            f"{len(ms)} m values"
+        )
+    for i, m in enumerate(ms):
+        k = ks[i] if ks is not None else m
+        for p in ps:
+            r, _c = most_square_factors(p)
+            for dtype in dtypes:
+                for strategy, combines in SURFACE_COMBINES.items():
+                    cell: list[dict[str, Any]] = []
+                    for combine, stages in combines:
+                        if not _stage_valid(strategy, stages, m, p, r):
+                            continue
+                        pred = model.predict(
+                            strategy, combine, m=m, k=k, p=p, dtype=dtype,
+                            stages=stages, b=b, r=r,
+                        )
+                        cell.append({
+                            "m": m, "k": k, "p": p, "dtype": dtype,
+                            "strategy": strategy, "combine": combine,
+                            "stages": stages if stages is not None else "",
+                            "predicted_s": pred.total_s,
+                            "compute_s": pred.compute_s,
+                            "wire_s": pred.wire_s,
+                            "latency_s": pred.latency_s,
+                            "wire_bytes": pred.wire_bytes,
+                            "winner": 0,
+                        })
+                    if cell:
+                        best = min(cell, key=lambda row: row["predicted_s"])
+                        best["winner"] = 1
+                        rows.extend(cell)
+    return rows
+
+
+def write_surface_csv(rows: list[dict[str, Any]], path) -> None:
+    import csv
+    from pathlib import Path
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=SURFACE_COLUMNS)
+        w.writeheader()
+        w.writerows(rows)
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m matvec_mpi_multiplier_tpu.tuning.cost_model",
+        description="Predict the combine-crossover surface from the "
+        "calibrated analytic cost model (docs/COST_MODEL.md), or run the "
+        "calibration probes.",
+    )
+    p.add_argument(
+        "--calibrate", choices=["full", "quick"], default=None,
+        help="run the probe protocol on the current backend's mesh and "
+        "persist the calibration record (cache schema v5)",
+    )
+    p.add_argument("--devices", type=int, default=None,
+                   help="mesh size for --calibrate (default: all)")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--host-devices", type=int, default=None)
+    p.add_argument("--cache", default=None, help="cache file override")
+    p.add_argument(
+        "--synthetic-calibration", action="store_true",
+        help="predict from documented TPU-class preview constants "
+        "instead of a cached calibration (hardware-independent surface)",
+    )
+    p.add_argument("--m", nargs="+", type=int,
+                   default=[256, 1024, 4096, 16384, 65536])
+    p.add_argument("--k", nargs="+", type=int, default=None,
+                   help="paired with --m positionally (default: square)")
+    p.add_argument("--p", nargs="+", type=int, default=[2, 4, 8, 16, 64])
+    p.add_argument("--dtype", nargs="+", default=["float32", "bfloat16"])
+    p.add_argument("--b", type=int, default=1, help="RHS columns")
+    p.add_argument("--out", default=None, help="CSV path (default stdout)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cache is not None:
+        import os
+
+        os.environ["MATVEC_TUNING_CACHE"] = args.cache
+
+    cache = TuningCache.load(args.cache)
+    if args.calibrate is not None:
+        from ..bench.sweep import configure_platform
+
+        configure_platform(args.platform, args.host_devices)
+        import jax
+
+        from ..parallel.mesh import make_mesh
+
+        n = args.devices or len(jax.devices())
+        mesh = make_mesh(n)
+        cal = calibrate(mesh, level=args.calibrate)
+        cache.record(calibration_key(int(mesh.devices.size)), cal.to_record())
+        path = cache.save()
+        print(f"calibration ({cal.level}) saved to {path}")
+
+    if args.synthetic_calibration:
+        model: CostModel | None = CostModel(Calibration.synthetic())
+    else:
+        # Any cached calibration OF THIS PLATFORM serves prediction (the
+        # constants are the machine's; p generalizes symbolically) —
+        # any_model_from_cache filters by fingerprint and prefers the
+        # largest probed mesh.
+        model = any_model_from_cache(cache)
+    if model is None:
+        print(
+            "no calibration record in the cache — run with --calibrate "
+            "full (or --synthetic-calibration for the preview surface)",
+            file=sys.stderr,
+        )
+        return 1
+
+    rows = crossover_surface(
+        model, ms=args.m, ks=args.k, ps=args.p, dtypes=args.dtype, b=args.b,
+    )
+    if args.out:
+        write_surface_csv(rows, args.out)
+        print(f"wrote {len(rows)} surface rows to {args.out}")
+    else:
+        import csv
+
+        w = csv.DictWriter(sys.stdout, fieldnames=SURFACE_COLUMNS)
+        w.writeheader()
+        w.writerows(rows)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,18 @@ All measurements ride the existing benchmark protocol (``bench.timing``):
 device-looped slope timing with median-of-samples, the same numbers the
 sweep CSVs record — so a tuned winner is by construction the candidate the
 benchmark would have ranked first.
+
+**Cost-model pruning** (``prune_margin=``; docs/COST_MODEL.md): when the
+cache carries a calibration record (``cost_model.calibrate`` — schema
+v5), every axis pre-ranks its candidates by predicted time and measures
+only those within the ambiguity margin of the predicted winner, plus the
+hysteresis default seat (never pruned — the margin comparison needs it).
+Every pruned candidate is logged and counted
+(``tuning_pruned_candidates_total`` — no silent caps), every measured
+candidate records its prediction into the obs registry
+(``tuning_predicted_vs_measured_ratio``), and an uncalibrated cache
+falls back to full measurement with a log line saying so. Decisions are
+still 100 % measured — the model only chooses what NOT to race.
 """
 
 from __future__ import annotations
@@ -68,11 +80,38 @@ TUNE_MIN_GAIN = 0.05
 
 
 def _measure_fn(
-    fn: Callable, args: tuple, *, n_reps: int, samples: int
+    fn: Callable, args: tuple, *, n_reps: int, samples: int,
+    measure: str = "loop",
 ) -> float | None:
-    """Median per-execution time of a bare device function, or None when the
-    backend is too noisy for this candidate (an unmeasurable candidate can
-    never become a recorded winner)."""
+    """Per-execution time of a bare device function (median of the slope
+    samples), or None when the backend is too noisy for this candidate
+    (an unmeasurable candidate can never become a recorded winner).
+
+    ``measure="sync"`` switches to the literal per-rep fence protocol
+    (minimum of the reps — the tuner's ranking statistic) on the same
+    device-resident operands: the method of record on oversubscribed
+    virtual meshes, where the loop protocol's adaptive rep-spread search
+    can stall for minutes in collective-rendezvous spin (the PR 5
+    crossover-study finding — ``tune_storage``/``tune_promotion`` race
+    full distributed programs through here, not just local kernels).
+    Any other value means the loop protocol."""
+    if measure == "sync":
+        import time as _time
+
+        # Completion fence: block_until_ready, NOT bench.timing._fence
+        # (whose scalar-sum fetch launches a SECOND collective program —
+        # on the oversubscribed meshes this mode exists for, two
+        # programs interleaving on one rendezvous pool is exactly the
+        # deadlock being avoided; block_until_ready is reliable on the
+        # local backends this path serves, the tunneled-backend caveat
+        # belongs to the loop/chain protocols).
+        jax.block_until_ready(fn(*args))  # compile + warm, untimed
+        times = []
+        for _ in range(max(1, n_reps) * max(1, samples)):
+            start = _time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            times.append(_time.perf_counter() - start)
+        return float(np.min(times))
     try:
         times = time_fn_looped(fn, args, n_reps=n_reps, samples=samples)
     except TimingError:
@@ -80,12 +119,20 @@ def _measure_fn(
     return float(np.median(times))
 
 
-def _record_candidate(axis: str, t: float | None) -> None:
+def _record_candidate(
+    axis: str, t: float | None, predicted: float | None = None
+) -> None:
     """Per-candidate measurement event into the process obs registry
     (``obs.registry.get_registry``): how many candidates each tuning axis
     measured, how many were unmeasurable, and the distribution of measured
     candidate times — the visibility a ``--tune`` pre-pass otherwise only
-    leaves in its log lines. A sweep's ``--metrics-out`` snapshots these."""
+    leaves in its log lines. A sweep's ``--metrics-out`` snapshots these.
+
+    ``predicted`` (when a calibration exists) additionally records the
+    cost model's prediction for this candidate against the measurement —
+    the ``tuning_predicted_vs_measured_ratio`` histogram and divergence
+    gauge behind the obs `cost model` panel and the ``health()``
+    regression signal (``cost_model.record_prediction``)."""
     from ..obs.registry import get_registry
 
     registry = get_registry()
@@ -102,6 +149,131 @@ def _record_candidate(axis: str, t: float | None) -> None:
         registry.histogram(
             "tuning_candidate_time_ms", "measured candidate times"
         ).observe(t * 1e3)
+        if predicted is not None:
+            from .cost_model import record_prediction
+
+            record_prediction(predicted, t)
+
+
+def _record_stale(axis: str, key: str, log: Callable[[str], None]) -> None:
+    """A cache hit re-measured anyway (``force=True`` over an existing
+    decision) used to happen silently; now it is counted
+    (``tuning_cache_stale_total``) and logged with the axis named, so
+    re-measurement cost — and any pruning win against it — is
+    attributable (ISSUE 10 satellite)."""
+    from ..obs.registry import get_registry
+
+    get_registry().counter(
+        "tuning_cache_stale_total",
+        "cache hits re-measured because the entry was stale (force)",
+    ).inc()
+    log(f"  {axis}: stale cache hit re-measured (force): {key}")
+
+
+def _plan_pruning(
+    context: str,
+    predictions: dict[str, float],
+    *,
+    keep: set[str],
+    margin: float,
+    log: Callable[[str], None],
+) -> set[str]:
+    """Predicted-time pre-ranking for one axis: keep the hysteresis
+    seat(s) in ``keep`` and every candidate predicted within ``margin``
+    of the predicted winner; prune the rest. EVERY pruned candidate is
+    logged and counted (no silent caps) so a wrong prediction stays
+    attributable — divergence then shows up in the obs panel, not as a
+    mystery regression. Returns the label set to measure."""
+    from ..obs.registry import get_registry
+    from .cost_model import PRUNED_COUNTER
+
+    best = min(predictions.values())
+    measure: set[str] = set()
+    counter = get_registry().counter(
+        PRUNED_COUNTER, "tuning candidates skipped by cost-model pruning"
+    )
+    for label, t in predictions.items():
+        if label in keep or t <= (1.0 + margin) * best:
+            measure.add(label)
+        else:
+            counter.inc()
+            log(
+                f"  {context} {label}: pruned (predicted {t * 1e6:.1f} us "
+                f"vs predicted best {best * 1e6:.1f} us, margin "
+                f"{margin:.2f})"
+            )
+    return measure
+
+
+def _measure_plan(
+    candidates: Iterable, predictions: dict[str, float],
+    measure_set: set[str] | None,
+) -> list:
+    """The candidates one axis actually races after a pruning plan:
+    everything when not pruning (``measure_set`` None — exhaustive or
+    uncalibrated fallback), else the kept set plus every candidate the
+    model had no prediction for (unpredictable ⇒ measured). Prediction
+    keys are the str() of the candidate (the overlap axis's ladder is
+    ints keyed by their str labels)."""
+    return [
+        c for c in candidates
+        if measure_set is None or str(c) not in predictions
+        or str(c) in measure_set
+    ]
+
+
+def _predict_combines(
+    cache: TuningCache,
+    family: str,
+    candidates: Iterable[str],
+    *,
+    m: int,
+    k: int,
+    mesh,
+    dtype: str,
+    stages: int | None,
+    keep: set[str],
+    prune_margin: float | None,
+    context: str,
+    log: Callable[[str], None],
+    b: int = 1,
+) -> tuple[dict[str, float], set[str] | None, list[str]]:
+    """Shared prediction + pruning plan for the combine-family axes:
+    predict every candidate the formula covers, then (in prune mode)
+    split into measure/prune sets via :func:`_plan_pruning`. Returns
+    ``(predictions, measure_set, pruned)`` — ``measure_set`` is None
+    when not pruning (exhaustive) or the cache is uncalibrated (full-
+    measurement fallback, logged); candidates without a prediction are
+    never pruned."""
+    from .cost_model import model_from_cache
+
+    p = int(mesh.devices.size)
+    model = model_from_cache(cache, p)
+    predictions: dict[str, float] = {}
+    if model is not None:
+        r, _c = mesh_grid_shape(mesh)
+        for cand in candidates:
+            s = stages if cand in ("overlap", "overlap_ring") else None
+            try:
+                predictions[cand] = model.predict(
+                    family, cand, m=m, k=k, p=p, dtype=dtype, stages=s,
+                    b=b, r=r,
+                ).total_s
+            except KeyError:
+                continue  # no formula for this schedule: never pruned
+    measure_set: set[str] | None = None
+    pruned: list[str] = []
+    if prune_margin is not None:
+        if model is None:
+            log(f"  {context}: cost model uncalibrated - measuring all "
+                "candidates")
+        elif predictions:
+            measure_set = _plan_pruning(
+                context, predictions, keep=keep, margin=prune_margin,
+                log=log,
+            )
+            pruned = sorted(set(predictions) - measure_set)
+    return predictions, measure_set, pruned
 
 
 def _pick_winner(
@@ -175,15 +347,32 @@ def tune_gemv(
     force: bool = False,
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
+    prune_margin: float | None = None,
+    measure: str = "loop",
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """Measure the kernel/tile candidates for one LOCAL (m, k, dtype) on one
     device and record the winner. Returns the decision (cached or fresh),
-    None when nothing was measurable."""
+    None when nothing was measurable.
+
+    ``prune_margin`` is accepted for axis uniformity but the kernel axis
+    never prunes: the model has no kernel-tier resolution (all candidates
+    share one local-body prediction), so every candidate stays inside any
+    margin — it is still measured, and its prediction is still recorded
+    for the divergence histogram."""
+    from .cost_model import any_model_from_cache
+
     key = gemv_key(m, k, dtype)
     existing = cache.lookup(key)
-    if existing is not None and not force:
-        return existing
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("gemv", key, log)
+    model = any_model_from_cache(cache)
+    predicted = (
+        model.predict_local(m, k, dtype).total_s if model is not None
+        else None
+    )
     rng = np.random.default_rng(seed)
     a = jnp.asarray(rng.uniform(0, 10, (m, k)), dtype=dtype)
     x = jnp.asarray(rng.uniform(0, 10, (k,)), dtype=dtype)
@@ -194,16 +383,17 @@ def tune_gemv(
     # first — the default, by construction.
     _measure_fn(
         _candidate_gemv_fn(cands[0]), (a, x), n_reps=max(1, n_reps // 4),
-        samples=1,
+        samples=1, measure=measure,
     )
     measured: dict[str, float] = {}
     by_label: dict[str, dict[str, Any]] = {}
     for cand in cands:
         label = _candidate_label(cand)
         t = _measure_fn(
-            _candidate_gemv_fn(cand), (a, x), n_reps=n_reps, samples=samples
+            _candidate_gemv_fn(cand), (a, x), n_reps=n_reps,
+            samples=samples, measure=measure,
         )
-        _record_candidate("gemv", t)
+        _record_candidate("gemv", t, predicted=predicted)
         if t is None:
             log(f"  gemv {m}x{k} {dtype} {label}: unmeasurable")
             continue
@@ -222,7 +412,7 @@ def tune_gemv(
         for label in ("xla", winner):
             t = _measure_fn(
                 _candidate_gemv_fn(by_label[label]), (a, x),
-                n_reps=n_reps, samples=samples,
+                n_reps=n_reps, samples=samples, measure=measure,
             )
             if t is not None:
                 measured[label] = t
@@ -286,15 +476,29 @@ def tune_gemm(
     force: bool = False,
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
+    prune_margin: float | None = None,
+    measure: str = "loop",
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """GEMM face of :func:`tune_gemv`: measure the kernel/tile candidates —
     the pallas tier expanded over its (bm, bn, bk) ladder — for one LOCAL
-    (m, k, n, dtype) on one device and record the winner."""
+    (m, k, n, dtype) on one device and record the winner. ``prune_margin``
+    is accepted for axis uniformity; like :func:`tune_gemv`, the kernel
+    axis records predictions but never prunes (no kernel-tier resolution
+    in the model)."""
+    from .cost_model import any_model_from_cache
+
     key = gemm_key(m, k, n, dtype)
     existing = cache.lookup(key)
-    if existing is not None and not force:
-        return existing
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("gemm", key, log)
+    model = any_model_from_cache(cache)
+    predicted = (
+        model.predict_local(m, k, dtype, b=n).total_s if model is not None
+        else None
+    )
     rng = np.random.default_rng(seed)
     a = jnp.asarray(rng.uniform(0, 10, (m, k)), dtype=dtype)
     b = jnp.asarray(rng.uniform(0, 10, (k, n)), dtype=dtype)
@@ -302,16 +506,17 @@ def tune_gemm(
     # Discarded cold-process warmup (same rationale as tune_gemv).
     _measure_fn(
         _candidate_gemm_fn(cands[0]), (a, b), n_reps=max(1, n_reps // 4),
-        samples=1,
+        samples=1, measure=measure,
     )
     measured: dict[str, float] = {}
     by_label: dict[str, dict[str, Any]] = {}
     for cand in cands:
         label = _gemm_candidate_label(cand)
         t = _measure_fn(
-            _candidate_gemm_fn(cand), (a, b), n_reps=n_reps, samples=samples
+            _candidate_gemm_fn(cand), (a, b), n_reps=n_reps,
+            samples=samples, measure=measure,
         )
-        _record_candidate("gemm", t)
+        _record_candidate("gemm", t, predicted=predicted)
         if t is None:
             log(f"  gemm {m}x{k}x{n} {dtype} {label}: unmeasurable")
             continue
@@ -328,7 +533,7 @@ def tune_gemm(
         for label in ("xla", winner):
             t = _measure_fn(
                 _candidate_gemm_fn(by_label[label]), (a, b),
-                n_reps=n_reps, samples=samples,
+                n_reps=n_reps, samples=samples, measure=measure,
             )
             if t is not None:
                 measured[label] = t
@@ -359,6 +564,7 @@ def tune_combine(
     min_gain: float = TUNE_MIN_GAIN,
     memo: dict | None = None,
     stages: int | None = None,
+    prune_margin: float | None = None,
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """Measure the combine-schedule candidates for one GLOBAL
@@ -371,14 +577,21 @@ def tune_combine(
     (colwise / colwise_ring / ... ) bind the SAME parameterized strategy, so
     under --strategy all their identical candidate programs are measured
     once, not once per registry name (only the hysteresis default differs
-    per name)."""
+    per name).
+
+    ``prune_margin`` enables cost-model pruning (module docstring): only
+    candidates predicted within the margin of the predicted winner — plus
+    the hysteresis default — are raced; candidates the model has no
+    formula for are never pruned."""
     from ..utils.io import generate_matrix, generate_vector
 
     p = int(mesh.devices.size)
     key = combine_key("matvec", strategy_name, m, k, p, dtype)
     existing = cache.lookup(key)
-    if existing is not None and not force:
-        return existing
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("combine", key, log)
     strat = get_strategy(strategy_name)
     try:
         candidates = strat.combine_candidates(mesh)
@@ -386,6 +599,16 @@ def tune_combine(
         # e.g. blockwise on a mesh without its 2-D axes: nothing to tune.
         return None
     if not candidates:
+        return None
+    family = "colwise" if strategy_name.startswith("colwise") else strategy_name
+    default = strat.default_combine(mesh)
+    predictions, measure_set, pruned = _predict_combines(
+        cache, family, candidates, m=m, k=k, mesh=mesh, dtype=dtype,
+        stages=stages, keep={default}, prune_margin=prune_margin,
+        context=f"combine {strategy_name} {m}x{k} p={p}", log=log,
+    )
+    plan = _measure_plan(candidates, predictions, measure_set)
+    if not plan:
         return None
     a = generate_matrix(m, k, seed=seed)
     x = generate_vector(k, seed=seed + 1)
@@ -395,14 +618,13 @@ def tune_combine(
     try:
         benchmark_strategy(
             strat, mesh, a, x, dtype=dtype, n_reps=1, measure=measure,
-            kernel=kernel, combine=candidates[0], chain_samples=1,
+            kernel=kernel, combine=plan[0], chain_samples=1,
             stages=stages,
         )
     except (MatvecError, TimingError):
         pass
-    family = "colwise" if strategy_name.startswith("colwise") else strategy_name
     measured: dict[str, float] = {}
-    for cand in candidates:
+    for cand in plan:
         memo_key = (family, cand, m, k, p, dtype, kernel, measure,
                     stages if cand == "overlap" else None)
         if memo is not None and memo_key in memo:
@@ -427,12 +649,11 @@ def tune_combine(
         # Rank on the MINIMUM rep time: on shared hosts the mean absorbs
         # contention spikes that have nothing to do with the schedule.
         t = float(result.min_time_s)
-        _record_candidate("combine", t)
+        _record_candidate("combine", t, predicted=predictions.get(cand))
         measured[cand] = t
         if memo is not None:
             memo[memo_key] = t
         log(f"  combine {strategy_name} {m}x{k} p={p} {cand}: {t * 1e6:.1f} us")
-    default = strat.default_combine(mesh)
     winner = _pick_winner(measured, default=default, min_gain=min_gain)
     if winner is None:
         return None
@@ -442,10 +663,15 @@ def tune_combine(
         # an adjacent, fully-warm re-measurement of the contending pair.
         for cand in (default, winner):
             try:
+                # stages= must ride along: a staged winner re-measured at
+                # the builder's default S would be a DIFFERENT schedule —
+                # the confirm pass could unseat the tuned-S winner with a
+                # time that belongs to no raced candidate (and the new
+                # predicted-vs-measured pairing was made at the tuned S).
                 result = benchmark_strategy(
                     strat, mesh, a, x, dtype=dtype, n_reps=n_reps,
                     measure=measure, kernel=kernel, combine=cand,
-                    chain_samples=samples,
+                    chain_samples=samples, stages=stages,
                 )
             except TimingError:
                 continue
@@ -454,6 +680,10 @@ def tune_combine(
         log(f"  combine {strategy_name} {m}x{k} p={p} confirm -> {winner}")
     best = {"combine": winner, "time_s": measured[winner],
             "candidates": measured}
+    if predictions:
+        best["predicted_s"] = predictions
+    if pruned:
+        best["pruned"] = pruned
     cache.record(key, best)
     return best
 
@@ -475,6 +705,7 @@ def tune_gemm_combine(
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
     stages: int | None = None,
+    prune_margin: float | None = None,
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """GEMM face of :func:`tune_combine`: measure the in-body combine
@@ -484,35 +715,51 @@ def tune_gemm_combine(
     consults. The combine key carries no n_rhs (a schedule crossover is a
     property of the (m, k, p) communication shape, and the engine reuses
     one decision across its whole bucket ladder), so the decision is
-    measured at the caller's representative ``n``."""
+    measured at the caller's representative ``n``. ``prune_margin``
+    enables cost-model pruning (module docstring), predicting each
+    schedule at ``b=n`` RHS columns."""
     from ..models.gemm import gemm_combine_candidates, validate_gemm
     from ..utils.io import generate_matrix
 
     p = int(mesh.devices.size)
     key = combine_key("gemm", strategy_name, m, k, p, dtype)
     existing = cache.lookup(key)
-    if existing is not None and not force:
-        return existing
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("gemm_combine", key, log)
     try:
         candidates = gemm_combine_candidates(strategy_name, mesh)
     except MatvecError:
         return None
     if not candidates:
         return None
+    strat = get_strategy(strategy_name)
+    family = (
+        "colwise" if strategy_name.startswith("colwise") else strategy_name
+    )
+    default = strat.default_combine(mesh)
+    predictions, measure_set, pruned = _predict_combines(
+        cache, family, candidates, m=m, k=k, mesh=mesh, dtype=dtype,
+        stages=stages, keep={default}, prune_margin=prune_margin, b=n,
+        context=f"gemm-combine {strategy_name} {m}x{k}x{n} p={p}", log=log,
+    )
+    plan = _measure_plan(candidates, predictions, measure_set)
+    if not plan:
+        return None
     a = generate_matrix(m, k, seed=seed)
     b = generate_matrix(k, n, seed=seed + 1)
-    strat = get_strategy(strategy_name)
     # Discarded cold-process warmup (same rationale as tune_combine).
     try:
         benchmark_gemm(
             strategy_name, mesh, a, b, dtype=dtype, n_reps=1,
-            measure=measure, kernel=kernel, combine=candidates[0],
+            measure=measure, kernel=kernel, combine=plan[0],
             chain_samples=1, stages=stages,
         )
     except (MatvecError, TimingError):
         pass
     measured: dict[str, float] = {}
-    for cand in candidates:
+    for cand in plan:
         bound = strat.with_combine(cand) or strat
         try:
             bound.validate(m, k, mesh)
@@ -533,11 +780,10 @@ def tune_gemm_combine(
                 f"{cand}: unmeasurable")
             continue
         t = float(result.min_time_s)
-        _record_candidate("gemm_combine", t)
+        _record_candidate("gemm_combine", t, predicted=predictions.get(cand))
         measured[cand] = t
         log(f"  gemm-combine {strategy_name} {m}x{k}x{n} p={p} {cand}: "
             f"{t * 1e6:.1f} us")
-    default = strat.default_combine(mesh)
     winner = _pick_winner(measured, default=default, min_gain=min_gain)
     if winner is None:
         return None
@@ -545,10 +791,13 @@ def tune_gemm_combine(
         # Confirmation pass (same rationale as tune_combine).
         for cand in (default, winner):
             try:
+                # stages= rides along for the same reason as tune_combine's
+                # confirm pass: the re-measurement must be of the SAME
+                # staged schedule the race (and its prediction) used.
                 result = benchmark_gemm(
                     strategy_name, mesh, a, b, dtype=dtype, n_reps=n_reps,
                     measure=measure, kernel=kernel, combine=cand,
-                    chain_samples=samples,
+                    chain_samples=samples, stages=stages,
                 )
             except TimingError:
                 continue
@@ -558,6 +807,10 @@ def tune_gemm_combine(
             f"confirm -> {winner}")
     best = {"combine": winner, "time_s": measured[winner],
             "candidates": measured, "n_rhs": n}
+    if predictions:
+        best["predicted_s"] = predictions
+    if pruned:
+        best["pruned"] = pruned
     cache.record(key, best)
     return best
 
@@ -576,11 +829,13 @@ def tune_promotion(
     buckets: tuple[int, ...] = (2, 4, 8, 16, 32),
     kernel: str = "xla",
     combine: str | None = None,
+    measure: str = "loop",
     n_reps: int = TUNE_N_REPS,
     samples: int = TUNE_SAMPLES,
     force: bool = False,
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
+    prune_margin: float | None = None,
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """The fourth autotuner axis: the GEMV→GEMM batch-promotion crossover.
@@ -597,17 +852,56 @@ def tune_promotion(
     conservative. ``b_star: null`` records "promotion never won" (the
     engine then keeps the per-column path; distinct from a cache miss,
     which falls back to the static default).
+
+    ``prune_margin`` enables decision-closure pruning: once a measured
+    bucket wins — fixing ``b*``, the smallest measured winner — the
+    remaining buckets cannot change the decision and are skipped (each
+    skip logged and counted). Note the model itself cannot prune this
+    axis's buckets: under ``T = max(compute, wire) + latency`` a batched
+    dispatch is ALWAYS predicted at or under ``b`` sequential ones
+    (compute and wire scale at most linearly in b, latency is paid
+    once), so a "predicted to lose" test can never fire — predictions
+    are still recorded per bucket for the divergence metrics.
     """
+    from .cost_model import model_from_cache
+
     p = int(mesh.devices.size)
     key = promote_key(strategy_name, m, k, p, dtype)
     existing = cache.lookup(key)
-    if existing is not None and not force:
-        return existing
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("promotion", key, log)
     strat = get_strategy(strategy_name)
     try:
         strat.validate(m, k, mesh)
     except MatvecError:
         return None
+    # Per-bucket predictions (when calibrated): the GEMM's predicted time
+    # vs b sequential dispatches — the same comparison the measurement
+    # decides, so a prune is a predicted-unambiguous loss.
+    model = model_from_cache(cache, p)
+    family = (
+        "colwise" if strategy_name.startswith("colwise") else strategy_name
+    )
+    comb = combine if combine not in (None, "auto") else (
+        strat.default_combine(mesh)
+    )
+    pred_seq: float | None = None
+    pred_gemm: dict[int, float] = {}
+    if model is not None:
+        r_, _c = mesh_grid_shape(mesh)
+        try:
+            pred_seq = model.predict(
+                family, comb, m=m, k=k, p=p, dtype=dtype, r=r_
+            ).total_s
+            for b in sorted(buckets):
+                pred_gemm[b] = model.predict(
+                    family, comb, m=m, k=k, p=p, dtype=dtype, b=b, r=r_
+                ).total_s
+        except KeyError:
+            pred_seq = None  # no formula: measure everything
+            pred_gemm = {}
     rng = np.random.default_rng(seed)
     a = jnp.asarray(rng.uniform(0, 10, (m, k)), dtype=dtype)
     x = jnp.asarray(rng.uniform(0, 10, (k,)), dtype=dtype)
@@ -615,9 +909,10 @@ def tune_promotion(
     a = jax.device_put(a, sh_a)
     matvec = strat.build(mesh, kernel=kernel, combine=combine)
     t_seq = _measure_fn(
-        matvec, (a, jax.device_put(x, sh_x)), n_reps=n_reps, samples=samples
+        matvec, (a, jax.device_put(x, sh_x)), n_reps=n_reps,
+        samples=samples, measure=measure,
     )
-    _record_candidate("promotion", t_seq)
+    _record_candidate("promotion", t_seq, predicted=pred_seq)
     if t_seq is None:
         return None
     log(f"  promote {strategy_name} {m}x{k} p={p} {dtype} "
@@ -625,14 +920,33 @@ def tune_promotion(
     gemm = strat.build_batched(mesh, kernel=kernel, combine=combine)
     _, sh_b = strat.batched_shardings(mesh)
     gemm_times: dict[str, float] = {}
+    pruned: list[str] = []
     b_star: int | None = None
+
+    def _prune_bucket(b: int, why: str) -> None:
+        from ..obs.registry import get_registry
+        from .cost_model import PRUNED_COUNTER
+
+        get_registry().counter(
+            PRUNED_COUNTER,
+            "tuning candidates skipped by cost-model pruning",
+        ).inc()
+        pruned.append(str(b))
+        log(f"  promote {strategy_name} {m}x{k} p={p} b={b}: pruned ({why})")
+
     for b in sorted(buckets):
+        if prune_margin is not None and b_star is not None:
+            # b* is the SMALLEST measured winner; later buckets cannot
+            # change the decision (docstring: the model itself cannot
+            # prune here — prediction says gemm never loses).
+            _prune_bucket(b, f"b*={b_star} already decided")
+            continue
         rhs = jnp.asarray(rng.uniform(0, 10, (k, b)), dtype=dtype)
         t_gemm = _measure_fn(
             gemm, (a, jax.device_put(rhs, sh_b)), n_reps=n_reps,
-            samples=samples,
+            samples=samples, measure=measure,
         )
-        _record_candidate("promotion", t_gemm)
+        _record_candidate("promotion", t_gemm, predicted=pred_gemm.get(b))
         if t_gemm is None:
             log(f"  promote {strategy_name} {m}x{k} p={p} b={b}: "
                 "unmeasurable")
@@ -647,6 +961,13 @@ def tune_promotion(
     if not gemm_times:
         return None
     best = {"b_star": b_star, "seq_time_s": t_seq, "gemm_times": gemm_times}
+    if pred_seq is not None:
+        best["predicted_s"] = {
+            "seq": pred_seq,
+            **{str(b): t for b, t in pred_gemm.items()},
+        }
+    if pruned:
+        best["pruned"] = pruned
     cache.record(key, best)
     return best
 
@@ -675,6 +996,7 @@ def tune_overlap(
     force: bool = False,
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
+    prune_margin: float | None = None,
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """The fifth autotuner axis: the staged-overlap stage count S.
@@ -697,8 +1019,10 @@ def tune_overlap(
     p = int(mesh.devices.size)
     key = overlap_key(strategy_name, m, k, p, dtype)
     existing = cache.lookup(key)
-    if existing is not None and not force:
-        return existing
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("overlap", key, log)
     strat = get_strategy(strategy_name)
     try:
         if "overlap" not in strat.combine_candidates(mesh):
@@ -717,6 +1041,39 @@ def tune_overlap(
     ]
     if not ladder:
         return None
+    family = (
+        "colwise" if strategy_name.startswith("colwise") else strategy_name
+    )
+    # Prediction plan (mirrors _predict_combines, with per-S labels).
+    from .cost_model import model_from_cache
+
+    predictions: dict[str, float] = {}
+    measure_set: set[str] | None = None
+    pruned: list[str] = []
+    model = model_from_cache(cache, p)
+    if model is not None:
+        r_, _c = mesh_grid_shape(mesh)
+        for s in ladder:
+            try:
+                predictions[str(s)] = model.predict(
+                    family, "overlap", m=m, k=k, p=p, dtype=dtype,
+                    stages=s, r=r_,
+                ).total_s
+            except KeyError:
+                break  # no staged formula for this family
+    if prune_margin is not None:
+        if model is None:
+            log(f"  overlap {strategy_name} {m}x{k} p={p}: cost model "
+                "uncalibrated - measuring all candidates")
+        elif predictions:
+            measure_set = _plan_pruning(
+                f"overlap {strategy_name} {m}x{k} p={p} S",
+                predictions, keep={"1"}, margin=prune_margin, log=log,
+            )
+            pruned = sorted(set(predictions) - measure_set)
+    plan = _measure_plan(ladder, predictions, measure_set)
+    if not plan:
+        return None
     a = generate_matrix(m, k, seed=seed)
     x = generate_vector(k, seed=seed + 1)
     # Discarded cold-process warmup (same rationale as tune_gemv): without
@@ -725,13 +1082,13 @@ def tune_overlap(
     try:
         benchmark_strategy(
             strat, mesh, a, x, dtype=dtype, n_reps=1, measure=measure,
-            kernel=kernel, combine="overlap", stages=ladder[0],
+            kernel=kernel, combine="overlap", stages=plan[0],
             chain_samples=1,
         )
     except (MatvecError, TimingError):
         pass
     measured: dict[str, float] = {}
-    for s in ladder:
+    for s in plan:
         try:
             result = benchmark_strategy(
                 strat, mesh, a, x, dtype=dtype, n_reps=n_reps,
@@ -743,7 +1100,7 @@ def tune_overlap(
             log(f"  overlap {strategy_name} {m}x{k} p={p} S={s}: unmeasurable")
             continue
         t = float(result.min_time_s)
-        _record_candidate("overlap", t)
+        _record_candidate("overlap", t, predicted=predictions.get(str(s)))
         measured[str(s)] = t
         log(f"  overlap {strategy_name} {m}x{k} p={p} S={s}: {t * 1e6:.1f} us")
     winner = _pick_winner(measured, default="1", min_gain=min_gain)
@@ -751,6 +1108,10 @@ def tune_overlap(
         return None
     best = {"stages": int(winner), "time_s": measured[winner],
             "candidates": measured}
+    if predictions:
+        best["predicted_s"] = predictions
+    if pruned:
+        best["pruned"] = pruned
     cache.record(key, best)
     return best
 
@@ -787,6 +1148,8 @@ def tune_storage(
     force: bool = False,
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
+    prune_margin: float | None = None,
+    measure: str = "loop",
     log: Callable[[str], None] = print,
 ) -> dict[str, Any] | None:
     """The sixth autotuner axis: the resident-A storage format.
@@ -813,12 +1176,15 @@ def tune_storage(
     """
     from ..ops.quantize import quantize_matrix
     from ..utils.io import generate_matrix, generate_vector
+    from .cost_model import model_from_cache
 
     p = int(mesh.devices.size)
     key = storage_key(strategy_name, m, k, p, dtype)
     existing = cache.lookup(key)
-    if existing is not None and not force:
-        return existing
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("storage", key, log)
     strat = get_strategy(strategy_name)
     try:
         strat.validate(m, k, mesh)
@@ -828,6 +1194,44 @@ def tune_storage(
         # A strategy instance bound to an A-tiling combine (colwise_overlap
         # & co.) has no quantized face to race.
         return None
+    # Prediction plan: formats race the SAME schedule (storage is
+    # orthogonal to the census — staticcheck/hlo.py), so their total
+    # predictions differ only in the resident-A byte term. Pruning ranks
+    # on the predicted COMPUTE term alone (the resident stream — the
+    # format's entire reason to exist): the shared collective cost would
+    # otherwise drown the byte differences and make every format read as
+    # ambiguous. The full prediction still feeds the divergence metrics.
+    family = (
+        "colwise" if strategy_name.startswith("colwise") else strategy_name
+    )
+    candidates = storage_format_candidates(dtype)
+    predictions: dict[str, float] = {}
+    rank_preds: dict[str, float] = {}
+    measure_set: set[str] | None = None
+    pruned: list[str] = []
+    model = model_from_cache(cache, p)
+    if model is not None:
+        r_, _c = mesh_grid_shape(mesh)
+        for fmt in candidates:
+            try:
+                pred = model.predict(
+                    family, strat.default_combine(mesh), m=m, k=k, p=p,
+                    dtype=dtype, storage=fmt, r=r_,
+                )
+            except KeyError:
+                break  # no formula for the default schedule
+            predictions[fmt] = pred.total_s
+            rank_preds[fmt] = pred.compute_s
+    if prune_margin is not None:
+        if model is None:
+            log(f"  storage {strategy_name} {m}x{k} p={p}: cost model "
+                "uncalibrated - measuring all candidates")
+        elif rank_preds:
+            measure_set = _plan_pruning(
+                f"storage {strategy_name} {m}x{k} p={p}",
+                rank_preds, keep={"native"}, margin=prune_margin, log=log,
+            )
+            pruned = sorted(set(rank_preds) - measure_set)
     a = np.asarray(generate_matrix(m, k, seed=seed), dtype=dtype)
     x = np.asarray(generate_vector(k, seed=seed + 1), dtype=dtype)
     sh_a, sh_x = strat.shardings(mesh)
@@ -838,7 +1242,7 @@ def tune_storage(
     bandwidth: dict[str, float] = {}
     native_bytes = a.size * a.itemsize
     warmed = False
-    for fmt in storage_format_candidates(dtype):
+    for fmt in _measure_plan(candidates, rank_preds, measure_set):
         if fmt == "native":
             operand = jax.device_put(a, sh_a)
             nbytes = native_bytes
@@ -856,11 +1260,15 @@ def tune_storage(
         if not warmed:
             # Discarded cold-process warmup (same rationale as tune_gemv).
             _measure_fn(
-                fn, (operand, x_dev), n_reps=max(1, n_reps // 4), samples=1
+                fn, (operand, x_dev), n_reps=max(1, n_reps // 4),
+                samples=1, measure=measure,
             )
             warmed = True
-        t = _measure_fn(fn, (operand, x_dev), n_reps=n_reps, samples=samples)
-        _record_candidate("storage", t)
+        t = _measure_fn(
+            fn, (operand, x_dev), n_reps=n_reps, samples=samples,
+            measure=measure,
+        )
+        _record_candidate("storage", t, predicted=predictions.get(fmt))
         if t is None:
             log(f"  storage {strategy_name} {m}x{k} p={p} {fmt}: "
                 "unmeasurable")
@@ -889,7 +1297,8 @@ def tune_storage(
                     sh_a,
                 )
             t = _measure_fn(
-                fn, (operand, x_dev), n_reps=n_reps, samples=samples
+                fn, (operand, x_dev), n_reps=n_reps, samples=samples,
+                measure=measure,
             )
             if t is not None:
                 measured[fmt] = t
@@ -901,6 +1310,10 @@ def tune_storage(
         "candidates": measured, "resident_bytes": resident,
         "bandwidth_gbps": bandwidth,
     }
+    if predictions:
+        best["predicted_s"] = predictions
+    if pruned:
+        best["pruned"] = pruned
     cache.record(key, best)
     return best
 
@@ -954,6 +1367,7 @@ def tune_config(
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
     memo: dict | None = None,
+    prune_margin: float | None = None,
     log: Callable[[str], None] = print,
 ) -> None:
     """Tune everything one sweep config consults at dispatch time: the
@@ -981,7 +1395,8 @@ def tune_config(
         for lm, lk, ln in sorted(local):
             tune_gemm(
                 lm, lk, ln, dtype, cache, n_reps=n_reps, samples=samples,
-                force=force, seed=seed, min_gain=min_gain, log=log,
+                force=force, seed=seed, min_gain=min_gain,
+                prune_margin=prune_margin, measure=measure, log=log,
             )
         # The overlap stage decision is op-agnostic (keyed on the (m, k, p)
         # communication shape, like promote): tune it here too so a
@@ -990,12 +1405,12 @@ def tune_config(
         ov = tune_overlap(
             strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
             measure=measure, n_reps=n_reps, samples=samples, force=force,
-            seed=seed, min_gain=min_gain, log=log,
+            seed=seed, min_gain=min_gain, prune_margin=prune_margin, log=log,
         )
         tune_gemm_combine(
             strategy_name, mesh, m, k, n, dtype, cache, kernel=kernel,
             measure=measure, n_reps=n_reps, samples=samples, force=force,
-            seed=seed, min_gain=min_gain, log=log,
+            seed=seed, min_gain=min_gain, prune_margin=prune_margin, log=log,
             stages=(ov or {}).get("stages"),
         )
         # The storage decision is op-agnostic like promote (one residency
@@ -1004,13 +1419,15 @@ def tune_config(
         tune_storage(
             strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
             n_reps=n_reps, samples=samples, force=force, seed=seed,
-            min_gain=min_gain, log=log,
+            min_gain=min_gain, prune_margin=prune_margin, measure=measure,
+            log=log,
         )
         return
     for lm, lk in sorted(local_gemv_shapes(strategy_name, m, k, mesh)):
         tune_gemv(
             lm, lk, dtype, cache, n_reps=n_reps, samples=samples,
-            force=force, seed=seed, min_gain=min_gain, log=log,
+            force=force, seed=seed, min_gain=min_gain,
+            prune_margin=prune_margin, measure=measure, log=log,
         )
     # Stage axis BEFORE the combine axis: the combine pass measures the
     # "overlap" candidate at its resolved S (passed explicitly — the
@@ -1019,18 +1436,19 @@ def tune_config(
     ov = tune_overlap(
         strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
         measure=measure, n_reps=n_reps, samples=samples, force=force,
-        seed=seed, min_gain=min_gain, log=log,
+        seed=seed, min_gain=min_gain, prune_margin=prune_margin, log=log,
     )
     tune_combine(
         strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
         measure=measure, n_reps=n_reps, samples=samples, force=force,
-        seed=seed, min_gain=min_gain, memo=memo, log=log,
-        stages=(ov or {}).get("stages"),
+        seed=seed, min_gain=min_gain, memo=memo, prune_margin=prune_margin,
+        log=log, stages=(ov or {}).get("stages"),
     )
     tune_storage(
         strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
         n_reps=n_reps, samples=samples, force=force, seed=seed,
-        min_gain=min_gain, log=log,
+        min_gain=min_gain, prune_margin=prune_margin, measure=measure,
+        log=log,
     )
 
 
@@ -1050,6 +1468,7 @@ def tune_sweep(
     force: bool = False,
     seed: int = 0,
     min_gain: float = TUNE_MIN_GAIN,
+    prune_margin: float | None = None,
     log: Callable[[str], None] = print,
 ) -> TuningCache:
     """Populate the cache for a whole sweep grid, saving incrementally after
@@ -1063,7 +1482,8 @@ def tune_sweep(
                     name, mesh, m, k, dtype, cache, op=op, n_rhs=n_rhs,
                     kernel=kernel, measure=measure, n_reps=n_reps,
                     samples=samples, force=force, seed=seed,
-                    min_gain=min_gain, memo=memo, log=log,
+                    min_gain=min_gain, memo=memo, prune_margin=prune_margin,
+                    log=log,
                 )
             cache.save()
     return cache

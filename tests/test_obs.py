@@ -529,7 +529,7 @@ def test_tune_gemv_populates_default_registry(devices, tmp_path, monkeypatch):
     reset_cache()
     reset_registry()
 
-    def fake_measure(fn, args, *, n_reps, samples):
+    def fake_measure(fn, args, *, n_reps, samples, measure="loop"):
         return 1e-5
 
     # Events are emitted at the tune_* call sites, not inside _measure_fn,

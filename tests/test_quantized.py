@@ -583,9 +583,10 @@ def test_tune_storage_records_decision_and_lookup(tmp_path, monkeypatch):
         assert rb["int8c"] < rb["native"] * 0.55
         assert set(decision["bandwidth_gbps"]) == set(decision["candidates"])
         cache.save()
-        # The JSON file is schema v4 and the dispatch-side lookup sees it.
+        # The JSON file is the current schema (v5 since the cost model's
+        # calibration kind) and the dispatch-side lookup sees it.
         raw = json.loads(path.read_text())
-        assert raw["version"] == 4
+        assert raw["version"] == 5
         reset_cache()
         assert lookup_storage(
             strategy="rowwise", m=64, k=512, p=8, dtype="float32"
@@ -643,7 +644,7 @@ def test_tune_storage_selects_by_measurement_both_ways(
     def scripted(times):
         seq = iter(times)
 
-        def fake_measure(fn, args, *, n_reps, samples):
+        def fake_measure(fn, args, *, n_reps, samples, measure="loop"):
             return next(seq)
 
         return fake_measure

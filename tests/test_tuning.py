@@ -295,7 +295,7 @@ def test_tune_gemv_records_fastest_candidate(cache_path, monkeypatch):
         wrapper.label = search._candidate_label(cand)
         return wrapper
 
-    def fake_measure(fn, args, *, n_reps, samples):
+    def fake_measure(fn, args, *, n_reps, samples, measure="loop"):
         label = getattr(fn, "label", None)
         if label is None:
             return 99.0  # the discarded cold-process warmup probe
@@ -414,7 +414,7 @@ def test_tune_gemm_records_tile_winner(cache_path, monkeypatch):
         wrapper.label = search._gemm_candidate_label(cand)
         return wrapper
 
-    def fake_measure(fn, args, *, n_reps, samples):
+    def fake_measure(fn, args, *, n_reps, samples, measure="loop"):
         label = getattr(fn, "label", None)
         if label is None:
             return 99.0  # the discarded cold-process warmup probe
