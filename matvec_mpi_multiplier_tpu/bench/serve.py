@@ -58,6 +58,18 @@ policy is measured against its expectation. The chaos overlay composes:
 one tenant (labels are tenant-prefixed), and the isolation acceptance
 asserts every OTHER tenant's availability column stays at 1.0.
 
+**Global-scheduler A/B** (``--global-sched on|off|both`` with
+``--tenants``; docs/SCHEDULING.md) routes submits through the
+cost-model-driven :class:`~..engine.GlobalScheduler` — predicted-time
+admission, cross-tenant interleaving/coalescing, demand-aware eviction
+(``--demand-weight``) — against the greedy baseline on the SAME seeded
+trace. ``--deadline-ms`` adds the SLO overlay: arrivals paced at
+``--rate`` req/s with deadlines anchored at scheduled arrivals, rows
+gaining the ``deadline_expires``/``rejected`` split (rejected ≠ failed),
+on-time goodput and end-to-end p50/p99; ``--decision-jsonl`` mirrors
+every scheduling decision. The committed capture is
+``data/gsched_demo/`` (``scripts/gsched_study.py``).
+
 Rows land in ``data/out/serve_<strategy>.csv`` (``--data-root`` to
 redirect; the committed demos live under ``data/engine_demo/``,
 ``data/batching_demo/`` and ``data/resilience_demo/``).
@@ -116,7 +128,12 @@ from ..resilience import (
     RetryPolicy,
     parse_fault_spec,
 )
-from ..utils.errors import ConfigError, DeadlineExceededError, MatvecError
+from ..utils.errors import (
+    AdmissionRejectedError,
+    ConfigError,
+    DeadlineExceededError,
+    MatvecError,
+)
 
 # The payload signature --poison-rate plants in row 0 of a poisoned
 # request (and the matching FaultSpec(poison=...) keys on): far outside
@@ -728,9 +745,10 @@ def run_serve_load(
 MULTITENANT_CSV_HEADER = (
     "n_rows, n_cols, n_devices, strategy, dtype, n_tenants, zipf_a, "
     "hbm_budget, budget_tenants, n_requests, wall_s, rps, hit_rate, "
-    "lru_floor, tenant, requests, hits, tenant_hit_rate, evictions, "
-    "evictions_caused, quota_rejections, failed_requests, availability, "
-    "resident_bytes, pinned"
+    "lru_floor, global_sched, deadline_ms, deadline_expires, on_time, "
+    "p50_e2e_ms, p99_e2e_ms, tenant, requests, hits, tenant_hit_rate, "
+    "evictions, evictions_caused, quota_rejections, failed_requests, "
+    "rejected, availability, resident_bytes, pinned"
 )
 
 
@@ -747,6 +765,12 @@ class TenantRow:
     failed_requests: int
     resident_bytes: int
     pinned: int
+    # Requests the global scheduler's predicted-time admission refused
+    # (typed AdmissionRejectedError, pre-dispatch). Rejected ≠ failed:
+    # a rejection consumed no device time and is retryable by design,
+    # so it has its own column and does NOT count against availability
+    # (resilience.is_rejection; docs/SCHEDULING.md).
+    rejected: int = 0
 
     @property
     def hit_rate(self) -> float:
@@ -754,12 +778,27 @@ class TenantRow:
 
     @property
     def availability(self) -> float:
-        """Fraction of this tenant's offered requests that returned a
-        result (quota rejections and fault failures both count against
-        it — the tenant-visible success rate)."""
+        """Fraction of this tenant's offered requests that neither
+        faulted nor expired (quota rejections, deadline expires and
+        fault failures all count against it — the tenant-visible
+        downtime). Admission REJECTIONS do not: they are typed,
+        pre-dispatch, zero-cost scheduling outcomes (``rejected``
+        column), not downtime."""
         if self.requests == 0:
             return float("nan")
         return (self.requests - self.failed_requests) / self.requests
+
+    @property
+    def served_rate(self) -> float:
+        """Fraction of offered requests that actually returned a result
+        (failures AND rejections both subtracted) — the honesty check
+        next to ``availability``: a scheduler cannot buy availability by
+        rejecting everything without this column collapsing."""
+        if self.requests == 0:
+            return float("nan")
+        return (
+            self.requests - self.failed_requests - self.rejected
+        ) / self.requests
 
 
 @dataclasses.dataclass(frozen=True)
@@ -782,6 +821,24 @@ class MultiTenantResult:
     hit_rate: float           # registry-wide: hits / submits
     lru_floor: float          # plain-LRU replay of the same trace
     rows: tuple[TenantRow, ...]
+    # Global-scheduler A/B columns (--global-sched; docs/SCHEDULING.md).
+    # deadline_expires counts requests that expired in an ENGINE gate
+    # (pre-dispatch deadline failures) — the failure mode predicted-time
+    # admission converts into typed rejects; the acceptance gate pins it
+    # at 0 with scheduling on. p50/p99 are end-to-end (scheduled arrival
+    # to materialized result) over SERVED requests; NaN without a
+    # deadline overlay.
+    global_sched: bool = False
+    deadline_ms: float = float("nan")
+    deadline_expires: int = 0
+    p50_e2e_ms: float = float("nan")
+    p99_e2e_ms: float = float("nan")
+    # SLO goodput: served requests whose end-to-end latency (scheduled
+    # arrival -> materialized result) landed INSIDE the deadline. The
+    # honest A/B numerator — a late serve burned device time for an
+    # answer nobody was waiting for, and a scheduler cannot win this
+    # column by rejecting everything.
+    on_time: int = 0
 
     @property
     def rps(self) -> float:
@@ -807,7 +864,10 @@ def append_multitenant_result(result: MultiTenantResult, root=None):
         f"{result.zipf_a:.3f}, {result.hbm_budget}, "
         f"{result.budget_tenants}, {result.n_requests}, "
         f"{result.wall_s:.6f}, {result.rps:.2f}, {result.hit_rate:.4f}, "
-        f"{result.lru_floor:.4f}"
+        f"{result.lru_floor:.4f}, {int(result.global_sched)}, "
+        f"{result.deadline_ms:.3f}, {result.deadline_expires}, "
+        f"{result.on_time}, "
+        f"{result.p50_e2e_ms:.4f}, {result.p99_e2e_ms:.4f}"
     )
     for row in result.rows:
         _append_row(
@@ -815,7 +875,8 @@ def append_multitenant_result(result: MultiTenantResult, root=None):
             f"{prefix}, {row.tenant}, {row.requests}, {row.hits}, "
             f"{row.hit_rate:.4f}, {row.evictions}, {row.evictions_caused}, "
             f"{row.quota_rejections}, {row.failed_requests}, "
-            f"{row.availability:.4f}, {row.resident_bytes}, {row.pinned}",
+            f"{row.rejected}, {row.availability:.4f}, "
+            f"{row.resident_bytes}, {row.pinned}",
         )
     return path
 
@@ -930,6 +991,13 @@ def run_serve_multitenant(
     integrity_gate: bool = False,
     resilience: bool | None = None,
     breaker_reset_s: float = 30.0,
+    global_sched: bool = False,
+    deadline_ms: float | None = None,
+    rate: float | None = None,
+    max_in_flight: int | None = None,
+    demand_weight: float = 0.0,
+    deadline_margin: float = 1.0,
+    decision_jsonl: str | None = None,
 ) -> MultiTenantResult:
     """Run the multi-tenant trace protocol for one (strategy, shape,
     mesh) config: ``n_tenants`` seeded matrices registered against
@@ -945,7 +1013,27 @@ def run_serve_multitenant(
     payload signature on a seeded fraction of one tenant's requests
     (every tenant's when ``poison_tenant`` is None) — the isolation
     acceptance asserts the OTHER tenants' availability columns stay at
-    1.0."""
+    1.0.
+
+    Global-scheduler A/B (``global_sched``; docs/SCHEDULING.md): route
+    every submit through a :class:`~..engine.GlobalScheduler` over the
+    same registry — predicted-time admission, cross-tenant interleaving
+    and coalescing, demand-aware eviction (``demand_weight``) — against
+    the greedy baseline on the SAME seeded trace. Per-tenant
+    ``requests``/``availability`` columns stay offered-trace-based in
+    both arms; the registry-side ``hits`` column counts DISPATCHES, so
+    in the (deadline-free) classic protocol a coalesced flush of b
+    same-group requests contributes one hit, not b — compare hit-rate
+    across arms only on the deadline overlay (which flushes per
+    request) or with coalescing accounted for. With ``deadline_ms``
+    the trace becomes an SLO overlay: arrivals are paced at ``rate``
+    req/s (a burst when None), each request's deadline is anchored at
+    its SCHEDULED arrival (loop lag consumes deadline budget — the
+    overload signal), results are drained concurrently, and the result
+    carries end-to-end p50/p99 over served requests plus the
+    ``deadline_expires``/``rejected`` split. ``max_in_flight`` arms the
+    engines' backpressure gate so overload queues instead of enqueueing
+    unboundedly — the greedy failure mode admission control deletes."""
     from ..utils.io import generate_matrix
 
     if n_tenants < 1:
@@ -996,6 +1084,7 @@ def run_serve_multitenant(
     registry = MatrixRegistry(
         mesh,
         hbm_budget=budget,
+        demand_weight=demand_weight,
         metrics=registry_metrics,
         fault_plan=plan,
         resilience=policy,
@@ -1003,6 +1092,7 @@ def run_serve_multitenant(
         strategy=strategy_name, kernel=kernel, combine=combine,
         stages=stages, dtype_storage=dtype_storage, dtype=dtype,
         max_bucket=max_bucket, promote=promote, donate=donate,
+        max_in_flight=max_in_flight,
     )
     tenant_ids = [f"tenant-{i}" for i in range(n_tenants)]
     payload_bytes = 0
@@ -1060,26 +1150,107 @@ def run_serve_multitenant(
                     int(j) for j in
                     prng.choice(target, size=n_poison, replace=False)
                 )
+        gs = None
+        if global_sched:
+            from ..engine import GlobalScheduler
+
+            gs = GlobalScheduler(
+                registry, cost_model="auto",
+                deadline_margin=deadline_margin,
+                decision_jsonl=decision_jsonl,
+            )
+        submit = (
+            gs.submit if gs is not None
+            else lambda tid, x, **kw: registry.submit(tid, x, **kw)
+        )
         failed = [0] * n_tenants
-        futures: list[tuple[int, object]] = []
-        start = time.perf_counter()
-        for j, t in enumerate(tenant_seq):
-            x = xpool[j % len(xpool)]
-            if j in poison_idx:
-                x = np.array(x)
-                x[0] = x.dtype.type(POISON_SIGNATURE)
-            try:
-                futures.append((int(t), registry.submit(tenant_ids[t], x)))
-            except MatvecError:
-                # Uncoalesced dispatch faults surface at submit; the
-                # trace keeps going — availability is the measurement.
-                failed[t] += 1
-        for t, fut in futures:
+        rejected = [0] * n_tenants
+        e2e_hist = registry_metrics.histogram(
+            "serve_e2e_latency_ms",
+            "scheduled-arrival to materialized-result host time over "
+            "served requests (deadline overlay)",
+            window=max(n_requests, 1),
+        )
+
+        on_time = [0]
+
+        def _consume(t: int, fut, arrival: float | None) -> None:
             try:
                 fut.result()
+            except AdmissionRejectedError:
+                rejected[t] += 1  # typed, pre-dispatch: rejected != failed
             except MatvecError:
                 failed[t] += 1
+            else:
+                if arrival is not None:
+                    lat_ms = (time.perf_counter() - arrival) * 1e3
+                    e2e_hist.observe(lat_ms)
+                    if deadline_ms is not None and lat_ms <= deadline_ms:
+                        on_time[0] += 1  # SLO goodput, not just served
+
+        start = time.perf_counter()
+        if deadline_ms is None:
+            # Classic protocol: submit in trace order, materialize once.
+            futures: list[tuple[int, object]] = []
+            for j, t in enumerate(tenant_seq):
+                x = xpool[j % len(xpool)]
+                if j in poison_idx:
+                    x = np.array(x)
+                    x[0] = x.dtype.type(POISON_SIGNATURE)
+                try:
+                    futures.append((int(t), submit(tenant_ids[t], x)))
+                except MatvecError:
+                    # Uncoalesced dispatch faults surface at submit; the
+                    # trace keeps going — availability is the measurement.
+                    failed[t] += 1
+            if gs is not None:
+                gs.flush()  # close the open coalescing batch pre-drain
+            for t, fut in futures:
+                _consume(t, fut, None)
+        else:
+            # SLO overlay: paced arrivals, deadlines anchored at the
+            # SCHEDULED arrival (loop lag consumes deadline budget — the
+            # overload signal), results drained concurrently so e2e
+            # latency is per-request, not drain-order.
+            gap_s = (1.0 / rate) if rate else 0.0
+            results: queue.Queue = queue.Queue()
+
+            def drainer() -> None:
+                while True:
+                    item = results.get()
+                    if item is None:
+                        return
+                    _consume(*item)
+
+            drain_thread = threading.Thread(target=drainer, daemon=True)
+            drain_thread.start()
+            for j, t in enumerate(tenant_seq):
+                x = xpool[j % len(xpool)]
+                if j in poison_idx:
+                    x = np.array(x)
+                    x[0] = x.dtype.type(POISON_SIGNATURE)
+                arrival = start + j * gap_s
+                while True:
+                    now = time.perf_counter()
+                    if now >= arrival:
+                        break
+                    time.sleep(min(arrival - now, 5e-4))
+                remaining = (
+                    arrival + deadline_ms / 1e3 - time.perf_counter()
+                ) * 1e3
+                try:
+                    fut = submit(
+                        tenant_ids[t], x, deadline_ms=remaining
+                    )
+                except MatvecError:
+                    failed[t] += 1
+                    continue
+                results.put((int(t), fut, arrival))
+            results.put(None)
+            drain_thread.join()
         wall = time.perf_counter() - start
+        if gs is not None:
+            gs.close()
 
         health = registry.health()
         if metrics_out is not None:
@@ -1109,6 +1280,7 @@ def run_serve_multitenant(
             evictions_caused=stat["evictions_caused"],
             quota_rejections=stat["quota_rejections"],
             failed_requests=failed[i],
+            rejected=rejected[i],
             resident_bytes=stat["resident_bytes"],
             pinned=int(stat["pinned"]),
         ))
@@ -1120,10 +1292,12 @@ def run_serve_multitenant(
         evictions_caused=sum(r.evictions_caused for r in rows),
         quota_rejections=sum(r.quota_rejections for r in rows),
         failed_requests=sum(r.failed_requests for r in rows),
+        rejected=sum(r.rejected for r in rows),
         resident_bytes=health["hbm"]["charged_bytes"],
         pinned=pin_hot,
     ))
     all_row = rows[-1]
+    counters = registry_metrics.snapshot()["counters"]
     return MultiTenantResult(
         n_rows=m, n_cols=k, n_devices=int(mesh.devices.size),
         strategy=strategy_name, dtype=dtype,
@@ -1135,6 +1309,17 @@ def run_serve_multitenant(
         ),
         lru_floor=floor,
         rows=tuple(rows),
+        global_sched=global_sched,
+        deadline_ms=(
+            float(deadline_ms) if deadline_ms is not None else float("nan")
+        ),
+        # Engine-gate deadline failures: the expire-after-queueing
+        # failure mode. Warmup submits carry no deadlines, so the total
+        # is the steady phase's.
+        deadline_expires=counters.get("engine_deadline_failures_total", 0),
+        on_time=on_time[0],
+        p50_e2e_ms=e2e_hist.percentile(50),
+        p99_e2e_ms=e2e_hist.percentile(99),
     )
 
 
@@ -1264,13 +1449,21 @@ def tune_serve(
     kernel: str = "xla",
     measure: str = "auto",
     min_gain: float | None = None,
+    prune_margin: float | None = None,
     seed: int = 0,
     log=print,
 ) -> None:
     """Pre-pass for ``--tune``: populate every tuning-cache axis a serve
     config consults — local kernels, combine schedules (matvec AND gemm,
     engine construction reads both), and the promotion crossover ``b*``
-    over the bucket ladder."""
+    over the bucket ladder.
+
+    ``prune_margin`` enables the cost model's predicted pre-ranking
+    (``--prune-margin``; docs/COST_MODEL.md) exactly as the CLI tuner
+    does: with a calibration in the cache, each axis measures only the
+    candidates predicted within the margin of the predicted winner —
+    the same ~40 % measurement cut, now on the serve warmup path too.
+    An uncalibrated cache measures exhaustively and says so."""
     from ..engine.buckets import bucket_ladder
     from ..tuning import TuningCache, reset_cache
     from ..tuning.search import TUNE_MIN_GAIN, tune_config, tune_promotion
@@ -1286,16 +1479,18 @@ def tune_serve(
                 tune_config(
                     name, mesh, m, k, dtype, cache, op="matvec",
                     kernel=kernel, measure=measure, min_gain=min_gain,
-                    seed=seed, log=log,
+                    prune_margin=prune_margin, seed=seed, log=log,
                 )
                 tune_config(
                     name, mesh, m, k, dtype, cache, op="gemm",
                     n_rhs=max_bucket, kernel=kernel, measure=measure,
-                    min_gain=min_gain, seed=seed, log=log,
+                    min_gain=min_gain, prune_margin=prune_margin,
+                    seed=seed, log=log,
                 )
                 tune_promotion(
                     name, mesh, m, k, dtype, cache, buckets=buckets,
-                    kernel=kernel, min_gain=min_gain, seed=seed, log=log,
+                    kernel=kernel, min_gain=min_gain,
+                    prune_margin=prune_margin, seed=seed, log=log,
                 )
             cache.save()
     cache.save()
@@ -1337,7 +1532,9 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
             strategies, sizes, [meshes[n] for n in counts], args.dtype,
             max_bucket=args.max_bucket, kernel=args.kernel,
             measure=getattr(args, "measure", "auto") or "auto",
-            min_gain=getattr(args, "min_gain", None), seed=args.seed,
+            min_gain=getattr(args, "min_gain", None),
+            prune_margin=getattr(args, "prune_margin", None),
+            seed=args.seed,
         )
     promote = args.promote
     if promote not in (None, "auto"):
@@ -1383,64 +1580,100 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                 if n_tenants:
                     # Multi-tenant trace mode (engine/registry.py): takes
                     # precedence over the load/sequential protocols.
-                    try:
-                        result = run_serve_multitenant(
-                            name, mesh, m, k, dtype=args.dtype,
-                            kernel=args.kernel, combine=args.combine,
-                            stages=getattr(args, "stages", None),
-                            dtype_storage=getattr(
-                                args, "dtype_storage", None
-                            ),
-                            n_tenants=n_tenants,
-                            zipf_a=getattr(args, "zipf_a", 1.1),
-                            hbm_budget=getattr(args, "hbm_budget", None),
-                            pin_hot=getattr(args, "pin_hot", 0),
-                            tenant_quota=getattr(
-                                args, "tenant_quota", None
-                            ),
-                            n_requests=args.n_requests,
-                            max_bucket=args.max_bucket,
-                            promote=promote, seed=args.seed,
-                            metrics_out=metrics_out,
-                            fault_spec=fault_spec,
-                            fault_seed=getattr(args, "fault_seed", 0),
-                            poison_rate=poison_rate,
-                            poison_tenant=getattr(
-                                args, "poison_tenant", None
-                            ),
-                            integrity_gate=getattr(
-                                args, "integrity_gate", False
-                            ),
-                            breaker_reset_s=getattr(
-                                args, "breaker_reset_s", 30.0
-                            ),
+                    # --global-sched both runs the greedy baseline first,
+                    # then the scheduled run on the SAME seeded trace
+                    # (docs/SCHEDULING.md's A/B protocol).
+                    gsched_modes = {
+                        None: (False,), "off": (False,), "on": (True,),
+                        "both": (False, True),
+                    }[getattr(args, "global_sched", None)]
+                    for gsched_on in gsched_modes:
+                        try:
+                            result = run_serve_multitenant(
+                                name, mesh, m, k, dtype=args.dtype,
+                                kernel=args.kernel, combine=args.combine,
+                                stages=getattr(args, "stages", None),
+                                dtype_storage=getattr(
+                                    args, "dtype_storage", None
+                                ),
+                                n_tenants=n_tenants,
+                                zipf_a=getattr(args, "zipf_a", 1.1),
+                                hbm_budget=getattr(
+                                    args, "hbm_budget", None
+                                ),
+                                pin_hot=getattr(args, "pin_hot", 0),
+                                tenant_quota=getattr(
+                                    args, "tenant_quota", None
+                                ),
+                                n_requests=args.n_requests,
+                                max_bucket=args.max_bucket,
+                                promote=promote, seed=args.seed,
+                                metrics_out=metrics_out,
+                                fault_spec=fault_spec,
+                                fault_seed=getattr(args, "fault_seed", 0),
+                                poison_rate=poison_rate,
+                                poison_tenant=getattr(
+                                    args, "poison_tenant", None
+                                ),
+                                integrity_gate=getattr(
+                                    args, "integrity_gate", False
+                                ),
+                                breaker_reset_s=getattr(
+                                    args, "breaker_reset_s", 30.0
+                                ),
+                                global_sched=gsched_on,
+                                deadline_ms=getattr(
+                                    args, "deadline_ms", None
+                                ),
+                                rate=getattr(args, "rate", None)
+                                if getattr(args, "deadline_ms", None)
+                                is not None else None,
+                                max_in_flight=getattr(
+                                    args, "max_in_flight", None
+                                ),
+                                demand_weight=getattr(
+                                    args, "demand_weight", 0.0
+                                ) if gsched_on else 0.0,
+                                decision_jsonl=getattr(
+                                    args, "decision_jsonl", None
+                                ) if gsched_on else None,
+                            )
+                        except MatvecError as e:
+                            print(f"skip {name} {m}x{k} p={n_dev}: {e}")
+                            continue
+                        if not args.no_csv:
+                            path = append_multitenant_result(
+                                result, args.data_root
+                            )
+                        else:
+                            path = None
+                        all_row = result.rows[-1]
+                        sched_suffix = ""
+                        if getattr(args, "deadline_ms", None) is not None:
+                            sched_suffix = (
+                                f" deadline={result.deadline_ms:.1f}ms "
+                                f"expires={result.deadline_expires} "
+                                f"rejected={all_row.rejected} "
+                                f"p99={result.p99_e2e_ms:.2f}ms"
+                            )
+                        print(
+                            f"serve-tenants {name} {m}x{k} p={n_dev} "
+                            f"tenants={result.n_tenants} "
+                            f"zipf_a={result.zipf_a} "
+                            "budget="
+                            f"{result.budget_tenants if result.hbm_budget else 'inf'} "
+                            f"gsched={'on' if gsched_on else 'off'} "
+                            f"{result.rps:.1f} req/s "
+                            f"hit={result.hit_rate:.3f} "
+                            f"(lru floor {result.lru_floor:.3f}) "
+                            f"evictions={all_row.evictions} "
+                            f"quota_rej={all_row.quota_rejections} "
+                            f"ok={all_row.availability:.3f}"
+                            + sched_suffix
                         )
-                    except MatvecError as e:
-                        print(f"skip {name} {m}x{k} p={n_dev}: {e}")
-                        continue
-                    if not args.no_csv:
-                        path = append_multitenant_result(
-                            result, args.data_root
-                        )
-                    else:
-                        path = None
-                    all_row = result.rows[-1]
-                    print(
-                        f"serve-tenants {name} {m}x{k} p={n_dev} "
-                        f"tenants={result.n_tenants} "
-                        f"zipf_a={result.zipf_a} "
-                        "budget="
-                        f"{result.budget_tenants if result.hbm_budget else 'inf'} "
-                        f"{result.rps:.1f} req/s "
-                        f"hit={result.hit_rate:.3f} "
-                        f"(lru floor {result.lru_floor:.3f}) "
-                        f"evictions={all_row.evictions} "
-                        f"quota_rej={all_row.quota_rejections} "
-                        f"ok={all_row.availability:.3f}"
-                    )
-                    if path is not None:
-                        print(f"CSV: {path}")
-                    n_done += 1
+                        if path is not None:
+                            print(f"CSV: {path}")
+                        n_done += 1
                     continue
                 if not load_mode:
                     try:
@@ -1682,6 +1915,43 @@ def build_parser() -> argparse.ArgumentParser:
         "tenants only (the chaos overlay's quota-pressure knob)",
     )
     p.add_argument(
+        "--global-sched", choices=["on", "off", "both"], default=None,
+        dest="global_sched",
+        help="with --tenants: route submits through the cost-model-"
+        "driven global scheduler (engine/global_scheduler.py; "
+        "docs/SCHEDULING.md) — predicted-time admission, cross-tenant "
+        "interleaving/coalescing, demand-aware eviction. 'both' runs "
+        "the greedy baseline then the scheduled run on the SAME seeded "
+        "trace (the A/B protocol of data/gsched_demo/)",
+    )
+    p.add_argument(
+        "--deadline-ms", type=float, default=None, dest="deadline_ms",
+        help="with --tenants: SLO overlay — every request carries this "
+        "deadline anchored at its SCHEDULED arrival (paced at --rate "
+        "req/s), so loop lag consumes deadline budget; rows gain "
+        "deadline_expires/rejected and end-to-end p50/p99 columns",
+    )
+    p.add_argument(
+        "--max-in-flight", type=int, default=None, dest="max_in_flight",
+        help="with --tenants: per-engine backpressure high-water mark "
+        "(engine/core.py) — overload queues at the gate instead of "
+        "enqueueing unboundedly, which is what greedy deadline-expires "
+        "under (and predicted-time admission rejects fast instead)",
+    )
+    p.add_argument(
+        "--demand-weight", type=float, default=2.0, dest="demand_weight",
+        help="with --global-sched on|both: weight of the predicted-"
+        "demand term in the registry's eviction score (0 = the PR 9 "
+        "recency+cost score; engine/registry.py)",
+    )
+    p.add_argument(
+        "--decision-jsonl", default=None, metavar="FILE",
+        dest="decision_jsonl",
+        help="with --global-sched: mirror every scheduling decision "
+        "(admit/reject/interleave/evict/flush, each with predicted_s "
+        "and reason) to FILE via the obs sink thread",
+    )
+    p.add_argument(
         "--fault-spec", default=None, metavar="SPEC",
         help="chaos mode: seeded fault-injection plan, e.g. "
         "'dispatch:device_error:p=0.05;dispatch:nan:times=2' "
@@ -1731,6 +2001,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--min-gain", type=float, default=None,
         help="with --tune: hysteresis margin (default 0.05; raise on "
         "noisy shared hosts — see the sweep CLI's flag of the same name)",
+    )
+    p.add_argument(
+        "--prune-margin", type=float, default=None, dest="prune_margin",
+        help="with --tune: cost-model predicted pre-ranking — measure "
+        "only candidates predicted within this margin of the predicted "
+        "winner (the CLI tuner's flag; ~40%% fewer measurements with a "
+        "calibrated cache, exhaustive + a log line without one)",
     )
     p.add_argument(
         "--measure", choices=["auto", "loop", "chain", "sync"],

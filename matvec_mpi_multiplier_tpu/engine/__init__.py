@@ -27,6 +27,7 @@ from .buckets import (
 )
 from .core import DEFAULT_PROMOTE_B, EngineStats, MatvecEngine, MatvecFuture
 from .executables import ExecKey, ExecStats, ExecutableCache
+from .global_scheduler import GlobalScheduler
 from .registry import (
     HbmAccountant,
     MatrixRegistry,
@@ -45,6 +46,7 @@ __all__ = [
     "MatvecEngine",
     "MatvecFuture",
     "EngineStats",
+    "GlobalScheduler",
     "MatrixRegistry",
     "TenantHandle",
     "TenantQuota",

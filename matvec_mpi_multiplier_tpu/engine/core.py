@@ -776,6 +776,37 @@ class MatvecEngine:
             self._donate,
         )
 
+    def prediction_config(self, b: int = 1) -> dict:
+        """The cost model's view of one dispatch through this engine's
+        PREFERRED config (``tuning.cost_model.CostModel.predict`` /
+        ``predict_admission`` kwargs): the resolved combine schedule —
+        the strategy's static default when none was pinned, since that is
+        the schedule a ``combine=None`` build lowers — at the bucket a
+        ``b``-column request would actually ride (``b >= b*`` promotes to
+        the padded GEMM bucket; below it the per-column path dispatches
+        ``b`` single-RHS programs, which the caller models as ``b``
+        sequential ``b=1`` predictions). Degradation-ladder fallbacks are
+        deliberately not modeled — admission predicts the healthy path,
+        and sustained divergence is the cost model's own regression
+        signal (docs/COST_MODEL.md)."""
+        gemm = self.b_star is not None and b >= self.b_star
+        combine = self._effective_combine(
+            self._gemm_combine if gemm else self._matvec_combine
+        )
+        if combine is None:
+            combine = self.strategy.default_combine(self.mesh)
+        return dict(
+            strategy=self.strategy.name,
+            combine=combine,
+            stages=self.stages,
+            m=self.m,
+            k=self.k,
+            p=mesh_size(self.mesh),
+            dtype=str(self.dtype),
+            b=bucket_for(b, self.max_bucket) if gemm else 1,
+            storage=self.storage,
+        )
+
     # ---- construction-time resolution ----
 
     def _resolve_storage(self, dtype_storage: str | None) -> str:

@@ -1,0 +1,730 @@
+"""Cost-model-driven global scheduler: every decision is a prediction.
+
+The serve path grew every ingredient of an SLO-aware scheduler without a
+brain wiring them together: the arrival-window scheduler (PR 6) coalesces
+only within one tenant, the registry (PR 9) evicts on recency without
+knowing what is about to arrive, and the calibrated α–β cost model
+(PR 10) can predict any dispatch's duration — yet the serve path never
+asked it. This module is the brain: a cross-tenant scheduling layer over
+the :class:`~.registry.MatrixRegistry` that consults the
+:class:`~..tuning.cost_model.CostModel` on every decision, the
+decide-from-a-model-first doctrine of GSPMD (arXiv 2105.04663) and the
+TPU distributed-linalg paper (arXiv 2112.09017, PAPERS.md). Four
+mechanisms (operator's guide: docs/SCHEDULING.md):
+
+* **predicted-time admission** — each request's ``deadline_ms`` is
+  checked at submit time against the queue-aware ETA for its ExecKey
+  (:meth:`~..tuning.cost_model.CostModel.predict_admission`: the
+  predicted backlog of outstanding dispatches + the restore transfer if
+  the tenant's ``A`` is evicted + the dispatch itself). A request that
+  cannot make its deadline is **rejected fast** with a typed
+  :class:`~..utils.errors.AdmissionRejectedError` — microseconds at the
+  door instead of burning a dispatch slot to expire in the backpressure
+  gate or serve an answer nobody is waiting for. Admission OWNS the
+  deadline: an admitted request is dispatched without one (the
+  prediction was the commitment), so deadline-expire after admission is
+  structurally zero — the failure mode this layer exists to delete.
+* **cross-tenant flush interleaving** — dispatch order is decided across
+  tenants, and ahead of a **predicted-long** dispatch the scheduler
+  enqueues the hottest evicted tenant's swap-in
+  (:meth:`~.registry.MatrixRegistry.prefetch` — the PR 9 async
+  ``device_put`` path), so eviction restores hide under compute instead
+  of stalling that tenant's next request.
+* **cross-tenant coalescing** — tenants whose engines share an exec
+  signature AND payload bytes (``registry.coalesce_group``: same
+  compiled programs, same ``A``) may share one column-stacked flush;
+  per-column results are bitwise-identical to solo submits by the PR 6
+  exactness doctrine (which batch column a request rides never changes
+  its output). Counted in ``sched_cross_tenant_coalesced_total``. The
+  coalescing here is opportunistic over back-to-back submissions (a
+  group switch, a width threshold, a deadline, or ``flush()`` closes
+  the open batch — there is no timer thread; the arrival-window
+  scheduler remains the latency-targeted per-engine coalescer).
+* **demand-aware eviction** — the registry's victim score gains a
+  predicted-demand term (each tenant's EWMA arrival rate — exported as
+  ``tenant_rate_req_per_s{tenant=...}`` — weighed by its predicted
+  restore cost; ``MatrixRegistry(demand_weight=...)``), so "about to be
+  asked for and expensive to bring back" protects a resident the way
+  "recently used" alone cannot. Rejected demand still ticks the
+  estimator (``registry.observe_demand``): a tenant refused under load
+  is exactly the tenant whose residency would fix the refusals.
+
+**Every decision explains itself**: admit / reject / interleave / evict
+(and each coalesced flush) lands in a bounded decision ring — mirrored
+to a JSONL file via the obs sink thread when ``decision_jsonl`` is set —
+carrying ``predicted_s`` and ``reason`` fields, and is mirrored as
+``gsched_*`` metrics the obs CLI renders as the ``global scheduler``
+panel (``python -m matvec_mpi_multiplier_tpu.obs metrics``).
+
+**Uncalibrated degrade** (the cold-cache contract): with no calibration
+record in the tuning cache the scheduler degrades to the greedy
+baseline — every request admitted, deadlines handed through to the
+engine's own gate, ONE warning log line — and never rejects on
+``predicted_s=None``. Calibrate (``python -m
+matvec_mpi_multiplier_tpu.tuning.cost_model --calibrate quick``) to turn
+prediction on.
+
+The admission path consults predictions but never *measures* — no probe,
+no ``perf_counter`` pair around a dispatch, no calibration. Enforced by
+staticcheck rule ``measurement-in-admission-path`` (marker
+``admit-ok:``): timing belongs to the tuner and the bench, and an
+admission gate that measures has put a benchmark in front of every
+request.
+
+Benchmarked by ``bench/serve.py --tenants ... --global-sched on|off|both
+--deadline-ms ...`` (same-trace A/B; the committed capture lives in
+``data/gsched_demo/``).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..obs.sink import JsonlSink
+from ..utils.errors import AdmissionRejectedError, ConfigError
+from .core import DEFAULT_PROMOTE_B, MatvecFuture
+from .registry import MatrixRegistry
+from .scheduler import QOS_TIERS, _SharedResult
+
+# Decision vocabulary (the ring's `decision` field and the gsched_*
+# counter suffixes).
+DECISIONS = ("admit", "reject", "interleave", "evict", "flush")
+
+# Bounded decision ring: enough to hold a whole bench trace's decisions
+# without growing with uptime.
+DEFAULT_DECISION_CAPACITY = 4096
+
+# Fallback per-dispatch queue charge when the model has no formula for a
+# config (the backlog estimate must not read an unpredictable dispatch
+# as free).
+_FALLBACK_DISPATCH_S = 1e-4
+
+
+class _GsSlice:
+    """One coalesced member's future: resolves to its own columns of the
+    shared flush result (mirrors the ``MatvecFuture`` face). Materializing
+    an un-flushed member triggers the flush itself — a caller can always
+    drain."""
+
+    def __init__(self, sched: "GlobalScheduler", vector: bool, width: int):
+        self._sched = sched
+        self._vector = vector
+        self.width = width
+        self._event = threading.Event()
+        self._shared: _SharedResult | None = None
+        self.offset: int | None = None
+        self.retired = False
+
+    def _resolve(self, shared: _SharedResult, offset: int) -> None:
+        self._shared = shared
+        self.offset = offset
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set() and self._shared.done()
+
+    def exception(self) -> Exception | None:
+        """The failure this member's flush carries (after someone
+        materialized the shared result), or None — including while the
+        batch is still open."""
+        if self._event.is_set() and self._shared._done:
+            return self._shared._error
+        return None
+
+    def result(self) -> np.ndarray:
+        if not self._event.is_set():
+            self._sched.flush()  # self-healing: draining forces the flush
+        self._event.wait()
+        block = self._shared.value()
+        self.retired = True
+        if self._vector:
+            return block[:, self.offset]
+        return block[:, self.offset:self.offset + self.width]
+
+
+class _PendingMember:
+    """One request waiting in the open cross-tenant batch."""
+
+    __slots__ = ("tenant_id", "block", "width", "future")
+
+    def __init__(self, tenant_id, block, width, future):
+        self.tenant_id = tenant_id
+        self.block = block
+        self.width = width
+        self.future = future
+
+
+class GlobalScheduler:
+    """SLO-aware cross-tenant scheduling over a
+    :class:`~.registry.MatrixRegistry` (module docstring has the
+    doctrine; docs/SCHEDULING.md the operator's guide).
+
+    Parameters
+    ----------
+    registry : the tenant fleet to schedule. The scheduler registers
+        itself as the registry's ``eviction_listener`` (eviction
+        decisions enter the trace) and counts into ``registry.metrics``.
+    cost_model : ``"auto"`` (any calibration record in the tuning cache,
+        largest probed mesh — ``tuning.cost_model.any_model_from_cache``),
+        an explicit :class:`~..tuning.cost_model.CostModel`, or None.
+        Without a model the scheduler degrades to the greedy baseline
+        (one warning line; never rejects).
+    deadline_margin : admission rejects when ``eta_s > deadline ·
+        margin``. 1.0 rejects exactly at the predicted miss; above 1.0
+        admits optimistically (tolerate prediction error), below 1.0
+        rejects conservatively (reserve headroom).
+    interleave_threshold_s : a dispatch predicted at or above this
+        overlaps the hottest evicted tenant's swap-in. None (default):
+        the predicted restore cost of a mean-size payload — a dispatch
+        long enough to hide the transfer it is covering.
+    coalesce : allow same-group cross-tenant coalescing (default True;
+        the A/B bench's ``off`` mode disables the whole layer, not this
+        flag).
+    flush_width : open-batch width that forces a flush — ``None`` uses
+        the fleet's tuned promotion point ``b*`` (static default on a
+        cold cache).
+    decision_jsonl : mirror every decision record to this JSONL file via
+        the obs sink thread (None: ring only).
+    decision_capacity : bounded decision-ring length.
+    clock : injectable monotonic clock (seconds) — deadline arithmetic
+        and decision timestamps; tests drive a fake one.
+    log : one-line warning sink (default: stderr) — the uncalibrated
+        degrade notice.
+    """
+
+    def __init__(
+        self,
+        registry: MatrixRegistry,
+        *,
+        cost_model="auto",
+        deadline_margin: float = 1.0,
+        interleave_threshold_s: float | None = None,
+        coalesce: bool = True,
+        flush_width: int | None = None,
+        decision_jsonl=None,
+        decision_capacity: int = DEFAULT_DECISION_CAPACITY,
+        clock: Callable[[], float] = time.monotonic,
+        log: Callable[[str], None] | None = None,
+    ):
+        if deadline_margin <= 0:
+            raise ConfigError(
+                f"deadline_margin must be > 0, got {deadline_margin}"
+            )
+        self.registry = registry
+        self.deadline_margin = float(deadline_margin)
+        self._interleave_threshold_s = interleave_threshold_s
+        self._coalesce = bool(coalesce)
+        self._flush_width = flush_width
+        self._clock = clock
+        self._log = log if log is not None else (
+            lambda line: print(line, file=sys.stderr)
+        )
+        if cost_model == "auto":
+            from ..tuning.cache import TuningCache
+            from ..tuning.cost_model import any_model_from_cache
+
+            cost_model = any_model_from_cache(TuningCache.load())
+        self.model = cost_model
+        if self.model is None:
+            # The cold-cache contract: greedy, loudly, exactly once.
+            self._log(
+                "global scheduler: cost model uncalibrated — degrading "
+                "to greedy admission (no predicted-time rejects; run "
+                "`python -m matvec_mpi_multiplier_tpu.tuning.cost_model "
+                "--calibrate quick` to enable them)"
+            )
+
+        # Admission bookkeeping mutex: pending batch, outstanding window,
+        # decision ring, prediction memo. Dispatches, prefetches and
+        # flushes run AFTER it is released (the engine/ lock disciplines,
+        # rules #8/#11).
+        self._lock = threading.Lock()
+        self._pending: list[_PendingMember] = []
+        self._pending_group: tuple | None = None
+        self._pending_width = 0
+        self._outstanding: list[tuple[object, float]] = []
+        self._decisions: list[dict] = []
+        self._decision_capacity = int(decision_capacity)
+        self._predict_memo: dict[tuple, float | None] = {}
+        self._closed = False
+        self._sink = (
+            JsonlSink(decision_jsonl) if decision_jsonl is not None else None
+        )
+
+        metrics = registry.metrics
+        self._c_decisions = metrics.counter(
+            "gsched_decisions_total",
+            "global-scheduler decisions (admit+reject+interleave+evict"
+            "+flush)",
+        )
+        self._c_admits = metrics.counter(
+            "gsched_admits_total", "requests admitted to dispatch"
+        )
+        self._c_rejects = metrics.counter(
+            "gsched_rejects_total",
+            "requests rejected fast at admission (typed "
+            "AdmissionRejectedError — predicted ETA past the deadline; "
+            "rejected != failed in availability accounting)",
+        )
+        self._c_interleaves = metrics.counter(
+            "gsched_interleaves_total",
+            "evicted-tenant swap-ins enqueued under a predicted-long "
+            "dispatch (prefetch overlapped with compute)",
+        )
+        self._c_evict_decisions = metrics.counter(
+            "gsched_evictions_total",
+            "demand-aware evictions recorded in the decision trace",
+        )
+        self._c_flushes = metrics.counter(
+            "gsched_flushes_total", "coalesced flushes dispatched"
+        )
+        self._c_cross_tenant = metrics.counter(
+            "sched_cross_tenant_coalesced_total",
+            "requests that shared a coalesced flush with another "
+            "tenant's (same exec signature, same payload bytes)",
+        )
+        self._g_queue = metrics.gauge(
+            "gsched_queue_predicted_s",
+            "predicted seconds of outstanding dispatch backlog at the "
+            "last admission decision",
+        )
+        self._g_greedy = metrics.gauge(
+            "gsched_degraded_greedy",
+            "1 while the scheduler is running WITHOUT a calibrated cost "
+            "model (greedy admission; no predicted-time rejects)",
+        )
+        self._g_greedy.set(0 if self.model is not None else 1)
+        self._h_predicted = metrics.histogram(
+            "gsched_predicted_dispatch_ms",
+            "predicted dispatch milliseconds per admitted request",
+        )
+
+        if registry.eviction_listener is None:
+            registry.eviction_listener = self._on_eviction
+
+    # ---- the decision trace ----
+
+    def _record(self, decision: str, tenant_id: str, *,
+                predicted_s, reason: str, **fields) -> None:
+        record = {
+            "decision": decision,
+            "tenant": tenant_id,
+            "predicted_s": predicted_s,
+            "reason": reason,
+            "t_s": self._clock(),
+            **fields,
+        }
+        with self._lock:
+            self._decisions.append(record)
+            if len(self._decisions) > self._decision_capacity:
+                del self._decisions[: -self._decision_capacity]
+        self._c_decisions.inc()
+        if self._sink is not None:
+            self._sink.put(record)
+
+    def decisions(self) -> list[dict]:
+        """Snapshot of the bounded decision ring (newest last)."""
+        with self._lock:
+            return list(self._decisions)
+
+    def _on_eviction(self, victim: str, caused_by: str, score: float,
+                     restore_bytes: int) -> None:
+        """Registry eviction listener: the eviction enters the decision
+        trace with its predicted restore cost. Runs under the registry
+        lock — bookkeeping only (the ring append and a queue put)."""
+        self._c_evict_decisions.inc()
+        self._record(
+            "evict", victim,
+            predicted_s=(
+                self.model.restore_s(restore_bytes)
+                if self.model is not None else None
+            ),
+            reason=(
+                f"lowest demand-aware victim score ({score:.3f}) making "
+                f"headroom for {caused_by}"
+            ),
+            caused_by=caused_by,
+            restore_bytes=restore_bytes,
+        )
+
+    # ---- prediction ----
+
+    def _predict_dispatch_s(self, engine, b: int) -> float | None:
+        """Predicted seconds for one ``b``-column dispatch through the
+        engine's preferred config — memoized per (engine, bucket). The
+        per-column path models ``b`` sequential single-RHS programs; a
+        config the formula cannot express predicts None (admitted, never
+        rejected)."""
+        if self.model is None:
+            return None
+        cfg = engine.prediction_config(b)
+        memo_key = (id(engine), cfg["b"])
+        with self._lock:
+            if memo_key in self._predict_memo:
+                base = self._predict_memo[memo_key]
+                return None if base is None else (
+                    base * (b if cfg["b"] == 1 else 1)
+                )
+        try:
+            base = self.model.predict(
+                cfg["strategy"], cfg["combine"], m=cfg["m"], k=cfg["k"],
+                p=cfg["p"], dtype=cfg["dtype"], stages=cfg["stages"],
+                b=cfg["b"], storage=cfg["storage"],
+            ).total_s
+        except Exception:  # swallow-ok: a formula-less schedule honestly predicts None — absence of a prediction IS the recorded outcome (never a rejection)
+            base = None
+        with self._lock:
+            self._predict_memo[memo_key] = base
+        return None if base is None else base * (b if cfg["b"] == 1 else 1)
+
+    def _queue_s(self) -> float:
+        """Predicted backlog: the sum of the outstanding (not yet done)
+        dispatches' predictions. Done futures are swept — a non-blocking
+        ``is_ready`` probe per entry."""
+        with self._lock:
+            self._outstanding = [
+                (fut, s) for fut, s in self._outstanding if not fut.done()
+            ]
+            total = sum(s for _, s in self._outstanding)
+        self._g_queue.set(total)
+        return total
+
+    def _track(self, fut, predicted_s: float | None) -> None:
+        """Track one dispatch in the predicted-backlog window. Greedy
+        mode (no model) never consults the backlog, so tracking there
+        would only accumulate future references that nothing sweeps
+        (_queue_s is the sweeper, and only admission calls it)."""
+        if self.model is None:
+            return
+        with self._lock:
+            self._outstanding.append(
+                (fut, predicted_s if predicted_s is not None
+                 else _FALLBACK_DISPATCH_S)
+            )
+
+    # ---- interleaving ----
+
+    def _interleave_threshold(self) -> float:
+        if self._interleave_threshold_s is not None:
+            return self._interleave_threshold_s
+        # Default: the restore cost of a mean-size payload — a dispatch
+        # long enough to hide the transfer it would cover.
+        with self.registry._lock:
+            mean = self.registry._mean_payload_locked()
+        return self.model.restore_s(int(mean))
+
+    def _maybe_interleave(self, tenant_id: str,
+                          dispatch_s: float | None) -> str | None:
+        """Ahead of a predicted-long dispatch, pick the hottest evicted
+        tenant and enqueue its swap-in so the restore overlaps under the
+        dispatch's compute. Returns the prefetched tenant id (or None).
+        The prefetch is enqueue-only (``device_put``); the decision is
+        recorded BEFORE it is issued, so the trace shows the swap-in
+        ordered ahead of the covering dispatch.
+
+        Damped against thrash: under a full budget every prefetch evicts
+        someone, so the swap-in only pays when the evicted candidate's
+        demand EXCEEDS the coldest unpinned resident's — otherwise the
+        fleet is already placed where the demand is, and "overlap a
+        swap" would just churn residencies under the hot set."""
+        if self.model is None or dispatch_s is None:
+            return None
+        if dispatch_s < self._interleave_threshold():
+            return None
+        best, best_rate = None, 0.0
+        coldest_resident = None
+        for tid in self.registry.tenant_ids():
+            if tid == tenant_id:
+                continue
+            entry = self.registry._tenants.get(tid)
+            if entry is None:
+                continue
+            rate = entry.rate.rate_per_s()
+            if entry.engine.resident:
+                if not entry.pinned and (
+                    coldest_resident is None or rate < coldest_resident
+                ):
+                    coldest_resident = rate
+            elif rate > best_rate:
+                best, best_rate = tid, rate
+        if best is None:
+            return None
+        if coldest_resident is not None and best_rate <= coldest_resident:
+            return None  # placement already follows demand: don't churn
+        entry = self.registry._tenants.get(best)
+        if entry is None:
+            return None  # raced an unregister between scan and pick
+        restore = entry.engine.resident_bytes
+        self._c_interleaves.inc()
+        self._record(
+            "interleave", best,
+            predicted_s=self.model.restore_s(restore),
+            reason=(
+                f"swap-in ({best_rate:.2f} req/s demand) overlapped "
+                f"under {tenant_id}'s {dispatch_s * 1e3:.3f} ms dispatch"
+            ),
+            under=tenant_id,
+            restore_bytes=restore,
+        )
+        try:
+            self.registry.prefetch(best, protect=tenant_id)
+        except ConfigError:
+            return None  # the tenant was unregistered mid-decision
+        return best
+
+    # ---- admission & dispatch ----
+
+    def submit(
+        self,
+        tenant_id: str,
+        x,
+        *,
+        deadline_ms: float | None = None,
+        qos: str = "standard",
+    ):
+        """Admit one request for ``tenant_id`` — a ``(k,)`` vector or
+        ``(k, b)`` block. Calibrated + deadlined: the queue-aware ETA is
+        checked first and an infeasible request fails fast with
+        :class:`AdmissionRejectedError` (no dispatch, no eviction
+        pressure). Admitted requests dispatch WITHOUT a deadline —
+        admission owns it (module docstring). Uncalibrated: greedy —
+        everything passes through with its deadline intact for the
+        engine's own gate."""
+        if qos not in QOS_TIERS:
+            raise ConfigError(
+                f"unknown QoS tier {qos!r}; expected one of {QOS_TIERS}"
+            )
+        if self._closed:
+            raise ConfigError("global scheduler is closed")
+        entry = self.registry._entry(tenant_id)
+        engine = entry.engine
+        block = np.asarray(x, dtype=engine.dtype)  # sync-ok: requests are host arrays (engine contract)
+        vector = block.ndim == 1
+        if block.ndim not in (1, 2) or block.shape[0] != engine.k or (
+            block.ndim == 2 and block.shape[1] == 0
+        ):
+            raise ConfigError(
+                f"request must be (k,) or (k, b) with k={engine.k}; got "
+                f"shape {block.shape}"
+            )
+        if vector:
+            block = block[:, None]
+        width = block.shape[1]
+
+        dispatch_s = self._predict_dispatch_s(engine, width)
+        if self.model is not None:
+            from ..tuning.cost_model import AdmissionEstimate
+
+            queue_s = self._queue_s()
+            swap_bytes = 0 if engine.resident else engine.resident_bytes
+            swap_s = self.model.restore_s(swap_bytes) if swap_bytes else 0.0
+            # One ETA formula in the repo: AdmissionEstimate composes the
+            # terms (the dispatch prediction itself is memoized here, so
+            # this is the dataclass, not a re-prediction).
+            est = (
+                AdmissionEstimate(
+                    dispatch_s=dispatch_s, queue_s=queue_s, swap_s=swap_s
+                )
+                if dispatch_s is not None else None
+            )
+            eta_s = est.eta_s if est is not None else None
+            if deadline_ms is not None and (
+                deadline_ms <= 0
+                or (
+                    eta_s is not None
+                    and eta_s * 1e3 > deadline_ms * self.deadline_margin
+                )
+            ):
+                # Reject fast: typed, pre-dispatch, traced. Rejected
+                # demand still ticks the tenant's rate estimator — its
+                # residency is what would fix the refusals.
+                self.registry.observe_demand(tenant_id)
+                self._c_rejects.inc()
+                reason = (
+                    "deadline elapsed before admission"
+                    if deadline_ms <= 0 else
+                    f"predicted eta {eta_s * 1e3:.3f} ms (queue "
+                    f"{queue_s * 1e3:.3f} + swap {swap_s * 1e3:.3f} + "
+                    f"dispatch {dispatch_s * 1e3:.3f}) > deadline "
+                    f"{deadline_ms:.3f} ms"
+                )
+                self._record(
+                    "reject", tenant_id, predicted_s=dispatch_s,
+                    reason=reason, eta_s=eta_s, queue_s=queue_s,
+                    deadline_ms=deadline_ms,
+                )
+                return MatvecFuture.failed(AdmissionRejectedError(
+                    f"request for tenant {tenant_id!r} rejected at "
+                    f"admission: {reason}"
+                ))
+            if dispatch_s is not None:
+                self._h_predicted.observe(dispatch_s * 1e3)
+            self._record(
+                "admit", tenant_id, predicted_s=dispatch_s,
+                reason=(
+                    "uncalibrated config: admitted without a prediction"
+                    if dispatch_s is None else
+                    f"predicted eta "
+                    f"{(eta_s if eta_s is not None else dispatch_s) * 1e3:.3f}"
+                    f" ms within "
+                    + (f"deadline {deadline_ms:.3f} ms"
+                       if deadline_ms is not None else "no deadline")
+                ),
+                eta_s=eta_s, queue_s=queue_s, deadline_ms=deadline_ms,
+            )
+            self._maybe_interleave(tenant_id, dispatch_s)
+            # Admission owns the deadline from here (module docstring).
+            engine_deadline = None
+        else:
+            # Greedy degrade: admit, deadline handed through to the
+            # engine's own gate, decision still traced (predicted_s is
+            # honestly None — and never a reason to reject).
+            self._c_admits.inc()
+            self._record(
+                "admit", tenant_id, predicted_s=None,
+                reason="greedy admission (cost model uncalibrated)",
+                deadline_ms=deadline_ms,
+            )
+            fut = self.registry.submit(
+                tenant_id, x, deadline_ms=deadline_ms
+            )
+            self._track(fut, None)
+            return fut
+
+        self._c_admits.inc()
+        if not self._coalesce:
+            fut = self.registry.submit(
+                tenant_id, x, deadline_ms=engine_deadline
+            )
+            self._track(fut, dispatch_s)
+            return fut
+        return self._enqueue_coalesced(
+            tenant_id, block, vector, width, dispatch_s,
+            flush_now=deadline_ms is not None or qos == "interactive",
+        )
+
+    def __call__(self, tenant_id: str, x) -> np.ndarray:
+        """Synchronous convenience: ``submit(tenant_id, x).result()``."""
+        return self.submit(tenant_id, x).result()
+
+    # ---- coalescing ----
+
+    def _resolved_flush_width(self, engine) -> int:
+        if self._flush_width is not None:
+            return self._flush_width
+        b_star = engine.b_star
+        return b_star if b_star is not None else DEFAULT_PROMOTE_B
+
+    def _enqueue_coalesced(self, tenant_id, block, vector, width,
+                           dispatch_s, flush_now: bool):
+        # Members reach registry.submit only through the flush OWNER, so
+        # their demand estimators would under-tick (the eviction score's
+        # input); tick each member here instead. The owner gets one
+        # extra tick per flush from registry.submit — a bounded
+        # overcount that never changes a hot/cold ranking.
+        self.registry.observe_demand(tenant_id)
+        group = self.registry.coalesce_group(tenant_id)
+        fut = _GsSlice(self, vector, width)
+        member = _PendingMember(tenant_id, block, width, fut)
+        engine = self.registry._entry(tenant_id).engine
+        batch = None
+        with self._lock:
+            if self._pending and self._pending_group != group:
+                # Order preservation: a different group's arrival closes
+                # the open batch first.
+                batch = self._swap_batch_locked()
+            self._pending.append(member)
+            self._pending_group = group
+            self._pending_width += width
+            if (
+                flush_now
+                or self._pending_width >= self._resolved_flush_width(engine)
+            ):
+                own = self._swap_batch_locked()
+            else:
+                own = None
+        if batch is not None:
+            self._flush_batch(batch)
+        if own is not None:
+            self._flush_batch(own)
+        return fut
+
+    def _swap_batch_locked(self) -> list[_PendingMember] | None:
+        if not self._pending:
+            return None
+        batch = self._pending
+        self._pending = []
+        self._pending_group = None
+        self._pending_width = 0
+        return batch
+
+    def _flush_batch(self, batch: list[_PendingMember]) -> None:
+        """Dispatch one swapped-out batch as ONE registry submit through
+        the first member's tenant (the flush owner — its residency and
+        hit accounting absorb the dispatch). Runs with no scheduler lock
+        held. Cross-tenant members are counted; per-member futures
+        resolve to their own columns of the shared result."""
+        owner = batch[0].tenant_id
+        stacked = (
+            batch[0].block if len(batch) == 1
+            else np.concatenate([m.block for m in batch], axis=1)
+        )
+        width = stacked.shape[1]
+        owner_engine = self.registry._entry(owner).engine
+        predicted = self._predict_dispatch_s(owner_engine, width)
+        cross = sum(1 for m in batch if m.tenant_id != owner)
+        if cross:
+            self._c_cross_tenant.inc(cross + 1)  # every sharing member
+        self._c_flushes.inc()
+        self._record(
+            "flush", owner, predicted_s=predicted,
+            reason=(
+                f"{len(batch)} request(s), {width} column(s)"
+                + (f", {cross} from other tenants" if cross else "")
+            ),
+            n_requests=len(batch), width=width,
+        )
+        try:
+            inner = self.registry.submit(owner, stacked)
+        except Exception as e:  # swallow-ok: the failure is parked in every member's future via MatvecFuture.failed — callers re-raise it at result()
+            shared = _SharedResult(MatvecFuture.failed(e))
+        else:
+            self._track(inner, predicted)
+            shared = _SharedResult(inner)
+        offset = 0
+        for m in batch:
+            m.future._resolve(shared, offset)
+            offset += m.width
+
+    def flush(self) -> int:
+        """Dispatch the open batch now (driver/drain code). Returns the
+        number of requests flushed."""
+        with self._lock:
+            batch = self._swap_batch_locked()
+        if batch is None:
+            return 0
+        self._flush_batch(batch)
+        return len(batch)
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        """Flush the open batch, stop accepting submits, release the
+        decision sink. Does NOT close the registry."""
+        if self._closed:
+            return
+        self.flush()
+        self._closed = True
+        if self._sink is not None:
+            self._sink.close()
+
+    def __enter__(self) -> "GlobalScheduler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -75,8 +75,10 @@ docs/MULTITENANT.md.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import itertools
 import threading
+import time
 from typing import Callable, Sequence
 
 from ..obs.registry import MetricsRegistry
@@ -89,6 +91,13 @@ from .executables import ExecutableCache
 # protection per cost_weight unit. 1.0 keeps homogeneous fleets exactly
 # LRU while still breaking recency ties toward the cheaper restore.
 DEFAULT_COST_WEIGHT = 1.0
+
+# Time constant of the per-tenant arrival-rate EWMA feeding demand-aware
+# eviction (and exported as tenant_rate_req_per_s{tenant=...} gauges):
+# long enough to remember a Zipf-hot tenant across a few of its gaps,
+# short enough that a tenant going cold stops being protected within
+# seconds.
+DEFAULT_RATE_TAU_S = 5.0
 
 # Tenant ids become fault-label prefixes (``<tenant>/op:strategy:...``),
 # metric label values and CSV cells — the grammar forbids the separators
@@ -194,8 +203,8 @@ class _Tenant:
     __slots__ = (
         "tenant_id", "engine", "quota", "pinned", "last_used", "active",
         "outstanding", "charged_bytes", "requests", "hits", "evictions",
-        "evictions_caused", "quota_rejections", "swap_ins",
-        "g_resident_bytes", "g_pinned", "c_requests", "c_hits",
+        "evictions_caused", "quota_rejections", "swap_ins", "payload_sha",
+        "rate", "g_resident_bytes", "g_pinned", "c_requests", "c_hits",
         "c_evictions", "c_evictions_caused", "c_quota_rejections",
     )
 
@@ -215,6 +224,8 @@ class _Tenant:
         self.evictions_caused = 0
         self.quota_rejections = 0
         self.swap_ins = 0
+        self.payload_sha = ""    # host-A content hash, lazy (coalesce groups)
+        self.rate = None         # per-tenant arrival RateEstimator
 
     def sweep(self) -> None:
         """Drop consumed futures from the outstanding window (the quota
@@ -282,6 +293,23 @@ class MatrixRegistry:
         unlimited; accounting still runs).
     cost_weight : eviction-score weight of restore cost vs recency
         (:data:`DEFAULT_COST_WEIGHT`; 0 = pure LRU).
+    demand_weight : eviction-score weight of PREDICTED DEMAND — each
+        tenant's EWMA arrival rate (its :class:`~..obs.registry.
+        RateEstimator`, exported as ``tenant_rate_req_per_s{tenant=...}``)
+        times its restore-cost ratio. One sustained request/s of demand
+        on a mean-size payload buys ``demand_weight`` recency serials of
+        protection: a hot tenant that is expensive to bring back stops
+        being evicted just because its last hit is a few serials old.
+        0 (the default) keeps the PR 9 recency+cost score exactly — the
+        LRU-floor gates stay byte-for-byte; the global scheduler
+        (``global_scheduler.py``; docs/SCHEDULING.md) turns it on.
+    rate_tau_s / rate_clock : the demand estimators' EWMA time constant
+        and injectable clock (tests drive a fake clock).
+    eviction_listener : ``callable(victim_id, caused_by_id, score,
+        restore_bytes)`` invoked after each eviction's reference drop,
+        under the registry lock — bookkeeping only by the lock
+        discipline (the global scheduler records the decision with its
+        predicted restore cost).
     metrics : shared obs registry for the whole fleet (default: a fresh
         one). Tenant engines count into it too, so ``engine_*`` counters
         read as fleet aggregates; per-tenant truth lives under the
@@ -301,6 +329,12 @@ class MatrixRegistry:
         *,
         hbm_budget: int | None = None,
         cost_weight: float = DEFAULT_COST_WEIGHT,
+        demand_weight: float = 0.0,
+        rate_tau_s: float = DEFAULT_RATE_TAU_S,
+        rate_clock: Callable[[], float] = time.monotonic,
+        eviction_listener: (
+            Callable[[str, str, float, int], None] | None
+        ) = None,
         metrics: MetricsRegistry | None = None,
         resilience=None,
         fault_plan=None,
@@ -316,6 +350,14 @@ class MatrixRegistry:
         if cost_weight < 0:
             raise ConfigError(f"cost_weight must be >= 0, got {cost_weight}")
         self.cost_weight = float(cost_weight)
+        if demand_weight < 0:
+            raise ConfigError(
+                f"demand_weight must be >= 0, got {demand_weight}"
+            )
+        self.demand_weight = float(demand_weight)
+        self.rate_tau_s = float(rate_tau_s)
+        self._rate_clock = rate_clock
+        self.eviction_listener = eviction_listener
         bad = _RESERVED_ENGINE_KWARGS.intersection(engine_defaults)
         if bad:
             raise ConfigError(
@@ -384,6 +426,12 @@ class MatrixRegistry:
             "registry_native_fallback_charges_total",
             "degradation-ladder native safe-tier placements charged to "
             "their tenant (the footprint a degraded dispatch adds)",
+        )
+        self._c_prefetches = self.metrics.counter(
+            "registry_prefetches_total",
+            "demand-driven prefetch() admissions (swap-ins enqueued to "
+            "overlap under another tenant's dispatch — the global "
+            "scheduler's interleaving)",
         )
 
     # ---- registration ----
@@ -458,6 +506,14 @@ class MatrixRegistry:
                 f"{quota.max_resident_bytes} quota"
             )
         entry = _Tenant(tenant_id, engine, quota)
+        # Per-tenant arrival-rate EWMA: the predicted-demand signal
+        # (demand-aware eviction) and a snapshot gauge.
+        entry.rate = self.metrics.rate_estimator(
+            f'tenant_rate_req_per_s{{tenant="{tenant_id}"}}',
+            "EWMA arrival rate of this tenant's offered requests "
+            "(admission-rejected demand included)",
+            tau_s=self.rate_tau_s, clock=self._rate_clock,
+        )
         entry.g_resident_bytes = self._tenant_gauge(
             tenant_id, "resident_bytes",
             "device-resident bytes charged to this tenant",
@@ -557,14 +613,34 @@ class MatrixRegistry:
         total = sum(e.engine.resident_bytes for e in self._tenants.values())
         return max(1.0, total / len(self._tenants))
 
+    def _victim_score_locked(self, e: _Tenant, mean: float,
+                             now: float) -> float:
+        """One tenant's eviction score (lowest evicts): recency, plus
+        the restore-cost ratio (PR 9), plus — when ``demand_weight`` is
+        on — the predicted-demand term: the tenant's EWMA arrival rate
+        weighed by that same restore ratio. A tenant being asked for
+        right now and expensive to bring back outranks a merely
+        recently-used one; a cold estimator (rate 0) reduces the score
+        to exactly the PR 9 form."""
+        restore_ratio = e.charged_bytes / mean
+        score = e.last_used + self.cost_weight * restore_ratio
+        if self.demand_weight:
+            score += (
+                self.demand_weight
+                * e.rate.rate_per_s(now=now)
+                * restore_ratio
+            )
+        return score
+
     def _pick_victim_locked(self, exclude: _Tenant) -> _Tenant | None:
-        """Cost-aware LRU: evict the eligible resident tenant with the
-        lowest ``last_used + cost_weight · restore_cost_ratio`` score.
-        Pinned tenants and tenants mid-submit (``active > 0`` — the
-        window between admission and the dispatch capturing its device
+        """Demand-aware cost-aware LRU: evict the eligible resident
+        tenant with the lowest :meth:`_victim_score_locked`. Pinned
+        tenants and tenants mid-submit (``active > 0`` — the window
+        between admission and the dispatch capturing its device
         reference) are never eligible; in-flight FUTURES need no
         protection (refcounted residency keeps their buffers alive)."""
         mean = self._mean_payload_locked()
+        now = self._rate_clock() if self.demand_weight else 0.0
         best: _Tenant | None = None
         best_score = None
         for e in self._tenants.values():
@@ -573,9 +649,7 @@ class MatrixRegistry:
                 or not e.engine.resident
             ):
                 continue
-            score = e.last_used + self.cost_weight * (
-                e.charged_bytes / mean
-            )
+            score = self._victim_score_locked(e, mean, now)
             if best_score is None or score < best_score:
                 best, best_score = e, score
         return best
@@ -587,18 +661,28 @@ class MatrixRegistry:
         docstring's soft-budget doctrine). Release is a reference drop,
         legal under the lock; the freed bytes enter the ledger through
         the victim's residency listener before the next victim is
-        scored."""
+        scored. The optional ``eviction_listener`` fires per victim
+        under the lock (bookkeeping only — the global scheduler's
+        decision trace)."""
         needed = entry.engine.resident_bytes
+        mean = self._mean_payload_locked()
+        now = self._rate_clock() if self.demand_weight else 0.0
         while not self.accountant.headroom(needed):
             victim = self._pick_victim_locked(entry)
             if victim is None:
                 break
+            score = self._victim_score_locked(victim, mean, now)
             victim.engine.release_residency()
             victim.evictions += 1
             victim.c_evictions.inc()
             self._c_evictions.inc()
             entry.evictions_caused += 1
             entry.c_evictions_caused.inc()
+            if self.eviction_listener is not None:
+                self.eviction_listener(
+                    victim.tenant_id, entry.tenant_id, score,
+                    victim.engine.resident_bytes,
+                )
 
     # ---- the serving face ----
 
@@ -625,6 +709,7 @@ class MatrixRegistry:
             entry.requests += 1
             entry.c_requests.inc()
             self._c_requests.inc()
+            entry.rate.observe()  # the demand signal eviction weighs
             quota = entry.quota
             if quota is not None and quota.max_in_flight is not None:
                 entry.sweep()
@@ -706,6 +791,84 @@ class MatrixRegistry:
             entry = self._entry(tenant_id)
             entry.pinned = False
             entry.g_pinned.set(0)
+
+    # ---- the global scheduler's hooks (docs/SCHEDULING.md) ----
+
+    def observe_demand(self, tenant_id: str, n: int = 1) -> None:
+        """Tick a tenant's demand estimator WITHOUT a submit — the
+        global scheduler calls this for admission-rejected requests, so
+        a tenant being refused under load still reads as hot demand to
+        the eviction score (its residency is exactly what would let its
+        next request be admitted)."""
+        with self._lock:
+            self._entry(tenant_id).rate.observe(n)
+
+    def demand_rate(self, tenant_id: str) -> float:
+        """The tenant's EWMA offered-request rate (req/s, idle-decayed)."""
+        with self._lock:
+            entry = self._entry(tenant_id)
+        return entry.rate.rate_per_s()
+
+    def coalesce_group(self, tenant_id: str) -> tuple:
+        """The tenant's cross-tenant coalescing identity: its engine's
+        exec signature plus the sha256 of its normalized host payload.
+        Tenants in one group run the SAME compiled programs over the
+        SAME ``A`` bytes, so their requests may share one column-stacked
+        flush with bitwise-identical per-column results (the PR 6
+        exactness doctrine — which column of the batch a request rides
+        never changes its output). The hash is computed LAZILY on first
+        use (this method is the only consumer) and cached — a registry
+        that never coalesces never pays an O(payload) hashing pass at
+        registration; the host payload is immutable for the tenant's
+        lifetime, so a racing duplicate computation is idempotent."""
+        with self._lock:
+            entry = self._entry(tenant_id)
+            sha = entry.payload_sha
+        if not sha:
+            sha = hashlib.sha256(entry.engine._a_host.tobytes()).hexdigest()
+            with self._lock:
+                entry.payload_sha = sha
+        return (entry.engine.exec_signature(), sha)
+
+    def prefetch(self, tenant_id: str, *, protect: str | None = None)\
+            -> bool:
+        """Demand-driven swap-in: admit the tenant's payload NOW (evict
+        by score if needed) without pinning it — the global scheduler
+        enqueues this ahead of a predicted-long dispatch so the
+        ``device_put`` restore overlaps under that dispatch's compute
+        instead of stalling the tenant's next request. Returns True when
+        this call placed the payload (False: already resident). The
+        prefetch counts as an anticipated USE (recency bumped) so the
+        next admission does not immediately re-evict it, and ``protect``
+        shields one tenant — the one whose dispatch the overlap hides —
+        from being chosen as the victim. The transfer itself happens
+        outside the lock, enqueue-only — the same discipline as
+        :meth:`pin` and the submit path."""
+        with self._lock:
+            entry = self._entry(tenant_id)
+            if entry.engine.resident:
+                return False
+            guard = (
+                self._tenants.get(protect)
+                if protect is not None else None
+            )
+            if guard is not None:
+                guard.active += 1  # victim-ineligible for this pick only
+            try:
+                self._evict_for_locked(entry)
+            finally:
+                if guard is not None:
+                    guard.active -= 1
+            entry.last_used = next(self._serial)
+            entry.active += 1
+        try:
+            placed = entry.engine.ensure_resident()
+        finally:
+            with self._lock:
+                entry.active -= 1
+        if placed:
+            self._c_prefetches.inc()
+        return placed
 
     # ---- warmup, stats, health ----
 

@@ -96,7 +96,11 @@ under the matrix registry coalescing is per-tenant by construction
 eviction is safe: a registry-managed engine re-places its retained host
 payload transparently inside the dispatch (``MatvecEngine._a_for``),
 accounted through the residency listener — the flusher thread needs no
-registry coordination.
+registry coordination. CROSS-tenant coalescing — tenants sharing an
+exec signature AND payload bytes contributing columns to one flush,
+counted in ``sched_cross_tenant_coalesced_total`` — lives in the global
+scheduler (``global_scheduler.py``; docs/SCHEDULING.md), which knows
+tenant identity; this class stays one-engine by design.
 """
 
 from __future__ import annotations

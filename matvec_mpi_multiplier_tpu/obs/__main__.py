@@ -175,6 +175,51 @@ def render_tenants(snapshot: dict) -> str | None:
     return "\n".join(out)
 
 
+def render_gsched(snapshot: dict) -> str | None:
+    """The global scheduler panel: the decision mix (admit / reject /
+    interleave / evict / flush), the predicted-dispatch distribution and
+    the predicted queue depth, read off the ``gsched_*`` metrics
+    (engine/global_scheduler.py; docs/SCHEDULING.md explains reading a
+    rejection trace). None when the snapshot carries no global-scheduler
+    vocabulary (a greedy run)."""
+    counters = snapshot.get("counters", {})
+    if "gsched_decisions_total" not in counters:
+        return None
+    gauges = snapshot.get("gauges", {})
+    hists = snapshot.get("histograms", {})
+    predicted = hists.get("gsched_predicted_dispatch_ms", {})
+    admits = counters.get("gsched_admits_total", 0)
+    rejects = counters.get("gsched_rejects_total", 0)
+    offered = admits + rejects
+    greedy = gauges.get("gsched_degraded_greedy", 0)
+    out = [
+        "global scheduler:",
+        f"  decisions         {counters.get('gsched_decisions_total', 0)}"
+        + (" [DEGRADED: greedy — cost model uncalibrated]" if greedy
+           else ""),
+        f"  admits            {admits}",
+        f"  rejects           {rejects} (typed, pre-dispatch; "
+        f"{(rejects / offered) if offered else float('nan'):.3f} of "
+        "offered — rejected != failed)",
+        f"  interleaves       "
+        f"{counters.get('gsched_interleaves_total', 0)} "
+        "(swap-ins overlapped under predicted-long dispatches)",
+        f"  evict decisions   {counters.get('gsched_evictions_total', 0)} "
+        "(demand-aware victim picks in the trace)",
+        f"  flushes           {counters.get('gsched_flushes_total', 0)} "
+        f"(cross-tenant coalesced requests "
+        f"{counters.get('sched_cross_tenant_coalesced_total', 0)})",
+        f"  predicted p50     "
+        f"{_fmt_ms(predicted.get('p50'))} per dispatch "
+        f"(p95 {_fmt_ms(predicted.get('p95'))}, "
+        f"n={predicted.get('count', 0)})",
+        f"  queue predicted   "
+        f"{gauges.get('gsched_queue_predicted_s', 0) * 1e3:.3f}ms "
+        "backlog at last admission",
+    ]
+    return "\n".join(out)
+
+
 def render_resilience(snapshot: dict) -> str | None:
     """The resilience panel: fault-injection volume, recovery activity
     (retries, downgrades, breaker opens/recoveries), blast-radius
@@ -214,6 +259,16 @@ def render_resilience(snapshot: dict) -> str | None:
             f"  availability      {rate:.4f} "
             f"({failed} fault-failed of {requests})"
         )
+        rejected = counters.get("gsched_rejects_total", 0)
+        if rejected:
+            # Rejected != failed (resilience.is_rejection): a typed
+            # pre-dispatch admission refusal is a scheduling outcome,
+            # not downtime — it never enters the failed numerator.
+            out.append(
+                f"  rejected          {rejected} "
+                "(typed pre-dispatch admission refusals — not counted "
+                "as failures)"
+            )
     out += [
         f"  faults injected   "
         f"{counters.get('resil_faults_injected_total', 0)}",
@@ -325,6 +380,9 @@ def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     tenants = render_tenants(snapshot)
     if tenants is not None:
         out.append(tenants)
+    gsched = render_gsched(snapshot)
+    if gsched is not None:
+        out.append(gsched)
     batching = render_batching(snapshot)
     if batching is not None:
         out.append(batching)

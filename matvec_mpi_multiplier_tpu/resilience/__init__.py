@@ -37,6 +37,7 @@ from .faults import (
     ResourceExhaustedError,
     ResultIntegrityError,
     is_payload_fault,
+    is_rejection,
     parse_fault_spec,
 )
 from .policy import (
@@ -60,6 +61,7 @@ __all__ = [
     "ResourceExhaustedError",
     "ResultIntegrityError",
     "is_payload_fault",
+    "is_rejection",
     "RetryPolicy",
     "CircuitBreaker",
     "ResiliencePolicy",

@@ -127,6 +127,20 @@ def refuse_nonfinite(
     )
 
 
+def is_rejection(exc: BaseException) -> bool:
+    """True when a failure is a SCHEDULING rejection, not a fault: the
+    global scheduler's predicted-time admission refused the request
+    before any dispatch (``AdmissionRejectedError``;
+    engine/global_scheduler.py). Availability accounting keeps the two
+    apart — **rejected ≠ failed**: a typed pre-dispatch refusal consumed
+    no device time, poisoned no batch, and is retryable by design,
+    whereas a fault failure is downtime. The serve bench and the obs
+    ``resilience`` panel count rejections in their own column."""
+    from ..utils.errors import AdmissionRejectedError
+
+    return isinstance(exc, AdmissionRejectedError)
+
+
 def is_payload_fault(exc: BaseException) -> bool:
     """True when a failure is scoped to the request's PAYLOAD, not the
     config or the device: a poisoned injected fault, or an

@@ -30,6 +30,11 @@ quant-fp64-scale          quant-ok     no float64 in quantization scale
 device-transfer-under-    registry-ok  no device transfer, dispatch, or
 registry-lock                          sync while holding a registry/
                                        residency mutex in engine/
+measurement-in-           admit-ok     the admission hot path consults
+admission-path                         predictions but never measures —
+                                       no timing-harness calls, no
+                                       perf_counter, no sync, no sleep
+                                       in engine/global_scheduler.py
 ========================  ===========  ====================================
 
 The first four are the old grep rules from ``scripts/tier1.sh`` /
@@ -719,6 +724,52 @@ def _check_registry_lock(sf: SourceFile):
                     "under the lock, device_put/dispatch after releasing "
                     "it (docs/MULTITENANT.md)"
                 )
+
+
+# The global scheduler's admission doctrine (engine/global_scheduler.py;
+# docs/SCHEDULING.md): every submit-time decision CONSULTS the calibrated
+# cost model — it never MEASURES. A measurement in the admission path
+# puts a benchmark in front of every request: a perf_counter pair around
+# a dispatch needs the dispatch to finish (a host sync on the submit
+# path), a timing-harness call (`time_matvec`, `benchmark_strategy`,
+# `calibrate`) runs reps, and a sleep stalls admission for every later
+# arrival. Deadline arithmetic uses the injectable monotonic clock, which
+# is a read, not a measurement — `time.monotonic` as a default-argument
+# REFERENCE stays legal; calling any of the names below in this scope
+# does not. Marker `admit-ok:` documents a sanctioned exception.
+
+
+def _admission_scope(rel: str) -> bool:
+    return rel == f"{_PKG}/engine/global_scheduler.py"
+
+
+_MEASUREMENT_CALLS = (
+    "perf_counter", "process_time", "timeit",
+    "time_matvec", "benchmark_strategy", "benchmark_gemm", "calibrate",
+    "_measure_fn", "block_until_ready", "sleep",
+)
+
+
+@_register(
+    "measurement-in-admission-path", "admit-ok",
+    "timing/measurement machinery in the global scheduler's admission "
+    "path (admission consults predictions; it never times a dispatch)",
+    _admission_scope,
+)
+def _check_admission_measurement(sf: SourceFile):
+    for call in _calls(sf.tree):
+        fn = call.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if attr in _MEASUREMENT_CALLS:
+            yield call, (
+                f"{attr}() in the admission path: admission consults the "
+                "calibrated cost model and never measures — timing a "
+                "dispatch here puts a benchmark (and its host sync) in "
+                "front of every request (move it to the tuner/bench, or "
+                "mark a deliberate exception with '# admit-ok: <reason>')"
+            )
 
 
 _MUTABLE_FACTORIES = (

@@ -211,6 +211,30 @@ class Prediction:
     wire_bytes: float
 
 
+@dataclasses.dataclass(frozen=True)
+class AdmissionEstimate:
+    """The global scheduler's queue-aware admission question, answered
+    (:meth:`CostModel.predict_admission`; docs/SCHEDULING.md): how long
+    until THIS request's result, counting everything already enqueued.
+
+    ``eta_s = queue_s + swap_s + dispatch_s`` — the predicted backlog of
+    outstanding dispatches, the restore transfer if the tenant's ``A``
+    is currently evicted (bytes over the calibrated resident-stream
+    bandwidth — the same constant that bounds the dispatch's own
+    ``T_compute``, since both move payload bytes through the memory
+    system), and the dispatch itself. Admission compares ``eta_s``
+    against the request's deadline; the decomposition is recorded on the
+    decision so a rejection trace explains itself."""
+
+    dispatch_s: float   # this request's predicted dispatch time
+    queue_s: float      # predicted backlog ahead of it (caller-supplied)
+    swap_s: float       # predicted restore cost (0 when resident)
+
+    @property
+    def eta_s(self) -> float:
+        return self.queue_s + self.swap_s + self.dispatch_s
+
+
 class CostModel:
     """Predict per-config dispatch time from one :class:`Calibration`.
 
@@ -299,6 +323,49 @@ class CostModel:
             total_s=total_s, compute_s=compute_s, wire_s=wire_s,
             latency_s=latency_s, flops=flops, a_bytes=a_bytes,
             wire_bytes=wire_bytes,
+        )
+
+    def restore_s(self, nbytes: int) -> float:
+        """Predicted cost of re-placing an evicted resident payload:
+        ``nbytes`` over the calibrated resident-stream bandwidth. Both
+        the swap-in transfer and the dispatch's own A-stream move payload
+        bytes through the memory system, so one calibrated constant
+        bounds both — the quantity demand-aware eviction weighs a
+        tenant's predicted demand against (engine/registry.py) and the
+        ``swap_s`` term of :meth:`predict_admission`."""
+        return float(nbytes) / self.calibration.mem_bps
+
+    def predict_admission(
+        self,
+        strategy: str | None,
+        combine: str | None,
+        *,
+        m: int,
+        k: int,
+        p: int,
+        dtype: str,
+        stages: int | None = None,
+        b: int = 1,
+        storage: str = "native",
+        r: int | None = None,
+        queue_s: float = 0.0,
+        swap_bytes: int = 0,
+    ) -> AdmissionEstimate:
+        """The queue-aware serving face of :meth:`predict`: the ETA of a
+        request submitted NOW — its own dispatch prediction, behind
+        ``queue_s`` of predicted backlog, behind the ``swap_bytes``
+        restore transfer when its tenant's ``A`` is evicted. The global
+        scheduler's admission gate (engine/global_scheduler.py) compares
+        ``.eta_s`` against the request's deadline at submit time —
+        reject-fast instead of deadline-expire (docs/SCHEDULING.md)."""
+        pred = self.predict(
+            strategy, combine, m=m, k=k, p=p, dtype=dtype, stages=stages,
+            b=b, storage=storage, r=r,
+        )
+        return AdmissionEstimate(
+            dispatch_s=pred.total_s,
+            queue_s=float(queue_s),
+            swap_s=self.restore_s(swap_bytes) if swap_bytes else 0.0,
         )
 
 

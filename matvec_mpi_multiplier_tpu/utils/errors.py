@@ -50,6 +50,22 @@ class DeadlineExceededError(MatvecError):
     can be retried."""
 
 
+class AdmissionRejectedError(MatvecError):
+    """The global scheduler's predicted-time admission refused a request
+    before any dispatch.
+
+    Raised by ``MatvecFuture.result()`` when the cost model's queue-aware
+    ETA (``engine/global_scheduler.py``; docs/SCHEDULING.md) says the
+    request cannot meet its ``deadline_ms``: rejecting at submit time
+    costs microseconds, while admitting it would burn a dispatch slot to
+    produce an answer after nobody is waiting (or to expire in the
+    backpressure gate). No device work ran and no eviction pressure was
+    exerted — the request can be retried with a looser deadline or on a
+    less loaded replica. A rejection is a *scheduling* outcome, distinct
+    from a fault: availability accounting keeps the two apart
+    (``resilience.is_rejection``; rejected ≠ failed)."""
+
+
 class TenantQuotaError(MatvecError):
     """A tenant's admission quota refused a request before dispatch.
 

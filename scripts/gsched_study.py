@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Global-scheduler evidence: the same-trace A/B capture (ISSUE 11
+acceptance; docs/SCHEDULING.md).
+
+One protocol, run twice on the SAME seeded 240-request Zipf chaos trace
+(the ``data/multitenant_demo/`` fleet: 6 tenants' 128x128 fp32 matrices,
+budget for 3, hottest pinned — plus an SLO overlay: 10 ms deadlines at
+1000 req/s offered with seeded latency-fault stragglers and a
+backpressure high-water mark): once greedy (``--global-sched off``),
+once through the cost-model-driven global scheduler (``on``). Committed
+artifacts under ``--out`` (``data/gsched_demo/``), gated by
+``tests/test_data_quality.py``:
+
+* ``tuning_cache.json`` — the quick calibration the scheduled run's
+  predictions came from (cache schema v5).
+* ``out/serve_tenants_rowwise.csv`` — BOTH runs' per-tenant rows (one
+  ``ALL`` row per run, ``global_sched`` 0/1): the deadline_expires /
+  rejected split, on-time goodput, end-to-end p50/p99, availability.
+* ``decisions.jsonl`` — the scheduled run's full decision trace: every
+  admit/reject/interleave/evict/flush with ``predicted_s`` and
+  ``reason``.
+* ``metrics.json`` — the scheduled run's registry snapshot (the
+  ``gsched_*`` vocabulary the obs panel renders).
+* ``summary.json`` — the A/B headline, asserted before anything is
+  written: scheduling ON must show better p99 AND availability, ZERO
+  engine deadline-expires (all converted to pre-dispatch rejects),
+  at least the baseline's on-time goodput, and every decision carrying
+  ``predicted_s``.
+
+Usage::
+
+    python scripts/gsched_study.py --platform cpu --host-devices 8 \
+        --out data/gsched_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# The committed protocol: the multitenant_demo fleet + the SLO overlay.
+# Deadline/rate chosen so the offered load is ~2x what the straggler-
+# afflicted fleet sustains inside the deadline — the regime where greedy
+# queues-then-expires and admission control has something to decide.
+N_TENANTS = 6
+SHAPE = 128
+ZIPF_A = 1.1
+HBM_BUDGET = "3x"
+PIN_HOT = 1
+N_REQUESTS = 240
+SEED = 0
+DEADLINE_MS = 10.0
+RATE_REQ_S = 1000.0
+MAX_IN_FLIGHT = 4
+DEADLINE_MARGIN = 1.5
+DEMAND_WEIGHT = 2.0
+FAULT_SPEC = "dispatch:latency:latency_ms=6,p=0.08"
+FAULT_SEED = 7
+
+
+def _row(result):
+    all_row = result.rows[-1]
+    served = (
+        all_row.requests - all_row.failed_requests - all_row.rejected
+    )
+    return {
+        "global_sched": result.global_sched,
+        "deadline_expires": result.deadline_expires,
+        "rejected": all_row.rejected,
+        "failed": all_row.failed_requests,
+        "served": served,
+        "on_time": result.on_time,
+        "p50_e2e_ms": result.p50_e2e_ms,
+        "p99_e2e_ms": result.p99_e2e_ms,
+        "availability": all_row.availability,
+        "hit_rate": result.hit_rate,
+        "evictions": all_row.evictions,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default="data/gsched_demo")
+    parser.add_argument("--platform", default="cpu")
+    parser.add_argument("--host-devices", type=int, default=8)
+    parser.add_argument("--calib-reps", type=int, default=5)
+    args = parser.parse_args(argv)
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    # The demo's tuning cache IS an artifact: the calibration the
+    # scheduled run consulted travels with the numbers it explains.
+    os.environ["MATVEC_TUNING_CACHE"] = str(out / "tuning_cache.json")
+
+    from matvec_mpi_multiplier_tpu.bench.serve import (
+        append_multitenant_result,
+        run_serve_multitenant,
+    )
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.tuning import reset_cache
+    from matvec_mpi_multiplier_tpu.tuning.cache import (
+        TuningCache,
+        calibration_key,
+    )
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import calibrate
+
+    configure_platform(args.platform, args.host_devices)
+    mesh = make_mesh(args.host_devices)
+
+    print("== quick calibration (2 probes) ==")
+    cal = calibrate(mesh, level="quick", n_reps=args.calib_reps)
+    cache = TuningCache.load()
+    cache.record(calibration_key(int(mesh.devices.size)), cal.to_record())
+    cache.save()
+    reset_cache()
+
+    common = dict(
+        n_tenants=N_TENANTS, zipf_a=ZIPF_A, hbm_budget=HBM_BUDGET,
+        pin_hot=PIN_HOT, n_requests=N_REQUESTS, seed=SEED,
+        max_in_flight=MAX_IN_FLIGHT, deadline_ms=DEADLINE_MS,
+        rate=RATE_REQ_S, fault_spec=FAULT_SPEC, fault_seed=FAULT_SEED,
+    )
+    print("== greedy baseline (--global-sched off) ==")
+    off = run_serve_multitenant(
+        "rowwise", mesh, SHAPE, SHAPE, **common
+    )
+    print("== scheduled run (--global-sched on) ==")
+    on = run_serve_multitenant(
+        "rowwise", mesh, SHAPE, SHAPE, global_sched=True,
+        demand_weight=DEMAND_WEIGHT, deadline_margin=DEADLINE_MARGIN,
+        decision_jsonl=str(out / "decisions.jsonl"),
+        metrics_out=str(out / "metrics.json"),
+        **common,
+    )
+
+    summary = {
+        "protocol": {
+            "n_tenants": N_TENANTS, "shape": SHAPE, "zipf_a": ZIPF_A,
+            "hbm_budget": HBM_BUDGET, "pin_hot": PIN_HOT,
+            "n_requests": N_REQUESTS, "seed": SEED,
+            "deadline_ms": DEADLINE_MS, "rate_req_s": RATE_REQ_S,
+            "max_in_flight": MAX_IN_FLIGHT,
+            "deadline_margin": DEADLINE_MARGIN,
+            "demand_weight": DEMAND_WEIGHT,
+            "fault_spec": FAULT_SPEC, "fault_seed": FAULT_SEED,
+            "calibration_level": cal.level,
+        },
+        "greedy": _row(off),
+        "scheduled": _row(on),
+    }
+    g, s = summary["greedy"], summary["scheduled"]
+    print(json.dumps(summary, indent=2))
+
+    # ---- the acceptance gates, asserted BEFORE committing anything ----
+    failures = []
+    if not s["p99_e2e_ms"] < g["p99_e2e_ms"]:
+        failures.append(
+            f"p99 not better: {s['p99_e2e_ms']:.2f} vs {g['p99_e2e_ms']:.2f}"
+        )
+    if not s["availability"] > g["availability"]:
+        failures.append(
+            f"availability not better: {s['availability']:.3f} vs "
+            f"{g['availability']:.3f}"
+        )
+    if s["deadline_expires"] != 0:
+        failures.append(
+            f"scheduled run still expired {s['deadline_expires']} "
+            "requests in an engine gate"
+        )
+    if s["rejected"] == 0:
+        failures.append("scheduled run rejected nothing (no admission)")
+    if g["deadline_expires"] == 0:
+        failures.append("baseline never expired (overload too mild)")
+    if not s["on_time"] >= g["on_time"]:
+        failures.append(
+            f"on-time goodput regressed: {s['on_time']} vs {g['on_time']}"
+        )
+    decisions = [
+        json.loads(ln)
+        for ln in (out / "decisions.jsonl").read_text().splitlines()
+    ]
+    if not decisions:
+        failures.append("decision trace is empty")
+    missing = [d for d in decisions if "predicted_s" not in d
+               or "reason" not in d]
+    if missing:
+        failures.append(
+            f"{len(missing)} decisions missing predicted_s/reason"
+        )
+    rejects = [d for d in decisions if d["decision"] == "reject"]
+    unpredicted = [d for d in rejects if d["predicted_s"] is None]
+    if unpredicted:
+        failures.append(
+            f"{len(unpredicted)} rejects carried predicted_s=None "
+            "(rejecting without a prediction is the bug the cold-cache "
+            "test pins)"
+        )
+    if failures:
+        print("GATE FAILURES:\n  " + "\n  ".join(failures),
+              file=sys.stderr)
+        return 1
+
+    for result in (off, on):
+        append_multitenant_result(result, root=out)
+    (out / "summary.json").write_text(json.dumps(summary, indent=2) + "\n")
+    print(f"\ncommitted A/B capture -> {out}")
+    print(
+        f"  p99 {g['p99_e2e_ms']:.2f} -> {s['p99_e2e_ms']:.2f} ms, "
+        f"availability {g['availability']:.3f} -> "
+        f"{s['availability']:.3f}, on-time {g['on_time']} -> "
+        f"{s['on_time']}, expires {g['deadline_expires']} -> 0 "
+        f"(rejected fast: {s['rejected']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,6 +154,59 @@ print(f"cost-model smoke ok: pruned {len(d2['pruned'])} of "
       f"S={d1['stages']}")
 PY
 
+# Global-scheduler smoke: a 2-probe quick calibration, then a synthetic
+# overload burst through the real admission path (engine/
+# global_scheduler.py + tuning/cost_model.py; docs/SCHEDULING.md). The
+# scheduler must reject-fast at least once (typed, pre-dispatch, with a
+# prediction on the decision) and the engines' deadline-expire counter
+# must stay at ZERO — the failure mode predicted-time admission exists
+# to delete. Seconds, not minutes: a regression here means SLO-aware
+# scheduling cannot even start.
+echo "global-scheduler smoke: reject-fast under a synthetic overload burst"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import numpy as np
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.engine import GlobalScheduler, MatrixRegistry
+from matvec_mpi_multiplier_tpu.tuning.cost_model import CostModel, calibrate
+from matvec_mpi_multiplier_tpu.utils.errors import AdmissionRejectedError
+
+mesh = make_mesh(8)
+model = CostModel(calibrate(mesh, level="quick", n_reps=3,
+                            log=lambda *_: None))
+rng = np.random.default_rng(0)
+reg = MatrixRegistry(mesh, strategy="rowwise", promote=None,
+                     demand_weight=2.0)
+for i in range(2):
+    reg.register(f"t{i}", rng.standard_normal((64, 64)).astype(np.float32))
+gs = GlobalScheduler(reg, cost_model=model)
+x = rng.standard_normal(64).astype(np.float32)
+served = rejected = 0
+# The burst: loose-deadline requests serve; sub-dispatch-time deadlines
+# CANNOT be met and must be rejected at the door, never queued to expire.
+for j in range(24):
+    fut = gs.submit(f"t{j % 2}", x,
+                    deadline_ms=1e6 if j % 3 == 0 else 1e-4)
+    if isinstance(fut.exception(), AdmissionRejectedError):
+        rejected += 1
+    else:
+        gs.flush()
+        assert fut.result().shape == (64,)
+        served += 1
+decisions = gs.decisions()
+assert rejected >= 1, "overload burst produced no reject-fast"
+assert served >= 1, "admission rejected everything"
+for d in decisions:
+    assert "predicted_s" in d and "reason" in d, d
+rejects = [d for d in decisions if d["decision"] == "reject"]
+assert rejects and all(d["predicted_s"] is not None for d in rejects)
+expires = reg.metrics.counter("engine_deadline_failures_total").value
+assert expires == 0, f"{expires} requests expired in an engine gate"
+gs.close(); reg.close()
+print(f"global-scheduler smoke ok: {served} served, {rejected} "
+      f"rejected fast with predictions, 0 deadline-expires")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

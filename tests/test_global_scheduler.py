@@ -1,0 +1,524 @@
+"""Global scheduler behavior tests (engine/global_scheduler.py; ISSUE 11).
+
+The acceptance doctrine, pinned deterministically:
+
+* **admission matrix** — tight/loose deadline × calibrated/uncalibrated ×
+  queue depth, on a fake clock and an explicit calibration: rejections
+  happen exactly where the queue-aware ETA says they must, and an
+  uncalibrated scheduler NEVER rejects (the cold-cache degrade contract,
+  one warning line).
+* **interleaving** — ahead of a predicted-long dispatch, the hottest
+  evicted tenant's swap-in is enqueued first (decision order pinned).
+* **cross-tenant coalescing** — same-signature same-payload tenants
+  share one flush with bitwise per-column results.
+* **A/B exactness** — the same trace with scheduling on and off produces
+  bitwise-identical results (the gate data/gsched_demo/ rides on).
+* **demand-aware eviction** — a high-demand resident survives a
+  less-recent low-demand one under pressure; demand_weight=0 keeps the
+  PR 9 score byte-for-byte (the LRU-floor gates elsewhere).
+* **rejected ≠ failed** — typed rejection, its own accounting column,
+  excluded from availability's failed numerator.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu.bench.serve import TenantRow
+from matvec_mpi_multiplier_tpu.engine import GlobalScheduler, MatrixRegistry
+from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+from matvec_mpi_multiplier_tpu.resilience import is_rejection
+from matvec_mpi_multiplier_tpu.tuning.cost_model import (
+    AdmissionEstimate,
+    Calibration,
+    CostModel,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import (
+    AdmissionRejectedError,
+    ConfigError,
+    DeadlineExceededError,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _cal(flops=1e9, mem_bps=1e9, alpha=1e-4, beta=1e9, p=8):
+    """An explicit, deterministic calibration (no probes): ms-scale
+    predictions for the 64x64 test shapes."""
+    return Calibration(
+        flops=flops, mem_bps=mem_bps,
+        alpha_s={"collective": alpha, "permute": alpha},
+        beta_bps={"collective": beta, "permute": beta},
+        p=p, level="synthetic", probes={},
+    )
+
+
+def _registry(mesh, n_tenants=3, m=64, k=64, seed=0, same_payload=False,
+              **kwargs):
+    rng = np.random.default_rng(seed)
+    shared = rng.standard_normal((m, k)).astype(np.float32)
+    reg = MatrixRegistry(
+        mesh, strategy="rowwise", promote=None, **kwargs
+    )
+    for i in range(n_tenants):
+        a = shared if same_payload else (
+            rng.standard_normal((m, k)).astype(np.float32)
+        )
+        reg.register(f"t{i}", a)
+    return reg
+
+
+# ------------------------------------------------------- admission matrix
+
+
+@pytest.mark.parametrize(
+    "calibrated,deadline_ms,queue_s,expect_reject",
+    [
+        (True, 1e-4, 0.0, True),     # tight, empty queue: dispatch alone misses
+        (True, 1e7, 0.0, False),     # loose, empty queue: admitted
+        (True, 1e7, 1e6, True),      # loose, deep queue: backlog misses it
+        (True, None, 1e6, False),    # no deadline: never rejected
+        (False, 1e-4, 0.0, False),   # uncalibrated: NEVER rejects (greedy)
+        (False, 1e7, 0.0, False),
+    ],
+)
+def test_admission_matrix(mesh, calibrated, deadline_ms, queue_s,
+                          expect_reject):
+    reg = _registry(mesh)
+    t = [0.0]
+    logs = []
+    gs = GlobalScheduler(
+        reg,
+        cost_model=CostModel(_cal()) if calibrated else None,
+        clock=lambda: t[0], log=logs.append, coalesce=False,
+    )
+    if queue_s:
+        # Prime the outstanding window with a fake in-flight dispatch of
+        # known predicted backlog (the queue-depth axis of the matrix).
+        class _Busy:
+            def done(self):
+                return False
+        gs._outstanding.append((_Busy(), queue_s))
+    x = np.ones(64, np.float32)
+    fut = gs.submit("t0", x, deadline_ms=deadline_ms)
+    err = fut.exception()
+    if expect_reject:
+        assert isinstance(err, AdmissionRejectedError), err
+        assert is_rejection(err)
+        with pytest.raises(AdmissionRejectedError):
+            fut.result()
+        last = gs.decisions()[-1]
+        assert last["decision"] == "reject"
+        assert last["predicted_s"] is not None and last["predicted_s"] > 0
+        assert "predicted eta" in last["reason"]
+        if queue_s:
+            assert last["queue_s"] >= queue_s
+    else:
+        gs.flush()
+        if not calibrated and deadline_ms is not None and deadline_ms < 1:
+            # Greedy hands the deadline to the ENGINE's own gate: a
+            # tight one fails THERE, typed DeadlineExceededError — never
+            # a rejection (the scheduler predicted nothing).
+            assert isinstance(fut.exception(), DeadlineExceededError)
+            assert not is_rejection(fut.exception())
+        else:
+            # Admitted (greedy included): a real result comes back.
+            y = fut.result()
+            ref = reg._entry("t0").engine(x)
+            assert np.array_equal(y, ref)
+        admits = [d for d in gs.decisions() if d["decision"] == "admit"]
+        assert admits, gs.decisions()
+        assert "reason" in admits[-1] and "predicted_s" in admits[-1]
+    # The degrade warning: exactly one line, only when uncalibrated.
+    assert len(logs) == (0 if calibrated else 1)
+    if not calibrated:
+        assert "uncalibrated" in logs[0]
+    gs.close()
+    reg.close()
+
+
+def test_cold_cache_degrades_to_greedy(mesh, tmp_path, monkeypatch):
+    """The bugfix pin: cost_model='auto' over an EMPTY tuning cache must
+    degrade to greedy — one warning, no rejects on predicted_s=None, the
+    deadline handed through to the engine's own gate (whose failure is
+    DeadlineExceededError, not AdmissionRejectedError)."""
+    from matvec_mpi_multiplier_tpu import tuning
+
+    monkeypatch.setenv(
+        "MATVEC_TUNING_CACHE", str(tmp_path / "cold_cache.json")
+    )
+    tuning.reset_cache()
+    reg = _registry(mesh)
+    logs = []
+    gs = GlobalScheduler(reg, cost_model="auto", log=logs.append)
+    assert gs.model is None
+    assert len(logs) == 1 and "uncalibrated" in logs[0]
+    assert reg.metrics.gauge("gsched_degraded_greedy").value == 1
+    x = np.ones(64, np.float32)
+    # A generous deadline serves; an already-elapsed one fails through
+    # the ENGINE gate (greedy semantics), never as a rejection.
+    ok = gs.submit("t0", x, deadline_ms=1e6)
+    assert ok.result().shape == (64,)
+    stale = gs.submit("t0", x, deadline_ms=-1.0)
+    assert isinstance(stale.exception(), DeadlineExceededError)
+    assert not is_rejection(stale.exception())
+    assert reg.metrics.counter("gsched_rejects_total").value == 0
+    # Every greedy decision is still traced — predicted_s honestly None.
+    for d in gs.decisions():
+        assert d["predicted_s"] is None
+        assert "greedy" in d["reason"]
+    gs.close()
+    reg.close()
+    tuning.reset_cache()
+
+
+def test_queue_aware_estimate_composes():
+    est = AdmissionEstimate(dispatch_s=0.5, queue_s=2.0, swap_s=0.25)
+    assert est.eta_s == pytest.approx(2.75)
+    model = CostModel(_cal(mem_bps=2e9))
+    assert model.restore_s(2 ** 31) == pytest.approx(2 ** 31 / 2e9)
+    adm = model.predict_admission(
+        "rowwise", "gather", m=64, k=64, p=8, dtype="float32",
+        queue_s=1.0, swap_bytes=2 * 10 ** 9,
+    )
+    solo = model.predict("rowwise", "gather", m=64, k=64, p=8,
+                         dtype="float32")
+    assert adm.dispatch_s == pytest.approx(solo.total_s)
+    assert adm.swap_s == pytest.approx(1.0)
+    assert adm.eta_s == pytest.approx(1.0 + 1.0 + solo.total_s)
+
+
+def test_prediction_config_routes_promotion(mesh):
+    from matvec_mpi_multiplier_tpu.engine import MatvecEngine
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((64, 64)).astype(np.float32)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=4,
+                          max_bucket=8)
+    one = engine.prediction_config(1)
+    assert one["b"] == 1 and one["combine"] == "gather"
+    assert one["strategy"] == "rowwise" and one["p"] == 8
+    wide = engine.prediction_config(6)
+    assert wide["b"] == 8  # bucket-padded GEMM path
+    below = engine.prediction_config(3)
+    assert below["b"] == 1  # per-column path
+
+
+# ----------------------------------------------------------- interleaving
+
+
+def test_interleave_swap_in_enqueued_before_long_dispatch(mesh):
+    """Ahead of a predicted-long dispatch, the hottest evicted tenant's
+    swap-in must be enqueued first: decision order pinned (interleave
+    before flush), residency restored."""
+    t = [0.0]
+    reg = _registry(
+        mesh, n_tenants=3,
+        hbm_budget=2 * 64 * 64 * 4,  # room for 2 of 3 payloads
+        rate_clock=lambda: t[0],
+    )
+    # Slow COMPUTE, fast memory: every dispatch predicts seconds while a
+    # restore predicts microseconds — the overlap is always worth it.
+    gs = GlobalScheduler(
+        reg, cost_model=CostModel(_cal(flops=1e3, mem_bps=1e9, beta=1e3)),
+        clock=lambda: t[0],
+    )
+    x = np.ones(64, np.float32)
+    # t0 and t1 resident; t2 evicted but HOT (recent demand ticks).
+    reg.submit("t0", x).result()
+    reg.submit("t1", x).result()
+    assert not reg._entry("t2").engine.resident
+    for _ in range(5):
+        t[0] += 0.01
+        reg.observe_demand("t2")
+    assert reg.demand_rate("t2") > 0
+    fut = gs.submit("t0", x)
+    gs.flush()
+    fut.result()
+    kinds = [d["decision"] for d in gs.decisions()]
+    assert "interleave" in kinds, kinds
+    inter = next(d for d in gs.decisions() if d["decision"] == "interleave")
+    assert inter["tenant"] == "t2"
+    assert inter["under"] == "t0"
+    assert inter["predicted_s"] > 0  # the restore this overlap hides
+    # The swap-in was ORDERED before the covering flush dispatched.
+    assert kinds.index("interleave") < kinds.index("flush")
+    assert reg._entry("t2").engine.resident
+    assert reg.metrics.counter("gsched_interleaves_total").value == 1
+    assert reg.metrics.counter("registry_prefetches_total").value == 1
+    gs.close()
+    reg.close()
+
+
+# ------------------------------------------------- cross-tenant coalescing
+
+
+def test_cross_tenant_coalescing_same_payload_bitwise(mesh):
+    """Two tenants registered with the SAME matrix form one coalesce
+    group: back-to-back submits share one flush, counted, and each
+    member's columns are bitwise what a solo submit returns (the PR 6
+    exactness doctrine across tenant boundaries)."""
+    reg = _registry(mesh, n_tenants=2, same_payload=True)
+    assert reg.coalesce_group("t0") == reg.coalesce_group("t1")
+    gs = GlobalScheduler(reg, cost_model=CostModel(_cal()))
+    rng = np.random.default_rng(7)
+    x0 = rng.standard_normal(64).astype(np.float32)
+    x1 = rng.standard_normal(64).astype(np.float32)
+    ref0 = reg._entry("t0").engine(x0)
+    ref1 = reg._entry("t1").engine(x1)
+    f0 = gs.submit("t0", x0)
+    f1 = gs.submit("t1", x1)
+    flushed = gs.flush()
+    assert flushed == 2
+    assert np.array_equal(f0.result(), ref0)
+    assert np.array_equal(f1.result(), ref1)
+    c = reg.metrics.counter("sched_cross_tenant_coalesced_total").value
+    assert c == 2  # both members shared a cross-tenant flush
+    flushes = [d for d in gs.decisions() if d["decision"] == "flush"]
+    assert len(flushes) == 1 and flushes[0]["n_requests"] == 2
+    assert "other tenants" in flushes[0]["reason"]
+    gs.close()
+    reg.close()
+
+
+def test_different_payloads_never_share_a_flush(mesh):
+    reg = _registry(mesh, n_tenants=2)  # distinct matrices
+    assert reg.coalesce_group("t0") != reg.coalesce_group("t1")
+    gs = GlobalScheduler(reg, cost_model=CostModel(_cal()))
+    x = np.ones(64, np.float32)
+    f0 = gs.submit("t0", x)
+    f1 = gs.submit("t1", x)  # group switch closes t0's batch first
+    gs.flush()
+    f0.result(), f1.result()
+    assert reg.metrics.counter(
+        "sched_cross_tenant_coalesced_total"
+    ).value == 0
+    assert reg.metrics.counter("gsched_flushes_total").value == 2
+    gs.close()
+    reg.close()
+
+
+# ------------------------------------------------------------ A/B exactness
+
+
+def test_ab_exactness_same_trace_bitwise(mesh):
+    """The same-trace A/B gate: scheduling on vs off, bitwise-identical
+    results request-for-request (no deadlines, no faults — pure
+    scheduling must never change a single bit)."""
+    rng = np.random.default_rng(3)
+    trace = [
+        (f"t{rng.integers(0, 3)}", rng.standard_normal(64).astype(np.float32))
+        for _ in range(24)
+    ]
+    reg_off = _registry(mesh, hbm_budget=2 * 64 * 64 * 4, seed=11)
+    baseline = [reg_off.submit(tid, x) for tid, x in trace]
+    baseline = [f.result() for f in baseline]
+    reg_off.close()
+
+    reg_on = _registry(mesh, hbm_budget=2 * 64 * 64 * 4, seed=11,
+                       demand_weight=2.0)
+    gs = GlobalScheduler(reg_on, cost_model=CostModel(_cal()))
+    scheduled = [gs.submit(tid, x) for tid, x in trace]
+    gs.flush()
+    scheduled = [f.result() for f in scheduled]
+    gs.close()
+    reg_on.close()
+    for i, (b, s) in enumerate(zip(baseline, scheduled)):
+        assert np.array_equal(b, s), f"request {i} diverged bitwise"
+
+
+# ------------------------------------------------- demand-aware eviction
+
+
+def test_demand_aware_eviction_protects_hot_tenant(mesh):
+    """Under pressure, a LESS-recent but high-demand resident survives a
+    MORE-recent idle one once demand_weight is on; with demand_weight=0
+    the same trace evicts by pure recency+cost (the PR 9 score,
+    unchanged)."""
+    def run(demand_weight):
+        t = [0.0]
+        reg = _registry(
+            mesh, n_tenants=3, hbm_budget=2 * 64 * 64 * 4,
+            demand_weight=demand_weight, rate_clock=lambda: t[0],
+        )
+        x = np.ones(64, np.float32)
+        reg.submit("t0", x).result()   # older, but HOT demand
+        reg.submit("t1", x).result()   # newer, idle
+        for _ in range(50):
+            t[0] += 0.01
+            reg.observe_demand("t0")
+        reg.submit("t2", x).result()   # needs a victim
+        h = reg.health()
+        evicted = [
+            tid for tid, s in h["tenants"].items() if not s["resident"]
+        ]
+        reg.close()
+        assert len(evicted) == 1
+        return evicted[0]
+
+    assert run(demand_weight=0.0) == "t0"    # pure recency: oldest loses
+    assert run(demand_weight=1000.0) == "t1"  # demand protects t0
+
+
+# -------------------------------------------- accounting & observability
+
+
+def test_rejected_is_not_failed_in_availability():
+    row = TenantRow(
+        tenant="t0", requests=10, hits=5, evictions=0,
+        evictions_caused=0, quota_rejections=0, failed_requests=2,
+        rejected=3, resident_bytes=0, pinned=0,
+    )
+    assert row.availability == pytest.approx(0.8)   # rejects excluded
+    assert row.served_rate == pytest.approx(0.5)    # but not hidden
+    assert is_rejection(AdmissionRejectedError("x"))
+    assert not is_rejection(DeadlineExceededError("x"))
+
+
+def test_decisions_carry_predicted_s_and_reason_and_jsonl(mesh, tmp_path):
+    path = tmp_path / "decisions.jsonl"
+    reg = _registry(mesh, n_tenants=2, hbm_budget=1 * 64 * 64 * 4)
+    gs = GlobalScheduler(
+        reg, cost_model=CostModel(_cal()), decision_jsonl=path,
+    )
+    x = np.ones(64, np.float32)
+    gs.submit("t0", x)
+    gs.flush()
+    gs.submit("t1", x)          # forces an eviction decision too
+    gs.flush()
+    gs.submit("t0", x, deadline_ms=1e-5)  # a reject
+    ring = gs.decisions()
+    kinds = {d["decision"] for d in ring}
+    assert {"admit", "flush", "reject", "evict"} <= kinds, kinds
+    for d in ring:
+        assert "predicted_s" in d and "reason" in d and "tenant" in d
+        if d["decision"] != "flush":  # flush may carry None on no-formula
+            assert d["predicted_s"] is None or d["predicted_s"] >= 0
+    gs.close()
+    reg.close()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert [d["decision"] for d in lines] == [d["decision"] for d in ring]
+    # Counter consistency: decisions_total covers the ring's entries.
+    snap = reg.metrics.snapshot()["counters"]
+    assert snap["gsched_decisions_total"] == len(ring)
+    assert snap["gsched_admits_total"] + snap["gsched_rejects_total"] == 3
+
+
+def test_gsched_obs_panel_renders(mesh):
+    from matvec_mpi_multiplier_tpu.obs.__main__ import (
+        render_gsched,
+        render_metrics,
+    )
+
+    assert render_gsched({"counters": {}}) is None  # no vocabulary
+    reg = _registry(mesh, n_tenants=2)
+    gs = GlobalScheduler(reg, cost_model=CostModel(_cal()))
+    x = np.ones(64, np.float32)
+    gs.submit("t0", x).result()
+    gs.submit("t0", x, deadline_ms=1e-5)
+    snap = reg.metrics.snapshot()
+    panel = render_gsched(snap)
+    assert panel is not None and panel.startswith("global scheduler:")
+    assert "rejects" in panel and "rejected != failed" in panel
+    assert "global scheduler:" in render_metrics(snap)
+    gs.close()
+    reg.close()
+
+
+def test_submit_validation_and_close(mesh):
+    reg = _registry(mesh, n_tenants=1)
+    gs = GlobalScheduler(reg, cost_model=CostModel(_cal()))
+    with pytest.raises(ConfigError):
+        gs.submit("t0", np.ones(63, np.float32))
+    with pytest.raises(ConfigError):
+        gs.submit("t0", np.ones(64, np.float32), qos="nope")
+    with pytest.raises(ConfigError):
+        GlobalScheduler(reg, cost_model=None, deadline_margin=0.0,
+                        log=lambda _line: None)
+    gs.close()
+    with pytest.raises(ConfigError):
+        gs.submit("t0", np.ones(64, np.float32))
+    reg.close()
+
+
+# ------------------------------------------------------- bench integration
+
+
+def test_multitenant_bench_ab_overlay_and_csv(mesh, tmp_path, monkeypatch):
+    """The --global-sched A/B through the real bench body on a tiny
+    trace: greedy vs scheduled on the same seed, the rejected/expires
+    split landing in the right columns, zero engine-gate expires with
+    scheduling on, and the extended CSV round-tripping."""
+    from matvec_mpi_multiplier_tpu import tuning
+    from matvec_mpi_multiplier_tpu.bench.serve import (
+        append_multitenant_result,
+        run_serve_multitenant,
+    )
+    from matvec_mpi_multiplier_tpu.bench.metrics import read_csv
+    from matvec_mpi_multiplier_tpu.tuning.cache import (
+        TuningCache,
+        calibration_key,
+    )
+
+    monkeypatch.setenv("MATVEC_TUNING_CACHE", str(tmp_path / "cache.json"))
+    tuning.reset_cache()
+    cache = TuningCache.load()
+    cache.record(calibration_key(8), _cal(
+        flops=1e9, mem_bps=1e9, alpha=1e-4, beta=1e9,
+    ).to_record())
+    cache.save()
+    tuning.reset_cache()
+
+    common = dict(
+        n_tenants=3, zipf_a=1.1, hbm_budget="2x", n_requests=30,
+        seed=0, deadline_ms=2.0, rate=4000.0, max_in_flight=2,
+    )
+    off = run_serve_multitenant("rowwise", mesh, 64, 64, **common)
+    on = run_serve_multitenant(
+        "rowwise", mesh, 64, 64, global_sched=True, demand_weight=2.0,
+        decision_jsonl=str(tmp_path / "d.jsonl"), **common,
+    )
+    assert not off.global_sched and on.global_sched
+    assert off.rows[-1].rejected == 0
+    # Scheduling on: whatever is not served was REJECTED typed, and the
+    # engine gate never expired an admitted request.
+    assert on.deadline_expires == 0
+    assert on.rows[-1].failed_requests == 0
+    served_on = 30 - on.rows[-1].rejected
+    assert served_on >= 1
+    if on.rows[-1].rejected:
+        assert (tmp_path / "d.jsonl").exists()
+    # CSV round-trip with the new columns.
+    for result in (off, on):
+        append_multitenant_result(result, root=tmp_path)
+    rows = read_csv(tmp_path / "out" / "serve_tenants_rowwise.csv")
+    all_rows = [r for r in rows if r["tenant"] == "ALL"]
+    assert sorted(r["global_sched"] for r in all_rows) == [0, 1]
+    sched_row = next(r for r in all_rows if r["global_sched"] == 1)
+    assert sched_row["rejected"] == on.rows[-1].rejected
+    assert sched_row["deadline_expires"] == 0
+    assert sched_row["on_time"] == on.on_time
+    tuning.reset_cache()
+
+
+def test_serve_cli_accepts_gsched_and_prune_flags():
+    """The new flags parse (the PR 10 leftover --prune-margin included)
+    and land on the namespace the sweep body reads."""
+    from matvec_mpi_multiplier_tpu.bench.serve import build_parser
+
+    args = build_parser().parse_args([
+        "--tenants", "3", "--global-sched", "both",
+        "--deadline-ms", "10", "--max-in-flight", "4",
+        "--demand-weight", "1.5", "--decision-jsonl", "d.jsonl",
+        "--tune", "--prune-margin", "0.5",
+    ])
+    assert args.global_sched == "both"
+    assert args.deadline_ms == 10.0
+    assert args.max_in_flight == 4
+    assert args.demand_weight == 1.5
+    assert args.decision_jsonl == "d.jsonl"
+    assert args.prune_margin == 0.5

@@ -153,6 +153,26 @@ RULE_FIXTURES = {
         "            self._plan(entry)\n"
         "        entry.a = jax.device_put(payload, sharding)\n",
     ),
+    "measurement-in-admission-path": (
+        f"{PKG}/engine/global_scheduler.py",
+        # timing a dispatch inside admission: a perf_counter pair around
+        # submit + the sync it needs puts a benchmark in front of every
+        # request (admission consults predictions; the tuner measures)
+        "import time\n"
+        "def admit(self, engine, x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    fut = engine.submit(x)\n"
+        "    fut.block_until_ready()\n"
+        "    self._observed = time.perf_counter() - t0\n"
+        "    return fut\n",
+        "import time\n"
+        "def admit(self, engine, x):\n"
+        "    t0 = time.perf_counter()  # admit-ok: seeded deliberate measurement\n"
+        "    fut = engine.submit(x)\n"
+        "    fut.block_until_ready()  # admit-ok: seeded deliberate sync\n"
+        "    self._observed = time.perf_counter() - t0  # admit-ok: seeded deliberate measurement\n"
+        "    return fut\n",
+    ),
     "scheduler-lock-across-dispatch": (
         f"{PKG}/engine/scheduler.py",
         # dispatch under the held admission lock: a backpressure stall
