@@ -144,7 +144,7 @@ from .buckets import (
     pad_columns,
     split_widths,
 )
-from .executables import ExecKey, ExecStats, ExecutableCache
+from .executables import DONATE_ARGNUMS, ExecKey, ExecStats, ExecutableCache
 
 # The degradation floor's local kernel: the portable tier every backend
 # compiles (the pallas/native tiers are exactly the exotic configs a
@@ -484,7 +484,7 @@ class MatvecEngine:
         self.kernel = kernel
         self.gather_output = gather_output
         self.max_bucket = max_bucket
-        self._donate = (1,) if donate else ()
+        self._donate = DONATE_ARGNUMS if donate else ()
         self._sh_a, self._sh_x = self.strategy.shardings(mesh)
         _, self._sh_b = self.strategy.batched_shardings(mesh)
         self.storage = self._resolve_storage(dtype_storage)
@@ -684,15 +684,15 @@ class MatvecEngine:
     @property
     def resident(self) -> bool:
         """True while the payload ``A`` operand is device-resident."""
-        return self._a is not None
+        return self._a is not None  # unguarded-ok: presence probe; a stale answer is benign — the dispatch path self-heals via ensure_resident (refcounted residency)
 
     @property
     def device_resident_bytes(self) -> int:
         """HBM bytes this engine's A residencies currently hold: the
         payload when resident, plus the native safe tier once the
         degradation ladder has placed it."""
-        total = self.resident_bytes if self._a is not None else 0
-        if self._a_native is not None:
+        total = self.resident_bytes if self._a is not None else 0  # unguarded-ok: accounting snapshot; the ledger RECONCILES to this value so a racing transition converges next notification (HbmAccountant doctrine)
+        if self._a_native is not None:  # unguarded-ok: same accounting-snapshot tolerance as the payload read above
             total += int(self._a_host.nbytes)
         return total
 
@@ -710,7 +710,7 @@ class MatvecEngine:
         fires once (the loser's buffer is dropped, freed by refcount).
         Raises :class:`ResidencyError` when the engine was evicted
         without ``retain_host`` (no payload to place from)."""
-        if self._a is not None:
+        if self._a is not None:  # unguarded-ok: double-checked placement — the decisive re-check runs under _residency_lock below; this bare read only skips staging work
             return False
         payload = self._qa_host if self.storage != NATIVE else self._a_host
         if payload is None:
@@ -1142,12 +1142,12 @@ class MatvecEngine:
         transparently here (a scheduler flush racing an eviction lands on
         a healed residency, not a crash)."""
         if key.storage == self.storage:
-            if self._a is None:
+            if self._a is None:  # unguarded-ok: self-heal probe; ensure_resident re-checks under _residency_lock and a lost race is a dropped buffer, not corruption
                 # Transparent re-admission: enqueue-only, accounted, and
                 # bitwise-identical to the pre-eviction residency.
                 self.ensure_resident()
-            return self._a
-        if self._a_native is None:
+            return self._a  # unguarded-ok: the dispatch captures its own reference; refcounted residency keeps a concurrently evicted buffer alive for this dispatch
+        if self._a_native is None:  # unguarded-ok: double-checked lazy placement — the decisive re-check runs under _residency_lock below
             # Enqueue-only placement (device_put is async), not a sync.
             placed = jax.device_put(self._a_host, self._sh_a)
             with self._residency_lock:
@@ -1157,7 +1157,7 @@ class MatvecEngine:
             self._notify_residency(
                 int(self._a_host.nbytes), "native_fallback"
             )
-        return self._a_native
+        return self._a_native  # unguarded-ok: same refcounted-capture tolerance as the payload return above
 
     def _get_traced(self, trace: ActiveTrace, key, builder):
         """Executable-cache lookup under its span, the hit|compile outcome
@@ -1238,7 +1238,7 @@ class MatvecEngine:
     # ---- resilient dispatch: retries, breakers, the ladder ----
 
     def _breaker_for(self, key: ExecKey) -> CircuitBreaker:
-        br = self._breakers.get(key)
+        br = self._breakers.get(key)  # unguarded-ok: double-checked get-or-create fast path; the decisive lookup repeats under _breakers_lock below
         if br is None:
             with self._breakers_lock:
                 br = self._breakers.get(key)
@@ -1582,7 +1582,7 @@ class MatvecEngine:
                 # True once the native safe tier has been placed (HBM is
                 # then holding BOTH residencies — a degraded quantized
                 # engine costs more than either alone).
-                "native_fallback_resident": self._a_native is not None,
+                "native_fallback_resident": self._a_native is not None,  # unguarded-ok: health() is a monotone point-in-time probe; staleness by one transition is its contract
             },
             "breakers": breakers,
             "degraded": degraded,

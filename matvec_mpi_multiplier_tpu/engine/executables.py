@@ -37,6 +37,26 @@ import jax
 
 from ..obs.registry import Counter
 
+# The dispatch path's donation spec: the RHS (arg index 1) is donated on
+# every engine dispatch so XLA may reuse its HBM for the output (module
+# docstring). ONE constant, shared with the staticcheck memory audit
+# (staticcheck/hlo.py) — the donation the audit verifies is by
+# construction the donation the engine sets.
+DONATE_ARGNUMS: tuple[int, ...] = (1,)
+
+
+def lower_artifact(builder: Callable[[], tuple[Callable, tuple, tuple[int, ...]]]):
+    """The ONE compiled-artifact lowering recipe: ``builder()`` returns
+    ``(fn, arg_structs, donate_argnums)`` and the artifact is
+    ``jit(fn, donate_argnums).lower(*arg_structs)``. Shared between
+    :meth:`ExecutableCache.get` (which compiles and fingerprints it) and
+    the staticcheck memory audit (``staticcheck/hlo.py``), so the
+    donation/aliasing and peak-liveness checks inspect byte-for-byte the
+    lowering the engine dispatches — the two passes cannot disagree
+    about which executable they audited."""
+    fn, arg_structs, donate = builder()
+    return jax.jit(fn, donate_argnums=donate).lower(*arg_structs)
+
 
 class ExecKey(NamedTuple):
     """Identity of one AOT executable in the cache."""
@@ -124,8 +144,7 @@ class ExecutableCache:
         if exe is not None:
             self._hits.inc()
             return exe
-        fn, arg_structs, donate = builder()
-        lowered = jax.jit(fn, donate_argnums=donate).lower(*arg_structs)
+        lowered = lower_artifact(builder)
         # Fingerprint the lowering: the same ExecKey must always map to
         # the same program text, or the AOT cache would silently recompile
         # (or serve divergent programs) across restarts. The staticcheck

@@ -566,7 +566,7 @@ class MatrixRegistry:
         its engine."""
         with self._lock:
             entry = self._entry(tenant_id)
-            entry.engine.release_residency()  # listener clears the ledger
+            entry.engine.release_residency()  # callback-ok: listener clears the ledger — reentrant by design (release fires _on_residency, which re-takes this RLock; module docstring)
             del self._tenants[tenant_id]
             self._g_tenants.set(len(self._tenants))
             self._g_resident_tenants.set(self._resident_count_locked())
@@ -672,14 +672,14 @@ class MatrixRegistry:
             if victim is None:
                 break
             score = self._victim_score_locked(victim, mean, now)
-            victim.engine.release_residency()
+            victim.engine.release_residency()  # callback-ok: the victim's residency listener re-enters this RLock to update the ledger BEFORE the next victim is scored — the reentrancy the lock is an RLock for (module docstring)
             victim.evictions += 1
             victim.c_evictions.inc()
             self._c_evictions.inc()
             entry.evictions_caused += 1
             entry.c_evictions_caused.inc()
             if self.eviction_listener is not None:
-                self.eviction_listener(
+                self.eviction_listener(  # callback-ok: bookkeeping-only contract, documented at the parameter — the global scheduler's _on_eviction appends to its ring and queues a sink record, never takes the registry lock
                     victim.tenant_id, entry.tenant_id, score,
                     victim.engine.resident_bytes,
                 )
@@ -687,7 +687,7 @@ class MatrixRegistry:
     # ---- the serving face ----
 
     def _entry(self, tenant_id: str) -> _Tenant:
-        entry = self._tenants.get(tenant_id)
+        entry = self._tenants.get(tenant_id)  # unguarded-ok: GIL-atomic dict.get; serving callers hold the lock, and the lock-free faces (TenantHandle.engine) tolerate racing an unregister — they get the entry or a ConfigError, never a torn dict
         if entry is None:
             raise ConfigError(f"unknown tenant {tenant_id!r}")
         return entry
@@ -952,7 +952,7 @@ class MatrixRegistry:
             self._closed = True
             entries = list(self._tenants.values())
             for e in entries:
-                e.engine.release_residency()
+                e.engine.release_residency()  # callback-ok: same reentrant ledger-clearing release as unregister (RLock; module docstring) — engines are closed after the lock is dropped
             self._tenants.clear()
             self._g_tenants.set(0)
             self._g_resident_tenants.set(0)

@@ -162,7 +162,7 @@ class _SharedResult:
         with self._lock:
             if not self._done:
                 try:
-                    self._value = self._future.result()
+                    self._value = self._future.result()  # callback-ok: materialize-once latch BY DESIGN — _future is an engine MatvecFuture (result() fetches host bytes, fires no scheduler/registry callback); siblings deliberately wait here for the one shared host fetch
                 except Exception as e:  # device error surfaces to every waiter
                     self._error = e
                 self._done = True
@@ -554,7 +554,7 @@ class ArrivalWindowScheduler:
             raise ConfigError(
                 f"unknown QoS tier {qos!r}; expected one of {QOS_TIERS}"
             )
-        if self._closed:
+        if self._closed:  # unguarded-ok: advisory fast-fail; the decisive check repeats under the condition on the queued path, and the bypass paths tolerate one racing close
             # Checked again under the condition on the queued path; this
             # early check keeps the refusal uniform across the bypass and
             # stale-on-arrival paths too.

@@ -270,7 +270,7 @@ class FaultPlan:
         with self._lock:
             self._armed = True
 
-    def _fire(self, i: int, spec: FaultSpec) -> bool:
+    def _fire_locked(self, i: int, spec: FaultSpec) -> bool:
         """Tally one matching event for spec ``i`` and decide injection
         (caller holds the lock)."""
         serial = self._matched[i]
@@ -317,7 +317,7 @@ class FaultPlan:
                     row0 = block[0] if block.ndim > 1 else block[:1]
                     if not np.any(row0 == block.dtype.type(spec.poison)):
                         continue
-                if not self._fire(i, spec):
+                if not self._fire_locked(i, spec):
                     continue
                 return self._action(i, spec)
         return None

@@ -158,9 +158,9 @@ class CircuitBreaker:
     @property
     def state(self) -> str:
         with self._lock:
-            return self._observable_state(self._clock())
+            return self._observable_state_locked(self._clock())
 
-    def _observable_state(self, now: float) -> str:
+    def _observable_state_locked(self, now: float) -> str:
         """OPEN reads as HALF_OPEN once the cooldown has elapsed (the
         transition itself happens lazily in ``allow``)."""
         if (
@@ -241,7 +241,7 @@ class CircuitBreaker:
         with self._lock:
             now = self._clock()
             return {
-                "state": self._observable_state(now),
+                "state": self._observable_state_locked(now),
                 "consecutive_failures": self._consecutive_failures,
                 "failures_total": self._failures_total,
                 "successes_total": self._successes_total,

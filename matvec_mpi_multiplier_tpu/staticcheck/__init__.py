@@ -29,7 +29,8 @@ communication-schedule claims become CI-time compile errors
 from __future__ import annotations
 
 from .corpus import SCAN_FILES, SCAN_ROOTS, SourceFile, iter_corpus, repo_root
-from .findings import Finding, render_json, render_text
+from .findings import DRIFT_RULES, Finding, render_json, render_text
+from .lockgraph import LOCKGRAPH_RULES, analyze, lockgraph_scope
 from .rules import (
     MARKERS,
     RULES,
@@ -39,15 +40,19 @@ from .rules import (
 )
 
 __all__ = [
+    "DRIFT_RULES",
     "Finding",
+    "LOCKGRAPH_RULES",
     "MARKERS",
     "RULES",
     "SCAN_FILES",
     "SCAN_ROOTS",
     "SourceFile",
+    "analyze",
     "check_marker_reasons",
     "get_rule",
     "iter_corpus",
+    "lockgraph_scope",
     "render_json",
     "render_text",
     "repo_root",

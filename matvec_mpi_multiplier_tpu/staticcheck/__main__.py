@@ -4,19 +4,33 @@ Usage::
 
     python -m matvec_mpi_multiplier_tpu.staticcheck            # rules + HLO audit
     python -m matvec_mpi_multiplier_tpu.staticcheck --rules    # AST rules only, ~1 s
-    python -m matvec_mpi_multiplier_tpu.staticcheck --hlo-audit
+    python -m matvec_mpi_multiplier_tpu.staticcheck --lockgraph  # rules #13-#15 only
+    python -m matvec_mpi_multiplier_tpu.staticcheck --hlo-audit  # schedule + memory
+    python -m matvec_mpi_multiplier_tpu.staticcheck --memory-audit
     python -m matvec_mpi_multiplier_tpu.staticcheck --json
     python -m matvec_mpi_multiplier_tpu.staticcheck --write-golden
     python -m matvec_mpi_multiplier_tpu.staticcheck --list
 
 ``scripts/tier1.sh --lint-only`` runs ``--rules`` (fail-fast: the AST
-layer never initializes a device backend — the parent package import
-still pulls jax in, but no compile/trace work runs). ``--hlo-audit``
-lowers every audited config on
-an abstract 8-device CPU mesh — this process forces the virtual-device
-flags itself, so it works from any shell. ``--root`` points the rule layer
-at another corpus (the seeded-violation agreement test). Exit status: 0
-clean, 1 findings, 2 usage/environment error.
+layer — the lock-graph auditor included — never initializes a device
+backend; the parent package import still pulls jax in, but no
+compile/trace work runs). ``--hlo-audit`` lowers every audited config on
+an abstract 8-device CPU mesh and runs BOTH artifact layers (collective
+schedule + compiled-artifact memory); ``--memory-audit`` runs the
+memory layer alone (donation → aliasing, peak liveness). This process
+forces the virtual-device flags itself, so it works from any shell.
+``--root`` points the rule layer at another corpus (the
+seeded-violation agreement test).
+
+Exit status (distinct per failure class, worst-first):
+
+* ``0`` — clean
+* ``1`` — AST rule findings (incl. the lock-graph rules)
+* ``2`` — usage/environment error
+* ``3`` — HLO-audit failures (schedule/bytes/dequant/donation/peak/
+  fingerprint — the tree violates an artifact invariant)
+* ``4`` — golden drift only (``hlo-golden``/``hlo-census`` — the tree
+  and the committed table disagree; re-bless or revert)
 """
 
 from __future__ import annotations
@@ -25,6 +39,25 @@ import argparse
 import os
 import sys
 from pathlib import Path
+
+EXIT_CLEAN = 0
+EXIT_RULES = 1
+EXIT_USAGE = 2
+EXIT_HLO = 3
+EXIT_DRIFT = 4
+
+
+def exit_status(findings) -> int:
+    """The CLI's verdict for a findings list: rule findings dominate,
+    then hard HLO-audit failures, then golden drift (severity
+    ``"drift"``)."""
+    if not findings:
+        return EXIT_CLEAN
+    if any(not f.rule.startswith("hlo-") for f in findings):
+        return EXIT_RULES
+    if any(f.severity != "drift" for f in findings):
+        return EXIT_HLO
+    return EXIT_DRIFT
 
 
 def _force_cpu_mesh() -> None:
@@ -48,13 +81,20 @@ def _force_cpu_mesh() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # Match the test tier (tests/conftest.py): x64 on. The schedule
+    # census is width-insensitive, but the memory audit's peak-liveness
+    # walk counts every tensor — scalar constants change width under
+    # x64, so the CLI and the suite must lower in the same mode or the
+    # golden peaks drift by a few bytes between them.
+    jax.config.update("jax_enable_x64", True)
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m matvec_mpi_multiplier_tpu.staticcheck",
         description=(
-            "AST lint rules + lowered-HLO collective-schedule audit "
+            "AST lint rules (incl. the lock-graph concurrency auditor) + "
+            "lowered-HLO schedule and compiled-artifact memory audits "
             "(docs/STATIC_ANALYSIS.md)"
         ),
     )
@@ -63,12 +103,24 @@ def main(argv=None) -> int:
         help="run the AST rule layer (default: rules + HLO audit)",
     )
     parser.add_argument(
+        "--lockgraph", action="store_true",
+        help="run ONLY the lock-graph concurrency rules (#13-#15: "
+        "lock-mixed-guard, lock-order-inversion, callback-under-lock)",
+    )
+    parser.add_argument(
         "--hlo-audit", action="store_true",
-        help="run the lowered-HLO collective-schedule audit",
+        help="run the lowered-HLO audit (collective schedule + "
+        "compiled-artifact memory)",
+    )
+    parser.add_argument(
+        "--memory-audit", action="store_true",
+        help="run the compiled-artifact memory audit alone (donation -> "
+        "aliasing, peak liveness vs the quantized ceilings)",
     )
     parser.add_argument(
         "--json", action="store_true",
-        help="machine-readable findings on stdout",
+        help="machine-readable findings on stdout (per-finding rule, "
+        "severity and marker fields)",
     )
     parser.add_argument(
         "--rule", action="append", metavar="NAME",
@@ -92,6 +144,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     from .findings import render_json, render_text
+    from .lockgraph import LOCKGRAPH_RULES
     from .rules import RULES, get_rule
 
     if args.list:
@@ -99,7 +152,7 @@ def main(argv=None) -> int:
         for name, rule in sorted(RULES.items()):
             marker = f"# {rule.marker}:" if rule.marker else "(no marker)"
             print(f"{name:<{width}}  {marker:<14}  {rule.description}")
-        return 0
+        return EXIT_CLEAN
 
     if args.rule:
         try:
@@ -107,20 +160,28 @@ def main(argv=None) -> int:
                 get_rule(name)
         except KeyError as e:
             print(f"staticcheck: {e.args[0]}", file=sys.stderr)
-            return 2
+            return EXIT_USAGE
 
-    run_rules_layer = args.rules or not (args.rules or args.hlo_audit)
-    run_hlo_layer = args.hlo_audit or not (args.rules or args.hlo_audit)
+    explicit = (
+        args.rules or args.lockgraph or args.hlo_audit or args.memory_audit
+    )
+    run_rules_layer = args.rules or not explicit
+    run_hlo_layer = args.hlo_audit or not explicit
+    run_memory_only = args.memory_audit and not args.hlo_audit
     if args.write_golden:
         run_hlo_layer = True
+        run_memory_only = False
 
     findings = []
-    if run_rules_layer:
+    if run_rules_layer or args.lockgraph:
         from .rules import run_rules
 
-        findings.extend(run_rules(root=args.root, rules=args.rule))
+        selected = args.rule
+        if args.lockgraph and not run_rules_layer:
+            selected = list(LOCKGRAPH_RULES) + (args.rule or [])
+        findings.extend(run_rules(root=args.root, rules=selected))
 
-    if run_hlo_layer:
+    if run_hlo_layer or run_memory_only:
         _force_cpu_mesh()
         from .hlo import run_hlo_audit, write_golden
 
@@ -132,14 +193,14 @@ def main(argv=None) -> int:
                 path = write_golden()
                 print(f"staticcheck: golden schedule table written to {path}",
                       file=sys.stderr)
-            findings.extend(run_hlo_audit())
+            findings.extend(run_hlo_audit(schedule=not run_memory_only))
         except RuntimeError as e:
             print(f"staticcheck: {e}", file=sys.stderr)
-            return 2
+            return EXIT_USAGE
 
     findings = sorted(set(findings))
     print(render_json(findings) if args.json else render_text(findings))
-    return 1 if findings else 0
+    return exit_status(findings)
 
 
 if __name__ == "__main__":

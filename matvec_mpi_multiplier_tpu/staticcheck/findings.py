@@ -13,14 +13,35 @@ import dataclasses
 import json
 
 
+# Rules whose findings mean "the committed golden table disagrees with
+# the tree" rather than "the tree violates an invariant" — a distinct
+# severity (and CLI exit status) because the remedy is different:
+# re-bless the table, or revert the schedule change.
+DRIFT_RULES = frozenset({"hlo-golden", "hlo-census"})
+
+
 @dataclasses.dataclass(frozen=True, order=True)
 class Finding:
-    """One rule violation (or audit mismatch), sorted file-then-line."""
+    """One rule violation (or audit mismatch), sorted file-then-line.
+
+    ``severity`` is ``"error"`` for invariant violations and ``"drift"``
+    for golden-table disagreements (:data:`DRIFT_RULES`); ``marker`` is
+    the ``# <marker>: <reason>`` comment that could exempt this finding
+    (None for rules without an escape hatch)."""
 
     path: str   # repo-relative posix path ("" for repo-level findings)
     line: int   # 1-indexed; 0 when no single line applies
     rule: str   # rule slug, e.g. "engine-host-sync"
     message: str
+    severity: str = "error"
+    marker: str | None = None
+
+    def __post_init__(self):
+        # The rule, not the construction site, owns the severity: a
+        # drift-rule Finding is "drift" even when a future call site
+        # forgets to say so (the CLI's exit-code classes depend on it).
+        if self.rule in DRIFT_RULES and self.severity == "error":
+            object.__setattr__(self, "severity", "drift")
 
     @property
     def location(self) -> str:

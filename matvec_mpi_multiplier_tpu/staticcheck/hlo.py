@@ -44,6 +44,29 @@ no compile — ~1 s for the whole table), and audited four ways:
   (``ops.quantize.matvec_quantized_dequant_first``) exists as the
   known-bad lowering this gate is tested against.
 
+* **Donation → aliasing audit** (``hlo-donation``) — the engine sets
+  ``donate_argnums`` on every dispatch and the registry's
+  ``HbmAccountant`` silently assumes the RHS buffer is actually reused;
+  this gate verifies the donation LOWERED: the compiled artifact's
+  ``@main`` RHS argument must carry ``tf.aliasing_output`` (shape-matched
+  input-output aliasing) or ``jax.buffer_donor`` (donated, compiler
+  chooses), read off the same lowering recipe the engine compiles
+  (``engine.executables.lower_artifact`` — one shared accessor, so the
+  cache's fingerprint and this audit can never disagree about which
+  executable they inspected). Dropping ``donate_argnums`` from the
+  dispatch path turns this red (mutation-tested).
+* **Peak-liveness estimate** (``hlo-peak-liveness``) — a static
+  peak-buffer estimate from the StableHLO: a linear-schedule liveness
+  walk over the module (function args live to last use, op results from
+  creation to last use, nested regions and calls contributing their own
+  peak at the issuing op), pinned per config in the golden table as
+  ``peak_bytes``/``peak_bytes_ratio``. Quantized configs must respect
+  the :data:`PEAK_LIVENESS_CEILING` ratios against their native
+  counterpart's peak — the liveness-level face of the storage ceilings,
+  catching a lowering that stores the payload's bytes but materializes
+  a dequantized full-width temporary (which the census gate sees
+  structurally and this gate sees quantitatively).
+
 The quantized configs' collective census equals their native
 counterpart's by construction — the combine operates on the fp32
 accumulator partials, never on the payload — so the storage axis is
@@ -82,15 +105,33 @@ AUDIT_M = 64
 AUDIT_K = 2048
 AUDIT_DTYPE = "float32"
 GOLDEN_REL = "data/staticcheck/golden_schedule.json"
-# Schema 2 over 1: every entry additionally pins the A-operand byte
-# accounting (a_bytes / a_bytes_ratio) and the table includes the
-# quantized-storage configs.
-GOLDEN_SCHEMA = 2
+# Schema 3 over 2: every entry additionally pins the compiled-artifact
+# memory audit — RHS donation state ("aliased"/"donated") and the static
+# peak-liveness estimate (peak_bytes / peak_bytes_ratio).
+GOLDEN_SCHEMA = 3
+
+# Audit-side override of the engine's dispatch-path donation spec:
+# None means "the engine's own DONATE_ARGNUMS" (engine/executables.py —
+# ONE constant, resolved lazily so importing this module never pulls
+# jax in). The donation mutation test patches this to () to prove the
+# audit goes red when the dispatch path stops donating.
+ENGINE_DONATE_ARGNUMS: tuple[int, ...] | None = None
 
 # Resident-A byte-ratio ceilings the quantized configs must meet
 # (acceptance pins; docs/QUANTIZATION.md derives them: 1-byte payload +
 # fp32 scale plane at 1/block density, ×2 for the compensated pair).
 STORAGE_BYTE_CEILING = {"int8": 0.30, "fp8": 0.30, "int8c": 0.55}
+
+# Peak-LIVENESS ceilings (quantized peak vs the native counterpart's
+# peak, both per-device — the memory audit's gate). Looser than the
+# resident-stream ceilings above because the liveness walk also sees
+# schedule temporaries (tile buffers, transpose/reshape copies, the
+# scan carry) that scale with m·block rather than with the payload; at
+# the audit operand the clean kernels measure 0.52–0.82×. What the gate
+# must catch is a lowering that materializes a dequantized full-width A
+# temporary — that lands at ≥ 1.1× native (the dequant-first mutation
+# test pins both sides of the margin).
+PEAK_LIVENESS_CEILING = {"int8": 0.70, "fp8": 0.70, "int8c": 0.90}
 
 # StableHLO op → the census name (the HLO spelling the paper's tables use).
 _KINDS = {
@@ -104,6 +145,10 @@ _KINDS = {
 _ITEMSIZE = {
     "float32": 4, "float64": 8, "bfloat16": 2, "float16": 2,
     "int8": 1, "float8": 1,
+    # Integer/pred widths the peak-liveness walk meets (iota indices,
+    # loop counters, masks); irrelevant to the collective payloads.
+    "int1": 1, "int16": 2, "int32": 4, "int64": 8, "uint32": 4,
+    "uint64": 8,
 }
 _TENSOR_RE = re.compile(r"tensor<(?:([0-9x]+)x)?([A-Za-z][A-Za-z0-9_]*)>")
 # StableHLO element-type spelling → the census name above. f8 variants all
@@ -111,6 +156,8 @@ _TENSOR_RE = re.compile(r"tensor<(?:([0-9x]+)x)?([A-Za-z][A-Za-z0-9_]*)>")
 _ELEM_NAMES = {
     "f32": "float32", "f64": "float64", "bf16": "bfloat16", "f16": "float16",
     "i8": "int8", "si8": "int8", "ui8": "int8",
+    "i1": "int1", "i16": "int16", "i32": "int32", "i64": "int64",
+    "ui32": "uint32", "ui64": "uint64",
 }
 
 _FLOAT_ELEMS = ("f32", "f64", "bf16", "f16")
@@ -300,6 +347,20 @@ def collective_census(lowered) -> tuple[dict[str, int], dict[str, int]]:
     return census, payload
 
 
+def _func_name(op) -> str:
+    """The sym_name of one ``func.func`` op, unquoted — the ONE
+    predicate every artifact gate walks the module with."""
+    return str(op.attributes["sym_name"]).strip('"')
+
+
+def _main_func(module):
+    """The module's ``@main`` entry function (None when absent)."""
+    for op in module.body.operations:
+        if op.operation.name == "func.func" and _func_name(op) == "main":
+            return op
+    return None
+
+
 def a_operand_bytes(lowered) -> int:
     """Bytes of the lowered program's resident-A input parameters: every
     ``@main`` argument except the trailing ``x`` — for native storage the
@@ -307,18 +368,276 @@ def a_operand_bytes(lowered) -> int:
     correction) leaves. Read off the ARTIFACT (the module's entry
     signature), not the builder's intent — that is the whole point of
     auditing."""
+    main = _main_func(lowered.compiler_ir(dialect="stablehlo"))
+    if main is None:
+        raise RuntimeError("lowered module has no @main function to audit")
+    types = [str(a.type) for a in main.regions[0].blocks[0].arguments]
+    if not types:
+        return 0
+    return sum(_tensor_bytes(t) for t in types[:-1])
+
+
+# ---------------------------------------------------------- memory audit
+#
+# The engine-recipe lowering: strategy build + sharded arg structs +
+# donate_argnums, through the SAME accessor the AOT cache compiles
+# (engine.executables.lower_artifact). The schedule census keeps its own
+# plain-struct lowering above (its golden fingerprints predate this
+# audit); the memory facts are read off the artifact the engine ships.
+
+
+def engine_builder(cfg: AuditConfig, mesh, kernel=None,
+                   donate: tuple[int, ...] | None = None):
+    """A builder in the engine's ``ExecutableCache`` contract —
+    ``() -> (fn, arg_structs, donate_argnums)`` — for one audited
+    config, mirroring ``MatvecEngine._matvec_builder_for`` (sharded
+    structs, quantized pytree template under quantized storage, the RHS
+    donated)."""
+    import jax
+    import numpy as np
+
+    from ..models import get_strategy
+
+    strat = get_strategy(cfg.strategy)
+    dtype = np.dtype(AUDIT_DTYPE)
+    sh_a, sh_x = strat.shardings(mesh)
+    if donate is None:
+        # Resolved at call time so (a) the donation mutation test can
+        # patch the module override, and (b) the default is literally
+        # the engine's own constant, never a copy that could drift.
+        donate = ENGINE_DONATE_ARGNUMS
+        if donate is None:
+            from ..engine.executables import DONATE_ARGNUMS
+
+            donate = DONATE_ARGNUMS
+
+    def builder():
+        kwargs: dict = {
+            "combine": cfg.combine,
+            "kernel": kernel if kernel is not None else cfg.kernel,
+        }
+        if cfg.stages is not None:
+            kwargs["stages"] = cfg.stages
+        if cfg.storage != "native":
+            from ..ops.quantize import quantized_like, quantized_struct
+
+            kwargs["dtype_storage"] = cfg.storage
+            a = quantized_like(
+                quantized_struct(
+                    AUDIT_M, AUDIT_K, cfg.storage, dtype,
+                    audit_block(cfg, mesh),
+                ),
+                lambda leaf: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=sh_a
+                ),
+            )
+        else:
+            a = jax.ShapeDtypeStruct((AUDIT_M, AUDIT_K), dtype, sharding=sh_a)
+        fn = strat.build(mesh, **kwargs)
+        x = jax.ShapeDtypeStruct((AUDIT_K,), dtype, sharding=sh_x)
+        return fn, (a, x), donate
+
+    return builder
+
+
+def lower_engine_artifact(cfg: AuditConfig, mesh, kernel=None,
+                          donate: tuple[int, ...] | None = None):
+    """One audited config lowered EXACTLY as the engine's executable
+    cache lowers it (``lower_artifact`` — the shared accessor, so the
+    memory audit and ``ExecutableCache.fingerprint`` inspect the same
+    artifact)."""
+    from ..engine.executables import lower_artifact
+
+    return lower_artifact(engine_builder(cfg, mesh, kernel, donate))
+
+
+def donation_state(lowered) -> str:
+    """How the RHS donation lowered: ``"aliased"`` (shape-matched
+    input-output aliasing, ``tf.aliasing_output``), ``"donated"``
+    (``jax.buffer_donor`` — the donation is recorded and the compiler
+    picks the reuse), or ``"none"`` — the state the engine and the HBM
+    accountant silently assume never happens. Read off the LAST ``@main``
+    argument's attributes — the RHS by the engine's calling convention —
+    not the whole module: a donation recorded on the wrong argument
+    (donating the resident A, which XLA must never clobber) reads as
+    ``"none"``, exactly as it should."""
+    main = _main_func(lowered.compiler_ir(dialect="stablehlo"))
+    if main is None:
+        return "none"
+    try:
+        arg_attrs = list(main.attributes["arg_attrs"])
+    except KeyError:
+        return "none"  # no per-arg attributes at all
+    if not arg_attrs:
+        return "none"
+    rhs = str(arg_attrs[-1])
+    if "tf.aliasing_output" in rhs:
+        return "aliased"
+    if "jax.buffer_donor" in rhs:
+        return "donated"
+    return "none"
+
+
+def _type_bytes(mlir_type) -> int:
+    return _tensor_bytes(str(mlir_type))
+
+
+def peak_buffer_bytes(lowered, devices: int = AUDIT_DEVICES) -> int:
+    """Static PER-DEVICE peak-liveness estimate over the lowered
+    StableHLO: walk the module in its printed (linear) schedule — block
+    arguments live from entry to their last use, op results from
+    creation to last use, ``func.call`` and nested regions (scan/while
+    bodies) contributing their callee/body peak at the issuing op.
+
+    Units are per-device HBM bytes: jit-level (global-shaped) tensors
+    count ``1/devices`` of their bytes (the sharded view each device
+    holds; small replicated operands are deliberately under-counted at
+    the same rate), while everything inside a ``shmap_body`` manual
+    region — where shapes are already per-shard — counts in full. One
+    consistent unit is what lets a per-shard dequantized temporary
+    register against the sharded payload instead of drowning under
+    global-shaped bookkeeping. An ESTIMATE of the allocator high-water
+    mark XLA's real (reordering, aliasing) schedule refines — pinned in
+    the golden table as a drift detector and gated for the quantized
+    configs (:data:`PEAK_LIVENESS_CEILING`)."""
     module = lowered.compiler_ir(dialect="stablehlo")
+    funcs: dict[str, object] = {}
     for op in module.body.operations:
-        if op.operation.name != "func.func":
-            continue
-        if "main" not in str(op.attributes["sym_name"]):
-            continue
-        args = op.regions[0].blocks[0].arguments
-        types = [str(a.type) for a in args]
-        if not types:
-            return 0
-        return sum(_tensor_bytes(t) for t in types[:-1])
-    raise RuntimeError("lowered module has no @main function to audit")
+        if op.operation.name == "func.func":
+            funcs[_func_name(op)] = op
+
+    func_peaks: dict[tuple, float] = {}
+
+    def func_peak(name: str, scale: float, stack: tuple = ()) -> float:
+        key = (name, scale)
+        if key in func_peaks:
+            return func_peaks[key]
+        if name not in funcs or name in stack:
+            return 0.0  # unknown callee / recursion guard
+        peak = max(
+            (block_peak(blk, scale, stack + (name,))
+             for blk in funcs[name].regions[0].blocks),
+            default=0.0,
+        )
+        func_peaks[key] = peak
+        return peak
+
+    def block_peak(block, scale: float, stack: tuple) -> float:
+        ops = list(block.operations)
+        last_use: list[tuple] = []  # (value, op index) — linear map; see below
+
+        def find(v):
+            for j, (u, idx) in enumerate(last_use):
+                if u == v:
+                    return j
+            return None
+
+        for i, op in enumerate(ops):
+            for v in op.operands:
+                j = find(v)
+                if j is None:
+                    last_use.append((v, i))
+                else:
+                    last_use[j] = (v, i)
+        alive: list[tuple] = []  # (value, bytes)
+        current = 0.0
+        for arg in block.arguments:
+            b = _type_bytes(arg.type) * scale
+            alive.append((arg, b))
+            current += b
+        peak = current
+        for i, op in enumerate(ops):
+            nested = 0.0
+            name = op.operation.name
+            if name == "func.call":
+                callee = str(op.attributes["callee"]).lstrip("@").strip('"')
+                # Entering a manual (shard_map body) region: shapes
+                # below are per-shard already — full-unit accounting.
+                callee_scale = (
+                    1.0 if callee.startswith("shmap_body") else scale
+                )
+                nested = func_peak(callee, callee_scale, stack)
+            else:
+                for region in op.regions:
+                    for blk in region.blocks:
+                        nested = max(nested, block_peak(blk, scale, stack))
+            created = [(r, _type_bytes(r.type) * scale) for r in op.results]
+            alive.extend(created)
+            current += sum(b for _, b in created)
+            peak = max(peak, current + nested)
+            # Release everything whose last use is behind us (results
+            # with no use die immediately — transient, already peaked).
+            survivors = []
+            for v, b in alive:
+                j = find(v)
+                dead = (j is None) if v in [r for r, _ in created] else (
+                    j is not None and last_use[j][1] <= i
+                )
+                if dead:
+                    current -= b
+                else:
+                    survivors.append((v, b))
+            alive = survivors
+        return peak
+
+    return int(round(func_peak("main", 1.0 / max(1, devices))))
+
+
+def memory_entry(cfg: AuditConfig, mesh, kernel=None,
+                 donate: tuple[int, ...] | None = None) -> dict:
+    """The compiled-artifact memory facts for one config: donation state
+    and the static peak-liveness estimate, off the engine-recipe
+    lowering. ``peak_bytes_ratio`` normalizes by the native
+    (m · k · itemsize) stream, like ``a_bytes_ratio``."""
+    lowered = lower_engine_artifact(cfg, mesh, kernel, donate)
+    peak = peak_buffer_bytes(lowered)
+    # Per-device units throughout: the ratio normalizes by the native
+    # resident-A stream's per-device share.
+    native_bytes = AUDIT_M * AUDIT_K * _ITEMSIZE[AUDIT_DTYPE] / AUDIT_DEVICES
+    return {
+        "donation": donation_state(lowered),
+        "peak_bytes": peak,
+        "peak_bytes_ratio": round(peak / native_bytes, 6),
+    }
+
+
+def native_counterpart(cfg: AuditConfig) -> AuditConfig:
+    """The same schedule under native storage — the baseline the
+    quantized peak-liveness ceiling compares against."""
+    return AuditConfig(cfg.strategy, cfg.combine, cfg.stages, cfg.kernel)
+
+
+def memory_findings(cfg: AuditConfig, entry: dict,
+                    native_peak: int | None) -> list[Finding]:
+    """The memory audit's gates for one config's :func:`memory_entry`:
+    donation must have lowered, and a quantized config's static peak
+    must respect its storage ceiling against the native counterpart's
+    peak (the liveness-level version of the ``a_bytes`` pin — a
+    lowering that materializes a dequantized full-width temporary blows
+    straight through it)."""
+    findings: list[Finding] = []
+    if entry["donation"] == "none":
+        findings.append(Finding(
+            f"<hlo:{cfg.key}>", 0, "hlo-donation",
+            "the RHS argument of the compiled artifact carries no "
+            "donation (neither tf.aliasing_output nor jax.buffer_donor): "
+            "the engine dispatch path dropped donate_argnums, so every "
+            "request churns a fresh padded-RHS allocation the HBM "
+            "accountant assumes is reused (engine/executables.py)",
+        ))
+    ceiling = PEAK_LIVENESS_CEILING.get(cfg.storage)
+    if ceiling is not None and native_peak:
+        if entry["peak_bytes"] > ceiling * native_peak:
+            findings.append(Finding(
+                f"<hlo:{cfg.key}>", 0, "hlo-peak-liveness",
+                f"static peak liveness {entry['peak_bytes']} bytes is "
+                f"{entry['peak_bytes'] / native_peak:.3f}x the native "
+                f"counterpart's {native_peak}, over the {cfg.storage} "
+                f"ceiling of {ceiling}x — the lowering materializes "
+                "full-width temporaries (early dequant?) and moves the "
+                "bytes the storage format exists not to move",
+            ))
+    return findings
 
 
 def _local_a_shape(cfg: AuditConfig, mesh) -> tuple[int, int]:
@@ -574,12 +893,14 @@ def audit_entry(cfg: AuditConfig, mesh, lowered=None) -> dict:
 
 
 def build_schedule_table(configs: Iterable[AuditConfig] | None = None) -> dict:
-    """The full golden-table payload for the current tree."""
+    """The full golden-table payload for the current tree: the schedule
+    census (plain-struct lowering) merged with the compiled-artifact
+    memory audit (engine-recipe lowering) per config."""
     import jax
 
     mesh = _audit_mesh()
     entries = {
-        cfg.key: audit_entry(cfg, mesh)
+        cfg.key: {**audit_entry(cfg, mesh), **memory_entry(cfg, mesh)}
         for cfg in _supported_configs(configs or AUDIT_CONFIGS)
     }
     return {
@@ -609,10 +930,17 @@ def run_hlo_audit(
     golden_path: Path | None = None,
     configs: Iterable[AuditConfig] | None = None,
     check_fingerprints: bool = True,
+    schedule: bool = True,
+    memory: bool = True,
 ) -> list[Finding]:
-    """The full audit: census + bytes vs formula and golden, the overlap
-    chunking gate (folded into both pins), and fingerprint stability.
-    Returns findings; empty means every schedule lowers as pinned."""
+    """The full lowered-artifact audit: the collective-schedule layer
+    (census + bytes vs formula and golden, the overlap chunking gate,
+    fingerprint stability — ``schedule=True``) and the compiled-artifact
+    memory layer (donation → aliasing, peak liveness vs the quantized
+    ceilings — ``memory=True``; the CLI's ``--memory-audit`` runs it
+    alone). Both compare against the golden table over whichever fields
+    they computed. Returns findings; empty means every config lowers as
+    pinned."""
     root = Path(root) if root is not None else repo_root()
     golden_path = (
         Path(golden_path) if golden_path is not None else root / GOLDEN_REL
@@ -640,11 +968,18 @@ def run_hlo_audit(
         ))
 
     mesh = _audit_mesh()
-    for cfg in configs:
-        lowered = lower_config(cfg, mesh)
-        observed = audit_entry(cfg, mesh, lowered)
-        exp_census, exp_payload = expected_schedule(cfg, mesh)
+    native_peaks: dict[str, int] = {}
 
+    def native_peak_for(cfg: AuditConfig) -> int:
+        base = native_counterpart(cfg)
+        peak = native_peaks.get(base.key)
+        if peak is None:
+            peak = peak_buffer_bytes(lower_engine_artifact(base, mesh))
+            native_peaks[base.key] = peak
+        return peak
+
+    for cfg in configs:
+        observed: dict = {}
         overlap_hint = ""
         if cfg.stages is not None:
             overlap_hint = (
@@ -652,32 +987,64 @@ def run_hlo_audit(
                 "chunked collectives (1/S of the un-staged bytes each), "
                 "never a full-width one"
             )
-        if observed["census"] != dict(sorted(exp_census.items())):
-            findings.append(Finding(
-                f"<hlo:{cfg.key}>", 0, "hlo-schedule",
-                f"collective census {observed['census']} != structural "
-                f"expectation {dict(sorted(exp_census.items()))}"
-                f"{overlap_hint}",
-            ))
-        elif observed["payload_bytes"] != dict(sorted(exp_payload.items())):
-            findings.append(Finding(
-                f"<hlo:{cfg.key}>", 0, "hlo-schedule",
-                f"collective payload {observed['payload_bytes']} != "
-                f"structural expectation "
-                f"{dict(sorted(exp_payload.items()))}{overlap_hint}",
-            ))
+        if schedule:
+            lowered = lower_config(cfg, mesh)
+            observed.update(audit_entry(cfg, mesh, lowered))
+            exp_census, exp_payload = expected_schedule(cfg, mesh)
 
-        ceiling = STORAGE_BYTE_CEILING.get(cfg.storage)
-        if ceiling is not None and observed["a_bytes_ratio"] > ceiling:
-            findings.append(Finding(
-                f"<hlo:{cfg.key}>", 0, "hlo-storage-bytes",
-                f"resident-A parameter bytes are "
-                f"{observed['a_bytes_ratio']:.3f}x the native stream, over "
-                f"the {cfg.storage} ceiling of {ceiling}x — the storage "
-                "format is not actually shrinking the bytes it exists to "
-                "shrink",
-            ))
-        findings.extend(early_dequant_findings(cfg, lowered, mesh))
+            if observed["census"] != dict(sorted(exp_census.items())):
+                findings.append(Finding(
+                    f"<hlo:{cfg.key}>", 0, "hlo-schedule",
+                    f"collective census {observed['census']} != structural "
+                    f"expectation {dict(sorted(exp_census.items()))}"
+                    f"{overlap_hint}",
+                ))
+            elif observed["payload_bytes"] != dict(sorted(exp_payload.items())):
+                findings.append(Finding(
+                    f"<hlo:{cfg.key}>", 0, "hlo-schedule",
+                    f"collective payload {observed['payload_bytes']} != "
+                    f"structural expectation "
+                    f"{dict(sorted(exp_payload.items()))}{overlap_hint}",
+                ))
+
+            ceiling = STORAGE_BYTE_CEILING.get(cfg.storage)
+            if ceiling is not None and observed["a_bytes_ratio"] > ceiling:
+                findings.append(Finding(
+                    f"<hlo:{cfg.key}>", 0, "hlo-storage-bytes",
+                    f"resident-A parameter bytes are "
+                    f"{observed['a_bytes_ratio']:.3f}x the native stream, "
+                    f"over the {cfg.storage} ceiling of {ceiling}x — the "
+                    "storage format is not actually shrinking the bytes it "
+                    "exists to shrink",
+                ))
+            findings.extend(early_dequant_findings(cfg, lowered, mesh))
+
+            if check_fingerprints:
+                # The census pass's lowering doubles as the first sample;
+                # one fresh rebuild probes determinism.
+                fp_a = lowering_fingerprint(lowered)
+                fp_b = lowering_fingerprint(lower_config(cfg, mesh))
+                if fp_a != fp_b:
+                    findings.append(Finding(
+                        f"<hlo:{cfg.key}>", 0, "hlo-fingerprint",
+                        f"two lowerings of ExecKey {exec_key(cfg)} hash "
+                        f"differently ({fp_a[:12]} vs {fp_b[:12]}): the "
+                        "engine's AOT cache would silently recompile (or "
+                        "serve divergent programs) across restarts",
+                    ))
+
+        if memory:
+            mem = memory_entry(cfg, mesh)
+            observed.update(mem)
+            if cfg.storage == "native":
+                # The audited natives ARE the quantized cells' baselines
+                # (the table orders natives first) — recording the peak
+                # here saves native_peak_for a redundant lowering.
+                native_peaks.setdefault(cfg.key, mem["peak_bytes"])
+                native_peak = None
+            else:
+                native_peak = native_peak_for(cfg)
+            findings.extend(memory_findings(cfg, mem, native_peak))
 
         if have_golden:
             # Empty/absent "configs" must read as every pin missing, not
@@ -690,27 +1057,22 @@ def run_hlo_audit(
                     f"config {cfg.key} missing from the golden table; "
                     "bless it with --write-golden",
                 ))
-            elif pinned != observed:
-                findings.append(Finding(
-                    GOLDEN_REL, 0, "hlo-census",
-                    f"{cfg.key}: lowered schedule {observed} != golden "
-                    f"{pinned}{overlap_hint}; if the change is deliberate, "
-                    "bless it with --write-golden",
-                ))
-
-        if check_fingerprints:
-            # The census pass's lowering doubles as the first sample; one
-            # fresh rebuild probes determinism.
-            fp_a = lowering_fingerprint(lowered)
-            fp_b = lowering_fingerprint(lower_config(cfg, mesh))
-            if fp_a != fp_b:
-                findings.append(Finding(
-                    f"<hlo:{cfg.key}>", 0, "hlo-fingerprint",
-                    f"two lowerings of ExecKey {exec_key(cfg)} hash "
-                    f"differently ({fp_a[:12]} vs {fp_b[:12]}): the "
-                    "engine's AOT cache would silently recompile (or "
-                    "serve divergent programs) across restarts",
-                ))
+            else:
+                # A full run (both layers) compares whole entries, so a
+                # stale/extra golden field is drift; a partial run
+                # (--memory-audit) compares only the fields it computed,
+                # without re-lowering the other layer's.
+                pinned_view = (
+                    pinned if (schedule and memory)
+                    else {k: pinned.get(k) for k in observed}
+                )
+                if pinned_view != observed:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-census",
+                        f"{cfg.key}: lowered artifact {observed} != golden "
+                        f"{pinned_view}{overlap_hint}; if the change is "
+                        "deliberate, bless it with --write-golden",
+                    ))
 
     if have_golden:
         audited = {cfg.key for cfg in AUDIT_CONFIGS}

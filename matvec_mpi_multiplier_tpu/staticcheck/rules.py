@@ -35,6 +35,14 @@ admission-path                         predictions but never measures —
                                        no timing-harness calls, no
                                        perf_counter, no sync, no sleep
                                        in engine/global_scheduler.py
+lock-mixed-guard          unguarded-ok attributes written under a lock
+                                       are never accessed bare
+                                       (lockgraph.py — whole-program)
+lock-order-inversion      lock-order-  the cross-class lock-acquisition
+                          ok           order graph stays acyclic
+callback-under-lock       callback-ok  no callback/listener invocation
+                                       while holding a lock (the PR 9
+                                       ledger-bug shape)
 ========================  ===========  ====================================
 
 The first four are the old grep rules from ``scripts/tier1.sh`` /
@@ -57,6 +65,8 @@ from typing import Callable, Iterable, Iterator
 
 from .corpus import SourceFile, iter_corpus, repo_root
 from .findings import Finding, dedup
+from .lockgraph import new_generation as lockgraph_new_generation
+from .lockgraph import register_lockgraph_rules
 
 # ------------------------------------------------------------ framework
 
@@ -135,6 +145,9 @@ def run_rules(
         list(RULES.values()) if rules is None
         else [get_rule(n) for n in rules]
     )
+    # One corpus validation per run for the whole-program lock-graph
+    # rules (their per-file checks share the run's analysis).
+    lockgraph_new_generation()
     findings: list[Finding] = []
     for path in iter_corpus(root):
         try:
@@ -153,7 +166,7 @@ def run_rules(
                     continue
                 findings.append(
                     Finding(sf.rel, getattr(node, "lineno", 0), rule.name,
-                            message)
+                            message, marker=rule.marker)
                 )
         findings.extend(_marker_reason_findings(sf, in_scope))
     return dedup(findings)
@@ -800,5 +813,10 @@ def _check_mutable_default(sf: SourceFile):
                     "and construct inside the body"
                 )
 
+
+# Rules #13-#15: the whole-program lock-graph auditor (lockgraph.py)
+# registers through the same decorator so markers, fixtures and the CLI
+# inherit; registration precedes the MARKERS snapshot below.
+register_lockgraph_rules(_register)
 
 MARKERS: dict[str, str] = _markers()

@@ -10,13 +10,18 @@
 # via utils/compat.py, no host syncs on the engine dispatch path, no
 # full-width collectives in staged-overlap bodies, no blocking I/O on the
 # dispatch hot path, no implicit fp64 promotion / import-time jnp work /
-# mutable default arguments. The same engine backs tests/test_lint.py
-# in-suite; this wrapper lets CI fail fast before spending the full
-# suite's runtime. --rules skips the lowered-HLO collective-schedule
-# audit (which needs the 8-device CPU mesh, and rides the suite via
-# tests/test_staticcheck.py) — the rule layer never initializes a device
-# backend (package import still pulls jax in; ~1 s total), keeping
-# --lint-only well under its 10-second budget.
+# mutable default arguments — PLUS the whole-program lock-graph
+# concurrency auditor (rules #13-#15: mixed guard access, lock-order
+# inversion cycles, callback-under-lock; staticcheck/lockgraph.py is
+# pure AST, so it rides --rules inside the lint budget). The same engine
+# backs tests/test_lint.py in-suite; this wrapper lets CI fail fast
+# before spending the full suite's runtime. --rules skips the
+# lowered-HLO schedule + compiled-artifact memory audits (which need the
+# 8-device CPU mesh, and ride the suite via tests/test_staticcheck.py)
+# — the rule layer never initializes a device backend (package import
+# still pulls jax in; ~1 s total), keeping --lint-only well under its
+# 10-second budget. Exit codes: 1 rule findings, 3 HLO-audit failures,
+# 4 golden drift (set -e fails this script on any of them).
 
 set -eu
 cd "$(dirname "$0")/.."

@@ -727,6 +727,16 @@ def test_golden_schedule_schema():
         ), key
         if storage == "native":
             assert entry["a_bytes"] == native_a_bytes, key
+        # schema 3: every entry pins the compiled-artifact memory audit —
+        # the RHS donation lowered ("donated"/"aliased", never "none")
+        # and the static per-device peak-liveness estimate.
+        assert entry["donation"] in ("donated", "aliased"), key
+        assert isinstance(entry["peak_bytes"], int), key
+        assert entry["peak_bytes"] > 0, key
+        per_device_native = native_a_bytes / mesh["devices"]
+        assert entry["peak_bytes_ratio"] == pytest.approx(
+            entry["peak_bytes"] / per_device_native, abs=1e-6
+        ), key
 
 
 def test_golden_schedule_pins_staged_overlap_chunking():
@@ -780,6 +790,30 @@ def test_golden_schedule_pins_quantized_byte_accounting():
         assert entry["a_bytes"] < native["a_bytes"], key
         assert entry["census"] == native["census"], key
         assert entry["payload_bytes"] == native["payload_bytes"], key
+
+
+def test_golden_schedule_pins_quantized_peak_liveness():
+    """The liveness-level storage pins (ISSUE 12): a quantized config's
+    static peak must sit under its documented ceiling relative to the
+    native counterpart's peak — the committed numbers themselves must
+    encode that the storage axis shrinks the allocator high-water mark,
+    not just the resident stream (a dequantized full-width temporary
+    would land at >= 1.1x native; tests/test_staticcheck.py proves the
+    gate bites by mutation)."""
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        PEAK_LIVENESS_CEILING,
+    )
+
+    configs = _golden()["configs"]
+    quantized = {k: v for k, v in configs.items() if k.count("|") == 3}
+    assert quantized, "golden lost its quantized-storage pins"
+    for key, entry in quantized.items():
+        native_key, storage = key.rsplit("|", 1)
+        native = configs[native_key]
+        assert entry["peak_bytes"] < native["peak_bytes"], key
+        assert entry["peak_bytes"] <= (
+            PEAK_LIVENESS_CEILING[storage] * native["peak_bytes"]
+        ), key
 
 
 # ---- quantized_demo: the committed storage-axis capture (ISSUE 8) ----

@@ -173,6 +173,111 @@ RULE_FIXTURES = {
         "    self._observed = time.perf_counter() - t0  # admit-ok: seeded deliberate measurement\n"
         "    return fut\n",
     ),
+    "lock-mixed-guard": (
+        f"{PKG}/engine/seeded.py",
+        # written under the lock in charge(), read bare in total() — the
+        # torn/stale-state shape the lock-graph auditor infers per class
+        "import threading\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._charged = 0\n"
+        "    def charge(self, n):\n"
+        "        with self._lock:\n"
+        "            self._charged += n\n"
+        "    def total(self):\n"
+        "        return self._charged\n",
+        "import threading\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._charged = 0\n"
+        "    def charge(self, n):\n"
+        "        with self._lock:\n"
+        "            self._charged += n\n"
+        "    def total(self):\n"
+        "        return self._charged  # unguarded-ok: seeded monotone snapshot read\n",
+    ),
+    "lock-order-inversion": (
+        f"{PKG}/engine/seeded.py",
+        # registry-lock -> engine-lock via place(), engine-lock ->
+        # registry-lock via charge(): a cycle two threads can deadlock on
+        "import threading\n"
+        "class SeededRegistry:\n"
+        "    def __init__(self, engine):\n"
+        "        self._registry_lock = threading.Lock()\n"
+        "        self.engine = engine\n"
+        "    def admit(self):\n"
+        "        with self._registry_lock:\n"
+        "            self.engine.seeded_place()\n"
+        "    def seeded_charge(self):\n"
+        "        with self._registry_lock:\n"
+        "            pass\n"
+        "class SeededEngine:\n"
+        "    def __init__(self, registry):\n"
+        "        self._residency_lock = threading.Lock()\n"
+        "        self.registry = registry\n"
+        "    def seeded_place(self):\n"
+        "        with self._residency_lock:\n"
+        "            pass\n"
+        "    def release(self):\n"
+        "        with self._residency_lock:\n"
+        "            self.registry.seeded_charge()\n",
+        # the discipline: the cross-lock call moves after release (the
+        # charge no longer happens under the residency lock)
+        "import threading\n"
+        "class SeededRegistry:\n"
+        "    def __init__(self, engine):\n"
+        "        self._registry_lock = threading.Lock()\n"
+        "        self.engine = engine\n"
+        "    def admit(self):\n"
+        "        with self._registry_lock:\n"
+        "            self.engine.seeded_place()\n"
+        "    def seeded_charge(self):\n"
+        "        with self._registry_lock:\n"
+        "            pass\n"
+        "class SeededEngine:\n"
+        "    def __init__(self, registry):\n"
+        "        self._residency_lock = threading.Lock()\n"
+        "        self.registry = registry\n"
+        "    def seeded_place(self):\n"
+        "        with self._residency_lock:\n"
+        "            pass\n"
+        "    def release(self):\n"
+        "        with self._residency_lock:\n"
+        "            pass\n"
+        "        self.registry.seeded_charge()\n",
+    ),
+    "callback-under-lock": (
+        f"{PKG}/engine/seeded.py",
+        # the PR 9 ledger-bug shape: the residency listener fires (via a
+        # helper) while the residency bookkeeping lock is held
+        "import threading\n"
+        "class SeededEngine:\n"
+        "    def __init__(self, listener):\n"
+        "        self._residency_lock = threading.Lock()\n"
+        "        self._listener = listener\n"
+        "        self._bytes = 0\n"
+        "    def _notify(self, delta):\n"
+        "        self._listener(delta, 'resident')\n"
+        "    def ensure(self, delta):\n"
+        "        with self._residency_lock:\n"
+        "            self._bytes += delta\n"
+        "            self._notify(delta)\n",
+        # the discipline: bookkeeping under the lock, callback after
+        "import threading\n"
+        "class SeededEngine:\n"
+        "    def __init__(self, listener):\n"
+        "        self._residency_lock = threading.Lock()\n"
+        "        self._listener = listener\n"
+        "        self._bytes = 0\n"
+        "    def _notify(self, delta):\n"
+        "        self._listener(delta, 'resident')\n"
+        "    def ensure(self, delta):\n"
+        "        with self._residency_lock:\n"
+        "            self._bytes += delta\n"
+        "        self._notify(delta)\n",
+    ),
     "scheduler-lock-across-dispatch": (
         f"{PKG}/engine/scheduler.py",
         # dispatch under the held admission lock: a backpressure stall
@@ -356,6 +461,382 @@ def test_cli_and_api_agree_on_seeded_corpus(tmp_path):
     assert [(f["path"], f["line"], f["rule"]) for f in cli] == [
         (f.path, f.line, f.rule) for f in api
     ]
+
+
+# ----------------------------------------------------- lock-graph auditor
+
+
+def test_lockgraph_clean_on_tree():
+    """The merge acceptance bar: zero bare lock-graph findings on the
+    real tree — every deliberate exception carries a reasoned marker
+    (AST-only; no backend init)."""
+    from matvec_mpi_multiplier_tpu.staticcheck import LOCKGRAPH_RULES
+
+    findings = run_rules(rules=list(LOCKGRAPH_RULES))
+    assert findings == [], "\n".join(
+        f"{f.location}: [{f.rule}] {f.message}" for f in findings
+    )
+
+
+def test_lockgraph_cross_file_inversion_corpus(tmp_path):
+    """The order graph spans files: registry-lock -> engine-lock in one
+    module, engine-lock -> registry-lock in another, and the cycle is
+    reported in both."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded_registry.py",
+        "import threading\n"
+        "class SeededRegistry:\n"
+        "    def __init__(self, engine):\n"
+        "        self._registry_lock = threading.Lock()\n"
+        "        self.engine = engine\n"
+        "    def admit(self):\n"
+        "        with self._registry_lock:\n"
+        "            self.engine.seeded_place()\n"
+        "    def seeded_charge(self):\n"
+        "        with self._registry_lock:\n"
+        "            pass\n",
+    )
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded_engine.py",
+        "import threading\n"
+        "class SeededEngine:\n"
+        "    def __init__(self):\n"
+        "        self._residency_lock = threading.Lock()\n"
+        "    def seeded_place(self):\n"
+        "        with self._residency_lock:\n"
+        "            pass\n"
+        "    def release(self, registry):\n"
+        "        with self._residency_lock:\n"
+        "            registry.seeded_charge()\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-order-inversion"])
+    assert {f.path for f in found} == {
+        f"{PKG}/engine/seeded_registry.py",
+        f"{PKG}/engine/seeded_engine.py",
+    }, found
+    for f in found:
+        assert "_registry_lock" in f.message
+        assert "_residency_lock" in f.message
+
+
+def test_lockgraph_unannotated_direct_acquisition_inversion(tmp_path):
+    """AB/BA through DIRECT `with self.other._x_lock:` acquisitions on
+    UNANNOTATED attributes (the repo's dominant constructor style): the
+    placeholder owner must unify with the class owning that uniquely
+    named lock, or the deadlock is invisible."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class SeededRegistry:\n"
+        "    def __init__(self, engine):\n"
+        "        self._registry_lock = threading.Lock()\n"
+        "        self.engine = engine\n"
+        "    def admit(self):\n"
+        "        with self._registry_lock:\n"
+        "            with self.engine._residency_lock:\n"
+        "                pass\n"
+        "class SeededEngine:\n"
+        "    def __init__(self, registry):\n"
+        "        self._residency_lock = threading.Lock()\n"
+        "        self.registry = registry\n"
+        "    def release(self):\n"
+        "        with self._residency_lock:\n"
+        "            with self.registry._registry_lock:\n"
+        "                pass\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-order-inversion"])
+    assert found, "unannotated direct AB/BA went undetected"
+    assert all(
+        "_registry_lock" in f.message and "_residency_lock" in f.message
+        for f in found
+    ), found
+
+
+def test_lockgraph_local_rooted_acquisition_inversion(tmp_path):
+    """A lock reached through a LOCAL/parameter (`with eng._b_lock:`)
+    still enters the order graph via unique-name unification — AB/BA
+    through locals is the commonest real spelling."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class SeededA:\n"
+        "    def __init__(self):\n"
+        "        self._alpha_lock = threading.Lock()\n"
+        "    def forward(self, peer):\n"
+        "        with self._alpha_lock:\n"
+        "            with peer._beta_lock:\n"
+        "                pass\n"
+        "class SeededB:\n"
+        "    def __init__(self):\n"
+        "        self._beta_lock = threading.Lock()\n"
+        "    def backward(self, peer):\n"
+        "        with self._beta_lock:\n"
+        "            with peer._alpha_lock:\n"
+        "                pass\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-order-inversion"])
+    assert found, "local-rooted AB/BA went undetected"
+
+
+def test_lockgraph_no_phantom_edges_from_locked_helpers(tmp_path):
+    """A `*_locked` helper on a TWO-lock class is guarded by what its
+    callers actually hold — the analyzer must not assume both own locks
+    and fabricate an impossible deadlock cycle."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class SeededEng:\n"
+        "    def __init__(self, other):\n"
+        "        self._gamma_lock = threading.Lock()\n"
+        "        self._delta_lock = threading.Lock()\n"
+        "        self.other = other\n"
+        "    def _bump_locked(self):\n"
+        "        with self.other._epsilon_lock:\n"
+        "            pass\n"
+        "    def bump(self):\n"
+        "        with self._gamma_lock:\n"
+        "            self._bump_locked()\n"
+        "class SeededOther:\n"
+        "    def __init__(self, eng):\n"
+        "        self._epsilon_lock = threading.Lock()\n"
+        "        self.eng = eng\n"
+        "    def touch(self):\n"
+        "        with self._epsilon_lock:\n"
+        "            with self.eng._delta_lock:\n"
+        "                pass\n",
+    )
+    # The only real order is gamma -> epsilon and epsilon -> delta: no
+    # execution path holds _delta_lock while acquiring _epsilon_lock, so
+    # there is no cycle — a finding here is a phantom edge.
+    found = run_rules(root=tmp_path, rules=["lock-order-inversion"])
+    assert found == [], found
+
+
+def test_lockgraph_marker_drops_an_edge(tmp_path):
+    """A `# lock-order-ok: <reason>` on an edge's acquisition/call site
+    removes that edge BEFORE cycle detection — the documented-safe
+    ordering breaks the cycle for both files."""
+    _, bad, _clean = RULE_FIXTURES["lock-order-inversion"]
+    marked = bad.replace(
+        "            self.registry.seeded_charge()\n",
+        "            self.registry.seeded_charge()  # lock-order-ok: seeded proven-safe ordering\n",
+    )
+    assert marked != bad
+    _seed(tmp_path, f"{PKG}/engine/seeded.py", marked)
+    assert run_rules(root=tmp_path, rules=["lock-order-inversion"]) == []
+
+
+def test_mutation_pr9_listener_under_lock_goes_red(tmp_path):
+    """Re-introducing the PR 9 ledger-bug shape — the engine's
+    residency listener fired (through the notify helper) while the
+    residency bookkeeping lock is held — turns the auditor red; the
+    shipped discipline (notify after release) stays green."""
+    shape = (
+        "import threading\n"
+        "class Engine:\n"
+        "    def __init__(self, residency_listener):\n"
+        "        self._residency_lock = threading.Lock()\n"
+        "        self._residency_listener = residency_listener\n"
+        "        self._a = None\n"
+        "    def _notify_residency(self, delta, reason):\n"
+        "        if self._residency_listener is not None:\n"
+        "            self._residency_listener(delta, reason)\n"
+        "    def ensure_resident(self, placed, nbytes):\n"
+        "        with self._residency_lock:\n"
+        "            self._a = placed\n"
+        "{indent}self._notify_residency(nbytes, 'resident')\n"
+    )
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        shape.format(indent="            "),  # under the lock: the bug
+    )
+    found = run_rules(root=tmp_path, rules=["callback-under-lock"])
+    assert any(
+        f.rule == "callback-under-lock"
+        and "_residency_listener" in f.message
+        for f in found
+    ), found
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        shape.format(indent="        "),      # after release: the fix
+    )
+    assert run_rules(root=tmp_path, rules=["callback-under-lock"]) == []
+
+
+def test_lockgraph_locked_helper_convention(tmp_path):
+    """`*_locked` helpers run with the caller's lock held: accesses in
+    their bodies are guarded, and CALLING one bare is itself a
+    finding."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._pending = []\n"
+        "    def _take_locked(self):\n"
+        "        batch = self._pending\n"
+        "        self._pending = []\n"
+        "        return batch\n"
+        "    def submit(self, item):\n"
+        "        with self._lock:\n"
+        "            self._pending.append(item)\n"
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            batch = self._take_locked()\n"
+        "        return batch\n",
+    )
+    assert run_rules(root=tmp_path, rules=["lock-mixed-guard"]) == []
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._pending = []\n"
+        "    def _take_locked(self):\n"
+        "        batch = self._pending\n"
+        "        self._pending = []\n"
+        "        return batch\n"
+        "    def submit(self, item):\n"
+        "        with self._lock:\n"
+        "            self._pending.append(item)\n"
+        "    def flush(self):\n"
+        "        return self._take_locked()\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-mixed-guard"])
+    assert any("*_locked helper" in f.message for f in found), found
+
+
+def test_lockgraph_multi_item_with_is_an_ordered_acquisition(tmp_path):
+    """`with self._a_lock, self._b_lock:` acquires left-to-right while
+    holding the earlier items — paired with a `b then a` path elsewhere
+    it is the textbook AB/BA inversion and must be found."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class Pair:\n"
+        "    def __init__(self):\n"
+        "        self._a_lock = threading.Lock()\n"
+        "        self._b_lock = threading.Lock()\n"
+        "    def forward(self):\n"
+        "        with self._a_lock, self._b_lock:\n"
+        "            pass\n"
+        "    def backward(self):\n"
+        "        with self._b_lock:\n"
+        "            with self._a_lock:\n"
+        "                pass\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-order-inversion"])
+    assert found, "AB/BA via a multi-item with went undetected"
+    assert all("_a_lock" in f.message and "_b_lock" in f.message
+               for f in found), found
+
+
+def test_lockgraph_wrong_lock_read_of_helper_written_attr(tmp_path):
+    """An attribute written only inside a `*_locked` helper is guarded
+    by the class's own locks — reading it under a DIFFERENT object's
+    lock is still a bare access and must be flagged."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class Counter:\n"
+        "    def __init__(self, other):\n"
+        "        self._state_lock = threading.Lock()\n"
+        "        self.other = other\n"
+        "        self._count = 0\n"
+        "    def _bump_locked(self):\n"
+        "        self._count += 1\n"
+        "    def bump(self):\n"
+        "        with self._state_lock:\n"
+        "            self._bump_locked()\n"
+        "    def peek(self):\n"
+        "        with self.other._foreign_lock:\n"
+        "            return self._count\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-mixed-guard"])
+    assert any(
+        "_count" in f.message and f.line == 14 for f in found
+    ), found
+
+
+def test_lockgraph_bare_invocation_of_guarded_callable_is_a_read(tmp_path):
+    """Calling `self._listener()` IS reading `_listener`: a callable
+    attribute written under the lock but invoked bare must be flagged
+    like any other mixed access."""
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class Notifier:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._listener = None\n"
+        "    def set_listener(self, fn):\n"
+        "        with self._lock:\n"
+        "            self._listener = fn\n"
+        "    def fire(self):\n"
+        "        self._listener()\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-mixed-guard"])
+    assert any(
+        "_listener" in f.message and f.line == 10 for f in found
+    ), found
+
+
+def test_lockgraph_marker_inside_with_body_does_not_exempt_the_edge(
+    tmp_path,
+):
+    """Edges anchor to the `with` head's context expression, so a
+    marker on an unrelated line INSIDE the block cannot silently exempt
+    the acquisition edge recorded at its head."""
+    _, bad, _clean = RULE_FIXTURES["lock-order-inversion"]
+    # The marker lands inside a with BODY (on the pass statement of
+    # seeded_charge), not on any acquisition/call edge site — the cycle
+    # must still be found.
+    marked = bad.replace(
+        "    def seeded_charge(self):\n"
+        "        with self._registry_lock:\n"
+        "            pass\n",
+        "    def seeded_charge(self):\n"
+        "        with self._registry_lock:\n"
+        "            pass  # lock-order-ok: seeded comment on an unrelated body line\n",
+    )
+    assert marked != bad
+    _seed(tmp_path, f"{PKG}/engine/seeded.py", marked)
+    found = run_rules(root=tmp_path, rules=["lock-order-inversion"])
+    assert found, "a body-line marker exempted the whole with's edges"
+
+
+def test_lockgraph_wrong_lock_message_names_the_held_lock(tmp_path):
+    _seed(
+        tmp_path, f"{PKG}/engine/seeded.py",
+        "import threading\n"
+        "class Counter:\n"
+        "    def __init__(self, other):\n"
+        "        self._state_lock = threading.Lock()\n"
+        "        self.other = other\n"
+        "        self._count = 0\n"
+        "    def bump(self):\n"
+        "        with self._state_lock:\n"
+        "            self._count += 1\n"
+        "    def peek(self):\n"
+        "        with self.other._foreign_lock:\n"
+        "            return self._count\n",
+    )
+    found = run_rules(root=tmp_path, rules=["lock-mixed-guard"])
+    assert any(
+        "holding only" in f.message and "_foreign_lock" in f.message
+        for f in found
+    ), found
+
+
+def test_lockgraph_findings_carry_marker_and_severity(tmp_path):
+    rel, bad, _clean = RULE_FIXTURES["lock-mixed-guard"]
+    _seed(tmp_path, rel, bad)
+    found = run_rules(root=tmp_path, rules=["lock-mixed-guard"])
+    assert found and all(
+        f.severity == "error" and f.marker == "unguarded-ok" for f in found
+    ), found
 
 
 # ---------------------------------------------------------------- layer 2
@@ -586,3 +1067,219 @@ def test_missing_golden_is_a_finding(devices, tmp_path):
         f.rule == "hlo-golden" and "--write-golden" in f.message
         for f in findings
     ), findings
+
+
+# ----------------------------------------------- compiled-artifact memory
+
+
+def test_donation_lowers_on_engine_recipe(devices):
+    """Every audited config's engine-recipe artifact records the RHS
+    donation (buffer_donor on CPU, aliasing_output where shapes match);
+    lowering WITHOUT donate_argnums reads as 'none' — the audit reads
+    the artifact, not the builder's intent."""
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        donation_state,
+        lower_engine_artifact,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    cfg = AuditConfig("rowwise", "gather")
+    assert donation_state(lower_engine_artifact(cfg, mesh)) in (
+        "donated", "aliased",
+    )
+    assert donation_state(
+        lower_engine_artifact(cfg, mesh, donate=())
+    ) == "none"
+    # A donation recorded on the WRONG argument — donating the resident
+    # A, which XLA must never clobber — is not the RHS donation the gate
+    # verifies: it must read as 'none', not pass on a whole-module grep.
+    assert donation_state(
+        lower_engine_artifact(cfg, mesh, donate=(0,))
+    ) == "none"
+
+
+def test_mutation_drop_donation_fails_memory_audit(devices, monkeypatch):
+    """The acceptance mutation: removing donate_argnums from the engine
+    dispatch recipe turns the memory audit red (hlo-donation) while the
+    untouched recipe passes."""
+    from matvec_mpi_multiplier_tpu.staticcheck import hlo
+
+    cfg = AuditConfig("colwise", "psum_scatter")
+    clean = run_hlo_audit(
+        configs=[cfg], check_fingerprints=False, schedule=False,
+    )
+    assert clean == [], clean
+    monkeypatch.setattr(hlo, "ENGINE_DONATE_ARGNUMS", ())
+    findings = run_hlo_audit(
+        configs=[cfg], check_fingerprints=False, schedule=False,
+    )
+    assert any(f.rule == "hlo-donation" for f in findings), findings
+    # The golden table pins the donation column too: the same mutation
+    # also reads as drift against the committed entry.
+    assert any(
+        f.rule == "hlo-census" and f.severity == "drift" for f in findings
+    ), findings
+
+
+def test_mutation_dequant_first_fails_peak_gate(devices):
+    """The liveness-level storage gate: a kernel that materializes the
+    dequantized full-width A before the contraction blows through the
+    quantized peak ceiling (vs the native counterpart's peak); the
+    sanctioned tile-wise kernel stays under it."""
+    from matvec_mpi_multiplier_tpu.ops.quantize import (
+        matvec_quantized_dequant_first,
+    )
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        lower_engine_artifact,
+        memory_entry,
+        memory_findings,
+        native_counterpart,
+        peak_buffer_bytes,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    for cfg in (
+        AuditConfig("rowwise", "gather", storage="int8"),
+        AuditConfig("colwise", "psum_scatter", storage="int8c"),
+    ):
+        native_peak = peak_buffer_bytes(
+            lower_engine_artifact(native_counterpart(cfg), mesh)
+        )
+        clean = memory_entry(cfg, mesh)
+        assert memory_findings(cfg, clean, native_peak) == []
+        bad = memory_entry(
+            cfg, mesh, kernel=matvec_quantized_dequant_first
+        )
+        findings = memory_findings(cfg, bad, native_peak)
+        assert any(f.rule == "hlo-peak-liveness" for f in findings), (
+            cfg.key, bad, native_peak,
+        )
+        # The dequantized temporary is not subtle: it lands at or above
+        # the native peak, nowhere near the quantized ceiling.
+        assert bad["peak_bytes"] > 0.95 * native_peak
+
+
+def test_peak_estimate_quantized_below_native(devices):
+    """The liveness story the golden table pins: every quantized
+    config's static peak sits below its native counterpart's — the
+    storage axis shrinks the high-water mark, not just the resident
+    stream."""
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        PEAK_LIVENESS_CEILING,
+        lower_engine_artifact,
+        memory_entry,
+        native_counterpart,
+        peak_buffer_bytes,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    cfg = AuditConfig("rowwise", "gather", storage="int8")
+    native_peak = peak_buffer_bytes(
+        lower_engine_artifact(native_counterpart(cfg), mesh)
+    )
+    entry = memory_entry(cfg, mesh)
+    assert 0 < entry["peak_bytes"] <= (
+        PEAK_LIVENESS_CEILING["int8"] * native_peak
+    )
+
+
+def test_shared_artifact_accessor(devices, monkeypatch):
+    """The ride-along contract: ExecutableCache compiles and the memory
+    audit inspects THE SAME artifact — both route through
+    engine.executables.lower_artifact, so they cannot disagree about
+    which executable they audited."""
+    import numpy as np
+
+    from matvec_mpi_multiplier_tpu import MatvecEngine, make_mesh
+    from matvec_mpi_multiplier_tpu.engine import executables
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        lower_engine_artifact,
+    )
+
+    calls = []
+    real = executables.lower_artifact
+
+    def spy(builder):
+        calls.append(builder)
+        return real(builder)
+
+    monkeypatch.setattr(executables, "lower_artifact", spy)
+    mesh = make_mesh(8)
+    # The audit side imports the accessor from the module at call time.
+    lower_engine_artifact(AuditConfig("rowwise", "gather"), mesh)
+    assert len(calls) == 1
+    # The cache side compiles through the same function.
+    a = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+    engine = MatvecEngine(
+        a, mesh, strategy="rowwise", combine="gather", promote=None,
+    )
+    engine.warmup(widths=(1,))
+    engine.close()
+    assert len(calls) >= 2
+
+
+# ----------------------------------------------------- CLI verdict/fields
+
+
+def test_exit_status_distinguishes_failure_classes():
+    from matvec_mpi_multiplier_tpu.staticcheck.__main__ import (
+        EXIT_CLEAN,
+        EXIT_DRIFT,
+        EXIT_HLO,
+        EXIT_RULES,
+        exit_status,
+    )
+    from matvec_mpi_multiplier_tpu.staticcheck.findings import Finding
+
+    rule = Finding("x.py", 3, "engine-host-sync", "m", marker="sync-ok")
+    hlo = Finding("<hlo:k>", 0, "hlo-donation", "m")
+    drift = Finding("g.json", 0, "hlo-census", "m", severity="drift")
+    assert exit_status([]) == EXIT_CLEAN
+    assert exit_status([rule, hlo, drift]) == EXIT_RULES
+    assert exit_status([hlo, drift]) == EXIT_HLO
+    assert exit_status([drift]) == EXIT_DRIFT
+
+
+def test_cli_json_findings_carry_rule_severity_marker(tmp_path):
+    rel, bad, _clean = RULE_FIXTURES["engine-host-sync"]
+    _seed(tmp_path, rel, bad)
+    proc = subprocess.run(
+        [sys.executable, "-m", "matvec_mpi_multiplier_tpu.staticcheck",
+         "--rules", "--root", str(tmp_path), "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1, proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"], payload
+    for f in payload["findings"]:
+        assert {"rule", "severity", "marker", "path", "line"} <= set(f)
+    sync = [f for f in payload["findings"] if f["rule"] == "engine-host-sync"]
+    assert sync and all(
+        f["marker"] == "sync-ok" and f["severity"] == "error" for f in sync
+    )
+
+
+def test_cli_lockgraph_flag_runs_only_lock_rules(tmp_path):
+    """--lockgraph restricts to rules #13-#15: a seeded host-sync
+    violation is invisible to it, a seeded mixed-guard one is not."""
+    _seed(tmp_path, RULE_FIXTURES["engine-host-sync"][0],
+          RULE_FIXTURES["engine-host-sync"][1])
+    proc = subprocess.run(
+        [sys.executable, "-m", "matvec_mpi_multiplier_tpu.staticcheck",
+         "--lockgraph", "--root", str(tmp_path), "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    _seed(tmp_path, RULE_FIXTURES["lock-mixed-guard"][0],
+          RULE_FIXTURES["lock-mixed-guard"][1])
+    proc = subprocess.run(
+        [sys.executable, "-m", "matvec_mpi_multiplier_tpu.staticcheck",
+         "--lockgraph", "--root", str(tmp_path), "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1, (proc.stdout, proc.stderr)
+    payload = json.loads(proc.stdout)
+    assert {f["rule"] for f in payload["findings"]} == {"lock-mixed-guard"}
