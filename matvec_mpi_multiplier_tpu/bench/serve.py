@@ -58,6 +58,21 @@ policy is measured against its expectation. The chaos overlay composes:
 one tenant (labels are tenant-prefixed), and the isolation acceptance
 asserts every OTHER tenant's availability column stays at 1.0.
 
+**Solver mode** (``--op cg|gmres|power|lanczos|chebyshev``;
+docs/SOLVERS.md) serves ANSWERS instead of multiplies: each request is
+one compiled-loop solve (``engine.submit(op=..., rhs=b, rtol=...,
+maxiter=...)``) against a seeded diagonally-dominant SPD operand, so
+every op converges by construction and a divergence is a signal, not
+noise. Rows land in ``serve_solver_<strategy>.csv`` with the
+answer-quality columns — ``iterations`` / ``final_residual`` /
+``time_per_iter_ms`` — next to the serving ones (solve p50/p99,
+compiles per phase; ``compiles_steady`` must stay 0 across repeated
+solves: rtol/maxiter are dynamic operands of ONE executable).
+``chebyshev``'s required spectral interval comes from Gershgorin
+bounds on the generated operand — cheap, deterministic, and honest
+about being bounds (a wider interval slows Chebyshev; it never breaks
+it). The committed capture is ``data/solver_demo/``.
+
 **Global-scheduler A/B** (``--global-sched on|off|both`` with
 ``--tenants``; docs/SCHEDULING.md) routes submits through the
 cost-model-driven :class:`~..engine.GlobalScheduler` — predicted-time
@@ -128,11 +143,13 @@ from ..resilience import (
     RetryPolicy,
     parse_fault_spec,
 )
+from ..solvers import SOLVER_OPS
 from ..utils.errors import (
     AdmissionRejectedError,
     ConfigError,
     DeadlineExceededError,
     MatvecError,
+    SolverDivergedError,
 )
 
 # The payload signature --poison-rate plants in row 0 of a poisoned
@@ -1439,6 +1456,251 @@ def run_serve(
     )
 
 
+# -------------------------------------------------------------- solvers
+#
+# The answer-serving protocol (solvers/; docs/SOLVERS.md): repeated
+# solves of A x = b (or eigenpair estimates) through the SAME engine
+# submit path as every multiply, against a seeded diagonally-dominant
+# SPD operand — valid for all five ops (CG/Chebyshev need SPD, GMRES
+# nonsingular, power/Lanczos symmetric), so one generator serves the
+# whole --op axis and convergence failures mean something.
+
+SOLVER_CSV_HEADER = (
+    "n, n_devices, strategy, dtype, combine, op, rtol, maxiter, "
+    "n_solves, iterations, final_residual, final_value, "
+    "time_per_iter_ms, solve_p50_ms, solve_p99_ms, wall_s, "
+    "solves_per_s, compiles_warmup, compiles_steady, divergences"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverServeResult:
+    """One solver-serve measurement (one CSV row).
+
+    ``iterations``/``final_residual``/``final_value`` are the LAST
+    converged solve's telemetry (the trace is seeded, so they are
+    reproducible); ``time_per_iter_ms`` is steady-phase wall time over
+    total iterations, both summed over CONVERGED solves only — a
+    diverged solve burns its full cap and would flatter the per-
+    iteration number. Divergences are counted, never folded in.
+    """
+
+    n: int
+    n_devices: int
+    strategy: str
+    dtype: str
+    combine: str
+    op: str
+    rtol: float
+    maxiter: int
+    n_solves: int
+    iterations: int
+    final_residual: float
+    final_value: float
+    time_per_iter_ms: float
+    solve_p50_ms: float
+    solve_p99_ms: float
+    wall_s: float
+    compiles_warmup: int
+    compiles_steady: int
+    divergences: int
+
+    @property
+    def solves_per_s(self) -> float:
+        if not (self.wall_s > 0):
+            return float("nan")
+        return self.n_solves / self.wall_s
+
+
+def solver_csv_path(strategy: str, root=None):
+    from .metrics import out_dir
+
+    return out_dir(root) / f"serve_solver_{strategy}.csv"
+
+
+def append_solver_result(result: SolverServeResult, root=None):
+    from ..parallel.distributed import is_main_process
+    from .metrics import _append_row
+
+    path = solver_csv_path(result.strategy, root)
+    if not is_main_process():
+        return path
+    row = (
+        f"{result.n}, {result.n_devices}, {result.strategy}, "
+        f"{result.dtype}, {result.combine}, {result.op}, "
+        f"{result.rtol:g}, {result.maxiter}, {result.n_solves}, "
+        f"{result.iterations}, {result.final_residual:.6e}, "
+        f"{result.final_value:.6e}, {result.time_per_iter_ms:.4f}, "
+        f"{result.solve_p50_ms:.4f}, {result.solve_p99_ms:.4f}, "
+        f"{result.wall_s:.6f}, {result.solves_per_s:.2f}, "
+        f"{result.compiles_warmup}, {result.compiles_steady}, "
+        f"{result.divergences}"
+    )
+    _append_row(path, SOLVER_CSV_HEADER, row)
+    return path
+
+
+def solver_operand(n: int, dtype, seed: int) -> np.ndarray:
+    """Seeded symmetric diagonally-dominant SPD operand: uniform(-1, 1)
+    symmetrized, diagonal set to the absolute row sum plus one. Every
+    Gershgorin disc then sits in [1, ·] — SPD with a bounded, shape-
+    independent condition regime, valid for all five served ops. One
+    diagonal entry is boosted 1.5× to isolate the dominant eigenvalue:
+    without a spectral gap the eigen ops (power/lanczos) converge like
+    (λ₂/λ₁)^k ≈ 1 and every solve would honestly diverge — correct
+    behavior, useless benchmark."""
+    rng = np.random.default_rng(seed)
+    g = rng.uniform(-1.0, 1.0, (n, n))
+    a = (g + g.T) / 2.0
+    np.fill_diagonal(a, np.abs(a).sum(axis=1) + 1.0)
+    a[0, 0] *= 1.5
+    return a.astype(dtype)
+
+
+def gershgorin_interval(a: np.ndarray) -> tuple[float, float]:
+    """Enclosing spectral interval from Gershgorin discs — chebyshev's
+    required ``interval=(λ_min, λ_max)`` without an eigendecomposition.
+    Bounds, not estimates: a wider interval costs Chebyshev iterations
+    but never correctness."""
+    d = np.abs(np.diag(a)).astype(np.float64)
+    r = np.abs(a).astype(np.float64).sum(axis=1) - d
+    return float((np.diag(a) - r).min()), float((np.diag(a) + r).max())
+
+
+def run_serve_solver(
+    strategy_name: str,
+    mesh,
+    n: int,
+    *,
+    op: str,
+    dtype: str = "float32",
+    kernel: str = "xla",
+    combine: str | None = None,
+    stages: int | None = None,
+    dtype_storage: str | None = None,
+    rtol: float = 1e-6,
+    maxiter: int | None = None,
+    restart: int | None = None,
+    steps: int | None = None,
+    n_solves: int = 20,
+    donate: bool = True,
+    seed: int = 0,
+    metrics_out: str | None = None,
+    trace_jsonl: str | None = None,
+) -> SolverServeResult:
+    """Run the solver-serve protocol for one (op, strategy, n, mesh)
+    config: one warmup solve (the compile), then ``n_solves`` steady
+    solves with fresh seeded right-hand sides (start vectors for the
+    eigen ops), each materialized immediately — a solve's latency IS
+    submit-to-answer, there is no meaningful dispatch-only number.
+
+    The zero-recompilation criterion carries over verbatim: rtol and
+    maxiter are dynamic operands, every steady solve hits the warm
+    executable, and the row's ``compiles_steady`` must be 0.
+    ``SolverDivergedError`` is counted and tolerated (availability is
+    the measurement); any other failure aborts the run.
+    """
+    from ..engine.core import DEFAULT_SOLVER_MAXITER
+
+    if op not in SOLVER_OPS:
+        raise ConfigError(
+            f"unknown solver op {op!r}; served ops: {SOLVER_OPS}"
+        )
+    a = solver_operand(n, dtype, seed)
+    interval = gershgorin_interval(a) if op == "chebyshev" else None
+    registry = MetricsRegistry()
+    engine = MatvecEngine(
+        a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
+        stages=stages, dtype_storage=dtype_storage, dtype=dtype,
+        donate=donate, metrics=registry, trace_jsonl=trace_jsonl,
+    )
+    solve_hist = registry.histogram(
+        "serve_solve_latency_ms",
+        "steady-phase submit-entry to materialized-answer host time",
+        window=max(n_solves, 1),
+    )
+    rng = np.random.default_rng(seed + 1)
+    rhs_pool = [
+        rng.standard_normal(n).astype(engine.dtype)
+        for _ in range(n_solves + 1)
+    ]
+
+    def solve(b):
+        return engine.submit(
+            op=op, rhs=b, rtol=rtol, maxiter=maxiter,
+            restart=restart, steps=steps, interval=interval,
+        ).result()
+
+    # ---- warmup: one solve compiles the loop (and its verification
+    # matvec) for this op's bucket; tolerate divergence the same way the
+    # steady phase does — warmup's job is the executable, not the answer.
+    try:
+        solve(rhs_pool[-1])
+    except SolverDivergedError:
+        pass
+    warm_stats = engine.stats
+    compiles_warmup = warm_stats.compiles
+
+    # ---- steady phase: every solve must hit the warm executable ----
+    divergences = 0
+    total_iters = 0
+    converged_s = 0.0
+    last_iters, last_resid, last_value = 0, float("nan"), float("nan")
+    start = time.perf_counter()
+    for i in range(n_solves):
+        t0 = time.perf_counter()
+        try:
+            res = solve(rhs_pool[i])
+        except SolverDivergedError:
+            divergences += 1
+            continue
+        dt = time.perf_counter() - t0
+        solve_hist.observe(dt * 1e3)
+        converged_s += dt
+        total_iters += res.n_iters
+        last_iters = res.n_iters
+        last_resid = res.residual_norm
+        last_value = res.value
+    wall = time.perf_counter() - start
+    steady_stats = engine.stats
+
+    if trace_jsonl is not None:
+        if not engine.flush_traces():
+            print(
+                f"WARNING: trace sink could not confirm {trace_jsonl} — "
+                "the file is missing or incomplete", file=sys.stderr,
+            )
+        engine.close()
+    if metrics_out is not None:
+        _ = engine.stats  # refresh the in_flight gauge before exporting
+        path = Path(metrics_out)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(registry.snapshot(), indent=2) + "\n")
+    return SolverServeResult(
+        n=n,
+        n_devices=int(mesh.devices.size),
+        strategy=strategy_name,
+        dtype=str(engine.dtype),
+        combine=combine or "default",
+        op=op,
+        rtol=rtol,
+        maxiter=DEFAULT_SOLVER_MAXITER if maxiter is None else int(maxiter),
+        n_solves=n_solves,
+        iterations=last_iters,
+        final_residual=last_resid,
+        final_value=last_value,
+        time_per_iter_ms=(
+            converged_s * 1e3 / total_iters if total_iters else float("nan")
+        ),
+        solve_p50_ms=solve_hist.percentile(50),
+        solve_p99_ms=solve_hist.percentile(99),
+        wall_s=wall,
+        compiles_warmup=compiles_warmup,
+        compiles_steady=steady_stats.compiles - compiles_warmup,
+        divergences=divergences,
+    )
+
+
 def tune_serve(
     strategies: Sequence[str],
     sizes: Sequence[tuple[int, int]],
@@ -1572,11 +1834,56 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
     flush_width = getattr(args, "flush_width", "auto")
     if flush_width not in (None, "auto"):
         flush_width = int(flush_width)
+    # Solver mode: --op selects a served solver; the namespace attr is
+    # solver_op because bench.sweep forwards its own args.op ("serve").
+    solver_op = getattr(args, "solver_op", "matvec") or "matvec"
     n_done = 0
     for m, k in sizes:
         for name in strategies:
             for n_dev in counts:
                 mesh = meshes[n_dev]
+                if solver_op != "matvec":
+                    try:
+                        result = run_serve_solver(
+                            name, mesh, m, op=solver_op,
+                            dtype=args.dtype, kernel=args.kernel,
+                            combine=args.combine,
+                            stages=getattr(args, "stages", None),
+                            dtype_storage=getattr(
+                                args, "dtype_storage", None
+                            ),
+                            rtol=getattr(args, "rtol", 1e-6),
+                            maxiter=getattr(args, "maxiter", None),
+                            restart=getattr(args, "restart", None),
+                            steps=getattr(args, "steps", None),
+                            n_solves=args.n_requests,
+                            seed=args.seed,
+                            metrics_out=metrics_out,
+                            trace_jsonl=trace_jsonl,
+                        )
+                    except MatvecError as e:
+                        print(f"skip {name} {m}x{m} p={n_dev}: {e}")
+                        continue
+                    if not args.no_csv:
+                        path = append_solver_result(result, args.data_root)
+                    else:
+                        path = None
+                    print(
+                        f"serve-solver {result.op} {name} {m}x{m} "
+                        f"p={n_dev} solves={result.n_solves} "
+                        f"iters={result.iterations} "
+                        f"resid={result.final_residual:.3e} "
+                        f"t/iter={result.time_per_iter_ms:.3f}ms "
+                        f"p50={result.solve_p50_ms:.2f}ms "
+                        f"p99={result.solve_p99_ms:.2f}ms "
+                        f"compiles={result.compiles_warmup}+"
+                        f"{result.compiles_steady} "
+                        f"div={result.divergences}"
+                    )
+                    if path is not None:
+                        print(f"CSV: {path}")
+                    n_done += 1
+                    continue
                 if n_tenants:
                     # Multi-tenant trace mode (engine/registry.py): takes
                     # precedence over the load/sequential protocols.
@@ -1843,6 +2150,36 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--promote", default="auto",
         help="GEMV->GEMM crossover b*: 'auto' (tuned), an int, or 'never'",
+    )
+    p.add_argument(
+        "--op", dest="solver_op", default="matvec",
+        choices=["matvec"] + list(SOLVER_OPS),
+        help="serve answers instead of multiplies (solvers/; "
+        "docs/SOLVERS.md): each request is one compiled-loop solve of "
+        "A x = b (cg/gmres/chebyshev) or an eigenpair estimate "
+        "(power/lanczos) against a seeded SPD operand; --n-requests "
+        "becomes the steady solve count and rows land in "
+        "serve_solver_<strategy>.csv",
+    )
+    p.add_argument(
+        "--rtol", type=float, default=1e-6,
+        help="with --op <solver>: relative convergence tolerance (a "
+        "DYNAMIC operand — changing it never recompiles)",
+    )
+    p.add_argument(
+        "--maxiter", type=int, default=None,
+        help="with --op <solver>: iteration cap (dynamic operand; "
+        "default: the engine's DEFAULT_SOLVER_MAXITER)",
+    )
+    p.add_argument(
+        "--restart", type=int, default=None,
+        help="with --op gmres: restart length (STATIC — part of the "
+        "executable's bucket key)",
+    )
+    p.add_argument(
+        "--steps", type=int, default=None,
+        help="with --op lanczos: Krylov steps (STATIC — part of the "
+        "executable's bucket key)",
     )
     p.add_argument(
         "--arrival", choices=["closed", "poisson", "burst"],

@@ -107,6 +107,7 @@ from typing import Callable, Sequence
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
 from ..models import get_strategy
 from ..models.base import (
@@ -136,7 +137,20 @@ from ..resilience.policy import (
     ResiliencePolicy,
     classify_failure,
 )
-from ..utils.errors import ConfigError, DeadlineExceededError, ResidencyError
+from ..solvers import (
+    DEFAULT_RESTART,
+    DEFAULT_STEPS,
+    SOLVER_OPS,
+    SolverResult,
+    build_solver,
+    solver_bucket,
+)
+from ..utils.errors import (
+    ConfigError,
+    DeadlineExceededError,
+    ResidencyError,
+    SolverDivergedError,
+)
 from .buckets import (
     DEFAULT_MAX_BUCKET,
     bucket_for,
@@ -156,6 +170,11 @@ SAFE_KERNEL = "xla"
 # re-reads A once instead of 4 times, so even bandwidth-bound shapes win,
 # while b=2 can sit inside measurement noise on fast local backends.
 DEFAULT_PROMOTE_B = 4
+
+# Iteration cap when a solver submit leaves ``maxiter`` unset — generous
+# enough for the well-conditioned serving regime, small enough that a
+# diverging solve fails typed in bounded time (docs/SOLVERS.md).
+DEFAULT_SOLVER_MAXITER = 1000
 
 
 class MatvecFuture:
@@ -290,6 +309,149 @@ class MatvecFuture:
         except BaseException:
             # A device error surfacing at the host fetch must not be
             # recorded as a fast successful request.
+            status = "materialize_error"
+            raise
+        finally:
+            self.retired = True
+            if span is not None:
+                span.__exit__(None, None, None)
+                trace.finish(status=status)
+            if self._materialize_hist is not None and status == "ok":
+                self._materialize_hist.observe(
+                    (time.perf_counter() - t0) * 1e3
+                )
+
+
+class SolverFuture:
+    """Async handle to one served solve (``engine.submit(op="cg", ...)``).
+
+    Mirrors :class:`MatvecFuture`'s face — ``done()`` / ``exception()`` /
+    ``result()`` / ``retired`` — so the tenant registry's quota
+    accounting and the global scheduler's tracking duck-type over both.
+    What differs is the contract: ``result()`` materializes a
+    :class:`~..solvers.common.SolverResult` and either returns a
+    CONVERGED answer or raises a typed error — ``SolverDivergedError``
+    when the compiled loop hit its iteration cap still above tolerance,
+    ``ResultIntegrityError``/``SolverDivergedError`` when the answer is
+    non-finite. An unconverged or corrupt ``x`` is never returned: for a
+    multiply a wrong block is the caller's to validate, but a solver's
+    whole point is the answer, so the refusal is unconditional (not
+    gated behind ``integrity_gate``)."""
+
+    def __init__(
+        self,
+        res: SolverResult,
+        op: str,
+        rtol: float,
+        cap: int,
+        trace: ActiveTrace | None = None,
+        corrupt: bool = False,
+        materialize_hist=None,
+        integrity_counter=None,
+        iter_hist=None,
+        divergence_counter=None,
+        residual_gauge=None,
+    ):
+        self._res = res
+        self.op = op
+        self._rtol = rtol
+        self._cap = cap  # maxiter (lanczos: its static step count)
+        self._corrupt = bool(corrupt)
+        self._error: Exception | None = None
+        self.retired = False
+        self._trace = trace
+        self._materialize_hist = materialize_hist
+        self._integrity_counter = integrity_counter
+        self._iter_hist = iter_hist
+        self._divergence_counter = divergence_counter
+        self._residual_gauge = residual_gauge
+
+    @classmethod
+    def failed(
+        cls, error: Exception, trace: ActiveTrace | None = None
+    ) -> "SolverFuture":
+        """A solve that was never dispatched (deadline/admission):
+        ``result()`` raises ``error``, ``done()`` is immediately True."""
+        fut = cls(None, op="", rtol=0.0, cap=0, trace=trace)
+        fut._error = error
+        return fut
+
+    def done(self) -> bool:
+        if self._res is None:
+            return True
+        arr = self._res.x
+        return bool(arr.is_ready()) if hasattr(arr, "is_ready") else True
+
+    def exception(self) -> Exception | None:
+        return self._error
+
+    def result(self) -> SolverResult:
+        """Materialize the solve on host: a :class:`SolverResult` whose
+        ``x`` is a numpy array and whose telemetry fields are Python
+        scalars. Raises :class:`SolverDivergedError` if the loop exited
+        on its cap (the partial iterate is withheld — retry with a larger
+        ``maxiter``/looser ``rtol``); finishes the request trace with
+        ``status=ok|diverged|integrity_failed``."""
+        if self._error is not None:
+            self.retired = True
+            raise self._error
+        trace = self._trace
+        t0 = time.perf_counter()
+        span = trace.span("materialize") if trace is not None else None
+        status = "ok"
+        try:
+            x = np.asarray(self._res.x)  # sync-ok: caller-requested materialization
+            if self._corrupt and np.issubdtype(x.dtype, np.floating):
+                # Injected silent-corruption fault (resilience/faults.py):
+                # the poison lands here so the refusal below catches it.
+                x = np.array(x)  # sync-ok: host-side writable copy
+                x[0] = np.nan
+            n_iters = int(self._res.n_iters)  # sync-ok: materialization
+            rnorm = float(self._res.residual_norm)  # sync-ok: materialization
+            value = float(self._res.value)  # sync-ok: materialization
+            converged = bool(self._res.converged)  # sync-ok: materialization
+            if self._iter_hist is not None:
+                self._iter_hist.observe(n_iters)
+            if self._residual_gauge is not None:
+                self._residual_gauge.set(rnorm)
+            if not np.all(np.isfinite(x)) or not np.isfinite(rnorm):
+                if self._integrity_counter is not None:
+                    err = refuse_nonfinite(
+                        x, self._integrity_counter,
+                        f"the materialized {self.op} solution",
+                    )
+                    if err is not None:
+                        status = "integrity_failed"
+                        self._error = err
+                        raise err
+                status = "integrity_failed"
+                self._error = SolverDivergedError(
+                    f"{self.op} solve produced a non-finite result "
+                    f"(residual_norm={rnorm}); the answer is withheld — "
+                    "check the operand for NaN/Inf or retry on the "
+                    "degraded tier"
+                )
+                raise self._error
+            if not converged:
+                status = "diverged"
+                if self._divergence_counter is not None:
+                    self._divergence_counter.inc()
+                self._error = SolverDivergedError(
+                    f"{self.op} solve exhausted its iteration cap "
+                    f"({self._cap}) at residual_norm={rnorm:.6e} without "
+                    f"meeting rtol={self._rtol:g}; the partial iterate is "
+                    "withheld (docs/SOLVERS.md: converged or typed "
+                    "failure, never a silently wrong x) — retry with a "
+                    "larger maxiter, a looser rtol, or a better-suited op"
+                )
+                raise self._error
+            return SolverResult(
+                x=x, value=value, n_iters=n_iters,
+                residual_norm=rnorm, converged=True,
+            )
+        except (SolverDivergedError, ResultIntegrityError):
+            raise
+        except BaseException:
             status = "materialize_error"
             raise
         finally:
@@ -487,6 +649,9 @@ class MatvecEngine:
         self._donate = DONATE_ARGNUMS if donate else ()
         self._sh_a, self._sh_x = self.strategy.shardings(mesh)
         _, self._sh_b = self.strategy.batched_shardings(mesh)
+        # Replicated sharding for the solver path's RHS and scalar operands
+        # (rtol/maxiter/interval ride as dynamic scalars — docs/SOLVERS.md).
+        self._sh_rep = NamedSharding(mesh, PartitionSpec())
         self.storage = self._resolve_storage(dtype_storage)
         self._a_native = None  # lazy native residency (the ladder's safe tier)
         self.retain_host = bool(retain_host)
@@ -629,6 +794,10 @@ class MatvecEngine:
         # Ladders are pure functions of the (fixed-at-construction) engine
         # config plus the bucket — memoized off the resilient hot path.
         self._ladders: dict = {}
+        # Solver metric handles, created on the FIRST solver submit so a
+        # pure-matvec engine's snapshot (and the obs `solvers` panel
+        # trigger) stays clean — same doctrine as the resilience counters.
+        self._solver_metrics = None
         self._retry_serials = itertools.count()
         if resilience is not None or fault_plan is not None:
             self._c_faults = self.metrics.counter(
@@ -1046,6 +1215,48 @@ class MatvecEngine:
             bucket, self.kernel, self._gemm_combine, self.stages
         )
 
+    def _solver_key(self, op: str, bucket: int) -> ExecKey:
+        """A solver executable's cache identity: the matvec key with the
+        op swapped in and the op's static shape parameter (GMRES restart,
+        Lanczos steps) in the bucket field — differing rtol/maxiter
+        values are dynamic operands, never new keys."""
+        return ExecKey(
+            op, self.strategy.name, self._kernel_label(),
+            self._combine_label(self._matvec_combine), bucket,
+            str(self.dtype), self.storage,
+        )
+
+    def _solver_builder_for(self, op, kernel, combine, stages, *,
+                            restart, steps, storage=None):
+        storage = self.storage if storage is None else storage
+
+        def builder():
+            fn = build_solver(
+                op, self.strategy, self.mesh, dtype=self.dtype,
+                kernel=kernel, combine=combine, stages=stages,
+                dtype_storage=None if storage == NATIVE else storage,
+                restart=restart, steps=steps,
+            )
+            scalar_f = jax.ShapeDtypeStruct(
+                (), np.float32, sharding=self._sh_rep
+            )
+            structs = (
+                self._a_struct(storage),
+                # The RHS rides replicated (the solver constrains it there
+                # anyway; re-slicing a replicated vector to a strategy's
+                # sharded x spec is a local slice, no collective).
+                jax.ShapeDtypeStruct(
+                    (self.k,), self.dtype, sharding=self._sh_rep
+                ),
+                scalar_f,  # rtol
+                jax.ShapeDtypeStruct((), np.int32, sharding=self._sh_rep),
+                scalar_f,  # interval lo (chebyshev; others ignore)
+                scalar_f,  # interval hi
+            )
+            return fn, structs, self._donate
+
+        return builder
+
     # ---- degradation ladders (resilience; docs/RESILIENCE.md) ----
     #
     # A ladder is an ordered list of (ExecKey, builder) config levels for
@@ -1094,6 +1305,41 @@ class MatvecEngine:
             )
             levels.append((safe_key, safe_builder))
         self._ladders[bucket] = levels
+        return levels
+
+    def _solver_levels(
+        self, op: str, bucket: int, restart: int, steps: int
+    ) -> list[tuple[ExecKey, Callable]]:
+        """The solver's degradation ladder: the engine's preferred
+        kernel/combine/storage first, then the same NATIVE-storage
+        xla/default-combine safe floor every other dispatch path falls
+        back to — a breaker opening on an exotic solver config degrades
+        the solve, never refuses it."""
+        cache_key = ("solver", op, bucket)
+        levels = self._ladders.get(cache_key)
+        if levels is not None:
+            return levels
+        preferred = self._solver_key(op, bucket)
+        levels = [(
+            preferred,
+            self._solver_builder_for(
+                op, self.kernel, self._matvec_combine, self.stages,
+                restart=restart, steps=steps,
+            ),
+        )]
+        safe_key = ExecKey(
+            op, self.strategy.name, SAFE_KERNEL, None, bucket,
+            str(self.dtype), NATIVE,
+        )
+        if safe_key != preferred:
+            levels.append((
+                safe_key,
+                self._solver_builder_for(
+                    op, SAFE_KERNEL, None, None,
+                    restart=restart, steps=steps, storage=NATIVE,
+                ),
+            ))
+        self._ladders[cache_key] = levels
         return levels
 
     # ---- dispatch (the hot path: enqueue-only, no host syncs) ----
@@ -1235,6 +1481,37 @@ class MatvecEngine:
             out = exe(self._a_for(key), jax.device_put(padded, self._sh_b))
         return self._track(out), corrupt
 
+    def _exec_solver(
+        self, op: str, rhs: np.ndarray, rtol: float, maxiter: int,
+        lo: float, hi: float, trace: ActiveTrace,
+        key: ExecKey, builder,
+    ) -> tuple[SolverResult, bool]:
+        """ONE solver dispatch at one config level: the whole iteration —
+        loop, convergence predicate, residuals — is inside the compiled
+        program, so this is a single enqueue exactly like a matvec
+        dispatch (one ``dispatch`` trace span per solve, the property the
+        solver demo's trace capture shows). The dynamic knobs ride as
+        replicated scalar operands; the fault sites are the matvec path's
+        ``compile``/``dispatch``, so existing fault specs match solver
+        keys by the same label grammar."""
+        if self._fault_plan is not None and key not in self._cache:
+            self._check_faults("compile", key)
+        exe = self._get_traced(trace, key, builder)
+        corrupt = self._check_faults("dispatch", key, block=rhs)
+        self._c_dispatches.inc()
+        rep = self._sh_rep
+        with trace.span("dispatch", op=op, bucket=key.bucket):
+            out = exe(
+                self._a_for(key),
+                jax.device_put(rhs, rep),
+                jax.device_put(np.float32(rtol), rep),
+                jax.device_put(np.int32(maxiter), rep),
+                jax.device_put(np.float32(lo), rep),
+                jax.device_put(np.float32(hi), rep),
+            )
+        self._track(out.x)
+        return out, corrupt
+
     # ---- resilient dispatch: retries, breakers, the ladder ----
 
     def _breaker_for(self, key: ExecKey) -> CircuitBreaker:
@@ -1373,10 +1650,17 @@ class MatvecEngine:
 
     def submit(
         self,
-        x,
+        x=None,
         *,
         deadline_ms: float | None = None,
         integrity: bool | None = None,
+        op: str = "matvec",
+        rhs=None,
+        rtol: float = 1e-6,
+        maxiter: int | None = None,
+        restart: int | None = None,
+        steps: int | None = None,
+        interval: tuple[float, float] | None = None,
     ) -> MatvecFuture:
         """Dispatch one request: a ``(k,)`` vector or a ``(k, b)`` block of
         ``b`` right-hand sides (columns). Returns immediately (unless the
@@ -1406,11 +1690,40 @@ class MatvecEngine:
         ``engine_dispatch_failures_total`` — callers (the scheduler's
         bisection, the serve bench's chaos loop) treat that as the
         request's failure, not the engine's.
+
+        ``op`` (default ``"matvec"``) selects a SERVED SOLVER instead of
+        a multiply: ``"cg"``/``"gmres"``/``"chebyshev"`` solve ``A x = b``
+        against the resident A, ``"power"``/``"lanczos"`` estimate its
+        extremal eigenpair (the request vector is then the start vector).
+        ``rhs`` is an alias for the positional request (the
+        ``engine.submit(op="cg", rhs=b, ...)`` spelling); ``rtol``/
+        ``maxiter`` are DYNAMIC operands of one compiled loop (changing
+        them never recompiles), while ``restart`` (gmres) and ``steps``
+        (lanczos) are static shapes keyed into the executable's bucket.
+        ``interval=(λ_min, λ_max)`` is chebyshev's required spectral
+        interval. Solver submits return a :class:`SolverFuture`; see
+        docs/SOLVERS.md for the convergence contract. The solver knobs
+        are ignored for ``op="matvec"``.
         """
         t0 = time.monotonic()
         t0_perf = time.perf_counter()
+        if rhs is not None:
+            if x is not None:
+                raise ConfigError(
+                    "pass the request as either the positional x or "
+                    "rhs=, not both"
+                )
+            x = rhs
+        if x is None:
+            raise ConfigError("submit() needs a request vector or block")
         x = np.asarray(x, dtype=self.dtype)  # sync-ok: requests are host arrays (see module docstring)
         self._c_requests.inc()
+        if op != "matvec":
+            return self._submit_solver(
+                x, op=op, rtol=rtol, maxiter=maxiter, restart=restart,
+                steps=steps, interval=interval, deadline_ms=deadline_ms,
+                t0=t0, t0_perf=t0_perf,
+            )
         if x.ndim == 1:
             if x.shape[0] != self.k:
                 raise ConfigError(
@@ -1488,6 +1801,144 @@ class MatvecEngine:
                 # The dispatch failed past every configured recovery: the
                 # request's trace must close (status says why) and the
                 # failure must count before it surfaces to the caller.
+                self._c_dispatch_failures.inc()
+                trace.finish(status="dispatch_failed")
+                self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
+                raise
+
+    def _solver_metric_handles(self):
+        """The obs `solvers` panel's vocabulary, created on first use
+        (constructor comment): iterations histogram, divergence counter,
+        residual gauge, request counter."""
+        if self._solver_metrics is None:
+            self._solver_metrics = (
+                self.metrics.counter(
+                    "solver_requests_total", "solver submits accepted"
+                ),
+                self.metrics.histogram(
+                    "solver_iterations",
+                    "iterations the compiled solver loop ran per solve",
+                ),
+                self.metrics.counter(
+                    "solver_divergences_total",
+                    "solves that exhausted their cap unconverged "
+                    "(SolverDivergedError raised at materialization)",
+                ),
+                self.metrics.gauge(
+                    "solver_residual_norm",
+                    "true residual norm of the last materialized solve",
+                ),
+            )
+        return self._solver_metrics
+
+    def _submit_solver(
+        self, rhs: np.ndarray, *, op, rtol, maxiter, restart, steps,
+        interval, deadline_ms, t0, t0_perf,
+    ) -> SolverFuture:
+        """The solver twin of :meth:`submit`'s dispatch tail: validate
+        host-side (the knobs are Python values here — the last place a
+        typed ConfigError can catch them), run the deadline/backpressure
+        gate, then ONE dispatch through the solver's degradation
+        ladder."""
+        if op not in SOLVER_OPS:
+            raise ConfigError(
+                f"unknown op {op!r}; expected 'matvec' or one of "
+                f"{sorted(SOLVER_OPS)}"
+            )
+        if self.m != self.k:
+            raise ConfigError(
+                f"op={op!r} iterates against a square resident A; this "
+                f"engine holds {self.m}x{self.k}"
+            )
+        if rhs.ndim != 1 or rhs.shape[0] != self.k:
+            raise ConfigError(
+                f"op={op!r} takes one (k,) right-hand side with "
+                f"k={self.k}; got shape {rhs.shape}"
+            )
+        rtol = float(rtol)
+        if not (rtol > 0.0):
+            raise ConfigError(f"rtol must be > 0, got {rtol}")
+        maxiter = (
+            DEFAULT_SOLVER_MAXITER if maxiter is None else int(maxiter)
+        )
+        if maxiter < 1:
+            raise ConfigError(f"maxiter must be >= 1, got {maxiter}")
+        restart = DEFAULT_RESTART if restart is None else int(restart)
+        steps = DEFAULT_STEPS if steps is None else int(steps)
+        if op == "chebyshev":
+            if interval is None:
+                raise ConfigError(
+                    "op='chebyshev' needs interval=(lambda_min, "
+                    "lambda_max) — the semi-iteration is defined by its "
+                    "spectral interval (estimate one with op='power'/"
+                    "'lanczos'; docs/SOLVERS.md)"
+                )
+            lo, hi = float(interval[0]), float(interval[1])
+            if not (0.0 < lo <= hi):
+                raise ConfigError(
+                    f"chebyshev interval needs 0 < lambda_min <= "
+                    f"lambda_max; got ({lo}, {hi})"
+                )
+        else:
+            lo = hi = 0.0
+        bucket = solver_bucket(op, restart=restart, steps=steps)
+        c_requests, iter_hist, c_div, g_resid = self._solver_metric_handles()
+        c_requests.inc()
+        trace = self.tracer.start(cols=1, kind=op)
+
+        def _expired() -> bool:
+            return (
+                deadline_ms is not None
+                and (time.monotonic() - t0) * 1e3 > deadline_ms
+            )
+
+        def _fail() -> SolverFuture:
+            self._c_deadline_failures.inc()
+            trace.finish(status="deadline_failed")
+            self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
+            return SolverFuture.failed(DeadlineExceededError(
+                f"request deadline of {deadline_ms} ms elapsed in the "
+                "backpressure gate before dispatch"
+            ), trace=trace)
+
+        with trace.span("submit"):
+            if deadline_ms is not None and deadline_ms <= 0:
+                return _fail()
+            with trace.span("gate", max_in_flight=self.max_in_flight):
+                self._admit()
+            if _expired():
+                return _fail()
+            try:
+                self._c_cols.inc()
+                levels = self._solver_levels(op, bucket, restart, steps)
+                if self._resilience is None:
+                    key, builder = levels[0]
+                    res, corrupt = self._exec_solver(
+                        op, rhs, rtol, maxiter, lo, hi, trace, key, builder
+                    )
+                else:
+                    def attempt(key, builder):
+                        return self._exec_solver(
+                            op, rhs, rtol, maxiter, lo, hi, trace,
+                            key, builder,
+                        )
+
+                    res, corrupt = self._walk_ladder(levels, attempt)
+                fut = SolverFuture(
+                    res, op=op, rtol=rtol,
+                    cap=steps if op == "lanczos" else maxiter,
+                    trace=trace, corrupt=corrupt,
+                    materialize_hist=self._h_materialize,
+                    integrity_counter=(
+                        self._integrity_counter()
+                        if self.integrity_gate else None
+                    ),
+                    iter_hist=iter_hist, divergence_counter=c_div,
+                    residual_gauge=g_resid,
+                )
+                self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
+                return fut
+            except BaseException:
                 self._c_dispatch_failures.inc()
                 trace.finish(status="dispatch_failed")
                 self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)

@@ -61,7 +61,12 @@ def lower_artifact(builder: Callable[[], tuple[Callable, tuple, tuple[int, ...]]
 class ExecKey(NamedTuple):
     """Identity of one AOT executable in the cache."""
 
-    op: str        # "matvec" | "gemm"
+    # "matvec" | "gemm" | a served solver op ("cg", "gmres", "power",
+    # "lanczos", "chebyshev" — solvers/ops.py::SOLVER_OPS). Solver keys
+    # reuse the bucket field for their static shape parameter (GMRES
+    # restart, Lanczos steps); dynamic knobs (rtol, maxiter, interval)
+    # are operands and never mint new keys.
+    op: str
     strategy: str
     kernel: str
     combine: str | None

@@ -382,6 +382,29 @@ class GlobalScheduler:
             self._predict_memo[memo_key] = base
         return None if base is None else base * (b if cfg["b"] == 1 else 1)
 
+    def _predict_solver_s(self, engine, op: str, k_est: int,
+                          restart: int | None,
+                          steps: int | None) -> float | None:
+        """Predicted seconds for one served solve through the engine's
+        preferred config (``CostModel.predict_solver`` at ``k_est`` =
+        the request's maxiter — worst-case, so a deadline reject is
+        honest about the cap the caller asked for). Un-memoized on
+        purpose: ``k_est`` varies per request and the prediction is pure
+        arithmetic. None (admit, never reject) when the formula cannot
+        express the config."""
+        if self.model is None:
+            return None
+        cfg = engine.prediction_config(1)
+        try:
+            return self.model.predict_solver(
+                op, cfg["strategy"], cfg["combine"], m=cfg["m"],
+                k=cfg["k"], p=cfg["p"], dtype=cfg["dtype"],
+                stages=cfg["stages"], storage=cfg["storage"],
+                k_est=k_est, restart=restart, steps=steps,
+            ).total_s
+        except Exception:  # swallow-ok: a formula-less schedule honestly predicts None — absence of a prediction IS the recorded outcome (never a rejection)
+            return None
+
     def _queue_s(self) -> float:
         """Predicted backlog: the sum of the outstanding (not yet done)
         dispatches' predictions. Done futures are swept — a non-blocking
@@ -482,10 +505,17 @@ class GlobalScheduler:
     def submit(
         self,
         tenant_id: str,
-        x,
+        x=None,
         *,
         deadline_ms: float | None = None,
         qos: str = "standard",
+        op: str = "matvec",
+        rhs=None,
+        rtol: float = 1e-6,
+        maxiter: int | None = None,
+        restart: int | None = None,
+        steps: int | None = None,
+        interval: tuple[float, float] | None = None,
     ):
         """Admit one request for ``tenant_id`` — a ``(k,)`` vector or
         ``(k, b)`` block. Calibrated + deadlined: the queue-aware ETA is
@@ -494,13 +524,27 @@ class GlobalScheduler:
         pressure). Admitted requests dispatch WITHOUT a deadline —
         admission owns it (module docstring). Uncalibrated: greedy —
         everything passes through with its deadline intact for the
-        engine's own gate."""
+        engine's own gate.
+
+        A solver ``op`` (``MatvecEngine.submit(op=...)`` semantics —
+        ``rhs``/``rtol``/``maxiter``/``restart``/``steps``/``interval``
+        pass through) is admitted against
+        :meth:`~..tuning.cost_model.CostModel.predict_solver` at ``k_est
+        = maxiter`` and dispatched solo: a solve is one loop against one
+        RHS, so cross-tenant column-stacking does not apply — solver
+        requests bypass the coalescing layer entirely."""
         if qos not in QOS_TIERS:
             raise ConfigError(
                 f"unknown QoS tier {qos!r}; expected one of {QOS_TIERS}"
             )
         if self._closed:
             raise ConfigError("global scheduler is closed")
+        if op != "matvec":
+            return self._submit_solver_op(
+                tenant_id, x, deadline_ms=deadline_ms, op=op, rhs=rhs,
+                rtol=rtol, maxiter=maxiter, restart=restart, steps=steps,
+                interval=interval,
+            )
         entry = self.registry._entry(tenant_id)
         engine = entry.engine
         block = np.asarray(x, dtype=engine.dtype)  # sync-ok: requests are host arrays (engine contract)
@@ -607,6 +651,100 @@ class GlobalScheduler:
             tenant_id, block, vector, width, dispatch_s,
             flush_now=deadline_ms is not None or qos == "interactive",
         )
+
+    def _submit_solver_op(
+        self, tenant_id: str, x, *, deadline_ms, op, rhs, rtol, maxiter,
+        restart, steps, interval,
+    ):
+        """The solver ops' admission + dispatch: same predicted-time gate
+        as the matvec path with :meth:`_predict_solver_s` supplying the
+        dispatch term, no coalescing (one loop, one RHS). Shape/alias
+        validation stays the engine's (``_submit_solver``) — the
+        scheduler forwards ``x``/``rhs`` untouched so ``submit(x,
+        rhs=...)`` double-supply raises the engine's typed error, not a
+        scheduler-shaped one."""
+        entry = self.registry._entry(tenant_id)
+        engine = entry.engine
+        kwargs = dict(
+            op=op, rhs=rhs, rtol=rtol, maxiter=maxiter,
+            restart=restart, steps=steps, interval=interval,
+        )
+        if self.model is None:
+            self._c_admits.inc()
+            self._record(
+                "admit", tenant_id, predicted_s=None,
+                reason="greedy admission (cost model uncalibrated)",
+                deadline_ms=deadline_ms, op=op,
+            )
+            fut = self.registry.submit(
+                tenant_id, x, deadline_ms=deadline_ms, **kwargs
+            )
+            self._track(fut, None)
+            return fut
+
+        from .core import DEFAULT_SOLVER_MAXITER
+        from ..tuning.cost_model import AdmissionEstimate
+
+        k_est = maxiter if maxiter is not None else DEFAULT_SOLVER_MAXITER
+        dispatch_s = self._predict_solver_s(engine, op, k_est, restart,
+                                            steps)
+        queue_s = self._queue_s()
+        swap_bytes = 0 if engine.resident else engine.resident_bytes
+        swap_s = self.model.restore_s(swap_bytes) if swap_bytes else 0.0
+        est = (
+            AdmissionEstimate(
+                dispatch_s=dispatch_s, queue_s=queue_s, swap_s=swap_s
+            )
+            if dispatch_s is not None else None
+        )
+        eta_s = est.eta_s if est is not None else None
+        if deadline_ms is not None and (
+            deadline_ms <= 0
+            or (
+                eta_s is not None
+                and eta_s * 1e3 > deadline_ms * self.deadline_margin
+            )
+        ):
+            self.registry.observe_demand(tenant_id)
+            self._c_rejects.inc()
+            reason = (
+                "deadline elapsed before admission"
+                if deadline_ms <= 0 else
+                f"predicted {op} eta {eta_s * 1e3:.3f} ms at "
+                f"maxiter={k_est} (queue {queue_s * 1e3:.3f} + swap "
+                f"{swap_s * 1e3:.3f} + solve {dispatch_s * 1e3:.3f}) > "
+                f"deadline {deadline_ms:.3f} ms"
+            )
+            self._record(
+                "reject", tenant_id, predicted_s=dispatch_s,
+                reason=reason, eta_s=eta_s, queue_s=queue_s,
+                deadline_ms=deadline_ms, op=op,
+            )
+            return MatvecFuture.failed(AdmissionRejectedError(
+                f"request for tenant {tenant_id!r} rejected at "
+                f"admission: {reason}"
+            ))
+        if dispatch_s is not None:
+            self._h_predicted.observe(dispatch_s * 1e3)
+        self._record(
+            "admit", tenant_id, predicted_s=dispatch_s,
+            reason=(
+                "uncalibrated config: admitted without a prediction"
+                if dispatch_s is None else
+                f"predicted {op} eta "
+                f"{(eta_s if eta_s is not None else dispatch_s) * 1e3:.3f}"
+                f" ms (maxiter={k_est}) within "
+                + (f"deadline {deadline_ms:.3f} ms"
+                   if deadline_ms is not None else "no deadline")
+            ),
+            eta_s=eta_s, queue_s=queue_s, deadline_ms=deadline_ms, op=op,
+        )
+        self._maybe_interleave(tenant_id, dispatch_s)
+        self._c_admits.inc()
+        # Admission owns the deadline from here (module docstring).
+        fut = self.registry.submit(tenant_id, x, deadline_ms=None, **kwargs)
+        self._track(fut, dispatch_s)
+        return fut
 
     def __call__(self, tenant_id: str, x) -> np.ndarray:
         """Synchronous convenience: ``submit(tenant_id, x).result()``."""

@@ -45,6 +45,12 @@ import jax.numpy as jnp
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..solvers.common import (
+    convergence_threshold,
+    host_norm,
+    keep_iterating,
+    residual_norm,
+)
 from .base import MatvecStrategy
 
 
@@ -104,11 +110,13 @@ def build_cg(
             )
         acc = jnp.promote_types(a.dtype, jnp.float32)
         b_acc = jax.lax.with_sharding_constraint(b.astype(acc), replicated)
-        b_norm = jnp.sqrt(jnp.sum(b_acc * b_acc))
+        b_norm = residual_norm(b_acc)
         # Absolute threshold from the relative tol: ||r|| <= tol * ||b||
         # (the standard scipy.sparse.linalg.cg semantics; the stopping
-        # norm is the TRUE residual's, preconditioned or not).
-        threshold = tol * b_norm
+        # norm is the TRUE residual's, preconditioned or not). The
+        # threshold arithmetic and the norm live in solvers/common.py —
+        # the ONE copy every solver in the tree stops on.
+        threshold = convergence_threshold(tol, b_norm)
 
         if use_jacobi:
             d = jnp.diagonal(a).astype(acc)
@@ -139,7 +147,7 @@ def build_cg(
             _, _, _, _, rr, k, _, rr_best = state
             # Keep going while the CURRENT iterate is above tolerance; the
             # best-so-far is what gets returned either way.
-            return (jnp.sqrt(rr) > threshold) & (k < max_iters)
+            return keep_iterating(jnp.sqrt(rr), threshold, k, max_iters)
 
         def body(state):
             x, r, p, rz, _, k, x_best, rr_best = state
@@ -186,7 +194,7 @@ def build_cg(
         # between refreshes — a min over noisy underestimates is biased
         # low and could claim convergence the returned x does not have.
         r_true = b_acc - mv(x_best)
-        rnorm_true = jnp.sqrt(jnp.sum(r_true * r_true))
+        rnorm_true = residual_norm(r_true)
         return CGResult(
             x=x_best,
             n_iters=k,
@@ -204,10 +212,9 @@ def solve_cg(
     return build_cg(strategy, mesh, **kwargs)(a, b)
 
 
-def _host_norm(v) -> float:
-    """Euclidean norm fetched to host (the refinement loop's control flow
-    is host-driven, unlike build_cg's device-side while_loop)."""
-    return float(jnp.sqrt(jnp.sum(v * v)))
+# The refinement loop's host-driven control flow fetches its norms via
+# solvers/common.py's host_norm — the same residual_norm every device-side
+# while_loop stops on, fetched once per trip (no second copy to drift).
 
 
 def build_refined(
@@ -329,14 +336,14 @@ def build_refined(
         a_aug = jnp.concatenate([a, b[:, None].astype(a.dtype)], axis=1)
         acc = jnp.promote_types(a.dtype, jnp.float32)
         b_acc = b.astype(acc)
-        b_norm = _host_norm(b_acc)
+        b_norm = host_norm(b_acc)
         threshold = tol * b_norm
 
         res = partial(residual, accurate_mv, a_aug, a)
         x_hi = jnp.zeros_like(b_acc)
         x_lo = jnp.zeros_like(b_acc)
         r = res(x_hi, x_lo)
-        rnorm = _host_norm(r)
+        rnorm = host_norm(r)
         trips = 0
         # Refine until STAGNATION, not until the residual threshold: under
         # ill-conditioning a small residual does not yet mean a small
@@ -348,7 +355,7 @@ def build_refined(
             d = inner_solve(a, r.astype(a.dtype)).x.astype(acc)
             nh, nl = df_add(x_hi, x_lo, d, jnp.zeros_like(d))
             r_new = res(nh, nl)
-            new_norm = _host_norm(r_new)
+            new_norm = host_norm(r_new)
             trips += 1
             if new_norm >= 0.5 * rnorm:
                 # Stagnation: keep whichever iterate is better and stop.

@@ -47,6 +47,11 @@ import jax.numpy as jnp
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..solvers.common import (
+    convergence_threshold,
+    keep_iterating,
+    residual_norm,
+)
 from .base import MatvecStrategy
 from .cg import CGResult  # shared result contract; n_iters = restart CYCLES
 
@@ -85,8 +90,8 @@ def build_gmres(
         n = a.shape[0]
         acc = jnp.promote_types(a.dtype, jnp.float32)
         b_acc = jax.lax.with_sharding_constraint(b.astype(acc), replicated)
-        b_norm = jnp.sqrt(jnp.sum(b_acc * b_acc))
-        threshold = tol * b_norm
+        b_norm = residual_norm(b_acc)
+        threshold = convergence_threshold(tol, b_norm)
 
         def mv(v: Array) -> Array:
             y = matvec(a, v.astype(a.dtype)).astype(acc)
@@ -113,7 +118,7 @@ def build_gmres(
                 h2 = V @ w
                 w = w - h2 @ V
                 h = h1 + h2
-                wnorm = jnp.sqrt(jnp.sum(w * w))
+                wnorm = residual_norm(w)
                 ok = wnorm > 0  # 0 = (lucky) breakdown: basis is invariant
                 vk1 = jnp.where(ok, w / jnp.where(ok, wnorm, 1.0), 0.0)
                 V = V.at[k + 1].set(vk1)
@@ -130,7 +135,7 @@ def build_gmres(
             # The convergence decision uses the TRUE residual — one extra
             # matvec per cycle buys immunity to basis-loss drift.
             r_new = b_acc - mv(x_new)
-            return x_new, r_new, jnp.sqrt(jnp.sum(r_new * r_new))
+            return x_new, r_new, residual_norm(r_new)
 
         x0 = jnp.zeros_like(b_acc)
         state0 = (x0, b_acc, b_norm, jnp.asarray(0, jnp.int32),
@@ -138,7 +143,7 @@ def build_gmres(
 
         def cond(state):
             _, _, rnorm, k, _, _ = state
-            return (rnorm > threshold) & (k < max_restarts)
+            return keep_iterating(rnorm, threshold, k, max_restarts)
 
         def body(state):
             x, r, rnorm, k, x_best, rn_best = state

@@ -31,6 +31,7 @@ import numpy as np
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..solvers.common import keep_iterating, residual_norm
 from .base import MatvecStrategy
 from .cg import build_cg
 
@@ -67,20 +68,20 @@ def build_spectral_norm(
             return jax.lax.with_sharding_constraint(y, replicated)
 
         v = v0.astype(acc)
-        v = v / jnp.sqrt(jnp.sum(v * v))
+        v = v / residual_norm(v)
         state0 = (v, jnp.asarray(0.0, acc), jnp.asarray(jnp.inf, acc),
                   jnp.asarray(0, jnp.int32))
 
         def cond(state):
             _, lam, prev, k = state
             rel_step = jnp.abs(lam - prev) / jnp.maximum(jnp.abs(lam), 1e-30)
-            return (rel_step > tol) & (k < max_iters)
+            return keep_iterating(rel_step, tol, k, max_iters)
 
         def body(state):
             v, lam, _, k = state
             av = mv(v)
             new_lam = jnp.sum(v * av)  # Rayleigh quotient (unit v)
-            norm = jnp.sqrt(jnp.sum(av * av))
+            norm = residual_norm(av)
             v = av / jnp.maximum(norm, 1e-30)
             return (v, new_lam, lam, k + 1)
 

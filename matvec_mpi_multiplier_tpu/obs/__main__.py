@@ -337,6 +337,38 @@ def render_cost_model(snapshot: dict) -> str | None:
     return "\n".join(out)
 
 
+def render_solvers(snapshot: dict) -> str | None:
+    """The served-solvers panel: request volume, the iterations-to-exit
+    distribution, divergences (typed ``SolverDivergedError`` exits — the
+    converged-or-typed-failure contract, docs/SOLVERS.md) and the last
+    materialized true residual, read off the ``solver_*`` metrics
+    (engine/core.py ``SolverFuture``). None when the snapshot carries no
+    solver vocabulary (a matvec-only run)."""
+    counters = snapshot.get("counters", {})
+    if "solver_requests_total" not in counters:
+        return None
+    hists = snapshot.get("histograms", {})
+    gauges = snapshot.get("gauges", {})
+    iters = hists.get("solver_iterations", {})
+    requests = counters.get("solver_requests_total", 0)
+    diverged = counters.get("solver_divergences_total", 0)
+    out = [
+        "solvers:",
+        f"  requests          {requests}",
+        f"  iterations p50    {iters.get('p50', float('nan')):.0f} "
+        f"(p95 {iters.get('p95', float('nan')):.0f}, "
+        f"n={iters.get('count', 0)})",
+        f"  divergences       {diverged} "
+        f"(typed SolverDivergedError; "
+        f"{(diverged / requests) if requests else float('nan'):.3f} of "
+        "requests — never a silently wrong x)",
+        f"  last residual     "
+        f"{gauges.get('solver_residual_norm', float('nan')):.3e} "
+        "(true ||b - A x|| at last materialize)",
+    ]
+    return "\n".join(out)
+
+
 def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     """Human-readable (or Prometheus text) rendering of a snapshot dict.
     Snapshots carrying batching-scheduler metrics get the ``batching``
@@ -383,6 +415,9 @@ def render_metrics(snapshot: dict, prometheus: bool = False) -> str:
     gsched = render_gsched(snapshot)
     if gsched is not None:
         out.append(gsched)
+    solvers = render_solvers(snapshot)
+    if solvers is not None:
+        out.append(solvers)
     batching = render_batching(snapshot)
     if batching is not None:
         out.append(batching)

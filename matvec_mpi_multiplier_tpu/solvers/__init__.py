@@ -1,0 +1,41 @@
+"""Served iterative solvers: multi-step operations against the resident A.
+
+The subsystem that turns "serve a multiply" into "serve an answer"
+(docs/SOLVERS.md): each op is ONE compiled ``lax.while_loop``/``scan``
+around the strategy's audited matvec, exposed through
+``engine.submit(op="cg", rhs=b, rtol=..., maxiter=...)`` so the AOT
+cache, bucket ladder, degradation ladder, deadline admission, tenancy,
+tracing and metrics all inherit.
+"""
+
+from .common import (
+    SolverResult,
+    convergence_threshold,
+    host_norm,
+    keep_iterating,
+    residual_norm,
+)
+from .ops import (
+    DEFAULT_RESTART,
+    DEFAULT_STEPS,
+    EIGEN_OPS,
+    SOLVER_OPS,
+    build_solver,
+    solver_bucket,
+    solver_matvec_count,
+)
+
+__all__ = [
+    "SolverResult",
+    "convergence_threshold",
+    "host_norm",
+    "keep_iterating",
+    "residual_norm",
+    "SOLVER_OPS",
+    "EIGEN_OPS",
+    "DEFAULT_RESTART",
+    "DEFAULT_STEPS",
+    "build_solver",
+    "solver_bucket",
+    "solver_matvec_count",
+]

@@ -1,0 +1,89 @@
+"""Shared solver arithmetic: ONE residual norm, ONE convergence predicate.
+
+Every iterative method in the tree — the standalone Krylov builders in
+``models/`` (cg, gmres, spectral), the refinement driver
+(``models/cg.py::build_refined``), and the served solver programs in
+``solvers/ops.py`` — stops on the same two scalars: a Euclidean residual
+norm and a ``still-running?`` predicate over (norm, threshold, step,
+cap). Before this module each site carried its own inline copy of both;
+copies drift (one site compares ``>=`` where another compares ``>``, one
+norm guards the zero vector and another doesn't), and a drifted
+convergence test is the kind of bug that returns a wrong answer with
+``converged=True``. So: one implementation of each, imported everywhere,
+no second copy to drift.
+
+Import discipline: this module depends on ``jax``/``jnp`` ONLY. Both
+``models/`` and ``solvers/`` (and the engine) import it, so it must sit
+below all of them in the dependency order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def residual_norm(v: Array) -> Array:
+    """THE Euclidean norm every solver stops on: ``sqrt(sum(v*v))``.
+
+    Deliberately ``jnp.sum``-based rather than ``jnp.linalg.norm``: on a
+    replicated O(n) vector the explicit form lowers to one fused
+    multiply-reduce with no collectives (the vectors are replicated, so
+    the reduction is device-local), keeping solver loop bodies' collective
+    census exactly the matvec's — the property the staticcheck HLO audit
+    pins (docs/STATIC_ANALYSIS.md)."""
+    return jnp.sqrt(jnp.sum(v * v))
+
+
+def host_norm(v: Array) -> float:
+    """:func:`residual_norm` fetched to host — for HOST-driven outer loops
+    only (``models/cg.py::build_refined``'s refinement trips). Never call
+    this inside a compiled solver body: the fetch is the host round-trip
+    the served solvers exist to eliminate (and the mutation the HLO audit
+    turns red on)."""
+    return float(residual_norm(v))
+
+
+def keep_iterating(rnorm: Array, threshold: Array, k: Array, cap) -> Array:
+    """THE ``lax.while_loop`` continuation predicate: still above tolerance
+    AND still under the iteration cap.
+
+    Strict ``>`` against the threshold (``||r|| <= tol * ||b||`` counts as
+    converged — scipy's semantics) and strict ``<`` against the cap. The
+    cap may be a Python int (the standalone builders' static
+    ``max_iters``) or a traced int32 scalar (the served solvers'
+    dynamic ``maxiter`` operand) — same predicate either way."""
+    return (rnorm > threshold) & (k < cap)
+
+
+def convergence_threshold(rtol, b_norm: Array) -> Array:
+    """Absolute stopping threshold from a relative tolerance:
+    ``rtol * ||b||`` — the one place the relative→absolute convention is
+    written down. ``rtol`` may be static (builders) or a traced scalar
+    (served solvers)."""
+    return rtol * b_norm
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class SolverResult:
+    """One served solve's answer + convergence telemetry, all
+    device-resident (the engine's ``SolverFuture`` materializes it).
+
+    ``x`` is the solution vector (linear ops) or the extremal
+    eigenvector (eigen ops); ``value`` is the eigenvalue estimate for
+    eigen ops and NaN for linear solves (a linear solve has no scalar
+    answer — NaN keeps the pytree shape uniform across ops so one
+    executable signature serves all five). ``residual_norm`` is the TRUE
+    residual of the returned iterate — ``||b - A x||`` for linear ops,
+    ``||A v - λ v||`` for eigen ops — recomputed outside the loop, never
+    the recurrence's drifted estimate."""
+
+    x: Array
+    value: Array
+    n_iters: Array
+    residual_norm: Array
+    converged: Array

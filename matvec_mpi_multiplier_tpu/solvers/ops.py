@@ -1,0 +1,452 @@
+"""Served solver programs: one compiled loop per op, dynamic knobs as operands.
+
+The engine's dispatch path serves ONE compiled artifact per
+:class:`~..engine.executables.ExecKey` and demands ``compiles_steady == 0``
+across a warm stream. A solver that retraced per tolerance — or worse,
+re-dispatched k matvecs from the host — would break both that doctrine and
+the deadline math. So every op here compiles to a single program with the
+uniform signature
+
+    ``fn(a, b, rtol, maxiter, p0, p1) -> SolverResult``
+
+where ``rtol``/``maxiter``/``p0``/``p1`` are DYNAMIC scalar operands
+(``p0``/``p1`` carry chebyshev's spectral interval; other ops ignore
+them): two solves with different tolerances or caps hit the same
+executable, and the only static shape parameters — GMRES's restart,
+Lanczos's step count — ride the ExecKey's ``bucket`` field exactly as the
+GEMM path's column bucket does.
+
+Inside each program the iteration is ``lax.while_loop``/``scan`` around
+the strategy's own sharded local-body + combine (``models/base.py``): the
+per-iteration matvec IS the audited matvec program, vectors stay
+replicated (their dots and axpys are device-local), and the loop's
+collective census therefore equals the matvec census — the invariant the
+staticcheck HLO audit pins per strategy×op (docs/STATIC_ANALYSIS.md). No
+host round-trip exists inside any loop; convergence is an on-device
+predicate (``solvers/common.py``) and the iteration cap is the loop's
+other exit. What the cap-exit means — a typed ``SolverDivergedError``,
+never a silently wrong ``x`` — is the engine's ``SolverFuture`` contract
+(docs/SOLVERS.md).
+
+The algorithms themselves are the tree's established ones: CG and
+restarted-GMRES follow ``models/cg.py``/``models/gmres.py`` (best-so-far
+iterates, true-residual reporting, CGS2 Arnoldi), power iteration follows
+``models/spectral.py``, Lanczos adds the tridiagonal Ritz machinery, and
+Chebyshev is the classic semi-iteration over a caller-supplied spectral
+interval. All stopping arithmetic imports from ``solvers/common.py`` —
+the one-copy rule.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.base import MatvecStrategy
+from .common import (
+    SolverResult,
+    convergence_threshold,
+    keep_iterating,
+    residual_norm,
+)
+
+# The served solver op vocabulary — the values `engine.submit(op=...)`
+# accepts beyond "matvec", in ExecKey.op's namespace.
+SOLVER_OPS: tuple[str, ...] = ("cg", "gmres", "power", "lanczos", "chebyshev")
+
+# Ops whose answer is an eigenpair (rhs is the START VECTOR, `value` is
+# the eigenvalue) rather than a linear-system solution (`value` is NaN).
+EIGEN_OPS: frozenset[str] = frozenset(("power", "lanczos"))
+
+# Default static shape parameters: GMRES's Arnoldi basis size (ADVICE r5's
+# small-restart default, shared with build_refined's inner GMRES) and
+# Lanczos's tridiagonalization depth. These are the ExecKey bucket values.
+DEFAULT_RESTART = 10
+DEFAULT_STEPS = 32
+
+# True-residual refresh period for served CG (models/cg.py's default).
+_RECOMPUTE_EVERY = 50
+
+_TINY = 1e-30  # division guard, matching models/spectral.py
+
+
+def solver_matvec_count(
+    op: str, k_est: int, *,
+    restart: int = DEFAULT_RESTART, steps: int = DEFAULT_STEPS,
+) -> int:
+    """Strategy-matvec count of one served solve at ``k_est`` iterations
+    — the symbolic iteration structure the analytic cost model multiplies
+    by its one-matvec prediction (``tuning.cost_model.predict_solver``).
+    Counts the loop body's matvecs plus each op's verification matvecs
+    (the true-residual refreshes and the final ``_linear_result`` /
+    Rayleigh check); the replicated vector work (dots, axpys, the CGS2
+    GEMVs) is deliberately uncounted — it is O(n) per device against the
+    matvec's O(n²/p) and carries no collective."""
+    if op == "gmres":
+        # Per restart cycle: restart Arnoldi matvecs + the cycle's true
+        # residual; +1 for the final verification.
+        return k_est * (restart + 2) + 1
+    if op == "lanczos":
+        # Fixed-depth scan; k_est is ignored exactly as maxiter is.
+        return steps + 1
+    if op == "cg":
+        # Body + periodic refresh + the final two-candidate verification.
+        return k_est + k_est // _RECOMPUTE_EVERY + 2
+    # power, chebyshev: body + one final verification matvec.
+    return k_est + 1
+
+
+def solver_bucket(op: str, *, restart: int, steps: int) -> int:
+    """The op's static shape parameter, encoded in ExecKey.bucket: GMRES's
+    restart, Lanczos's step count, 1 for the shape-free loops (the same
+    degenerate bucket the matvec path uses)."""
+    if op == "gmres":
+        return restart
+    if op == "lanczos":
+        return steps
+    return 1
+
+
+def build_solver(
+    op: str,
+    strategy: MatvecStrategy,
+    mesh: Mesh,
+    *,
+    dtype,
+    kernel: str | Callable = "xla",
+    combine: str | None = None,
+    stages: int | None = None,
+    dtype_storage=None,
+    restart: int = DEFAULT_RESTART,
+    steps: int = DEFAULT_STEPS,
+) -> Callable[..., SolverResult]:
+    """Return the op's un-jitted program ``fn(a, b, rtol, maxiter, p0, p1)``
+    — the engine wraps it in its AOT ``lower_artifact`` recipe with the
+    matvec path's donation spec (b, arg 1, is donated: each solve's RHS is
+    a fresh padded array whose buffer is garbage after dispatch).
+
+    ``dtype`` is the engine's operand dtype (the matvec input dtype);
+    never inferred from ``a``, which under quantized ``dtype_storage`` is
+    a packed pytree with no ``.dtype``. Shape validation happened when the
+    engine bound the strategy; the square-matrix requirement is the
+    engine's to check at submit (``m == k``)."""
+    if op not in SOLVER_OPS:
+        raise ValueError(f"unknown solver op {op!r}; expected {SOLVER_OPS}")
+    if op == "gmres" and restart < 1:
+        raise ValueError(f"restart must be >= 1, got {restart}")
+    if op == "lanczos" and steps < 2:
+        raise ValueError(f"lanczos needs steps >= 2, got {steps}")
+    matvec = strategy.build(
+        mesh, kernel=kernel, gather_output=True, combine=combine,
+        stages=stages, dtype_storage=dtype_storage,
+    )
+    replicated = NamedSharding(mesh, P())
+    acc = jnp.promote_types(dtype, jnp.float32)
+
+    def _prologue(a, b, rtol):
+        b_acc = jax.lax.with_sharding_constraint(b.astype(acc), replicated)
+
+        def mv(v: Array) -> Array:
+            y = matvec(a, v.astype(dtype)).astype(acc)
+            return jax.lax.with_sharding_constraint(y, replicated)
+
+        return b_acc, rtol.astype(acc), mv
+
+    def _linear_result(mv, b_acc, threshold, x, k, x_alt=None):
+        # TRUE residual of the returned iterate (one extra matvec, same
+        # collective set as the loop body): a recurrence minimum is biased
+        # low and could claim convergence the returned x does not have.
+        # With ``x_alt`` (CG's best-so-far, tracked by the recurrence),
+        # both candidates are measured and the verified-better one wins.
+        rnorm = residual_norm(b_acc - mv(x))
+        if x_alt is not None:
+            rnorm_alt = residual_norm(b_acc - mv(x_alt))
+            better = rnorm_alt < rnorm
+            x = jnp.where(better, x_alt, x)
+            rnorm = jnp.where(better, rnorm_alt, rnorm)
+        return SolverResult(
+            x=x,
+            value=jnp.asarray(jnp.nan, acc),
+            n_iters=k,
+            residual_norm=rnorm,
+            converged=rnorm <= threshold,
+        )
+
+    if op == "cg":
+
+        def solver(a, b, rtol, maxiter, p0, p1):
+            b_acc, rtol_acc, mv = _prologue(a, b, rtol)
+            threshold = convergence_threshold(rtol_acc, residual_norm(b_acc))
+            x0 = jnp.zeros_like(b_acc)
+            r0 = b_acc  # x0 = 0, so r = b - A@0; no pre-loop collective
+            state0 = (
+                x0, r0, r0, jnp.sum(r0 * r0), jnp.sum(r0 * r0),
+                jnp.asarray(0, jnp.int32), x0, jnp.sum(r0 * r0),
+            )
+
+            def cond(state):
+                _, _, _, _, rr, k, _, _ = state
+                return keep_iterating(jnp.sqrt(rr), threshold, k, maxiter)
+
+            def body(state):
+                x, r, p, rz, _, k, x_best, rr_best = state
+                ap = mv(p)
+                # pᵀAp > 0 for SPD A; stall (not inf/NaN) on breakdown so
+                # the loop exits on maxiter with converged=False.
+                pap = jnp.sum(p * ap)
+                safe = pap > 0
+                alpha = jnp.where(safe, rz / jnp.where(safe, pap, 1.0), 0.0)
+                x = x + alpha * p
+                r_rec = r - alpha * ap
+                rr_rec = jnp.sum(r_rec * r_rec)
+                # True-residual refresh: periodically (finite-precision
+                # drift hygiene, models/cg.py) AND whenever the recurrence
+                # is about to declare convergence — the loop may only exit
+                # converged on a VERIFIED residual, never the recurrence's
+                # drifted estimate. lax.cond: where would run the extra
+                # matvec every iteration.
+                refresh = ((k + 1) % _RECOMPUTE_EVERY == 0) | (
+                    jnp.sqrt(rr_rec) <= threshold
+                )
+                r = jax.lax.cond(
+                    refresh,
+                    lambda: b_acc - mv(x),
+                    lambda: r_rec,
+                )
+                rz_new = jnp.sum(r * r)
+                beta = jnp.where(
+                    safe, rz_new / jnp.where(rz != 0, rz, 1.0), 0.0
+                )
+                p = r + beta * p
+                better = rz_new < rr_best
+                x_best = jnp.where(better, x, x_best)
+                rr_best = jnp.where(better, rz_new, rr_best)
+                return (x, r, p, rz_new, rz_new, k + 1, x_best, rr_best)
+
+            x, _, _, _, _, k, x_best, _ = jax.lax.while_loop(
+                cond, body, state0
+            )
+            return _linear_result(mv, b_acc, threshold, x, k, x_alt=x_best)
+
+        return solver
+
+    if op == "gmres":
+        m = restart
+
+        def solver(a, b, rtol, maxiter, p0, p1):
+            b_acc, rtol_acc, mv = _prologue(a, b, rtol)
+            n = b.shape[0]
+            b_norm = residual_norm(b_acc)
+            threshold = convergence_threshold(rtol_acc, b_norm)
+
+            def cycle(x, r, rnorm):
+                # One GMRES(m) cycle: CGS2 Arnoldi over a fixed-shape
+                # basis, tiny on-device Hessenberg lstsq (models/gmres.py).
+                safe = rnorm > 0
+                v0 = jnp.where(safe, r / jnp.where(safe, rnorm, 1.0), 0.0)
+                V0 = jnp.zeros((m + 1, n), acc).at[0].set(v0)
+                H0 = jnp.zeros((m + 1, m), acc)
+
+                def arnoldi_step(j, carry):
+                    V, H = carry
+                    w = mv(V[j])
+                    h1 = V @ w
+                    w = w - h1 @ V
+                    h2 = V @ w
+                    w = w - h2 @ V
+                    h = h1 + h2
+                    wnorm = residual_norm(w)
+                    ok = wnorm > 0  # 0 = lucky breakdown
+                    vj1 = jnp.where(ok, w / jnp.where(ok, wnorm, 1.0), 0.0)
+                    V = V.at[j + 1].set(vj1)
+                    H = H.at[:, j].set(h.at[j + 1].set(wnorm))
+                    return (V, H)
+
+                V, H = jax.lax.fori_loop(0, m, arnoldi_step, (V0, H0))
+                e1 = jnp.zeros((m + 1,), acc).at[0].set(rnorm)
+                y, *_ = jnp.linalg.lstsq(H, e1)
+                x_new = x + y @ V[:m]
+                r_new = b_acc - mv(x_new)
+                return x_new, r_new, residual_norm(r_new)
+
+            x0 = jnp.zeros_like(b_acc)
+            state0 = (x0, b_acc, b_norm, jnp.asarray(0, jnp.int32),
+                      x0, b_norm)
+
+            def cond(state):
+                _, _, rnorm, k, _, _ = state
+                # maxiter caps restart CYCLES; worst-case matvec count is
+                # maxiter * (restart + 2).
+                return keep_iterating(rnorm, threshold, k, maxiter)
+
+            def body(state):
+                x, r, rnorm, k, x_best, rn_best = state
+                x, r, rnorm = cycle(x, r, rnorm)
+                better = rnorm < rn_best
+                x_best = jnp.where(better, x, x_best)
+                rn_best = jnp.where(better, rnorm, rn_best)
+                return (x, r, rnorm, k + 1, x_best, rn_best)
+
+            _, _, _, k, x_best, _ = jax.lax.while_loop(cond, body, state0)
+            return _linear_result(mv, b_acc, threshold, x_best, k)
+
+        return solver
+
+    if op == "power":
+
+        def solver(a, b, rtol, maxiter, p0, p1):
+            b_acc, rtol_acc, mv = _prologue(a, b, rtol)
+            # rhs is the START vector (callers pass a seeded random one; a
+            # deterministic start could be orthogonal to the dominant
+            # eigenvector — models/spectral.py).
+            v0 = b_acc / jnp.maximum(residual_norm(b_acc), _TINY)
+            state0 = (v0, jnp.asarray(0.0, acc), jnp.asarray(jnp.inf, acc),
+                      jnp.asarray(0, jnp.int32))
+
+            def cond(state):
+                _, lam, resid, k = state
+                # Relative eigenresidual: ||A v − λ v|| <= rtol·|λ|.
+                thresh = convergence_threshold(
+                    rtol_acc, jnp.maximum(jnp.abs(lam), _TINY)
+                )
+                return keep_iterating(resid, thresh, k, maxiter)
+
+            def body(state):
+                v, _, _, k = state
+                av = mv(v)
+                lam = jnp.sum(v * av)  # Rayleigh quotient (unit v)
+                resid = residual_norm(av - lam * v)
+                v = av / jnp.maximum(residual_norm(av), _TINY)
+                return (v, lam, resid, k + 1)
+
+            v, _, _, k = jax.lax.while_loop(cond, body, state0)
+            # Final Rayleigh pair from the returned vector (same matvec).
+            av = mv(v)
+            lam = jnp.sum(v * av)
+            resid = residual_norm(av - lam * v)
+            thresh = convergence_threshold(
+                rtol_acc, jnp.maximum(jnp.abs(lam), _TINY)
+            )
+            return SolverResult(
+                x=v, value=lam, n_iters=k, residual_norm=resid,
+                converged=resid <= thresh,
+            )
+
+        return solver
+
+    if op == "lanczos":
+        s_steps = steps
+
+        def solver(a, b, rtol, maxiter, p0, p1):
+            b_acc, rtol_acc, mv = _prologue(a, b, rtol)
+            n = b.shape[0]
+            v1 = b_acc / jnp.maximum(residual_norm(b_acc), _TINY)
+            V0 = jnp.zeros((s_steps, n), acc).at[0].set(v1)
+
+            # Fixed-depth tridiagonalization under scan: the step count is
+            # the ExecKey bucket (static shape), so `maxiter` is ignored —
+            # docs/SOLVERS.md's catalogue says so out loud.
+            def step(carry, j):
+                V, v_prev, v, beta_prev = carry
+                w = mv(v) - beta_prev * v_prev
+                alpha = jnp.sum(v * w)
+                w = w - alpha * v
+                # One full reorthogonalization pass against the built
+                # basis (rows > j are zero, masking implicit) — the CGS2
+                # trick from gmres, one (steps×n) MXU matvec per step.
+                w = w - (V @ w) @ V
+                beta = residual_norm(w)
+                v_next = w / jnp.maximum(beta, _TINY)
+                V = jax.lax.cond(
+                    j + 1 < s_steps,
+                    lambda V: V.at[j + 1].set(v_next),
+                    lambda V: V,
+                    V,
+                )
+                return (V, v, v_next, beta), (alpha, beta)
+
+            (V, _, _, _), (alphas, betas) = jax.lax.scan(
+                step, (V0, jnp.zeros_like(v1), v1, jnp.asarray(0.0, acc)),
+                jnp.arange(s_steps),
+            )
+            # T = tridiag(alphas, betas[:-1]); tiny dense symmetric eig on
+            # device, replicated — no collective.
+            T = (
+                jnp.diag(alphas)
+                + jnp.diag(betas[:-1], 1)
+                + jnp.diag(betas[:-1], -1)
+            )
+            evals, evecs = jnp.linalg.eigh(T)
+            theta = evals[-1]  # extremal (largest) Ritz value
+            s_vec = evecs[:, -1]
+            y = s_vec @ V  # Ritz vector
+            y = y / jnp.maximum(residual_norm(y), _TINY)
+            # TRUE eigenresidual of the Ritz pair (one extra matvec), not
+            # the |β_m s_m| bound — same honesty rule as the linear ops.
+            resid = residual_norm(mv(y) - theta * y)
+            thresh = convergence_threshold(
+                rtol_acc, jnp.maximum(jnp.abs(theta), _TINY)
+            )
+            return SolverResult(
+                x=y, value=theta,
+                n_iters=jnp.asarray(s_steps, jnp.int32),
+                residual_norm=resid, converged=resid <= thresh,
+            )
+
+        return solver
+
+    # chebyshev
+    def solver(a, b, rtol, maxiter, p0, p1):
+        b_acc, rtol_acc, mv = _prologue(a, b, rtol)
+        # Spectral interval [λ_min, λ_max] from the dynamic operands; the
+        # engine validated 0 < p0 <= p1 at submit (they are Python floats
+        # there — here they are traced, so no check is possible).
+        lmin = p0.astype(acc)
+        lmax = p1.astype(acc)
+        d = (lmax + lmin) / 2
+        c = (lmax - lmin) / 2
+        threshold = convergence_threshold(rtol_acc, residual_norm(b_acc))
+        x0 = jnp.zeros_like(b_acc)
+        r0 = b_acc
+        state0 = (x0, r0, jnp.zeros_like(b_acc), jnp.asarray(0.0, acc),
+                  jnp.sum(r0 * r0), jnp.asarray(0, jnp.int32))
+
+        def cond(state):
+            _, _, _, _, rr, k = state
+            return keep_iterating(jnp.sqrt(rr), threshold, k, maxiter)
+
+        def body(state):
+            x, r, p, alpha, _, k = state
+            # Classic Chebyshev semi-iteration (Saad Alg. 12.1), with the
+            # β/α division folded away: β = factor·α where factor is
+            # ½c²α (k=1) or ¼c²α (k≥2), so α' = 1/(d − factor).
+            factor = (
+                jnp.where(k == 0, 0.0, jnp.where(k == 1, 0.5, 0.25))
+                * c * c * alpha
+            )
+            alpha_new = 1.0 / (d - factor)
+            beta = factor * alpha
+            p = r + beta * p
+            ap = mv(p)
+            x = x + alpha_new * p
+            r_rec = r - alpha_new * ap
+            rr_rec = jnp.sum(r_rec * r_rec)
+            # Same verified-exit rule as CG: when the recurrence residual
+            # is about to stop the loop, replace it with the true residual
+            # so a converged exit is a verified one. (The true r feeds the
+            # next p as well — the semi-iteration tolerates it.)
+            r = jax.lax.cond(
+                jnp.sqrt(rr_rec) <= threshold,
+                lambda: b_acc - mv(x),
+                lambda: r_rec,
+            )
+            return (x, r, p, alpha_new, jnp.sum(r * r), k + 1)
+
+        x, _, _, _, _, k = jax.lax.while_loop(cond, body, state0)
+        return _linear_result(mv, b_acc, threshold, x, k)
+
+    return solver

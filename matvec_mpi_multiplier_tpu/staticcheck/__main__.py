@@ -193,7 +193,10 @@ def main(argv=None) -> int:
                 path = write_golden()
                 print(f"staticcheck: golden schedule table written to {path}",
                       file=sys.stderr)
-            findings.extend(run_hlo_audit(schedule=not run_memory_only))
+            findings.extend(run_hlo_audit(
+                schedule=not run_memory_only,
+                solvers=not run_memory_only,
+            ))
         except RuntimeError as e:
             print(f"staticcheck: {e}", file=sys.stderr)
             return EXIT_USAGE

@@ -67,6 +67,18 @@ no compile — ~1 s for the whole table), and audited four ways:
   a dequantized full-width temporary (which the census gate sees
   structurally and this gate sees quantitatively).
 
+* **Served-solver schedule pins** (``hlo-solver-schedule`` /
+  ``hlo-solver-loop``) — every ``solvers/ops.py`` program (the engine's
+  ``submit(op="cg"|...)`` artifacts) is lowered per strategy × op and
+  audited as a whole program: its collective-kind set must EQUAL the
+  matvec counterpart's (the loop body's matvec is the only collective
+  site; the verified-exit and final true-residual matvecs reuse the same
+  combine, so counts — pinned in the golden's ``solvers`` section — may
+  exceed the matvec's), and the module must contain ≥ 1
+  ``stablehlo.while`` (scan/fori included), so a host-synced residual
+  check — which would tear the iteration out of the compiled program and
+  re-dispatch k matvecs per solve — is a compile-time error.
+
 The quantized configs' collective census equals their native
 counterpart's by construction — the combine operates on the fp32
 accumulator partials, never on the payload — so the storage axis is
@@ -105,10 +117,19 @@ AUDIT_M = 64
 AUDIT_K = 2048
 AUDIT_DTYPE = "float32"
 GOLDEN_REL = "data/staticcheck/golden_schedule.json"
+# Schema 4 over 3: the table gains a top-level "solvers" section pinning
+# each served solver loop's whole-program collective census and
+# stablehlo.while count per strategy × op (the solver audit below).
 # Schema 3 over 2: every entry additionally pins the compiled-artifact
 # memory audit — RHS donation state ("aliased"/"donated") and the static
 # peak-liveness estimate (peak_bytes / peak_bytes_ratio).
-GOLDEN_SCHEMA = 3
+GOLDEN_SCHEMA = 4
+
+# The solver audit's square operand (the solver ops need m == k). Shares
+# the audit mesh's divisibility needs (8 devices, the 2x4 grid); small on
+# purpose — the census counts are size-independent, and 15 solver
+# lowerings ride every full audit.
+SOLVER_AUDIT_N = 256
 
 # Audit-side override of the engine's dispatch-path donation spec:
 # None means "the engine's own DONATE_ARGNUMS" (engine/executables.py —
@@ -232,6 +253,46 @@ AUDIT_CONFIGS: tuple[AuditConfig, ...] = (
     AuditConfig("colwise", "psum_scatter", storage="int8"),
     AuditConfig("colwise", "psum_scatter", storage="int8c"),
     AuditConfig("blockwise", "gather", storage="int8"),
+)
+
+
+class SolverAuditConfig(NamedTuple):
+    """One audited served-solver lowering: a solver op compiled around one
+    strategy × combine matvec (``solvers/ops.py::build_solver`` — the
+    program the engine's ``submit(op=...)`` path dispatches)."""
+
+    op: str
+    strategy: str
+    combine: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.op}|{self.strategy}|{self.combine}"
+
+    @property
+    def matvec(self) -> AuditConfig:
+        """The matvec counterpart whose collective-kind SET the solver's
+        whole-program census must equal (the loop body's matvec is the
+        only collective site; everything else rides replicated)."""
+        return AuditConfig(self.strategy, self.combine)
+
+
+# Every served op (solvers/ops.py::SOLVER_OPS — the audit cross-checks
+# the two lists and reddens on drift, so a new op cannot ship unpinned)
+# across one combine per strategy family: the default gathers plus
+# colwise's psum, whose non-empty census makes the op-SET gate bite
+# (rowwise/blockwise gather lower their combine as GSPMD sharding
+# constraints — empty census — so for them the while-count pin is the
+# live tripwire).
+_SOLVER_AUDIT_OPS = ("cg", "gmres", "power", "lanczos", "chebyshev")
+SOLVER_AUDIT_CONFIGS: tuple[SolverAuditConfig, ...] = tuple(
+    SolverAuditConfig(op, strategy, combine)
+    for strategy, combine in (
+        ("rowwise", "gather"),
+        ("colwise", "psum"),
+        ("blockwise", "gather"),
+    )
+    for op in _SOLVER_AUDIT_OPS
 )
 
 
@@ -892,16 +953,129 @@ def audit_entry(cfg: AuditConfig, mesh, lowered=None) -> dict:
     }
 
 
-def build_schedule_table(configs: Iterable[AuditConfig] | None = None) -> dict:
+# ---------------------------------------------------------- solver audit
+#
+# The served-solver layer: each solvers/ops.py program is one compiled
+# lax.while_loop/scan around the strategy matvec, so its WHOLE-PROGRAM
+# collective census must use exactly the matvec counterpart's collective
+# kinds (x0 = 0 means no pre-loop matvec; the verified-exit refreshes and
+# the final true-residual check re-issue the same combine, so COUNTS can
+# exceed the matvec's 1 — the golden pins them exactly, the structural
+# gate checks the SET). A host-synced residual check would tear the loop
+# out of the program (no stablehlo.while left — the while-count gate);
+# an un-staged all-gather smuggled into the loop changes the kind set
+# (the op-set gate); any count drift trips the golden pin.
+
+
+def while_op_count(lowered) -> int:
+    """Count of ``stablehlo.while`` ops in the lowered module — ≥ 1 is
+    the solver audit's the-loop-stayed-on-device gate (``lax.scan`` and
+    ``fori_loop`` lower to while too, so every solver op qualifies)."""
+    count = 0
+
+    def walk(op):
+        nonlocal count
+        for region in op.regions:
+            for block in region.blocks:
+                for child in block.operations:
+                    if child.operation.name == "stablehlo.while":
+                        count += 1
+                    walk(child.operation)
+
+    walk(lowered.compiler_ir(dialect="stablehlo").operation)
+    return count
+
+
+def lower_solver_config(scfg: SolverAuditConfig, mesh):
+    """Build and lower one served solver against the square audit operand
+    (trace-only), with the engine's uniform signature
+    ``fn(a, b, rtol, maxiter, p0, p1)`` — dynamic knobs as scalar
+    operands, exactly what ``MatvecEngine._solver_builder_for``
+    compiles."""
+    import jax
+    import numpy as np
+
+    from ..models import get_strategy
+    from ..solvers import build_solver
+
+    dtype = np.dtype(AUDIT_DTYPE)
+    fn = build_solver(
+        scfg.op, get_strategy(scfg.strategy), mesh,
+        dtype=dtype, combine=scfg.combine,
+    )
+    n = SOLVER_AUDIT_N
+    a = jax.ShapeDtypeStruct((n, n), dtype)
+    b = jax.ShapeDtypeStruct((n,), dtype)
+    f32 = jax.ShapeDtypeStruct((), np.float32)
+    i32 = jax.ShapeDtypeStruct((), np.int32)
+    return jax.jit(fn).lower(a, b, f32, i32, f32, f32)
+
+
+def solver_audit_entry(scfg: SolverAuditConfig, mesh, lowered=None) -> dict:
+    """One solver config's observed schedule: the whole-program collective
+    census + payload bytes (at the SOLVER operand — not comparable to the
+    matvec entries' bytes) and the ``stablehlo.while`` count."""
+    if lowered is None:
+        lowered = lower_solver_config(scfg, mesh)
+    census, payload = collective_census(lowered)
+    return {
+        "census": dict(sorted(census.items())),
+        "payload_bytes": dict(sorted(payload.items())),
+        "while_ops": while_op_count(lowered),
+    }
+
+
+def solver_findings(
+    scfg: SolverAuditConfig, entry: dict, mesh
+) -> list[Finding]:
+    """The structural (golden-independent) gates for one solver entry:
+    collective-kind SET equality with the matvec counterpart, and at
+    least one on-device loop."""
+    findings: list[Finding] = []
+    exp_census, _ = expected_schedule(scfg.matvec, mesh)
+    if set(entry["census"]) != set(exp_census):
+        findings.append(Finding(
+            f"<hlo:{scfg.key}>", 0, "hlo-solver-schedule",
+            f"solver program's collective kinds "
+            f"{sorted(entry['census'])} != the "
+            f"{scfg.matvec.key.rsplit('|', 1)[0]} matvec counterpart's "
+            f"{sorted(exp_census)} — the loop body issues collectives "
+            "the audited matvec schedule does not (an un-staged gather "
+            "or a stray reduction inside the iteration)",
+        ))
+    if entry["while_ops"] < 1:
+        findings.append(Finding(
+            f"<hlo:{scfg.key}>", 0, "hlo-solver-loop",
+            "solver program lowered with no stablehlo.while: the "
+            "iteration left the device (a host-driven loop re-dispatching "
+            "matvecs — k host round-trips per solve, and the "
+            "compiles_steady == 0 / deadline story no longer covers the "
+            "solve; solvers/ops.py compiles the loop)",
+        ))
+    return findings
+
+
+def build_schedule_table(
+    configs: Iterable[AuditConfig] | None = None,
+    solver_configs: Iterable[SolverAuditConfig] | None = None,
+) -> dict:
     """The full golden-table payload for the current tree: the schedule
     census (plain-struct lowering) merged with the compiled-artifact
-    memory audit (engine-recipe lowering) per config."""
+    memory audit (engine-recipe lowering) per config, plus the served
+    solver loops' census/while pins per strategy × op."""
     import jax
 
     mesh = _audit_mesh()
     entries = {
         cfg.key: {**audit_entry(cfg, mesh), **memory_entry(cfg, mesh)}
         for cfg in _supported_configs(configs or AUDIT_CONFIGS)
+    }
+    solver_entries = {
+        scfg.key: solver_audit_entry(scfg, mesh)
+        for scfg in (
+            SOLVER_AUDIT_CONFIGS if solver_configs is None
+            else tuple(solver_configs)
+        )
     }
     return {
         "schema": GOLDEN_SCHEMA,
@@ -910,8 +1084,10 @@ def build_schedule_table(configs: Iterable[AuditConfig] | None = None) -> dict:
             "grid": list(mesh.devices.shape),
         },
         "operand": {"m": AUDIT_M, "k": AUDIT_K, "dtype": AUDIT_DTYPE},
+        "solver_operand": {"n": SOLVER_AUDIT_N, "dtype": AUDIT_DTYPE},
         "jax_version_at_capture": jax.__version__,
         "configs": entries,
+        "solvers": solver_entries,
     }
 
 
@@ -932,19 +1108,28 @@ def run_hlo_audit(
     check_fingerprints: bool = True,
     schedule: bool = True,
     memory: bool = True,
+    solvers: bool | None = None,
+    solver_configs: Iterable[SolverAuditConfig] | None = None,
 ) -> list[Finding]:
     """The full lowered-artifact audit: the collective-schedule layer
     (census + bytes vs formula and golden, the overlap chunking gate,
-    fingerprint stability — ``schedule=True``) and the compiled-artifact
+    fingerprint stability — ``schedule=True``), the compiled-artifact
     memory layer (donation → aliasing, peak liveness vs the quantized
     ceilings — ``memory=True``; the CLI's ``--memory-audit`` runs it
-    alone). Both compare against the golden table over whichever fields
-    they computed. Returns findings; empty means every config lowers as
-    pinned."""
+    alone), and the served-solver layer (whole-program collective-kind
+    set vs the matvec counterpart, the on-device while pin, golden count
+    pins — ``solvers=True``). All compare against the golden table over
+    whichever fields they computed. Returns findings; empty means every
+    config lowers as pinned."""
     root = Path(root) if root is not None else repo_root()
     golden_path = (
         Path(golden_path) if golden_path is not None else root / GOLDEN_REL
     )
+    if solvers is None:
+        # A narrowed matvec-config run (tests auditing one cell) should
+        # not pay for 15 solver lowerings; full audits always include
+        # them, as does an explicit solver_configs narrowing.
+        solvers = configs is None or solver_configs is not None
     configs = _supported_configs(configs or AUDIT_CONFIGS)
     findings: list[Finding] = []
 
@@ -1073,6 +1258,53 @@ def run_hlo_audit(
                         f"{pinned_view}{overlap_hint}; if the change is "
                         "deliberate, bless it with --write-golden",
                     ))
+
+    if solvers:
+        golden_solvers = golden.get("solvers", {}) if have_golden else {}
+        if solver_configs is None:
+            # Coverage cross-check (default set only — a subset run is a
+            # deliberate narrowing): every served op must be audited, so
+            # a new SOLVER_OPS entry cannot ship unpinned.
+            from ..solvers import SOLVER_OPS
+
+            missing_ops = sorted(set(SOLVER_OPS) - set(_SOLVER_AUDIT_OPS))
+            if missing_ops:
+                findings.append(Finding(
+                    "<hlo:solvers>", 0, "hlo-solver-coverage",
+                    f"served solver ops {missing_ops} have no audit "
+                    "configs; extend SOLVER_AUDIT_CONFIGS and re-bless "
+                    "the golden table",
+                ))
+        for scfg in (
+            SOLVER_AUDIT_CONFIGS if solver_configs is None
+            else tuple(solver_configs)
+        ):
+            entry = solver_audit_entry(scfg, mesh)
+            findings.extend(solver_findings(scfg, entry, mesh))
+            if have_golden:
+                pinned = golden_solvers.get(scfg.key)
+                if pinned is None:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-golden",
+                        f"solver config {scfg.key} missing from the "
+                        "golden table; bless it with --write-golden",
+                    ))
+                elif pinned != entry:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-census",
+                        f"{scfg.key}: lowered solver program {entry} != "
+                        f"golden {pinned}; a collective-count or loop "
+                        "change inside a served solver — if deliberate, "
+                        "bless it with --write-golden",
+                    ))
+        if have_golden and solver_configs is None:
+            audited_solvers = {scfg.key for scfg in SOLVER_AUDIT_CONFIGS}
+            for stale in sorted(set(golden_solvers) - audited_solvers):
+                findings.append(Finding(
+                    GOLDEN_REL, 0, "hlo-golden",
+                    f"golden table pins unknown solver config {stale}; "
+                    "regenerate with --write-golden",
+                ))
 
     if have_golden:
         audited = {cfg.key for cfg in AUDIT_CONFIGS}

@@ -325,6 +325,65 @@ class CostModel:
             wire_bytes=wire_bytes,
         )
 
+    def predict_solver(
+        self,
+        op: str,
+        strategy: str | None,
+        combine: str | None,
+        *,
+        m: int,
+        k: int,
+        p: int,
+        dtype: str,
+        k_est: int,
+        stages: int | None = None,
+        storage: str = "native",
+        r: int | None = None,
+        restart: int | None = None,
+        steps: int | None = None,
+    ) -> Prediction:
+        """One served solve (``engine.submit(op="cg"|...)``): ``k_est``
+        iterations × the one-matvec prediction, with each op's iteration
+        structure — GMRES's (restart + 2) matvecs per cycle, Lanczos's
+        fixed depth, the verification matvecs — supplied by the solver
+        subsystem's own symbolic count
+        (``solvers.ops.solver_matvec_count``), so the model and the
+        compiled programs share one iteration-structure truth. ``k_est``
+        is the caller's iteration estimate — admission passes the
+        request's ``maxiter`` (a worst-case bound, hence a conservative
+        ETA; docs/SCHEDULING.md). The per-iteration replicated vector
+        work is uncounted (see the count's docstring), so predictions
+        are matvec-dominated estimates — exactly as good as the matvec
+        model underneath."""
+        from ..solvers import (
+            DEFAULT_RESTART, DEFAULT_STEPS, SOLVER_OPS, solver_matvec_count,
+        )
+
+        if op not in SOLVER_OPS:
+            raise ValueError(
+                f"unknown solver op {op!r}; expected one of {SOLVER_OPS}"
+            )
+        if k_est < 1:
+            raise ValueError(f"k_est must be >= 1, got {k_est}")
+        per = self.predict(
+            strategy, combine, m=m, k=k, p=p, dtype=dtype, stages=stages,
+            b=1, storage=storage, r=r,
+        )
+        n_mv = solver_matvec_count(
+            op, int(k_est),
+            restart=restart if restart is not None else DEFAULT_RESTART,
+            steps=steps if steps is not None else DEFAULT_STEPS,
+        )
+        return Prediction(
+            total_s=n_mv * per.total_s,
+            compute_s=n_mv * per.compute_s,
+            wire_s=n_mv * per.wire_s,
+            latency_s=n_mv * per.latency_s,
+            flops=n_mv * per.flops,
+            a_bytes=per.a_bytes,
+            wire_bytes=n_mv * per.wire_bytes,
+        )
+
     def restore_s(self, nbytes: int) -> float:
         """Predicted cost of re-placing an evicted resident payload:
         ``nbytes`` over the calibrated resident-stream bandwidth. Both
@@ -350,6 +409,10 @@ class CostModel:
         r: int | None = None,
         queue_s: float = 0.0,
         swap_bytes: int = 0,
+        op: str = "matvec",
+        k_est: int | None = None,
+        restart: int | None = None,
+        steps: int | None = None,
     ) -> AdmissionEstimate:
         """The queue-aware serving face of :meth:`predict`: the ETA of a
         request submitted NOW — its own dispatch prediction, behind
@@ -357,11 +420,28 @@ class CostModel:
         restore transfer when its tenant's ``A`` is evicted. The global
         scheduler's admission gate (engine/global_scheduler.py) compares
         ``.eta_s`` against the request's deadline at submit time —
-        reject-fast instead of deadline-expire (docs/SCHEDULING.md)."""
-        pred = self.predict(
-            strategy, combine, m=m, k=k, p=p, dtype=dtype, stages=stages,
-            b=b, storage=storage, r=r,
-        )
+        reject-fast instead of deadline-expire (docs/SCHEDULING.md).
+
+        A solver ``op`` routes through :meth:`predict_solver` with
+        ``k_est`` iterations (the scheduler passes the request's
+        ``maxiter`` — worst-case, so a rejection is honest about the cap
+        the caller asked for)."""
+        if op != "matvec":
+            if k_est is None:
+                raise ValueError(
+                    f"predict_admission(op={op!r}) needs k_est (the "
+                    "iteration estimate — admission passes maxiter)"
+                )
+            pred = self.predict_solver(
+                op, strategy, combine, m=m, k=k, p=p, dtype=dtype,
+                k_est=k_est, stages=stages, storage=storage, r=r,
+                restart=restart, steps=steps,
+            )
+        else:
+            pred = self.predict(
+                strategy, combine, m=m, k=k, p=p, dtype=dtype,
+                stages=stages, b=b, storage=storage, r=r,
+            )
         return AdmissionEstimate(
             dispatch_s=pred.total_s,
             queue_s=float(queue_s),

@@ -78,6 +78,22 @@ class TenantQuotaError(MatvecError):
     not evict or degrade its neighbors'."""
 
 
+class SolverDivergedError(MatvecError):
+    """A served iterative solve hit its iteration cap without meeting its
+    tolerance.
+
+    Raised by ``SolverFuture.result()`` (``engine/core.py``) when the
+    compiled solver loop (``solvers/``; docs/SOLVERS.md) exhausted
+    ``maxiter`` with the on-device convergence predicate still false. The
+    loop ran entirely on device — the residual norm and iteration count
+    in the message are the loop's own carried state, not a host-side
+    recomputation — so the partial iterate is NOT returned: an
+    unconverged ``x`` is a silently wrong answer, and the contract is
+    converged-or-typed-failure. Retry with a larger ``maxiter``, a looser
+    ``rtol``, a restarted/preconditioned variant, or (for chebyshev) a
+    corrected spectral interval."""
+
+
 class ResidencyError(MatvecError):
     """A dispatch needed the resident ``A`` operand while it was evicted
     and the engine holds no host copy to restore it from.

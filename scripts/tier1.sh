@@ -212,6 +212,49 @@ print(f"global-scheduler smoke ok: {served} served, {rejected} "
       f"rejected fast with predictions, 0 deadline-expires")
 PY
 
+# Served-solver smoke: engine.submit(op="cg") on a small seeded SPD
+# operand (solvers/; docs/SOLVERS.md) — convergence against the host
+# residual, a rtol/maxiter sweep sharing ONE compiled loop
+# (compiles_steady == 0, the knobs are dynamic operands), and the typed
+# SolverDivergedError contract on a starved cap. Seconds, not minutes: a
+# regression here means serving answers cannot even start, which should
+# fail fast before the suite runs the full gate in tests/test_solvers.py.
+echo "solver smoke: served CG converges compile-flat, diverges typed"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import numpy as np
+from matvec_mpi_multiplier_tpu import MatvecEngine, make_mesh
+from matvec_mpi_multiplier_tpu.bench.serve import solver_operand
+from matvec_mpi_multiplier_tpu.utils.errors import SolverDivergedError
+
+mesh = make_mesh(8)
+a = solver_operand(128, "float32", seed=0)
+engine = MatvecEngine(a, mesh, strategy="rowwise", promote=None)
+rng = np.random.default_rng(1)
+b0 = rng.standard_normal(128).astype(np.float32)
+res = engine.submit(op="cg", rhs=b0, rtol=1e-5).result()
+assert res.converged and res.n_iters >= 1
+relres = np.linalg.norm(b0 - a @ res.x) / np.linalg.norm(b0)
+assert relres <= 1e-4, f"host residual {relres:.2e}"
+compiles = engine.stats.compiles
+for i in range(6):  # sweep the dynamic knobs: same executable
+    b = rng.standard_normal(128).astype(np.float32)
+    r = engine.submit(op="cg", rhs=b, rtol=(1e-3, 1e-5)[i % 2],
+                      maxiter=(200, 1000)[i % 2]).result()
+    assert r.converged
+assert engine.stats.compiles == compiles, "solver knob sweep recompiled"
+try:
+    engine.submit(op="cg", rhs=b0, rtol=1e-7, maxiter=2).result()
+except SolverDivergedError:
+    pass
+else:
+    raise AssertionError("starved cap did not raise SolverDivergedError")
+divergences = engine.metrics.counter("solver_divergences_total").value
+assert divergences == 1, divergences
+print(f"solver smoke ok: cg relres {relres:.2e} in {res.n_iters} iters, "
+      f"{compiles} compile(s) across the sweep, 1 typed divergence")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

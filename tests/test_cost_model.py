@@ -542,3 +542,59 @@ def test_cli_without_calibration_fails_loudly(tmp_path, capsys):
     rc = main(["--cache", str(tmp_path / "empty.json")])
     assert rc == 1
     assert "no calibration" in capsys.readouterr().err
+
+
+# ------------------------------------------------------ solver predictions
+
+
+def test_predict_solver_scales_by_matvec_count():
+    """One solve = solver_matvec_count(op, k_est) × one matvec — the
+    model and the compiled loops share one iteration-structure truth
+    (solvers/ops.py), so the scaling is exact, not approximate."""
+    from matvec_mpi_multiplier_tpu.solvers import solver_matvec_count
+
+    model = cm.CostModel(_cal())
+    shape = dict(m=256, k=256, p=8, dtype="float32")
+    per = model.predict("rowwise", "gather", **shape)
+    for op, kw in [
+        ("cg", {}), ("power", {}), ("chebyshev", {}),
+        ("gmres", {"restart": 7}), ("lanczos", {"steps": 16}),
+    ]:
+        pred = model.predict_solver(
+            op, "rowwise", "gather", k_est=25, **shape, **kw,
+        )
+        n_mv = solver_matvec_count(op, 25, restart=kw.get("restart", 10),
+                                   steps=kw.get("steps", 32))
+        assert pred.total_s == pytest.approx(n_mv * per.total_s)
+        assert pred.flops == pytest.approx(n_mv * per.flops)
+        assert pred.wire_bytes == n_mv * per.wire_bytes
+        # A stays resident across iterations: its bytes are counted once.
+        assert pred.a_bytes == per.a_bytes
+
+
+def test_predict_solver_rejects_bad_inputs():
+    model = cm.CostModel(_cal())
+    with pytest.raises(ValueError, match="unknown solver op"):
+        model.predict_solver("jacobi", "rowwise", "gather",
+                             m=64, k=64, p=8, dtype="float32", k_est=5)
+    with pytest.raises(ValueError, match="k_est"):
+        model.predict_solver("cg", "rowwise", "gather",
+                             m=64, k=64, p=8, dtype="float32", k_est=0)
+
+
+def test_predict_admission_routes_solver_ops():
+    """op="cg" admission = predict_solver at k_est, queue/swap terms
+    unchanged; a solver op without k_est is a loud ValueError (the
+    scheduler always passes maxiter)."""
+    model = cm.CostModel(_cal())
+    shape = dict(m=64, k=64, p=8, dtype="float32")
+    est = model.predict_admission(
+        "rowwise", "gather", **shape, queue_s=0.5, swap_bytes=0,
+        op="cg", k_est=100,
+    )
+    direct = model.predict_solver("cg", "rowwise", "gather", **shape,
+                                  k_est=100)
+    assert est.dispatch_s == pytest.approx(direct.total_s)
+    assert est.eta_s == pytest.approx(0.5 + direct.total_s)
+    with pytest.raises(ValueError, match="needs k_est"):
+        model.predict_admission("rowwise", "gather", **shape, op="cg")

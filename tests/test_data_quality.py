@@ -816,6 +816,47 @@ def test_golden_schedule_pins_quantized_peak_liveness():
         ), key
 
 
+def test_golden_schedule_pins_solver_loops():
+    """The served-solver pins (ISSUE 14, docs/SOLVERS.md): every
+    op×strategy×combine in the solver audit table is pinned, each entry's
+    collective-kind SET equals its matvec counterpart's (a solver is the
+    matvec's schedule iterated, never a new communication pattern), and
+    each lowers to at least one `stablehlo.while` — the compiled-loop
+    criterion whose absence means a host-driven loop (one host sync per
+    iteration). For rowwise|gather the census is empty (the gather is
+    GSPMD-invisible), so the while-count is that family's live tripwire."""
+    from matvec_mpi_multiplier_tpu.solvers import SOLVER_OPS
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        SOLVER_AUDIT_CONFIGS,
+    )
+
+    payload = _golden()
+    operand = payload["solver_operand"]
+    # Solvers iterate against a SQUARE resident A — a separate operand
+    # from the (rectangular) matvec one, pinned alongside it.
+    assert operand["n"] > 0
+    solvers = payload["solvers"]
+    assert set(solvers) == {cfg.key for cfg in SOLVER_AUDIT_CONFIGS}
+    audited_ops = {key.split("|")[0] for key in solvers}
+    assert audited_ops == set(SOLVER_OPS), (
+        "solver audit table must cover every served op"
+    )
+    configs = payload["configs"]
+    for key, entry in solvers.items():
+        op, strategy, combine = key.split("|")
+        census = entry["census"]
+        assert set(census) <= _CENSUS_KINDS, key
+        assert set(census) == set(entry["payload_bytes"]), key
+        matvec = configs[f"{strategy}|{combine}|xla"]
+        assert set(census) == set(matvec["census"]), (
+            f"{key}: solver census kinds {sorted(census)} != matvec "
+            f"counterpart's {sorted(matvec['census'])}"
+        )
+        assert entry["while_ops"] >= 1, (
+            f"{key}: no stablehlo.while — the loop runs on the host"
+        )
+
+
 # ---- quantized_demo: the committed storage-axis capture (ISSUE 8) ----
 #
 # Artifacts: tuning_cache.json (the v4 sixth-axis race: winners +
@@ -1208,3 +1249,106 @@ def test_gsched_demo_calibration_cache_travels_with_the_numbers():
     assert len(cals) == 1
     assert cals[0]["level"] == "quick"
     assert cals[0]["mem_bps"] > 0 and cals[0]["flops"] > 0
+
+
+# ---- solver_demo: the committed answer-serving capture (ISSUE 14,
+# docs/SOLVERS.md). Same doctrine as the other demo gates: the
+# convergence, zero-recompile and typed-failure properties the capture
+# exists to demonstrate are regression-tested on the committed bytes.
+
+SOLVER_DEMO = REPO / "data" / "solver_demo"
+
+
+def _solver_demo_rows() -> dict[str, dict]:
+    from matvec_mpi_multiplier_tpu.solvers import SOLVER_OPS
+
+    rows = _rows(SOLVER_DEMO / "out" / "serve_solver_rowwise.csv")
+    by_op = {row["op"]: row for row in rows}
+    assert set(by_op) == set(SOLVER_OPS), (
+        f"solver demo must hold one row per served op: {sorted(by_op)}"
+    )
+    assert len(rows) == len(by_op), "duplicate op rows"
+    return by_op
+
+
+def _solver_demo_artifact(name: str):
+    import json
+
+    path = SOLVER_DEMO / name
+    if not path.exists():
+        pytest.skip(f"{path} not committed")
+    if name.endswith(".jsonl"):
+        return [
+            json.loads(ln)
+            for ln in path.read_text().splitlines() if ln.strip()
+        ]
+    return json.loads(path.read_text())
+
+
+def test_solver_demo_every_op_converged_compile_free():
+    """The acceptance pins: every served op converged on the committed
+    capture (divergences == 0 — an unconverged solve is a typed error,
+    never a row), and every op's steady phase ran entirely on its single
+    warmup compile (rtol/maxiter are dynamic operands of ONE loop)."""
+    for op, row in _solver_demo_rows().items():
+        assert row["divergences"] == 0, op
+        assert row["n_solves"] >= 5, op
+        assert row["iterations"] >= 1, op
+        assert 0 < row["final_residual"] < 1e-3, op
+        assert row["time_per_iter_ms"] > 0, op
+        assert 0 < row["solve_p50_ms"] <= row["solve_p99_ms"], op
+        assert row["compiles_warmup"] >= 1, op
+        assert row["compiles_steady"] == 0, op
+
+
+def test_solver_demo_eigen_ops_agree():
+    """power and lanczos reach the same dominant eigenvalue through two
+    different Krylov processes — a cross-algorithm consistency check no
+    single op can fake (the operand's boosted diagonal isolates λ₁)."""
+    rows = _solver_demo_rows()
+    lam_power = rows["power"]["final_value"]
+    lam_lanczos = rows["lanczos"]["final_value"]
+    assert np.isfinite(lam_power) and lam_power > 0
+    assert lam_lanczos == pytest.approx(lam_power, rel=1e-3)
+
+
+def test_solver_demo_metrics_pin_the_solver_counters():
+    """The cg run's snapshot carries the solver metric vocabulary the
+    obs `solvers` panel reads, consistent with its CSV row: requests =
+    1 warmup + n_solves steady, zero divergences, iterations histogram
+    counting every materialized solve, and the residual gauge equal to
+    the row's final_residual (the true ||b - A x|| at last
+    materialize)."""
+    snap = _solver_demo_artifact("metrics.json")
+    cg = _solver_demo_rows()["cg"]
+    c = snap["counters"]
+    assert c["solver_requests_total"] == cg["n_solves"] + 1
+    assert c["solver_divergences_total"] == 0
+    assert c["engine_compiles_total"] == cg["compiles_warmup"]
+    hists = snap["histograms"]
+    assert hists["solver_iterations"]["count"] == c["solver_requests_total"]
+    assert hists["serve_solve_latency_ms"]["count"] == cg["n_solves"]
+    assert snap["gauges"]["solver_residual_norm"] == pytest.approx(
+        cg["final_residual"], rel=1e-5
+    )
+
+
+def test_solver_demo_trace_pins_zero_steady_recompiles():
+    """One span tree per cg solve: the first request carries the single
+    exec_lookup compile, every later lookup is a hit, and every dispatch
+    span is the solver's (op=cg) — the zero-recompile criterion span by
+    span, on the answer-serving path."""
+    records = _solver_demo_artifact("trace.jsonl")
+    snap = _solver_demo_artifact("metrics.json")
+    assert len(records) == snap["counters"]["solver_requests_total"]
+    outcomes = []
+    for rec in records:
+        assert rec["status"] == "ok"
+        assert rec["attrs"]["kind"] == "cg"
+        children = {
+            c["name"]: c for c in rec["spans"][0]["children"]
+        }
+        assert children["dispatch"]["attrs"]["op"] == "cg"
+        outcomes.append(children["exec_lookup"]["attrs"]["outcome"])
+    assert outcomes[0] == "compile"
+    assert all(o == "hit" for o in outcomes[1:]), outcomes

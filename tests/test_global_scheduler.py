@@ -522,3 +522,80 @@ def test_serve_cli_accepts_gsched_and_prune_flags():
     assert args.demand_weight == 1.5
     assert args.decision_jsonl == "d.jsonl"
     assert args.prune_margin == 0.5
+
+
+# ------------------------------------------------------ solver admission
+
+
+def _spd_registry(mesh, n_tenants=2, n=64, **kwargs):
+    """Solver-grade tenants: the bench's seeded diagonally-dominant SPD
+    family (the `_registry` helper's standard_normal payloads are
+    rectangular-minded and not SPD, so CG has no convergence promise on
+    them)."""
+    from matvec_mpi_multiplier_tpu.bench.serve import solver_operand
+
+    reg = MatrixRegistry(mesh, strategy="rowwise", promote=None, **kwargs)
+    for i in range(n_tenants):
+        reg.register(f"t{i}", solver_operand(n, "float32", seed=i))
+    return reg
+
+
+def test_solver_admit_carries_op_and_predicted_s(mesh):
+    """An admitted solver request's decision record names the op and a
+    positive predicted_s (the maxiter-worst-case solve prediction) —
+    the ISSUE 14 admission acceptance, verbatim."""
+    reg = _spd_registry(mesh)
+    gs = GlobalScheduler(reg, cost_model=CostModel(_cal()),
+                         coalesce=False)
+    b = np.ones(64, np.float32)
+    res = gs.submit("t0", deadline_ms=1e7, op="cg", rhs=b,
+                    rtol=1e-5).result()
+    assert res.converged
+    last = gs.decisions()[-1]
+    assert last["decision"] == "admit"
+    assert last["op"] == "cg"
+    assert last["predicted_s"] is not None and last["predicted_s"] > 0
+    assert "maxiter" in last["reason"]
+    # The solver prediction is iteration-scaled: far above one matvec.
+    matvec_s = gs.model.predict(
+        "rowwise", "gather", m=64, k=64, p=8, dtype="float32"
+    ).total_s
+    assert last["predicted_s"] > 10 * matvec_s
+    gs.close()
+
+
+def test_solver_tight_deadline_rejects_typed_with_op(mesh):
+    reg = _spd_registry(mesh)
+    gs = GlobalScheduler(reg, cost_model=CostModel(_cal()),
+                         coalesce=False)
+    fut = gs.submit("t0", deadline_ms=1e-4, op="cg",
+                    rhs=np.ones(64, np.float32))
+    err = fut.exception()
+    assert isinstance(err, AdmissionRejectedError)
+    assert is_rejection(err)
+    last = gs.decisions()[-1]
+    assert last["decision"] == "reject"
+    assert last["op"] == "cg"
+    assert "predicted cg eta" in last["reason"]
+    assert last["predicted_s"] > 0
+    gs.close()
+
+
+def test_solver_greedy_admits_without_prediction(mesh):
+    """Uncalibrated scheduler: solver ops pass straight through (admit
+    with predicted_s None, never a rejection) and the answer still
+    converges — degradation-not-refusal, solver edition."""
+    logs = []
+    reg = _spd_registry(mesh)
+    gs = GlobalScheduler(reg, cost_model=None, log=logs.append,
+                         coalesce=False)
+    res = gs.submit("t1", op="cg", rhs=np.ones(64, np.float32),
+                    rtol=1e-5).result()
+    assert res.converged
+    last = gs.decisions()[-1]
+    assert last["decision"] == "admit"
+    assert last["op"] == "cg"
+    assert last["predicted_s"] is None
+    assert "greedy" in last["reason"]
+    assert logs and "uncalibrated" in logs[0]
+    gs.close()

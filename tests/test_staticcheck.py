@@ -1283,3 +1283,128 @@ def test_cli_lockgraph_flag_runs_only_lock_rules(tmp_path):
     assert proc.returncode == 1, (proc.stdout, proc.stderr)
     payload = json.loads(proc.stdout)
     assert {f["rule"] for f in payload["findings"]} == {"lock-mixed-guard"}
+
+
+# ------------------------------------------------------- solver audit
+
+
+def test_solver_audit_table_covers_ops_and_strategies():
+    """Every served op × the three strategy faces (ISSUE 14): the audit
+    table is the coverage contract test_data_quality's golden gate pins
+    on disk."""
+    from matvec_mpi_multiplier_tpu.solvers import SOLVER_OPS
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        SOLVER_AUDIT_CONFIGS,
+    )
+
+    ops = {c.op for c in SOLVER_AUDIT_CONFIGS}
+    assert ops == set(SOLVER_OPS)
+    faces = {(c.strategy, c.combine) for c in SOLVER_AUDIT_CONFIGS}
+    assert faces == {
+        ("rowwise", "gather"), ("colwise", "psum"),
+        ("blockwise", "gather"),
+    }
+    assert len(SOLVER_AUDIT_CONFIGS) == len(ops) * len(faces)
+    # Every config names a matvec counterpart that the main audit table
+    # also lowers — the kind-set gate compares against a pinned cell.
+    audited = {c.key for c in AUDIT_CONFIGS}
+    for scfg in SOLVER_AUDIT_CONFIGS:
+        assert scfg.matvec.key in audited, scfg.key
+
+
+def test_solver_lowering_passes_structural_gates(devices):
+    """One real lowering (cg around the colwise psum matvec): the
+    compiled program keeps its lax.while on device, uses exactly the
+    matvec counterpart's collective kinds, and solver_findings is
+    empty."""
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        SOLVER_AUDIT_CONFIGS,
+        solver_audit_entry,
+        solver_findings,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    scfg = next(
+        c for c in SOLVER_AUDIT_CONFIGS
+        if c.op == "cg" and c.strategy == "colwise"
+    )
+    entry = solver_audit_entry(scfg, mesh)
+    assert entry["while_ops"] >= 1
+    assert "all-reduce" in entry["census"]
+    assert solver_findings(scfg, entry, mesh) == []
+
+
+def test_mutation_host_driven_loop_fails_solver_audit(devices):
+    """The failure mode the while-count gate exists for: a 'solver'
+    whose iteration is a host-unrolled Python loop of matvecs lowers
+    with NO stablehlo.while — k host round-trips per solve, the
+    compiles-flat story dead. Feed that real lowering through
+    solver_audit_entry and the audit goes red."""
+    import jax
+    import jax.numpy as jnp
+
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        SOLVER_AUDIT_CONFIGS,
+        SOLVER_AUDIT_N,
+        solver_audit_entry,
+        solver_findings,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    # rowwise|gather's census is empty, so the kind-set gate stays green
+    # and the while gate alone must catch the unrolled loop.
+    scfg = next(
+        c for c in SOLVER_AUDIT_CONFIGS
+        if c.op == "cg" and c.strategy == "rowwise"
+    )
+
+    def unrolled_cg(a, b, rtol, maxiter, p0, p1):
+        x = jnp.zeros_like(b)
+        r = b
+        for _ in range(3):  # fixed-depth Python loop: no lax.while
+            x = x + rtol * r
+            r = b - a @ x
+        return x, jnp.float32(0), jnp.int32(3), rtol, True
+
+    n = SOLVER_AUDIT_N
+    import numpy as np
+    dt = np.float32
+    lowered = jax.jit(unrolled_cg).lower(
+        jax.ShapeDtypeStruct((n, n), dt), jax.ShapeDtypeStruct((n,), dt),
+        jax.ShapeDtypeStruct((), np.float32),
+        jax.ShapeDtypeStruct((), np.int32),
+        jax.ShapeDtypeStruct((), np.float32),
+        jax.ShapeDtypeStruct((), np.float32),
+    )
+    entry = solver_audit_entry(scfg, mesh, lowered=lowered)
+    assert entry["while_ops"] == 0
+    findings = solver_findings(scfg, entry, mesh)
+    assert any(f.rule == "hlo-solver-loop" for f in findings), findings
+
+
+def test_mutation_stray_collective_fails_solver_kind_gate(devices):
+    """A collective kind the matvec counterpart never issues (an
+    un-staged all-gather smuggled into the loop body) trips
+    hlo-solver-schedule — exercised on a fabricated entry so the test
+    stays census-level, not lowering-level."""
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        SOLVER_AUDIT_CONFIGS,
+        solver_findings,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    scfg = next(
+        c for c in SOLVER_AUDIT_CONFIGS
+        if c.op == "cg" and c.strategy == "colwise"
+    )
+    bad = {
+        "census": {"all_gather": 6, "psum": 6},
+        "payload_bytes": {"all_gather": 1, "psum": 1},
+        "while_ops": 1,
+    }
+    findings = solver_findings(scfg, bad, mesh)
+    assert any(f.rule == "hlo-solver-schedule" for f in findings), findings
+    assert any("all_gather" in f.message for f in findings)
