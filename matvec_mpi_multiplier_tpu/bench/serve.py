@@ -175,7 +175,8 @@ SERVE_CSV_HEADER = (
     "hits_steady, promo_b, promo_gemm_s, promo_seq_s, promo_speedup, "
     "arrival, rate_req_s, concurrency, coalesce, mean_batch_width, "
     "coalesce_ratio, success_rate, failed_requests, retries, downgrades, "
-    "dtype_storage, resident_bytes"
+    "dtype_storage, resident_bytes, speculated, escalation_rate, "
+    "spec_bandwidth_ratio"
 )
 
 
@@ -229,6 +230,16 @@ class ServeResult:
     # winner, not the request) and its HBM payload bytes.
     dtype_storage: str = "native"
     resident_bytes: int = 0
+    # Speculative-serving columns (ops/speculative.py; docs/QUANTIZATION.md):
+    # speculated counts requests served through the int8c speculative tier,
+    # escalation_rate is the engine's gauge (escalations over speculative
+    # dispatches — the cost model's ε feed), and spec_bandwidth_ratio is
+    # the amortized resident-stream bytes per request relative to native:
+    # (spec_bytes + rate·native_bytes) / native_bytes. NaN when the run
+    # never armed speculation.
+    speculated: int = 0
+    escalation_rate: float = float("nan")
+    spec_bandwidth_ratio: float = float("nan")
 
     @property
     def success_rate(self) -> float:
@@ -284,7 +295,9 @@ def append_serve_result(result: ServeResult, root=None):
         f"{result.coalesce}, {result.mean_batch_width:.3f}, "
         f"{result.coalesce_ratio:.3f}, {result.success_rate:.4f}, "
         f"{result.failed_requests}, {result.retries}, {result.downgrades}, "
-        f"{result.dtype_storage}, {result.resident_bytes}"
+        f"{result.dtype_storage}, {result.resident_bytes}, "
+        f"{result.speculated}, {result.escalation_rate:.4f}, "
+        f"{result.spec_bandwidth_ratio:.4f}"
     )
     _append_row(path, SERVE_CSV_HEADER, row)
     return path
@@ -1360,6 +1373,7 @@ def run_serve(
     promo_reps: int = 20,
     metrics_out: str | None = None,
     trace_jsonl: str | None = None,
+    rtol: float | None = None,
 ) -> ServeResult:
     """Run the serve protocol for one (strategy, shape, mesh) config.
 
@@ -1367,6 +1381,10 @@ def run_serve(
     the steady-phase dispatch-latency histogram, one registry) as JSON.
     ``trace_jsonl``: stream every request's span tree to a JSONL file
     (flushed before return, so the file is complete when this returns).
+    ``rtol``: per-request tolerance forwarded to every steady-phase
+    ``submit()`` — with ``dtype_storage="speculate"`` armed this routes
+    the stream through the int8c speculative tier (escalating only on a
+    failed on-device check); ``None`` keeps every request exact/native.
     """
     from ..utils.io import generate_matrix
 
@@ -1405,12 +1423,25 @@ def run_serve(
     start = time.perf_counter()
     for w in sequence:
         t0 = time.perf_counter()
-        futures.append(engine.submit(pool[int(w)]))
+        futures.append(engine.submit(pool[int(w)], rtol=rtol))
         latency_hist.observe((time.perf_counter() - t0) * 1e3)
     _drain(futures)
     wall = time.perf_counter() - start
 
     steady_stats = engine.stats
+    # Speculative accounting (read AFTER the drain: escalations settle at
+    # result()-time, so the counters are final here).
+    health = engine.health()
+    speculated = int(health["counters"]["speculative_dispatches"])
+    if engine.spec_resident_bytes:
+        esc_rate = float(health["storage"]["escalation_rate"])
+        native_stream = int(m) * int(k) * np.dtype(engine.dtype).itemsize
+        spec_ratio = (
+            engine.spec_resident_bytes + esc_rate * native_stream
+        ) / native_stream
+    else:
+        esc_rate = float("nan")
+        spec_ratio = float("nan")
     promo_b, promo_gemm, promo_seq = measure_promotion(
         engine, pool, n_reps=promo_reps
     )
@@ -1453,6 +1484,9 @@ def run_serve(
         promo_seq_s=promo_seq,
         dtype_storage=engine.storage,
         resident_bytes=engine.resident_bytes,
+        speculated=speculated,
+        escalation_rate=esc_rate,
+        spec_bandwidth_ratio=spec_ratio,
     )
 
 
@@ -1996,6 +2030,7 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                             seed=args.seed,
                             metrics_out=metrics_out,
                             trace_jsonl=trace_jsonl,
+                            rtol=getattr(args, "spec_rtol", None),
                         )
                     except MatvecError as e:
                         print(f"skip {name} {m}x{k} p={n_dev}: {e}")
@@ -2009,6 +2044,12 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                         f"resident={result.resident_bytes / 1e6:.2f}MB"
                         if result.dtype_storage != "native" else ""
                     )
+                    if result.speculated:
+                        storage_suffix += (
+                            f" spec={result.speculated} "
+                            f"esc_rate={result.escalation_rate:.4f} "
+                            f"bw_ratio={result.spec_bandwidth_ratio:.3f}"
+                        )
                     print(
                         f"serve {name} {m}x{k} p={n_dev} "
                         f"b*={result.b_star} {result.rps:.1f} req/s "
@@ -2133,11 +2174,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--dtype-storage", dest="dtype_storage", default=None,
-        choices=["native", "int8", "int8c", "fp8", "auto"],
+        choices=["native", "int8", "int8c", "fp8", "auto", "speculate"],
         help="resident-A storage format (ops/quantize.py): quantize A "
         "once at residency and serve from the low-bit payload; 'auto' "
-        "consults the tuned sixth axis (native on a miss). CSV rows "
-        "record the resolved format + resident bytes",
+        "consults the tuned sixth axis (native on a miss); 'speculate' "
+        "arms the int8c speculative tier beside native (requests opt in "
+        "via --spec-rtol). CSV rows record the resolved format + "
+        "resident bytes",
+    )
+    p.add_argument(
+        "--spec-rtol", dest="spec_rtol", type=float, default=None,
+        help="per-request relative tolerance for matvec serving: with "
+        "--dtype-storage speculate, every steady-phase request is served "
+        "from the int8c tier with an on-device residual check, escalating "
+        "to native only on a miss (ops/speculative.py). Default None = "
+        "exact/native for every request",
     )
     p.add_argument(
         "--n-requests", type=int, default=200,

@@ -123,6 +123,14 @@ from ..ops.quantize import (
     quantize_matrix,
     quantized_like,
 )
+from ..ops.speculative import (
+    SPEC_RTOL_FLOOR,
+    build_speculative,
+    eligible as spec_eligible,
+    probe_count,
+    probe_matrix,
+    project_probes,
+)
 from ..obs.sink import JsonlSink
 from ..obs.tracing import ActiveTrace, RequestTracer
 from ..resilience.faults import (
@@ -165,6 +173,16 @@ from .executables import DONATE_ARGNUMS, ExecKey, ExecStats, ExecutableCache
 # breaker may be routing around).
 SAFE_KERNEL = "xla"
 
+# The speculative tier's vocabulary (docs/QUANTIZATION.md "speculative
+# serving"): SPECULATE is the storage label speculative ExecKeys carry —
+# never a resident FORMAT; a speculative engine's own storage stays
+# native so rtol=None requests are bitwise-identical to a plain engine —
+# and SPEC_STORAGE is the format the speculative resident quantizes to
+# (the compensated pair: ~1e-6 normwise error at 0.52x the bytes, the
+# tier the whole speculation exists to serve from).
+SPECULATE = "speculate"
+SPEC_STORAGE = "int8c"
+
 # Static promotion default on a tuning-cache miss: one GEMM dispatch
 # replaces 4+ GEMV dispatches. Conservative on purpose — at b=4 the block
 # re-reads A once instead of 4 times, so even bandwidth-bound shapes win,
@@ -195,14 +213,27 @@ class MatvecFuture:
         materialize_hist=None,
         integrity_counter=None,
     ):
-        # parts: (device_array, width[, corrupt]) — width=None marks a
-        # rank-1 single column; an int marks a rank-2 block whose first
-        # `width` columns are real (the rest is bucket padding). corrupt
-        # marks a part an injected "nan" fault poisons at materialization
-        # (resilience/faults.py — simulated silent device corruption).
+        # parts: (device_array, width[, corrupt[, accept, resolve]]) —
+        # width=None marks a rank-1 single column; an int marks a rank-2
+        # block whose first `width` columns are real (the rest is bucket
+        # padding). corrupt marks a part an injected "nan" fault poisons
+        # at materialization (resilience/faults.py — simulated silent
+        # device corruption). accept/resolve mark a SPECULATIVE part
+        # (docs/QUANTIZATION.md): accept is the on-device verdict of the
+        # fused acceptance check, and resolve(accepted) is the engine's
+        # settlement callback — bookkeeping on accept, the traced native
+        # re-dispatch (its replacement parts) on a miss.
         self._parts = [
-            (p[0], p[1], bool(p[2]) if len(p) > 2 else False) for p in parts
+            (
+                p[0], p[1], bool(p[2]) if len(p) > 2 else False,
+                p[3] if len(p) > 4 else None,
+                p[4] if len(p) > 4 else None,
+            )
+            for p in parts
         ]
+        # Speculative settlement is memoized: a second result() call
+        # re-materializes but must not re-read verdicts or re-escalate.
+        self._settled: list[tuple] | None = None
         self._vector = vector
         self._error: Exception | None = None
         # Set once result() has returned (or raised): the caller has
@@ -232,15 +263,16 @@ class MatvecFuture:
     def device_values(self) -> list[jax.Array]:
         """The raw (still padded) device arrays — for callers chaining
         device-side work without materializing (empty for a failed
-        future)."""
-        return [arr for arr, _, _ in self._parts]
+        future). For a speculative part this is the CANDIDATE (the
+        verdict is only read at materialization)."""
+        return [arr for arr, *_ in self._parts]
 
     def done(self) -> bool:
         """True when every part's device computation has completed (never
         blocks). A failed future is done by definition."""
         return all(
             bool(arr.is_ready()) if hasattr(arr, "is_ready") else True
-            for arr, _, _ in self._parts
+            for arr, *_ in self._parts
         )
 
     def exception(self) -> Exception | None:
@@ -276,6 +308,31 @@ class MatvecFuture:
                 raise err
         return out
 
+    def _resolve_parts(self) -> list[tuple]:
+        """Settle every speculative verdict ONCE (memoized): read each
+        speculative part's device accept predicate — the one host read
+        the speculative path adds, and it happens here because result()
+        is the engine's sync point by contract — and either keep the
+        verified candidate or splice in the parts of the engine's traced
+        native re-dispatch (``resolve(False)``; span kind=escalate).
+        Plain parts pass through untouched."""
+        if self._settled is None:
+            settled: list[tuple] = []
+            for arr, width, corrupt, accept, resolve in self._parts:
+                if accept is None:
+                    settled.append((arr, width, corrupt))
+                    continue
+                ok = bool(np.asarray(accept))  # sync-ok: caller-requested materialization (the speculative verdict settles here by design)
+                if ok:
+                    resolve(True)
+                    settled.append((arr, width, corrupt))
+                else:
+                    settled.extend(
+                        (p[0], p[1], p[2]) for p in resolve(False)
+                    )
+            self._settled = settled
+        return self._settled
+
     def result(self) -> np.ndarray:
         """Materialize on host: ``(m,)`` for a vector request, ``(m, b)``
         for a block request (pad columns sliced away). A failed future
@@ -290,11 +347,12 @@ class MatvecFuture:
         span = trace.span("materialize") if trace is not None else None
         status = "ok"
         try:
+            parts = self._resolve_parts()
             if self._vector:
-                arr, _, corrupt = self._parts[0]
+                arr, _, corrupt = parts[0]
                 return self._gate(self._host_part(arr, corrupt))
             cols = []
-            for arr, width, corrupt in self._parts:
+            for arr, width, corrupt in parts:
                 host = self._host_part(arr, corrupt)
                 cols.append(
                     host[:, None] if width is None else host[:, :width]
@@ -699,6 +757,50 @@ class MatvecEngine:
             self._a_host = a
             self.storage_block = None
             self.resident_bytes = int(a.nbytes)
+        if self.speculative:
+            # The speculative tier's resident set, built ONCE here
+            # (docs/QUANTIZATION.md "speculative serving"): the
+            # compensated-int8 payload the candidate dispatches against,
+            # the seeded probe matrix U, and its float64-accumulated
+            # projection P = U A off the NATIVE operand (the check must
+            # measure the quantization error, so its reference cannot
+            # itself be quantized). Probe count is sized for the tightest
+            # ELIGIBLE tolerance (the SPEC_RTOL_FLOOR eligibility gate),
+            # so one fixed P/U serves every admissible rtol.
+            self._spec_probes = probe_count(SPEC_RTOL_FLOOR)
+            sq = quantize_matrix(
+                a, SPEC_STORAGE,
+                contraction_shards=self.strategy.contraction_shards(mesh),
+            )
+            self._spec_qa_host = sq
+            self._spec_qa_template = quantized_like(
+                sq,
+                lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype),
+            )
+            self.spec_storage_block = sq.block
+            u = probe_matrix(self._spec_probes, self.m, self.dtype)
+            p = project_probes(u, a, self.dtype)
+            self._spec_u_host, self._spec_p_host = u, p
+            # P contracts against the request x, so it shards over the
+            # strategy's x spec (the fused check closes the product with
+            # one psum of s scalars); U contracts against the gathered
+            # candidate and rides replicated.
+            spec_x = self.strategy.specs(mesh)[1]
+            self._sh_p = NamedSharding(
+                mesh, PartitionSpec(None, *tuple(spec_x))
+            )
+            self.spec_resident_bytes = int(sq.nbytes + u.nbytes + p.nbytes)
+            # The speculative set is placed/released WITH the payload —
+            # one residency, honestly accounted as one footprint.
+            self.resident_bytes += self.spec_resident_bytes
+        else:
+            self._spec_probes = None
+            self._spec_qa_host = self._spec_qa_template = None
+            self._spec_u_host = self._spec_p_host = None
+            self._sh_p = None
+            self.spec_storage_block = None
+            self.spec_resident_bytes = 0
+        self._spec_qa = self._spec_p = self._spec_u = None
         self._matvec_combine, self._gemm_combine = self._resolve_combine(
             combine
         )
@@ -749,12 +851,52 @@ class MatvecEngine:
         )
         self._g_resident.set(0)
         # Info metric, Prometheus-style: the label set carries the fact,
-        # the value is always 1 (the obs `storage` panel reads it).
+        # the value is always 1 (the obs `storage` panel reads it). The
+        # `reason` label says WHY this format serves — "explicit"/"tuned"
+        # vs "auto_degraded" — so a silent degrade is visible in any
+        # metrics snapshot, not just health().
         self.metrics.gauge(
             f'engine_storage_format{{format="{self.storage}",'
-            f'dtype="{self.dtype}"}}',
+            f'dtype="{self.dtype}",reason="{self.storage_reason}"}}',
             "resident-A storage format (info metric; value is always 1)",
         ).set(1)
+        # Storage-axis fallback visibility: every time the engine passes
+        # on the storage tier it was asked or tuned for — an auto winner
+        # degraded at construction, or a speculative-armed engine serving
+        # an rtol request native (breaker open, or rtol under the
+        # eligibility floor). Created only when the storage axis is
+        # engaged, so a plain engine's snapshot stays clean.
+        if dtype_storage is not None:
+            self._c_storage_fallbacks = self.metrics.counter(
+                "engine_storage_fallbacks_total",
+                "requests (or the construction itself) served native "
+                "despite a quantized/speculative storage ask",
+            )
+            if self._storage_degraded:
+                self._c_storage_fallbacks.inc()
+        else:
+            self._c_storage_fallbacks = None
+        if self.speculative:
+            self._c_speculative = self.metrics.counter(
+                "engine_speculative_dispatches_total",
+                "requests served through the speculative int8c tier "
+                "(candidate + fused acceptance check, one program)",
+            )
+            self._c_escalations = self.metrics.counter(
+                "engine_escalations_total",
+                "speculative candidates the on-device check rejected "
+                "(a traced native re-dispatch served the request)",
+            )
+            self._g_escalation_rate = self.metrics.gauge(
+                "engine_escalation_rate",
+                "escalations / speculative dispatches, refreshed at each "
+                "speculative settlement (the cost model's ε feed)",
+            )
+            self._g_escalation_rate.set(0.0)
+        else:
+            self._c_speculative = None
+            self._c_escalations = None
+            self._g_escalation_rate = None
         self._h_submit = self.metrics.histogram(
             "engine_submit_latency_ms", "submit() entry-to-return host time"
         )
@@ -843,8 +985,12 @@ class MatvecEngine:
                 # PR 8 doctrine: a plain quantized engine keeps the
                 # struct-only template (plus the original A for the
                 # native safe tier), never the host payload copy; a plain
-                # native engine keeps no host copy at all.
+                # native engine keeps no host copy at all. The speculative
+                # host set follows the same rule — a non-releasable
+                # engine's speculative residency is placed once, for life.
                 self._qa_host = None
+                self._spec_qa_host = None
+                self._spec_u_host = self._spec_p_host = None
                 if self.storage == NATIVE:
                     self._a_host = None
 
@@ -889,10 +1035,23 @@ class MatvecEngine:
                 "residency)"
             )
         placed = jax.device_put(payload, self._sh_a)
+        spec = None
+        if self.speculative:
+            # The speculative set rides the payload residency: placed
+            # together, accounted together (resident_bytes includes it),
+            # re-placed bitwise-identically from the same host arrays on
+            # a registry swap-in.
+            spec = (
+                jax.device_put(self._spec_qa_host, self._sh_a),
+                jax.device_put(self._spec_p_host, self._sh_p),
+                jax.device_put(self._spec_u_host, self._sh_rep),
+            )
         with self._residency_lock:
             if self._a is not None:
                 return False  # lost a concurrent placement race
             self._a = placed
+            if spec is not None:
+                self._spec_qa, self._spec_p, self._spec_u = spec
         self._notify_residency(self.resident_bytes, "resident")
         return True
 
@@ -916,6 +1075,9 @@ class MatvecEngine:
                 released += int(self._a_host.nbytes)
                 self._a_native = None
             self._a = None
+            # The speculative set is part of the payload residency
+            # (resident_bytes already includes it).
+            self._spec_qa = self._spec_p = self._spec_u = None
         self._notify_residency(-released, "released")
         return released
 
@@ -943,9 +1105,13 @@ class MatvecEngine:
             self.gather_output,
             self.max_bucket,
             self._donate,
-        )
+            # Speculative arming extends the compiled-program space (the
+            # fused check programs); a plain engine's signature is
+            # byte-identical to pre-speculation, so existing shared
+            # caches keep sharing.
+        ) + ((SPECULATE, self._spec_probes) if self.speculative else ())
 
-    def prediction_config(self, b: int = 1) -> dict:
+    def prediction_config(self, b: int = 1, rtol: float | None = None) -> dict:
         """The cost model's view of one dispatch through this engine's
         PREFERRED config (``tuning.cost_model.CostModel.predict`` /
         ``predict_admission`` kwargs): the resolved combine schedule —
@@ -954,16 +1120,22 @@ class MatvecEngine:
         ``b``-column request would actually ride (``b >= b*`` promotes to
         the padded GEMM bucket; below it the per-column path dispatches
         ``b`` single-RHS programs, which the caller models as ``b``
-        sequential ``b=1`` predictions). Degradation-ladder fallbacks are
-        deliberately not modeled — admission predicts the healthy path,
-        and sustained divergence is the cost model's own regression
-        signal (docs/COST_MODEL.md)."""
+        sequential ``b=1`` predictions). A request declaring an ELIGIBLE
+        ``rtol`` on a speculative-armed engine prices as
+        ``storage="speculate"`` — the two-tier expected cost
+        ``T_quant + T_check + ε·T_native`` (tuning/cost_model.py).
+        Degradation-ladder fallbacks are deliberately not modeled —
+        admission predicts the healthy path, and sustained divergence is
+        the cost model's own regression signal (docs/COST_MODEL.md)."""
         gemm = self.b_star is not None and b >= self.b_star
         combine = self._effective_combine(
             self._gemm_combine if gemm else self._matvec_combine
         )
         if combine is None:
             combine = self.strategy.default_combine(self.mesh)
+        storage = self.storage
+        if self.speculative and spec_eligible(rtol):
+            storage = SPECULATE
         return dict(
             strategy=self.strategy.name,
             combine=combine,
@@ -973,7 +1145,7 @@ class MatvecEngine:
             p=mesh_size(self.mesh),
             dtype=str(self.dtype),
             b=bucket_for(b, self.max_bucket) if gemm else 1,
-            storage=self.storage,
+            storage=storage,
         )
 
     # ---- construction-time resolution ----
@@ -986,7 +1158,38 @@ class MatvecEngine:
         without the dtype), or a strategy instance bound to an A-tiling
         combine — auto must never be worse-informed than native. An
         EXPLICIT format fails loudly instead: a serve config that asked
-        for quantized storage must not silently serve full-width bytes."""
+        for quantized storage must not silently serve full-width bytes.
+
+        Also the ONE place the speculative tier arms
+        (``dtype_storage="speculate"``, or a tuned ``speculate`` winner
+        under ``"auto"``) and the one place ``storage_reason`` is
+        written: health()/obs must distinguish "explicitly quantized"
+        from "auto-degraded to native", and a degrade here is counted in
+        ``engine_storage_fallbacks_total`` once the metrics registry
+        exists (``_storage_degraded``)."""
+        self.speculative = False
+        self._storage_degraded = False
+        self.storage_reason = (
+            "default" if dtype_storage is None else "explicit"
+        )
+
+        def _degrade() -> str:
+            self.storage_reason = "auto_degraded"
+            self._storage_degraded = True
+            return NATIVE
+
+        if dtype_storage == SPECULATE:
+            if not self.strategy.storage_combine_ok(None):
+                raise ConfigError(
+                    f"strategy {self.strategy.name!r} binds an A-tiling "
+                    "combine schedule, which cannot compose with the "
+                    "speculative int8c resident (dtype_storage="
+                    f"{SPECULATE!r}; docs/QUANTIZATION.md)"
+                )
+            # The PRIMARY residency stays native: rtol=None requests ride
+            # the exact pre-speculation path, bitwise-identical.
+            self.speculative = True
+            return NATIVE
         if dtype_storage == "auto":
             from ..tuning import lookup_storage
 
@@ -995,14 +1198,21 @@ class MatvecEngine:
                 p=mesh_size(self.mesh), dtype=str(self.dtype),
             )
             fmt = (decision or {}).get("storage") or NATIVE
+            if fmt == SPECULATE:
+                if not self.strategy.storage_combine_ok(None):
+                    return _degrade()
+                self.speculative = True
+                self.storage_reason = "tuned"
+                return NATIVE
             try:
                 fmt = normalize_storage(fmt)
             except ConfigError:
-                return NATIVE  # foreign cache, unknown format name
+                return _degrade()  # foreign cache, unknown format name
             if fmt == "fp8" and not fp8_supported():
-                return NATIVE
+                return _degrade()
             if fmt != NATIVE and not self.strategy.storage_combine_ok(None):
-                return NATIVE
+                return _degrade()
+            self.storage_reason = "tuned" if decision else "auto_miss"
             return fmt
         fmt = normalize_storage(dtype_storage)
         if fmt != NATIVE and not self.strategy.storage_combine_ok(None):
@@ -1214,6 +1424,79 @@ class MatvecEngine:
         return self._gemm_builder_for(
             bucket, self.kernel, self._gemm_combine, self.stages
         )
+
+    # ---- the speculative tier (docs/QUANTIZATION.md "speculative
+    # serving"): candidate + fused acceptance check in ONE program,
+    # keyed under storage="speculate" so it never collides with (or
+    # perturbs) the native executables the rtol=None path rides. ----
+
+    def _spec_combine(self, combine: str | None) -> str | None:
+        """The combine the speculative (quantized) program runs: the
+        engine's resolved name unless it tiles A inside its schedule
+        body — the same filter quantized residency applies — in which
+        case the static default serves."""
+        return None if combine in STORAGE_INCOMPATIBLE_COMBINES else combine
+
+    def _spec_matvec_key(self) -> ExecKey:
+        return ExecKey(
+            "matvec", self.strategy.name, self._kernel_label(),
+            self._spec_combine(self._matvec_combine), 1, str(self.dtype),
+            SPECULATE,
+        )
+
+    def _spec_gemm_key(self, bucket: int) -> ExecKey:
+        return ExecKey(
+            "gemm", self.strategy.name, self._kernel_label(),
+            self._spec_combine(self._gemm_combine), bucket,
+            str(self.dtype), SPECULATE,
+        )
+
+    def _spec_builder_for(self, bucket: int | None = None):
+        """Builder for the fused speculative program
+        (``ops/speculative.py::build_speculative``). Operands are
+        ``(aq, p, u, x, rtol)`` — the request ``x`` is python-arg 3, so
+        donation names index 3, not the native paths' DONATE_ARGNUMS;
+        ``rtol`` rides as a dynamic replicated scalar (changing
+        tolerance never recompiles, the solver operands' rule)."""
+        combine = self._spec_combine(
+            self._matvec_combine if bucket is None else self._gemm_combine
+        )
+
+        def builder():
+            fn = build_speculative(
+                self.strategy, self.mesh, probes=self._spec_probes,
+                kernel=self.kernel, combine=combine, stages=None,
+                storage=SPEC_STORAGE, gather_output=self.gather_output,
+                b=bucket,
+            )
+            s = self._spec_probes
+            if bucket is None:
+                x_struct = jax.ShapeDtypeStruct(
+                    (self.k,), self.dtype, sharding=self._sh_x
+                )
+            else:
+                x_struct = jax.ShapeDtypeStruct(
+                    (self.k, bucket), self.dtype, sharding=self._sh_b
+                )
+            structs = (
+                quantized_like(
+                    self._spec_qa_template,
+                    lambda leaf: jax.ShapeDtypeStruct(
+                        leaf.shape, leaf.dtype, sharding=self._sh_a
+                    ),
+                ),
+                jax.ShapeDtypeStruct(
+                    (s, self.k), self.dtype, sharding=self._sh_p
+                ),
+                jax.ShapeDtypeStruct(
+                    (s, self.m), self.dtype, sharding=self._sh_rep
+                ),
+                x_struct,
+                jax.ShapeDtypeStruct((), np.float32, sharding=self._sh_rep),
+            )
+            return fn, structs, ((3,) if self._donate else ())
+
+        return builder
 
     def _solver_key(self, op: str, bucket: int) -> ExecKey:
         """A solver executable's cache identity: the matvec key with the
@@ -1648,6 +1931,155 @@ class MatvecEngine:
                 for j in range(width)
             ]
 
+    # ---- speculative dispatch (serve int8c first, verify on-device,
+    # escalate only on miss — the ISSUE's two-tier path) ----
+
+    def _spec_operands(self):
+        """The speculative tier's device operands (quantized payload,
+        projection P, probes U), self-healing residency exactly like
+        :meth:`_a_for`: an evicted registry tenant re-places
+        transparently, enqueue-only, accounted under the payload
+        residency."""
+        if self._spec_qa is None:  # unguarded-ok: self-heal probe; ensure_resident re-checks under _residency_lock and a lost race is a dropped buffer, not corruption
+            self.ensure_resident()
+        return self._spec_qa, self._spec_p, self._spec_u  # unguarded-ok: the dispatch captures its own references; refcounted residency keeps concurrently evicted buffers alive for this dispatch
+
+    def _spec_allowed(self) -> bool:
+        """The speculative breaker's admission: escalation storms open it
+        (record_failure per miss at settlement) and the tier stands down
+        to native until the cooldown half-opens it — the existing
+        breaker ladder, not a new mechanism. One breaker (the matvec
+        spec key's) governs the whole tier; without resilience the tier
+        is always admitted (escalations still count)."""
+        if self._resilience is None:
+            return True
+        return self._breaker_for(self._spec_matvec_key()).allow()
+
+    def _spec_admit(self, rtol: float | None) -> float | None:
+        """The routing decision for one matvec/GEMM request: the declared
+        tolerance when the speculative tier should serve it — armed,
+        eligible (rtol at or above the floor the int8c budget sets), and
+        the breaker admits. A pass on an ARMED engine is a visible
+        storage fallback, never silent."""
+        if rtol is None:
+            return None
+        rtol = float(rtol)
+        if not (rtol > 0.0):
+            raise ConfigError(f"rtol must be > 0, got {rtol}")
+        if not self.speculative:
+            return None
+        if not spec_eligible(rtol) or not self._spec_allowed():
+            self._c_storage_fallbacks.inc()
+            return None
+        return rtol
+
+    def _spec_record(self, accepted: bool) -> None:
+        """Settlement bookkeeping (runs at materialization, host-side by
+        contract): verdict counters, the ε gauge the cost model reads,
+        and the speculative breaker — a miss is the CONFIG's failure
+        signal (quantization budget blown for this operand mix), so it
+        feeds the breaker like any degraded dispatch."""
+        if not accepted:
+            self._c_escalations.inc()
+        spec = self._c_speculative.value
+        if spec:
+            self._g_escalation_rate.set(self._c_escalations.value / spec)
+        if self._resilience is not None:
+            br = self._breaker_for(self._spec_matvec_key())
+            (br.record_success if accepted else br.record_failure)()
+
+    def _exec_spec(self, x, rtol, trace, key, builder, bucket=None):
+        """One speculative dispatch: candidate + fused check, ONE enqueue
+        (the accept predicate is a device output of the same program —
+        nothing here syncs; the verdict settles at materialization)."""
+        if self._fault_plan is not None and key not in self._cache:
+            self._check_faults("compile", key)
+        exe = self._get_traced(trace, key, builder)
+        corrupt = self._check_faults("dispatch", key, block=x)
+        self._c_dispatches.inc()
+        self._c_speculative.inc()
+        qa, p, u = self._spec_operands()
+        attrs = {"op": "matvec"} if bucket is None else {
+            "op": "gemm", "bucket": bucket,
+        }
+        with trace.span("dispatch", kind="speculate", **attrs):
+            y, _est, accept = exe(
+                qa, p, u,
+                jax.device_put(
+                    x, self._sh_x if bucket is None else self._sh_b
+                ),
+                jax.device_put(np.float32(rtol), self._sh_rep),
+            )
+        self._track(y)
+        return y, accept, corrupt
+
+    def _spec_fallback(self, exc: Exception) -> None:
+        """A speculative COMPILE/DISPATCH error (not a verdict miss) must
+        never fail a request native would have served: classify it for
+        the breaker, count the visible fallback, and let the caller ride
+        native — whose own ladder/bucket machinery owns any further
+        recovery (including RESOURCE_EXHAUSTED's bucket shrink)."""
+        if self._resilience is not None:
+            br = self._breaker_for(self._spec_matvec_key())
+            if is_payload_fault(exc):
+                br.record_inconclusive()
+            else:
+                br.record_failure()
+        self._c_storage_fallbacks.inc()
+
+    def _spec_part_matvec(self, col: np.ndarray, rtol: float,
+                          trace: ActiveTrace) -> tuple:
+        """One column through the speculative tier -> one 5-part
+        ``(candidate, None, corrupt, accept, resolve)``. ``resolve``
+        runs at settlement: bookkeeping on accept; on a miss it IS the
+        escalation — a traced native re-dispatch (span kind=escalate)
+        through the regular ladder, never a silent wrong answer."""
+        try:
+            y, accept, corrupt = self._exec_spec(
+                col, rtol, trace, self._spec_matvec_key(),
+                self._spec_builder_for(),
+            )
+        except Exception as exc:  # swallow-ok: _spec_fallback records it (breaker + fallbacks counter); the request rides the native ladder, which owns recovery
+            self._spec_fallback(exc)
+            return self._dispatch_matvec(col, trace)
+
+        def resolve(accepted: bool) -> list:
+            self._spec_record(accepted)
+            if accepted:
+                return []
+            with trace.span("escalate", op="matvec", kind="escalate"):
+                return [self._dispatch_matvec(col, trace)]
+
+        return (y, None, corrupt, accept, resolve)
+
+    def _spec_part_block(self, chunk: np.ndarray, rtol: float,
+                         trace: ActiveTrace) -> list:
+        """One <= max_bucket-wide chunk through the speculative GEMM
+        tier; the batched check accepts only when EVERY real column
+        passes (pad columns are zero and trivially pass), so a miss
+        escalates the whole chunk through the native block path."""
+        width = chunk.shape[1]
+        bucket = bucket_for(width, self.max_bucket)
+        with trace.span("bucket_pad", width=width, bucket=bucket):
+            padded = pad_columns(chunk, bucket)
+        try:
+            y, accept, corrupt = self._exec_spec(
+                padded, rtol, trace, self._spec_gemm_key(bucket),
+                self._spec_builder_for(bucket), bucket=bucket,
+            )
+        except Exception as exc:  # swallow-ok: _spec_fallback records it (breaker + fallbacks counter); the chunk rides the native block path, which owns recovery
+            self._spec_fallback(exc)
+            return self._dispatch_block(chunk, trace)
+
+        def resolve(accepted: bool) -> list:
+            self._spec_record(accepted)
+            if accepted:
+                return []
+            with trace.span("escalate", op="gemm", kind="escalate"):
+                return self._dispatch_block(chunk, trace)
+
+        return [(y, width, corrupt, accept, resolve)]
+
     def submit(
         self,
         x=None,
@@ -1656,7 +2088,7 @@ class MatvecEngine:
         integrity: bool | None = None,
         op: str = "matvec",
         rhs=None,
-        rtol: float = 1e-6,
+        rtol: float | None = None,
         maxiter: int | None = None,
         restart: int | None = None,
         steps: int | None = None,
@@ -1703,7 +2135,18 @@ class MatvecEngine:
         ``interval=(λ_min, λ_max)`` is chebyshev's required spectral
         interval. Solver submits return a :class:`SolverFuture`; see
         docs/SOLVERS.md for the convergence contract. The solver knobs
-        are ignored for ``op="matvec"``.
+        other than ``rtol`` are ignored for ``op="matvec"``.
+
+        ``rtol`` on a PLAIN matvec/GEMM request is the speculative
+        contract (docs/QUANTIZATION.md "speculative serving"): the
+        caller declares a relative tolerance, and a speculative-armed
+        engine (``dtype_storage="speculate"``) may serve the request
+        from the int8c resident — candidate and acceptance check fused
+        in one program — escalating to a traced native re-dispatch only
+        when the on-device check misses. ``rtol=None`` (the default)
+        means EXACT: the dispatch is bitwise-identical to an engine with
+        no speculative tier. For solver ops ``rtol=None`` keeps the
+        historical 1e-6 default.
         """
         t0 = time.monotonic()
         t0_perf = time.perf_counter()
@@ -1756,8 +2199,15 @@ class MatvecEngine:
                 "backpressure gate before dispatch"
             ), trace=trace)
 
+        spec_rtol = self._spec_admit(rtol)
         gate = self.integrity_gate if integrity is None else bool(integrity)
         integrity_counter = self._integrity_counter() if gate else None
+        if spec_rtol is not None and integrity_counter is None:
+            # Speculative answers are refused unconditionally when
+            # non-finite (the solver doctrine): the caller declared a
+            # tolerance, so a poisoned candidate must fail typed, never
+            # serve within it — even when the optional gate is off.
+            integrity_counter = self._integrity_counter()
         with trace.span("submit"):
             if deadline_ms is not None and deadline_ms <= 0:
                 # Stale on arrival (upstream queueing): skip even the drain.
@@ -1769,8 +2219,13 @@ class MatvecEngine:
             try:
                 if x.ndim == 1:
                     self._c_cols.inc()
+                    part = (
+                        self._spec_part_matvec(x, spec_rtol, trace)
+                        if spec_rtol is not None
+                        else self._dispatch_matvec(x, trace)
+                    )
                     fut = MatvecFuture(
-                        [self._dispatch_matvec(x, trace)], vector=True,
+                        [part], vector=True,
                         trace=trace, materialize_hist=self._h_materialize,
                         integrity_counter=integrity_counter,
                     )
@@ -1786,10 +2241,20 @@ class MatvecEngine:
                     for width in split_widths(b, self.max_bucket):
                         chunk = x[:, offset:offset + width]
                         offset += width
-                        parts.extend(self._dispatch_block(chunk, trace))
+                        parts.extend(
+                            self._spec_part_block(chunk, spec_rtol, trace)
+                            if spec_rtol is not None
+                            else self._dispatch_block(chunk, trace)
+                        )
                 else:
                     for j in range(b):
-                        parts.append(self._dispatch_matvec(x[:, j], trace))
+                        parts.append(
+                            self._spec_part_matvec(
+                                x[:, j], spec_rtol, trace
+                            )
+                            if spec_rtol is not None
+                            else self._dispatch_matvec(x[:, j], trace)
+                        )
                 fut = MatvecFuture(
                     parts, vector=False,
                     trace=trace, materialize_hist=self._h_materialize,
@@ -1855,7 +2320,10 @@ class MatvecEngine:
                 f"op={op!r} takes one (k,) right-hand side with "
                 f"k={self.k}; got shape {rhs.shape}"
             )
-        rtol = float(rtol)
+        # None keeps the solvers' historical default: submit()'s rtol
+        # default changed to None for the speculative matvec contract
+        # (None = exact there), but a solver ALWAYS has a tolerance.
+        rtol = float(1e-6 if rtol is None else rtol)
         if not (rtol > 0.0):
             raise ConfigError(f"rtol must be > 0, got {rtol}")
         maxiter = (
@@ -1958,9 +2426,16 @@ class MatvecEngine:
         would dispatch to under :meth:`submit`'s routing (sub-``b*`` widths
         take the per-column path, so they compile no GEMM bucket). Returns
         the number of fresh compiles. After this, a stream confined to
-        those widths never compiles again — the serve bench's warm phase."""
+        those widths never compiles again — the serve bench's warm phase.
+        A speculative-armed engine warms BOTH tiers (the fused check
+        programs alongside the native ones), so a mixed rtol/exact
+        stream — escalations included — runs compile-free."""
         before = self._cache.stats.compiles
         self._cache.get(self._matvec_key(), self._matvec_builder)
+        if self.speculative:
+            self._cache.get(
+                self._spec_matvec_key(), self._spec_builder_for()
+            )
         if self.b_star is not None:
             if widths is None:
                 buckets = set(bucket_ladder(self.max_bucket))
@@ -1975,6 +2450,11 @@ class MatvecEngine:
                 self._cache.get(
                     self._gemm_key(bucket), self._gemm_builder(bucket)
                 )
+                if self.speculative:
+                    self._cache.get(
+                        self._spec_gemm_key(bucket),
+                        self._spec_builder_for(bucket),
+                    )
         return self._cache.stats.compiles - before
 
     def _integrity_counter(self):
@@ -2026,6 +2506,11 @@ class MatvecEngine:
             "integrity_gate": self.integrity_gate,
             "storage": {
                 "format": self.storage,
+                # WHY this format serves: "explicit"/"tuned" vs
+                # "auto_degraded"/"auto_miss"/"default" — the field that
+                # makes an auto-degrade distinguishable from a caller's
+                # own native ask (the satellite fix).
+                "reason": self.storage_reason,
                 "resident": self.resident,
                 "resident_bytes": self.resident_bytes,
                 "device_resident_bytes": self.device_resident_bytes,
@@ -2034,6 +2519,11 @@ class MatvecEngine:
                 # then holding BOTH residencies — a degraded quantized
                 # engine costs more than either alone).
                 "native_fallback_resident": self._a_native is not None,  # unguarded-ok: health() is a monotone point-in-time probe; staleness by one transition is its contract
+                "speculative": self.speculative,
+                "escalation_rate": (
+                    self._g_escalation_rate.value
+                    if self._g_escalation_rate is not None else 0.0
+                ),
             },
             "breakers": breakers,
             "degraded": degraded,
@@ -2050,6 +2540,9 @@ class MatvecEngine:
                 "dispatch_failures": self._c_dispatch_failures.value,
                 "deadline_failures": self._c_deadline_failures.value,
                 "integrity_failures": _val(self._c_integrity),
+                "storage_fallbacks": _val(self._c_storage_fallbacks),
+                "speculative_dispatches": _val(self._c_speculative),
+                "escalations": _val(self._c_escalations),
             },
         }
 

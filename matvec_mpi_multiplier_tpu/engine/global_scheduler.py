@@ -354,16 +354,20 @@ class GlobalScheduler:
 
     # ---- prediction ----
 
-    def _predict_dispatch_s(self, engine, b: int) -> float | None:
+    def _predict_dispatch_s(
+        self, engine, b: int, rtol: float | None = None,
+    ) -> float | None:
         """Predicted seconds for one ``b``-column dispatch through the
-        engine's preferred config — memoized per (engine, bucket). The
+        engine's preferred config — memoized per (engine, bucket,
+        storage; an eligible ``rtol`` on a speculative-armed engine
+        prices the two-tier expected cost, a distinct memo seat). The
         per-column path models ``b`` sequential single-RHS programs; a
         config the formula cannot express predicts None (admitted, never
         rejected)."""
         if self.model is None:
             return None
-        cfg = engine.prediction_config(b)
-        memo_key = (id(engine), cfg["b"])
+        cfg = engine.prediction_config(b, rtol)
+        memo_key = (id(engine), cfg["b"], cfg["storage"])
         with self._lock:
             if memo_key in self._predict_memo:
                 base = self._predict_memo[memo_key]
@@ -511,7 +515,7 @@ class GlobalScheduler:
         qos: str = "standard",
         op: str = "matvec",
         rhs=None,
-        rtol: float = 1e-6,
+        rtol: float | None = None,
         maxiter: int | None = None,
         restart: int | None = None,
         steps: int | None = None,
@@ -532,7 +536,15 @@ class GlobalScheduler:
         :meth:`~..tuning.cost_model.CostModel.predict_solver` at ``k_est
         = maxiter`` and dispatched solo: a solve is one loop against one
         RHS, so cross-tenant column-stacking does not apply — solver
-        requests bypass the coalescing layer entirely."""
+        requests bypass the coalescing layer entirely.
+
+        A MATVEC request declaring ``rtol`` (the speculative contract —
+        ``MatvecEngine.submit(rtol=...)``) passes it through and also
+        bypasses coalescing: the fused acceptance check carries ONE
+        tolerance per dispatch, and stacking members with different
+        budgets would verify every column against the tightest. The
+        admission prediction prices such a request as
+        ``storage="speculate"`` when the tenant's engine is armed."""
         if qos not in QOS_TIERS:
             raise ConfigError(
                 f"unknown QoS tier {qos!r}; expected one of {QOS_TIERS}"
@@ -560,7 +572,7 @@ class GlobalScheduler:
             block = block[:, None]
         width = block.shape[1]
 
-        dispatch_s = self._predict_dispatch_s(engine, width)
+        dispatch_s = self._predict_dispatch_s(engine, width, rtol)
         if self.model is not None:
             from ..tuning.cost_model import AdmissionEstimate
 
@@ -635,15 +647,17 @@ class GlobalScheduler:
                 deadline_ms=deadline_ms,
             )
             fut = self.registry.submit(
-                tenant_id, x, deadline_ms=deadline_ms
+                tenant_id, x, deadline_ms=deadline_ms, rtol=rtol
             )
             self._track(fut, None)
             return fut
 
         self._c_admits.inc()
-        if not self._coalesce:
+        if not self._coalesce or rtol is not None:
+            # rtol requests dispatch solo (docstring: one tolerance per
+            # fused check) — speculation and coalescing don't stack.
             fut = self.registry.submit(
-                tenant_id, x, deadline_ms=engine_deadline
+                tenant_id, x, deadline_ms=engine_deadline, rtol=rtol
             )
             self._track(fut, dispatch_s)
             return fut

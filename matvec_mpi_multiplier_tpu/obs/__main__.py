@@ -69,15 +69,21 @@ def render_batching(snapshot: dict) -> str | None:
 
 
 def render_storage(snapshot: dict) -> str | None:
-    """The storage panel: the resident-A format and its HBM payload read
-    off ``engine_resident_bytes`` and the ``engine_storage_format{...}``
-    info gauge (engine/core.py; docs/QUANTIZATION.md). None when the
-    snapshot predates the storage axis (no resident-bytes gauge)."""
+    """The storage panel: the resident-A format, its HBM payload, WHY the
+    engine landed on that format (the ``reason`` label — "explicit" vs
+    "tuned" vs "auto_degraded", so a silent speculation-disable is
+    visible), and the speculative tier's dispatch/escalation story, read
+    off ``engine_resident_bytes``, the ``engine_storage_format{...}``
+    info gauge, and the ``engine_storage_fallbacks_total`` /
+    ``engine_speculative_*`` / ``engine_escalation*`` metrics
+    (engine/core.py; docs/QUANTIZATION.md). None when the snapshot
+    predates the storage axis (no resident-bytes gauge)."""
     gauges = snapshot.get("gauges", {})
     if "engine_resident_bytes" not in gauges:
         return None
+    counters = snapshot.get("counters", {})
     resident = gauges["engine_resident_bytes"]
-    fmt, dtype = "native", "?"
+    fmt, dtype, reason = "native", "?", None
     for name in gauges:
         if name.startswith("engine_storage_format{"):
             # Prometheus-style info metric: the label set carries the fact.
@@ -87,13 +93,33 @@ def render_storage(snapshot: dict) -> str | None:
             )
             fmt = labels.get("format", "native").strip('"')
             dtype = labels.get("dtype", "?").strip('"')
+            reason = labels.get("reason", "").strip('"') or None
     out = [
         "storage:",
-        f"  format          {fmt} (operand dtype {dtype})",
+        f"  format          {fmt} (operand dtype {dtype})"
+        + (f" [{reason}]" if reason else ""),
         f"  resident bytes  {resident:.3e} "
         + ("(quantized payload + per-block scales)" if fmt != "native"
            else "(full-width A)"),
     ]
+    if reason == "auto_degraded" or "engine_storage_fallbacks_total" in counters:
+        fallbacks = counters.get("engine_storage_fallbacks_total", 0)
+        out.append(
+            f"  fallbacks       {fallbacks} "
+            "(requested format degraded to native — "
+            + ("SILENT speculation/quantization disable"
+               if reason == "auto_degraded" else "per-request tier misses")
+            + ")"
+        )
+    if "engine_speculative_dispatches_total" in counters:
+        spec = counters.get("engine_speculative_dispatches_total", 0)
+        esc = counters.get("engine_escalations_total", 0)
+        rate = gauges.get("engine_escalation_rate", float("nan"))
+        out.append(
+            f"  speculative     {spec} dispatches, {esc} escalations "
+            f"(rate {rate:.4f} — the cost model's ε feed; "
+            "docs/QUANTIZATION.md: reading the escalation gauge)"
+        )
     return "\n".join(out)
 
 

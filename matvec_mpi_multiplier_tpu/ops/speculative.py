@@ -1,0 +1,244 @@
+"""Speculative quantized dispatch: the on-device acceptance check.
+
+The paper's claim is that distributed matvec is bandwidth-bound, and the
+compensated int8 resident (``ops/quantize.py``) already moves ~0.52x the
+bytes of native at ~1e-6 normwise error. What kept it opt-in is the
+exactness doctrine: a multiply must not silently return an approximate
+answer. This module supplies the missing piece — a CHEAP, on-device,
+seeded acceptance check that turns "approximate" into "verified within
+the caller's declared tolerance", so the engine can serve the quantized
+tier first and escalate to the native program only on a miss
+(``engine/core.py::submit(rtol=...)``; docs/QUANTIZATION.md derives the
+bound reproduced below).
+
+The check is a **sampled-projection residual**. For a candidate
+``y_hat ~= A x`` the true residual is ``r = A x - y_hat`` — computing it
+exactly would cost the native matvec the speculation exists to avoid.
+Instead, draw ``s`` fixed Gaussian probes ``U in R^{s x m}`` (seeded —
+every engine draws the SAME probes, so two engines serving one stream
+agree on every accept/escalate decision) and precompute ``P = U A`` once
+at residency in float64. Per request the estimator is::
+
+    est = || P x - U y_hat ||_2 / sqrt(s)
+
+which is unbiased for ``||r||_2^2`` (each probe row gives
+``(u_i . r) ~ N(0, ||r||^2)``, so ``||U r||^2 / s`` is a chi-square mean
+with ``E = ||r||^2``), costs ``O(s (k + m))`` flops against the native
+``O(m k)``, and contracts over A's own sharding: ``P`` shards over the
+strategy's contraction axis, so ``P x`` is a local slab product plus
+**one extra psum of s scalars** — never a full-width collective (the
+staticcheck ``hlo-spec-*`` gates pin exactly that lowering).
+
+Acceptance reuses the ONE tolerance comparison every solver stops on
+(``solvers/common.py`` — the one-copy rule)::
+
+    accept  =  NOT above_tolerance(est, convergence_threshold(
+                   SPEC_MARGIN * rtol, ||y_hat||))
+
+The ``SPEC_MARGIN = 1/2`` headroom is what makes the derived bound work:
+a wrong answer (true relative residual > rtol) is served only if the
+estimator UNDER-reports ``||r||`` by more than 2x, and the chi-square
+lower tail gives ``P[est^2 <= eps ||r||^2] <= exp(-(s/2)(eps - 1 -
+ln eps))`` with ``eps = SPEC_MARGIN^2``. :func:`probe_count` inverts
+that bound so the false-accept probability is at most the caller's own
+``rtol`` — tighter tolerances buy proportionally more probes (the
+probe-count table in docs/QUANTIZATION.md evaluates it).
+
+Everything on the hot path is inside ONE compiled program: the quantized
+matvec, the projection, the norm, and the accept PREDICATE all lower
+together, and the escalate decision leaves the device only at
+materialization time (``MatvecFuture.result()`` is the engine's sync
+point by contract). The ``hlo-spec-host-sync`` audit proves the predicate
+is a device output, not a per-request host round-trip.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import numpy as np
+
+from ..solvers.common import (
+    above_tolerance,
+    convergence_threshold,
+    residual_norm,
+)
+from .quantize import INT8C_EPS, normalize_storage
+
+# Fixed probe seed: the sampled projection must be a pure function of
+# (seed, s, m) so independent engines — and a restarted one — make
+# identical accept/escalate decisions on identical requests.
+SPEC_SEED = 0x5BEC
+
+# Acceptance headroom: the estimate must clear HALF the caller's budget.
+# A served miss then requires a >= 1/SPEC_MARGIN estimator under-report,
+# which is what the chi-square tail bound in probe_count() prices.
+SPEC_MARGIN = 0.5
+
+# Eligibility floor: below the compensated format's own per-element
+# quantization budget (ops/quantize.py::INT8C_EPS) the speculative tier
+# would escalate almost always — callers this tight ride native directly.
+SPEC_RTOL_FLOOR = INT8C_EPS
+
+# Probe-count clamp: 8 probes bound the check's cost floor; 128 cap the
+# resident P/U footprint for pathological rtol values.
+MIN_PROBES = 8
+MAX_PROBES = 128
+
+# Chernoff exponent constant for the chi-square lower tail at
+# eps = SPEC_MARGIN^2:  (eps - 1 - ln eps) / 2  per probe.
+_CHERNOFF_RATE = (SPEC_MARGIN**2 - 1 - 2 * math.log(SPEC_MARGIN)) / 2.0
+
+
+def eligible(rtol: float | None) -> bool:
+    """True when a declared tolerance admits the speculative tier at all:
+    a tolerance is declared and sits above :data:`SPEC_RTOL_FLOOR`."""
+    return rtol is not None and float(rtol) >= SPEC_RTOL_FLOOR
+
+
+def probe_count(rtol: float) -> int:
+    """Probes needed so the false-accept probability is at most ``rtol``.
+
+    The derived bound (module docstring; docs/QUANTIZATION.md): accepting
+    a candidate whose true relative residual exceeds ``rtol`` requires
+    ``est^2 <= SPEC_MARGIN^2 ||r||^2``, and the chi-square lower tail
+    gives ``P <= exp(-s * _CHERNOFF_RATE)``. Solving ``P <= rtol``::
+
+        s >= ln(1 / rtol) / _CHERNOFF_RATE
+
+    clamped to [:data:`MIN_PROBES`, :data:`MAX_PROBES`]. The budget
+    scales with the caller's own tolerance on purpose: a caller declaring
+    rtol=1e-6 is trusting the check with a stronger contract than one
+    declaring 1e-2, so the check spends proportionally more probes.
+    """
+    rtol = float(rtol)
+    if not (rtol > 0.0):
+        raise ValueError(f"rtol must be > 0, got {rtol}")
+    if rtol >= 1.0:
+        return MIN_PROBES
+    s = math.ceil(math.log(1.0 / rtol) / _CHERNOFF_RATE)
+    return max(MIN_PROBES, min(MAX_PROBES, s))
+
+
+def probe_matrix(n_probes: int, m: int, dtype=np.float32) -> np.ndarray:
+    """The seeded ``(s, m)`` Gaussian probe matrix ``U``. Deterministic in
+    (seed, s, m) and independent of A — the cross-engine agreement the
+    speculative tests pin."""
+    rng = np.random.default_rng(SPEC_SEED)
+    return rng.standard_normal((int(n_probes), int(m))).astype(dtype)
+
+
+def project_probes(u: np.ndarray, a: np.ndarray, dtype=None) -> np.ndarray:
+    """``P = U A`` precomputed ONCE at residency, accumulated in float64
+    off the NATIVE operand (the check must measure the quantization error,
+    so its reference projection cannot itself be quantized) and stored at
+    the serving dtype. ``(s, k)`` — one row per probe."""
+    dtype = np.dtype(dtype if dtype is not None else a.dtype)
+    p = np.asarray(u, np.float64) @ np.asarray(a, np.float64)
+    return p.astype(dtype)
+
+
+def _sharded_axes(spec) -> tuple[str, ...]:
+    """Mesh axis names a PartitionSpec actually shards over (flattened)."""
+    names: list[str] = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        names.extend((entry,) if isinstance(entry, str) else tuple(entry))
+    return tuple(names)
+
+
+def build_speculative(
+    strategy,
+    mesh,
+    *,
+    probes: int,
+    kernel: str | Callable = "xla",
+    combine: str | None = None,
+    stages: int | None = None,
+    storage: str = "int8c",
+    gather_output: bool = True,
+    b: int | None = None,
+) -> Callable:
+    """Build the fused speculative program for one strategy config.
+
+    Returns ``fn(aq, p, u, x, rtol) -> (y_hat, est, accept)`` where
+    ``aq`` is the quantized resident pytree, ``p``/``u`` the precomputed
+    projection and probe matrices (:func:`project_probes` /
+    :func:`probe_matrix`), ``x`` the request (``(k,)``, or ``(k, b)``
+    when ``b`` is given — the engine's bucket-padded GEMM face), and
+    ``rtol`` a DYNAMIC f32 scalar (changing tolerance never recompiles).
+    ``accept`` is a device bool — scalar, all-columns-must-pass on the
+    batched face; ``est`` is the worst estimated RELATIVE residual
+    across real+pad columns (pad columns are zero, so they contribute
+    est=0 and always pass).
+
+    Everything — candidate, projection, norm, predicate — is one traced
+    program: the quantized matvec's own collective schedule plus one
+    psum of ``s`` scalars when the strategy shards its contraction axis
+    (colwise/blockwise; rowwise's contraction is local, so its check
+    adds no collective at all). The escalate decision is the caller's to
+    read at materialization; nothing here syncs.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..utils.compat import shard_map
+
+    storage = normalize_storage(storage)
+    build = strategy.build_batched if b is not None else strategy.build
+    inner = build(
+        mesh,
+        kernel=kernel,
+        gather_output=gather_output,
+        combine=combine,
+        stages=stages,
+        dtype_storage=storage,
+    )
+    spec_x = strategy.specs(mesh)[1]
+    contraction_axes = _sharded_axes(spec_x)
+
+    def _project_x(p, x):
+        """``t1 = P x`` in A's own sharding: a local slab product plus one
+        psum of s scalars per column over the contraction axis — the one
+        extra reduction the staticcheck census pins. Falls back to a plain
+        (local) product when the contraction axis is unsharded (rowwise)
+        or on the batched face (whose operand sharding GSPMD re-lays
+        anyway; the matvec face is the audited one)."""
+        if not contraction_axes or b is not None:
+            return p @ x
+
+        def body(p_loc, x_loc):
+            return jax.lax.psum(p_loc @ x_loc, contraction_axes)
+
+        return shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(None, *tuple(spec_x)), spec_x),
+            out_specs=P(),
+        )(p, x)
+
+    def spec_fn(aq, p, u, x, rtol):
+        y_hat = inner(aq, x)
+        t1 = _project_x(p, x)            # (s,) | (s, b)
+        t2 = u @ y_hat                   # (s,) | (s, b)
+        diff = t1 - t2
+        scale = 1.0 / jnp.sqrt(jnp.asarray(float(probes), diff.dtype))
+        if b is None:
+            est = residual_norm(diff) * scale
+            y_norm = residual_norm(y_hat)
+        else:
+            est = jax.vmap(residual_norm, in_axes=1)(diff) * scale
+            y_norm = jax.vmap(residual_norm, in_axes=1)(y_hat)
+        threshold = convergence_threshold(
+            jnp.asarray(SPEC_MARGIN, est.dtype) * rtol, y_norm
+        )
+        miss = above_tolerance(est, threshold)
+        est_rel = jnp.max(
+            jnp.where(y_norm > 0, est / jnp.where(y_norm > 0, y_norm, 1), est)
+        )
+        return y_hat, est_rel, ~jnp.any(miss)
+
+    return spec_fn

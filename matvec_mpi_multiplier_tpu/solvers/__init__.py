@@ -10,6 +10,7 @@ tracing and metrics all inherit.
 
 from .common import (
     SolverResult,
+    above_tolerance,
     convergence_threshold,
     host_norm,
     keep_iterating,
@@ -27,6 +28,7 @@ from .ops import (
 
 __all__ = [
     "SolverResult",
+    "above_tolerance",
     "convergence_threshold",
     "host_norm",
     "keep_iterating",

@@ -47,16 +47,30 @@ def host_norm(v: Array) -> float:
     return float(residual_norm(v))
 
 
+def above_tolerance(rnorm: Array, threshold: Array) -> Array:
+    """THE tolerance comparison every convergence/acceptance decision in
+    the tree is built from: strict ``>`` against the threshold, so
+    ``||r|| <= tol * ||b||`` counts as converged — scipy's semantics.
+
+    Two consumers, ONE comparison (the one-copy rule this module exists
+    for): the solver loops' continuation predicate
+    (:func:`keep_iterating`) and the speculative dispatch path's
+    on-device acceptance check (``ops/speculative.py`` — a speculative
+    answer is ACCEPTED exactly when its estimated residual is NOT above
+    tolerance, so the matvec check and the solver exit can never drift
+    onto different inequalities)."""
+    return rnorm > threshold
+
+
 def keep_iterating(rnorm: Array, threshold: Array, k: Array, cap) -> Array:
     """THE ``lax.while_loop`` continuation predicate: still above tolerance
-    AND still under the iteration cap.
+    (:func:`above_tolerance`) AND still under the iteration cap.
 
-    Strict ``>`` against the threshold (``||r|| <= tol * ||b||`` counts as
-    converged — scipy's semantics) and strict ``<`` against the cap. The
-    cap may be a Python int (the standalone builders' static
-    ``max_iters``) or a traced int32 scalar (the served solvers'
-    dynamic ``maxiter`` operand) — same predicate either way."""
-    return (rnorm > threshold) & (k < cap)
+    Strict ``<`` against the cap. The cap may be a Python int (the
+    standalone builders' static ``max_iters``) or a traced int32 scalar
+    (the served solvers' dynamic ``maxiter`` operand) — same predicate
+    either way."""
+    return above_tolerance(rnorm, threshold) & (k < cap)
 
 
 def convergence_threshold(rtol, b_norm: Array) -> Array:

@@ -117,13 +117,17 @@ AUDIT_M = 64
 AUDIT_K = 2048
 AUDIT_DTYPE = "float32"
 GOLDEN_REL = "data/staticcheck/golden_schedule.json"
+# Schema 5 over 4: the table gains a top-level "speculative" section
+# pinning each fused speculative program's census (the int8c counterpart's
+# schedule + at most ONE tiny extra reduction), probe count, and the
+# device-predicate output count (the speculative audit below).
 # Schema 4 over 3: the table gains a top-level "solvers" section pinning
 # each served solver loop's whole-program collective census and
 # stablehlo.while count per strategy × op (the solver audit below).
 # Schema 3 over 2: every entry additionally pins the compiled-artifact
 # memory audit — RHS donation state ("aliased"/"donated") and the static
 # peak-liveness estimate (peak_bytes / peak_bytes_ratio).
-GOLDEN_SCHEMA = 4
+GOLDEN_SCHEMA = 5
 
 # The solver audit's square operand (the solver ops need m == k). Shares
 # the audit mesh's divisibility needs (8 devices, the 2x4 grid); small on
@@ -1055,14 +1059,195 @@ def solver_findings(
     return findings
 
 
+# ----------------------------------------------------- speculative audit
+#
+# The speculative-dispatch layer (ops/speculative.py; the engine's
+# submit(rtol=...) tier): the fused candidate + acceptance-check program
+# must lower to the int8c counterpart's collective schedule plus AT MOST
+# one extra reduction whose payload is the probe vector (s scalars) —
+# never a full-width collective (which would spend the bandwidth the
+# speculation exists to save) — and the accept/escalate decision must be
+# a device predicate in the artifact's outputs, not a host round-trip
+# inside the program (hlo-spec-host-sync). Rowwise contracts locally, so
+# its check adds NO collective at all; the golden pins each cell exactly.
+
+
+class SpecAuditConfig(NamedTuple):
+    """One audited speculative lowering: the fused int8c candidate +
+    acceptance check compiled for one strategy × combine
+    (``ops.speculative.build_speculative`` — the program the engine's
+    ``submit(rtol=...)`` path dispatches)."""
+
+    strategy: str
+    combine: str
+
+    @property
+    def key(self) -> str:
+        return f"speculate|{self.strategy}|{self.combine}"
+
+    @property
+    def counterpart(self) -> AuditConfig:
+        """The int8c matvec cell whose collective schedule the fused
+        program must contain (storage is census-orthogonal, so the
+        counterpart's EXPECTED schedule is the strategy × combine
+        formula; the int8c framing matters for the byte story, not the
+        census)."""
+        return AuditConfig(self.strategy, self.combine, storage="int8c")
+
+
+# One cell per strategy family, same combines as the solver audit:
+# colwise's psum makes the one-extra-reduction gate bite (its counterpart
+# census is non-empty), rowwise/blockwise gather pin the
+# zero-extra-collective (rowwise) and sharded-contraction (blockwise)
+# faces.
+SPEC_AUDIT_CONFIGS: tuple[SpecAuditConfig, ...] = (
+    SpecAuditConfig("rowwise", "gather"),
+    SpecAuditConfig("colwise", "psum"),
+    SpecAuditConfig("blockwise", "gather"),
+)
+
+
+def _audit_probes() -> int:
+    """The probe count the engine arms with (its resident P/U are sized
+    at the eligibility floor — engine/core.py's constructor makes the
+    same call)."""
+    from ..ops.speculative import SPEC_RTOL_FLOOR, probe_count
+
+    return probe_count(SPEC_RTOL_FLOOR)
+
+
+def lower_spec_config(scfg: SpecAuditConfig, mesh):
+    """Build and lower one fused speculative program against the audit
+    operand (trace-only), with the engine's operand signature
+    ``fn(aq, p, u, x, rtol)`` — the quantized pytree, the precomputed
+    projection/probe matrices, the request, and the DYNAMIC tolerance
+    scalar (exactly what ``MatvecEngine._spec_builder_for`` compiles)."""
+    import jax
+    import numpy as np
+
+    from ..models import get_strategy
+    from ..ops.quantize import quantized_struct
+    from ..ops.speculative import build_speculative
+
+    dtype = np.dtype(AUDIT_DTYPE)
+    s = _audit_probes()
+    fn = build_speculative(
+        get_strategy(scfg.strategy), mesh, probes=s,
+        combine=scfg.combine, storage="int8c",
+    )
+    aq = quantized_struct(
+        AUDIT_M, AUDIT_K, "int8c", dtype, audit_block(scfg.counterpart, mesh)
+    )
+    p_struct = jax.ShapeDtypeStruct((s, AUDIT_K), dtype)
+    u_struct = jax.ShapeDtypeStruct((s, AUDIT_M), dtype)
+    x = jax.ShapeDtypeStruct((AUDIT_K,), dtype)
+    rtol = jax.ShapeDtypeStruct((), np.float32)
+    return jax.jit(fn).lower(aq, p_struct, u_struct, x, rtol)
+
+
+def pred_output_count(lowered) -> int:
+    """How many ``i1`` tensors the module's ``@main`` RETURNS — the
+    hlo-spec-host-sync gate's subject. The accept predicate must leave
+    the program as a device output (the engine reads it once, at
+    ``MatvecFuture.result()`` — its contractual sync point); a lowering
+    with no boolean result means the decision was resolved inside the
+    trace, i.e. a host round-trip per request."""
+    main = _main_func(lowered.compiler_ir(dialect="stablehlo"))
+    if main is None:
+        return 0
+    ftype = str(main.attributes["function_type"])
+    results = ftype.rsplit("->", 1)[-1]
+    return results.count("tensor<i1>")
+
+
+def spec_audit_entry(scfg: SpecAuditConfig, mesh, lowered=None) -> dict:
+    """One speculative config's observed artifact: the whole-program
+    collective census + payload bytes, the probe count it was built at,
+    and the device-predicate output count."""
+    if lowered is None:
+        lowered = lower_spec_config(scfg, mesh)
+    census, payload = collective_census(lowered)
+    return {
+        "census": dict(sorted(census.items())),
+        "payload_bytes": dict(sorted(payload.items())),
+        "probes": _audit_probes(),
+        "pred_outputs": pred_output_count(lowered),
+    }
+
+
+def spec_findings(
+    scfg: SpecAuditConfig, entry: dict, mesh
+) -> list[Finding]:
+    """The structural (golden-independent) gates for one speculative
+    entry: the counterpart's schedule must survive intact, the check may
+    add at most ONE reduction of probe-vector payload (never a
+    full-width collective), and the escalate decision must be a device
+    predicate output."""
+    findings: list[Finding] = []
+    exp_census, exp_payload = expected_schedule(scfg.counterpart, mesh)
+    census = entry["census"]
+    payload = entry["payload_bytes"]
+    missing = {
+        kind: n for kind, n in exp_census.items()
+        if census.get(kind, 0) < n
+    }
+    extra = {
+        kind: census[kind] - exp_census.get(kind, 0)
+        for kind in census
+        if census[kind] > exp_census.get(kind, 0)
+    }
+    if missing:
+        findings.append(Finding(
+            f"<hlo:{scfg.key}>", 0, "hlo-spec-schedule",
+            f"fused speculative program lost collectives {missing} from "
+            f"its {scfg.counterpart.key} counterpart's schedule "
+            f"{dict(sorted(exp_census.items()))} — the candidate matvec "
+            "no longer lowers the audited combine",
+        ))
+    if set(extra) - {"all-reduce"} or sum(extra.values()) > 1:
+        findings.append(Finding(
+            f"<hlo:{scfg.key}>", 0, "hlo-spec-schedule",
+            f"acceptance check added {extra} beyond the "
+            f"{scfg.counterpart.key} counterpart's schedule — the check "
+            "must cost at most ONE extra reduction (the psum of s probe "
+            "scalars; rowwise adds none)",
+        ))
+    # The one allowed extra reduction must move the probe vector, not a
+    # full-width operand: s scalars at the serving itemsize.
+    check_ceiling = entry["probes"] * _ITEMSIZE[AUDIT_DTYPE]
+    extra_ar_bytes = (
+        payload.get("all-reduce", 0) - exp_payload.get("all-reduce", 0)
+    )
+    if extra.get("all-reduce") and extra_ar_bytes > check_ceiling:
+        findings.append(Finding(
+            f"<hlo:{scfg.key}>", 0, "hlo-spec-schedule",
+            f"the check's extra all-reduce moves {extra_ar_bytes} bytes, "
+            f"over the {check_ceiling}-byte probe-vector ceiling "
+            f"({entry['probes']} probes × {_ITEMSIZE[AUDIT_DTYPE]} B) — a "
+            "full-width collective smuggled into the acceptance check "
+            "spends the bandwidth the speculation exists to save",
+        ))
+    if entry["pred_outputs"] < 1:
+        findings.append(Finding(
+            f"<hlo:{scfg.key}>", 0, "hlo-spec-host-sync",
+            "fused speculative program returns no i1 predicate: the "
+            "accept/escalate decision was resolved inside the trace — a "
+            "host round-trip per request — instead of riding to "
+            "MatvecFuture.result() as a device output",
+        ))
+    return findings
+
+
 def build_schedule_table(
     configs: Iterable[AuditConfig] | None = None,
     solver_configs: Iterable[SolverAuditConfig] | None = None,
+    spec_configs: Iterable[SpecAuditConfig] | None = None,
 ) -> dict:
     """The full golden-table payload for the current tree: the schedule
     census (plain-struct lowering) merged with the compiled-artifact
     memory audit (engine-recipe lowering) per config, plus the served
-    solver loops' census/while pins per strategy × op."""
+    solver loops' census/while pins per strategy × op, plus the fused
+    speculative programs' census/predicate pins per strategy family."""
     import jax
 
     mesh = _audit_mesh()
@@ -1077,6 +1262,13 @@ def build_schedule_table(
             else tuple(solver_configs)
         )
     }
+    spec_entries = {
+        scfg.key: spec_audit_entry(scfg, mesh)
+        for scfg in (
+            SPEC_AUDIT_CONFIGS if spec_configs is None
+            else tuple(spec_configs)
+        )
+    }
     return {
         "schema": GOLDEN_SCHEMA,
         "mesh": {
@@ -1088,6 +1280,7 @@ def build_schedule_table(
         "jax_version_at_capture": jax.__version__,
         "configs": entries,
         "solvers": solver_entries,
+        "speculative": spec_entries,
     }
 
 
@@ -1110,17 +1303,22 @@ def run_hlo_audit(
     memory: bool = True,
     solvers: bool | None = None,
     solver_configs: Iterable[SolverAuditConfig] | None = None,
+    speculative: bool | None = None,
+    spec_configs: Iterable[SpecAuditConfig] | None = None,
 ) -> list[Finding]:
     """The full lowered-artifact audit: the collective-schedule layer
     (census + bytes vs formula and golden, the overlap chunking gate,
     fingerprint stability — ``schedule=True``), the compiled-artifact
     memory layer (donation → aliasing, peak liveness vs the quantized
     ceilings — ``memory=True``; the CLI's ``--memory-audit`` runs it
-    alone), and the served-solver layer (whole-program collective-kind
+    alone), the served-solver layer (whole-program collective-kind
     set vs the matvec counterpart, the on-device while pin, golden count
-    pins — ``solvers=True``). All compare against the golden table over
-    whichever fields they computed. Returns findings; empty means every
-    config lowers as pinned."""
+    pins — ``solvers=True``), and the speculative-dispatch layer (fused
+    check census vs the int8c counterpart + one probe-vector reduction,
+    the hlo-spec-host-sync device-predicate pin — ``speculative=True``).
+    All compare against the golden table over whichever fields they
+    computed. Returns findings; empty means every config lowers as
+    pinned."""
     root = Path(root) if root is not None else repo_root()
     golden_path = (
         Path(golden_path) if golden_path is not None else root / GOLDEN_REL
@@ -1130,6 +1328,9 @@ def run_hlo_audit(
         # not pay for 15 solver lowerings; full audits always include
         # them, as does an explicit solver_configs narrowing.
         solvers = configs is None or solver_configs is not None
+    if speculative is None:
+        # Same narrowing rule as the solver layer.
+        speculative = configs is None or spec_configs is not None
     configs = _supported_configs(configs or AUDIT_CONFIGS)
     findings: list[Finding] = []
 
@@ -1304,6 +1505,39 @@ def run_hlo_audit(
                     GOLDEN_REL, 0, "hlo-golden",
                     f"golden table pins unknown solver config {stale}; "
                     "regenerate with --write-golden",
+                ))
+
+    if speculative:
+        golden_spec = golden.get("speculative", {}) if have_golden else {}
+        for scfg in (
+            SPEC_AUDIT_CONFIGS if spec_configs is None
+            else tuple(spec_configs)
+        ):
+            entry = spec_audit_entry(scfg, mesh)
+            findings.extend(spec_findings(scfg, entry, mesh))
+            if have_golden:
+                pinned = golden_spec.get(scfg.key)
+                if pinned is None:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-golden",
+                        f"speculative config {scfg.key} missing from the "
+                        "golden table; bless it with --write-golden",
+                    ))
+                elif pinned != entry:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-census",
+                        f"{scfg.key}: lowered speculative program {entry} "
+                        f"!= golden {pinned}; a census, probe-count or "
+                        "predicate change inside the fused check — if "
+                        "deliberate, bless it with --write-golden",
+                    ))
+        if have_golden and spec_configs is None:
+            audited_spec = {scfg.key for scfg in SPEC_AUDIT_CONFIGS}
+            for stale in sorted(set(golden_spec) - audited_spec):
+                findings.append(Finding(
+                    GOLDEN_REL, 0, "hlo-golden",
+                    f"golden table pins unknown speculative config "
+                    f"{stale}; regenerate with --write-golden",
                 ))
 
     if have_golden:

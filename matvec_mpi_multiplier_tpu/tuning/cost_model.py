@@ -84,6 +84,15 @@ PRUNE_MARGIN = 0.5
 DIVERGENCE_LOG10 = 1.0
 DIVERGENCE_MIN_SAMPLES = 8
 
+# Prior escalation rate ε for the speculative tier's expected two-tier
+# cost  T_spec = T_int8c + T_check + ε·T_native  before any stream has
+# been observed. The engine refreshes the real rate into the
+# ``engine_escalation_rate`` gauge at every speculative settlement;
+# :meth:`CostModel.refresh_escalation_rate` adopts it once speculative
+# dispatches exist. 2% matches the committed well-conditioned capture's
+# acceptance bound (data/speculative_demo/ pins < 5%).
+DEFAULT_ESCALATION_RATE = 0.02
+
 # Metric names (the obs `cost model` panel and divergence_health read
 # these; search._record_candidate writes them).
 RATIO_HISTOGRAM = "tuning_predicted_vs_measured_ratio"
@@ -246,6 +255,32 @@ class CostModel:
 
     def __init__(self, calibration: Calibration):
         self.calibration = calibration
+        # ε in T_spec = T_int8c + T_check + ε·T_native. Starts at the
+        # prior; refresh_escalation_rate() adopts the engine's measured
+        # gauge so re-tuning under a hostile stream stops choosing the
+        # speculative seat on its own evidence.
+        self.escalation_rate = DEFAULT_ESCALATION_RATE
+
+    def refresh_escalation_rate(self, registry=None) -> float:
+        """Adopt the measured escalation rate from an obs registry's
+        ``engine_escalation_rate`` gauge (engine-local ``engine.metrics``
+        or the process default). The gauge is adopted only once
+        ``engine_speculative_dispatches_total`` shows real speculative
+        traffic — a zero-observation gauge is 'no evidence', not 'never
+        escalates'. Reads via ``snapshot()`` (non-creating: never plants
+        speculative metrics in a registry that never armed). Returns the
+        rate now in effect."""
+        from ..obs.registry import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        snap = reg.snapshot()
+        dispatches = snap.get("counters", {}).get(
+            "engine_speculative_dispatches_total", 0
+        )
+        rate = snap.get("gauges", {}).get("engine_escalation_rate")
+        if dispatches and rate is not None:
+            self.escalation_rate = float(rate)
+        return self.escalation_rate
 
     def predict_local(
         self, m: int, k: int, dtype: str, *, b: int = 1,
@@ -279,6 +314,12 @@ class CostModel:
         # hlo.schedule_formula and must redden the model and the audit
         # through the one shared symbol.
         from ..staticcheck import hlo
+
+        if storage == "speculate":
+            return self._predict_speculative(
+                strategy, combine,
+                m=m, k=k, p=p, dtype=dtype, stages=stages, b=b, r=r,
+            )
 
         cal = self.calibration
         itemsize = hlo.dtype_itemsize(dtype)
@@ -323,6 +364,93 @@ class CostModel:
             total_s=total_s, compute_s=compute_s, wire_s=wire_s,
             latency_s=latency_s, flops=flops, a_bytes=a_bytes,
             wire_bytes=wire_bytes,
+        )
+
+    def _predict_speculative(
+        self,
+        strategy: str | None,
+        combine: str | None,
+        *,
+        m: int,
+        k: int,
+        p: int,
+        dtype: str,
+        stages: int | None = None,
+        b: int = 1,
+        r: int | None = None,
+    ) -> Prediction:
+        """Expected two-tier cost of speculative dispatch (ISSUE: the
+        engine serves the int8c resident plus a fused acceptance check
+        first, escalating to the native program only on a miss)::
+
+            T_spec = T_int8c + T_check + ε·T_native
+
+        * **T_int8c / T_native** — the same model, recursed at the two
+          tiers' storage formats (the quantized tier inherits its
+          structural byte ratio; the native term is the escalation
+          re-dispatch).
+        * **T_check** — the sampled projection (``ops/speculative.py``):
+          ``2·s·(k+m)·b`` FLOPs against the resident ``P (s,k)`` +
+          ``U (s,m)`` stream, plus ONE collective launch when the
+          strategy shards its contraction axis (colwise/blockwise psum of
+          s scalars — rowwise contracts locally and adds none). The
+          payload is s itemsize-scalars per column: latency-dominated by
+          construction, so only α is charged.
+        * **ε** — :attr:`escalation_rate`, the measured gauge once
+          traffic exists (:meth:`refresh_escalation_rate`), the
+          :data:`DEFAULT_ESCALATION_RATE` prior before.
+
+        ``total_s`` is the SUM of the two tiers' totals (the escalation
+        re-dispatch cannot overlap the check it waits on), and
+        ``a_bytes`` is the expected amortized resident stream per request
+        — the ≤ 0.60×native bound the committed demo capture pins.
+        """
+        from ..ops.speculative import SPEC_RTOL_FLOOR, probe_count
+        from ..staticcheck import hlo
+
+        # The speculative tier's candidate storage is pinned to int8c
+        # (engine/core.py::SPEC_STORAGE — not imported: tuning must not
+        # depend on the engine layer).
+        quant = self.predict(
+            strategy, combine, m=m, k=k, p=p, dtype=dtype,
+            stages=stages, b=b, storage="int8c", r=r,
+        )
+        native = self.predict(
+            strategy, combine, m=m, k=k, p=p, dtype=dtype,
+            stages=stages, b=b, storage="native", r=r,
+        )
+        cal = self.calibration
+        itemsize = hlo.dtype_itemsize(dtype)
+        s = probe_count(SPEC_RTOL_FLOOR)
+        check_flops = 2.0 * s * (k + m) * b
+        check_bytes = s * (k + m) * itemsize
+        check_compute_s = max(
+            (check_flops / p) / cal.flops,
+            (check_bytes / p) / cal.mem_bps,
+        )
+        sharded_contraction = (
+            strategy is not None and combine is not None
+            and p > 1 and strategy != "rowwise"
+        )
+        check_latency_s = (
+            cal.alpha_s["collective"] if sharded_contraction else 0.0
+        )
+        check_s = check_compute_s + check_latency_s
+        eps = self.escalation_rate
+        return Prediction(
+            total_s=quant.total_s + check_s + eps * native.total_s,
+            compute_s=(
+                quant.compute_s + check_compute_s + eps * native.compute_s
+            ),
+            wire_s=quant.wire_s + eps * native.wire_s,
+            latency_s=(
+                quant.latency_s + check_latency_s + eps * native.latency_s
+            ),
+            flops=quant.flops + check_flops + eps * native.flops,
+            a_bytes=int(round(
+                quant.a_bytes + check_bytes + eps * native.a_bytes
+            )),
+            wire_bytes=quant.wire_bytes + eps * native.wire_bytes,
         )
 
     def predict_solver(

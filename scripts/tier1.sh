@@ -255,6 +255,55 @@ print(f"solver smoke ok: cg relres {relres:.2e} in {res.n_iters} iters, "
       f"{compiles} compile(s) across the sweep, 1 typed divergence")
 PY
 
+# Speculative smoke: both verdicts of the two-tier dispatch through a
+# real 8-device distributed build (ops/speculative.py + engine rtol
+# routing; docs/QUANTIZATION.md "speculative serving"). A well-
+# conditioned request must be served from the int8c tier WITHOUT
+# escalating; a cancellation-built adversarial operand must fail the
+# on-device check and escalate to the bitwise-native answer — the
+# escalation counter is asserted both ways, so a check that always
+# accepts OR always rejects fails here in seconds.
+echo "speculative smoke: int8c accept + forced escalation, counter both ways"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import numpy as np
+from matvec_mpi_multiplier_tpu import MatvecEngine, make_mesh
+
+mesh = make_mesh(8)
+rng = np.random.default_rng(0)
+a_ok = rng.uniform(0.0, 10.0, (64, 256)).astype(np.float32)
+x_ok = rng.uniform(0.0, 10.0, 256).astype(np.float32)
+
+clean = MatvecEngine(a_ok, mesh, strategy="rowwise", promote=None,
+                     dtype_storage="speculate")
+y = clean.submit(x_ok, rtol=1e-3).result()
+oracle = a_ok.astype(np.float64) @ x_ok.astype(np.float64)
+rel = np.linalg.norm(y - oracle) / np.linalg.norm(oracle)
+assert rel <= 1e-3, f"accepted candidate off budget: {rel:.2e}"
+h = clean.health()
+assert h["counters"]["speculative_dispatches"] == 1, h["counters"]
+assert h["counters"]["escalations"] == 0, "clean operand escalated"
+
+# Catastrophic cancellation: Ax ~ 0 while the int8c grid error stays at
+# the grid scale, so the candidate's RELATIVE error explodes.
+a_bad = rng.standard_normal((64, 256)).astype(np.float64)
+x_bad = rng.standard_normal(256).astype(np.float64)
+a_bad -= np.outer(a_bad @ x_bad, x_bad) / float(x_bad @ x_bad)
+a_bad, x_bad = a_bad.astype(np.float32), x_bad.astype(np.float32)
+spec = MatvecEngine(a_bad, mesh, strategy="rowwise", promote=None,
+                    dtype_storage="speculate")
+plain = MatvecEngine(a_bad, mesh, strategy="rowwise", promote=None)
+y_bad = spec.submit(x_bad, rtol=1e-3).result()
+h = spec.health()
+assert h["counters"]["escalations"] == 1, "adversarial operand accepted"
+assert np.array_equal(y_bad, plain.submit(x_bad).result()), (
+    "escalated answer != native answer"
+)
+print(f"speculative smoke ok: accept relerr {rel:.2e}, escalation "
+      f"rate {h['storage']['escalation_rate']:.1f} on the adversary, "
+      "escalated answer bitwise-native")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

@@ -857,6 +857,58 @@ def test_golden_schedule_pins_solver_loops():
         )
 
 
+def test_golden_schedule_pins_speculative_lowering():
+    """The speculative-dispatch pins (ISSUE 16, docs/QUANTIZATION.md
+    "speculative serving"): every strategy×combine in the speculative
+    audit table is pinned, each fused candidate+check program's census
+    is its int8c counterpart's plus AT MOST one extra all-reduce whose
+    payload is the s-scalar check psum (never a full-width collective —
+    the check must not smuggle the native product back in), and each
+    lowers its accept verdict as a device predicate output (``i1``) —
+    the escalate decision syncs nothing until result()."""
+    from matvec_mpi_multiplier_tpu.ops.speculative import (
+        SPEC_RTOL_FLOOR,
+        probe_count,
+    )
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        SPEC_AUDIT_CONFIGS,
+    )
+
+    payload = _golden()
+    spec = payload["speculative"]
+    assert set(spec) == {cfg.key for cfg in SPEC_AUDIT_CONFIGS}
+    m = payload["operand"]["m"]
+    itemsize = {"float32": 4, "float64": 8, "bfloat16": 2}[
+        payload["operand"]["dtype"]
+    ]
+    s = probe_count(SPEC_RTOL_FLOOR)
+    for key, entry in spec.items():
+        assert entry["probes"] == s, key
+        assert entry["pred_outputs"] >= 1, (
+            f"{key}: no i1 device output — the verdict would need a "
+            "host sync inside the dispatch"
+        )
+        census, bytes_ = entry["census"], entry["payload_bytes"]
+        assert set(census) <= _CENSUS_KINDS, key
+        assert set(census) == set(bytes_), key
+        # The smuggling bound: the fused program's whole collective
+        # payload fits inside one output combine plus the s-scalar check
+        # psum. An operand-sized collective (k or m×k elements — the
+        # native product shipped back under the speculative label) is
+        # orders of magnitude over this and fails loudly. Whether the
+        # census EQUALS the int8c counterpart's + exactly one reduction
+        # is the live auditor's job (spec_findings re-lowers both).
+        total = sum(bytes_.values())
+        assert total % itemsize == 0, key
+        assert total <= (m + s) * itemsize, (
+            f"{key}: {total} B of collective payload — more than the "
+            f"output + check psum bound {(m + s) * itemsize} B"
+        )
+    # Where the contraction axis isn't sharded the check adds NOTHING:
+    # the rowwise family's fused program pins an empty census.
+    assert spec["speculate|rowwise|gather"]["census"] == {}
+
+
 # ---- quantized_demo: the committed storage-axis capture (ISSUE 8) ----
 #
 # Artifacts: tuning_cache.json (the v4 sixth-axis race: winners +
@@ -960,6 +1012,97 @@ def test_quantized_demo_metrics_pin_the_storage_gauges():
     assert quant and int(quant[-1]["resident_bytes"]) == int(
         gauges["engine_resident_bytes"]
     )
+
+
+# ---- speculative_demo: the committed two-tier serving capture (ISSUE 16) --
+#
+# Artifacts: out/serve_rowwise.csv (a native baseline row and a
+# speculative row, same seed and width mix) and metrics.json (the
+# speculative run's registry snapshot). The acceptance numbers the demo
+# exists to pin: escalation_rate < 0.05 on the well-conditioned stream,
+# amortized resident-stream bytes <= 0.60x native, compile-free steady
+# phase under speculation. Capture commands in
+# data/speculative_demo/README.md.
+
+SPECULATIVE_DEMO = REPO / "data" / "speculative_demo"
+
+SPEC_DEMO_ESCALATION_BOUND = 0.05
+SPEC_DEMO_BYTES_BOUND = 0.60
+
+
+def _speculative_rows():
+    path = SPECULATIVE_DEMO / "out" / "serve_rowwise.csv"
+    assert path.exists(), (
+        f"missing {path} — recapture per data/speculative_demo/README.md"
+    )
+    rows = read_csv(path)
+    native = [r for r in rows if int(r["speculated"]) == 0]
+    spec = [r for r in rows if int(r["speculated"]) > 0]
+    assert native and spec, (
+        "demo needs both a native baseline row and a speculative row"
+    )
+    return native[-1], spec[-1]
+
+
+def test_speculative_demo_escalation_and_bytes_bounds():
+    native, spec = _speculative_rows()
+    # Same config, same offered stream: the comparison is apples-apples.
+    for col in ("n_rows", "n_cols", "strategy", "n_requests",
+                "total_cols", "max_bucket"):
+        assert native[col] == spec[col], col
+    rate = float(spec["escalation_rate"])
+    assert 0.0 <= rate < SPEC_DEMO_ESCALATION_BOUND, (
+        f"well-conditioned stream escalated at {rate}"
+    )
+    ratio = float(spec["spec_bandwidth_ratio"])
+    assert 0.0 < ratio <= SPEC_DEMO_BYTES_BOUND, (
+        f"amortized speculative stream at {ratio}x native bytes"
+    )
+    # The ratio column is derivable from the committed rows themselves:
+    # (speculative residency + rate x native residency) / native. The
+    # speculative row's resident_bytes carries BOTH tiers (the native
+    # payload stays placed for rtol=None requests and escalations).
+    native_bytes = int(native["resident_bytes"])
+    spec_bytes = int(spec["resident_bytes"]) - native_bytes
+    assert 0 < spec_bytes < native_bytes
+    assert ratio == pytest.approx(
+        (spec_bytes + rate * native_bytes) / native_bytes, abs=5e-4
+    )
+
+
+def test_speculative_demo_serves_compile_free():
+    native, spec = _speculative_rows()
+    for row in (native, spec):
+        assert int(row["compiles_steady"]) == 0, row
+        assert float(row["success_rate"]) == 1.0, row
+    # Both tiers warmed: the speculative row compiles MORE up front
+    # (the fused check programs ride alongside the native set).
+    assert int(spec["compiles_warmup"]) > int(native["compiles_warmup"])
+
+
+def test_speculative_demo_metrics_agree_with_csv():
+    import json
+
+    path = SPECULATIVE_DEMO / "metrics.json"
+    assert path.exists(), (
+        f"missing {path} — recapture per data/speculative_demo/README.md"
+    )
+    snap = json.loads(path.read_text())
+    _, spec = _speculative_rows()
+    c, g = snap["counters"], snap["gauges"]
+    assert c["engine_speculative_dispatches_total"] == int(
+        spec["speculated"]
+    )
+    assert g["engine_escalation_rate"] == pytest.approx(
+        float(spec["escalation_rate"]), abs=5e-5
+    )
+    assert c["engine_escalations_total"] == round(
+        g["engine_escalation_rate"]
+        * c["engine_speculative_dispatches_total"]
+    )
+    # No silent speculation disable anywhere in the capture.
+    assert c["engine_storage_fallbacks_total"] == 0
+    assert g["engine_resident_bytes"] == int(spec["resident_bytes"])
 
 
 # --------------------------------------------------------------------------

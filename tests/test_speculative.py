@@ -1,0 +1,256 @@
+"""Speculative quantized dispatch acceptance (ISSUE 16).
+
+The two-tier serving contract (docs/QUANTIZATION.md "speculative
+serving"): ``engine.submit(x, rtol=...)`` on a speculative-armed engine
+serves the int8c candidate fused with the seeded sampled-projection
+check, and the verdict settles at ``result()`` — accept keeps the
+candidate, a miss IS a traced native re-dispatch. Four behavioral
+guarantees pinned here:
+
+* **Never a silent wrong answer** — adversarial operands built to break
+  the int8c grid (catastrophic cancellation: ``y = Ax ≈ 0`` while the
+  quantization error stays at the grid scale) MUST escalate, and the
+  escalated answer is bitwise the native engine's.
+* **No speculation tax on exact requests** — ``rtol=None`` through an
+  armed engine is bitwise-identical to a plain native engine.
+* **Determinism** — the probe set is seeded (`ops/speculative.py::
+  SPEC_SEED`), so two independently constructed engines reach identical
+  verdicts on identical streams.
+* **Typed refusal under chaos** — a poisoned speculative candidate
+  raises ``ResultIntegrityError`` (the gate is FORCED on speculative
+  futures), never serves.
+
+Plus the serving discipline: a 200-request mixed rtol/exact
+mixed-width stream over a warmed engine compiles nothing.
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.engine import MatvecEngine
+from matvec_mpi_multiplier_tpu.ops.speculative import (
+    SPEC_RTOL_FLOOR,
+    eligible,
+    probe_count,
+    probe_matrix,
+)
+from matvec_mpi_multiplier_tpu.resilience import (
+    FaultPlan,
+    FaultSpec,
+    ResultIntegrityError,
+)
+from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+M, K = 64, 256
+RTOL = 1e-3
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _well_conditioned(seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.uniform(0.0, 10.0, (M, K)).astype(np.float32)
+    x = rng.uniform(0.0, 10.0, K).astype(np.float32)
+    return a, x
+
+
+def _adversarial(seed=3):
+    """Operands the int8c tier cannot serve within RTOL: project A's
+    rows against x so the true product nearly cancels (``Ax ≈ 0``)
+    while each row keeps O(1) entries — the quantization error stays at
+    the grid scale, so the RELATIVE error of the candidate explodes and
+    the check must reject."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((M, K)).astype(np.float64)
+    x = rng.standard_normal(K).astype(np.float64)
+    a = a - np.outer(a @ x, x) / float(x @ x)
+    return a.astype(np.float32), x.astype(np.float32)
+
+
+def _engine(a, mesh, **kw):
+    kw.setdefault("strategy", "rowwise")
+    kw.setdefault("promote", 2)
+    kw.setdefault("max_bucket", 8)
+    return MatvecEngine(a, mesh, dtype_storage="speculate", **kw)
+
+
+# ------------------------------------------------- acceptance contract
+
+
+def test_well_conditioned_stream_never_escalates(mesh):
+    a, x = _well_conditioned()
+    engine = _engine(a, mesh)
+    oracle = a.astype(np.float64) @ x.astype(np.float64)
+    for _ in range(5):
+        y = engine.submit(x, rtol=RTOL).result()
+        rel = np.linalg.norm(y - oracle) / np.linalg.norm(oracle)
+        assert rel <= RTOL
+    h = engine.health()
+    assert h["counters"]["speculative_dispatches"] == 5
+    assert h["counters"]["escalations"] == 0
+    assert h["storage"]["escalation_rate"] == 0.0
+    assert h["storage"]["speculative"] is True
+
+
+def test_adversarial_operand_escalates_and_answer_is_native(mesh):
+    a, x = _adversarial()
+    spec = _engine(a, mesh)
+    plain = MatvecEngine(a, mesh, strategy="rowwise", promote=2,
+                         max_bucket=8)
+    y = spec.submit(x, rtol=RTOL).result()
+    h = spec.health()
+    assert h["counters"]["speculative_dispatches"] == 1
+    assert h["counters"]["escalations"] == 1, (
+        "the cancellation operand must fail the on-device check"
+    )
+    assert h["storage"]["escalation_rate"] == 1.0
+    # The escalated answer IS the native answer — bitwise, not approx.
+    np.testing.assert_array_equal(y, plain.submit(x).result())
+
+
+def test_gemm_block_escalates_per_chunk(mesh):
+    a, x = _adversarial()
+    engine = _engine(a, mesh)
+    plain = MatvecEngine(a, mesh, strategy="rowwise", promote=2,
+                         max_bucket=8)
+    xb = np.stack([x, x + np.float32(0.25), 2 * x], axis=1)
+    y = engine.submit(xb, rtol=RTOL).result()
+    assert y.shape == (M, 3)
+    h = engine.health()
+    assert h["counters"]["escalations"] >= 1
+    np.testing.assert_array_equal(y, plain.submit(xb).result())
+
+
+def test_rtol_none_is_bitwise_native(mesh):
+    a, x = _well_conditioned(seed=1)
+    armed = _engine(a, mesh)
+    plain = MatvecEngine(a, mesh, strategy="rowwise", promote=2,
+                         max_bucket=8)
+    y_armed = armed.submit(x).result()
+    np.testing.assert_array_equal(y_armed, plain.submit(x).result())
+    assert armed.health()["counters"]["speculative_dispatches"] == 0
+
+
+def test_sub_floor_rtol_serves_native(mesh):
+    a, x = _well_conditioned(seed=2)
+    engine = _engine(a, mesh)
+    tight = SPEC_RTOL_FLOOR / 10.0
+    assert not eligible(tight)
+    y = engine.submit(x, rtol=tight).result()
+    assert engine.health()["counters"]["speculative_dispatches"] == 0
+    np.testing.assert_allclose(
+        y, a.astype(np.float64) @ x.astype(np.float64), rtol=1e-5
+    )
+
+
+def test_nonpositive_rtol_rejected(mesh):
+    a, x = _well_conditioned(seed=2)
+    engine = _engine(a, mesh)
+    with pytest.raises(ConfigError):
+        engine.submit(x, rtol=0.0)
+    with pytest.raises(ConfigError):
+        engine.submit(x, rtol=-1e-3)
+
+
+# ------------------------------------------------------- determinism
+
+
+def test_probe_set_is_seeded_and_shared():
+    s = probe_count(SPEC_RTOL_FLOOR)
+    np.testing.assert_array_equal(
+        probe_matrix(s, M, np.float32), probe_matrix(s, M, np.float32)
+    )
+
+
+def test_verdicts_deterministic_across_engines(mesh):
+    """Two independently constructed engines draw the same probes
+    (SPEC_SEED), so a given request meets the same verdict in both —
+    speculation is reproducible, not a per-process coin flip."""
+    a_bad, x_bad = _adversarial()
+    a_ok, x_ok = _well_conditioned()
+    for a, x, esc in ((a_bad, x_bad, 1), (a_ok, x_ok, 0)):
+        e1, e2 = _engine(a, mesh), _engine(a, mesh)
+        y1 = e1.submit(x, rtol=RTOL).result()
+        y2 = e2.submit(x, rtol=RTOL).result()
+        np.testing.assert_array_equal(y1, y2)
+        assert e1.health()["counters"]["escalations"] == esc
+        assert e2.health()["counters"]["escalations"] == esc
+
+
+# ------------------------------------------------------------- chaos
+
+
+@pytest.mark.chaos
+def test_poisoned_candidate_fails_typed_never_served(mesh):
+    """A silently corrupted speculative candidate must raise
+    ``ResultIntegrityError`` at result() even with the optional
+    integrity gate OFF — the caller declared a tolerance, so the gate
+    is forced on speculative futures (engine/core.py::submit)."""
+    a, x = _well_conditioned()
+    engine = _engine(
+        a, mesh,
+        fault_plan=FaultPlan(
+            [FaultSpec(site="dispatch", kind="nan", times=1)]
+        ),
+    )
+    assert engine.integrity_gate is False
+    fut = engine.submit(x, rtol=RTOL)
+    with pytest.raises(ResultIntegrityError):
+        fut.result()
+    h = engine.health()
+    assert h["counters"]["integrity_failures"] == 1
+    # The refusal is cached, not re-counted; the stream recovers.
+    with pytest.raises(ResultIntegrityError):
+        fut.result()
+    assert engine.health()["counters"]["integrity_failures"] == 1
+    y = engine.submit(x, rtol=RTOL).result()
+    assert np.all(np.isfinite(y))
+
+
+# ------------------------------------------------- serving discipline
+
+
+def test_mixed_stream_compiles_nothing_after_warmup(mesh):
+    """200 requests mixing exact (rtol=None) and speculative traffic
+    across the width mix: zero steady-phase compiles — both tiers ride
+    the warmed ExecKey set, and escalations re-dispatch through already
+    -compiled native executables."""
+    a, _ = _well_conditioned()
+    engine = _engine(a, mesh)
+    widths = (1, 2, 3, 4, 6, 8)
+    engine.warmup(widths)
+    rng = np.random.default_rng(7)
+    pool = {
+        w: rng.uniform(0.0, 10.0, (K, w)).astype(np.float32)
+        for w in widths
+    }
+    # Cover every (width, tier) pair once inside the warm phase. An
+    # escalation needs no executable of its own: the miss re-dispatches
+    # through the same native ExecKeys the exact submissions warm here.
+    warm = []
+    for w in widths:
+        xw = pool[w][:, 0] if w == 1 else pool[w]
+        warm.append(engine.submit(xw))
+        warm.append(engine.submit(xw, rtol=RTOL))
+    for f in warm:
+        f.result()
+    compiles_warm = engine.stats.compiles
+
+    futures = []
+    for i, w in enumerate(rng.choice(widths, size=200)):
+        xw = pool[w][:, 0] if w == 1 else pool[w]
+        futures.append(
+            engine.submit(xw, rtol=RTOL if i % 2 else None)
+        )
+    for f in futures:
+        f.result()
+    h = engine.health()
+    assert engine.stats.compiles == compiles_warm, (
+        "steady phase must be compile-free across both tiers"
+    )
+    assert h["counters"]["speculative_dispatches"] > 0
+    assert h["counters"]["escalations"] == 0
