@@ -1500,8 +1500,8 @@ def run_serve(
 # whole --op axis and convergence failures mean something.
 
 SOLVER_CSV_HEADER = (
-    "n, n_devices, strategy, dtype, combine, op, rtol, maxiter, "
-    "n_solves, iterations, final_residual, final_value, "
+    "n, n_devices, strategy, dtype, combine, op, solver_kernel, rtol, "
+    "maxiter, n_solves, iterations, final_residual, final_value, "
     "time_per_iter_ms, solve_p50_ms, solve_p99_ms, wall_s, "
     "solves_per_s, compiles_warmup, compiles_steady, divergences"
 )
@@ -1525,6 +1525,7 @@ class SolverServeResult:
     dtype: str
     combine: str
     op: str
+    solver_kernel: str
     rtol: float
     maxiter: int
     n_solves: int
@@ -1562,6 +1563,7 @@ def append_solver_result(result: SolverServeResult, root=None):
     row = (
         f"{result.n}, {result.n_devices}, {result.strategy}, "
         f"{result.dtype}, {result.combine}, {result.op}, "
+        f"{result.solver_kernel}, "
         f"{result.rtol:g}, {result.maxiter}, {result.n_solves}, "
         f"{result.iterations}, {result.final_residual:.6e}, "
         f"{result.final_value:.6e}, {result.time_per_iter_ms:.4f}, "
@@ -1609,10 +1611,12 @@ def run_serve_solver(
     op: str,
     dtype: str = "float32",
     kernel: str = "xla",
+    solver_kernel: str = "xla",
     combine: str | None = None,
     stages: int | None = None,
     dtype_storage: str | None = None,
     rtol: float = 1e-6,
+    rtol_sweep: "Sequence[float] | None" = None,
     maxiter: int | None = None,
     restart: int | None = None,
     steps: int | None = None,
@@ -1633,6 +1637,16 @@ def run_serve_solver(
     executable, and the row's ``compiles_steady`` must be 0.
     ``SolverDivergedError`` is counted and tolerated (availability is
     the measurement); any other failure aborts the run.
+
+    ``solver_kernel`` selects the iteration tier (``"xla"`` /
+    ``"pallas_fused"`` / ``"auto"`` — engine/core.py): the
+    ``--solver-kernel`` A/B that measures the fused tier's
+    iteration-latency floor (``data/fused_solver_demo/``).
+    ``rtol_sweep`` cycles the steady solves across a tolerance ladder
+    instead of one fixed rtol — every solve still hits the SAME warm
+    executable (rtol is a dynamic operand), so a sweep row proves
+    ``compiles_steady == 0`` across the whole ladder, not just at one
+    point; the CSV's rtol column records the tightest swept value.
     """
     from ..engine.core import DEFAULT_SOLVER_MAXITER
 
@@ -1644,7 +1658,8 @@ def run_serve_solver(
     interval = gershgorin_interval(a) if op == "chebyshev" else None
     registry = MetricsRegistry()
     engine = MatvecEngine(
-        a, mesh, strategy=strategy_name, kernel=kernel, combine=combine,
+        a, mesh, strategy=strategy_name, kernel=kernel,
+        solver_kernel=solver_kernel, combine=combine,
         stages=stages, dtype_storage=dtype_storage, dtype=dtype,
         donate=donate, metrics=registry, trace_jsonl=trace_jsonl,
     )
@@ -1659,9 +1674,11 @@ def run_serve_solver(
         for _ in range(n_solves + 1)
     ]
 
-    def solve(b):
+    rtols = tuple(rtol_sweep) if rtol_sweep else (rtol,)
+
+    def solve(b, i=0):
         return engine.submit(
-            op=op, rhs=b, rtol=rtol, maxiter=maxiter,
+            op=op, rhs=b, rtol=rtols[i % len(rtols)], maxiter=maxiter,
             restart=restart, steps=steps, interval=interval,
         ).result()
 
@@ -1684,7 +1701,7 @@ def run_serve_solver(
     for i in range(n_solves):
         t0 = time.perf_counter()
         try:
-            res = solve(rhs_pool[i])
+            res = solve(rhs_pool[i], i)
         except SolverDivergedError:
             divergences += 1
             continue
@@ -1717,7 +1734,8 @@ def run_serve_solver(
         dtype=str(engine.dtype),
         combine=combine or "default",
         op=op,
-        rtol=rtol,
+        solver_kernel=solver_kernel,
+        rtol=min(rtols),
         maxiter=DEFAULT_SOLVER_MAXITER if maxiter is None else int(maxiter),
         n_solves=n_solves,
         iterations=last_iters,
@@ -1886,7 +1904,11 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                             dtype_storage=getattr(
                                 args, "dtype_storage", None
                             ),
+                            solver_kernel=getattr(
+                                args, "solver_kernel", "xla"
+                            ) or "xla",
                             rtol=getattr(args, "rtol", 1e-6),
+                            rtol_sweep=getattr(args, "rtol_sweep", None),
                             maxiter=getattr(args, "maxiter", None),
                             restart=getattr(args, "restart", None),
                             steps=getattr(args, "steps", None),
@@ -1904,7 +1926,8 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                         path = None
                     print(
                         f"serve-solver {result.op} {name} {m}x{m} "
-                        f"p={n_dev} solves={result.n_solves} "
+                        f"p={n_dev} tier={result.solver_kernel} "
+                        f"solves={result.n_solves} "
                         f"iters={result.iterations} "
                         f"resid={result.final_residual:.3e} "
                         f"t/iter={result.time_per_iter_ms:.3f}ms "
@@ -2213,9 +2236,23 @@ def build_parser() -> argparse.ArgumentParser:
         "serve_solver_<strategy>.csv",
     )
     p.add_argument(
+        "--solver-kernel", default="xla",
+        choices=["xla", "pallas_fused", "auto"],
+        help="with --op cg|chebyshev: the iteration tier — XLA's fusion "
+        "schedule, the fused Pallas whole-iteration kernel "
+        "(ops/pallas_solver.py; interpret-gated off-TPU), or the tuned "
+        "decision (tuning.lookup_solver_kernel)",
+    )
+    p.add_argument(
         "--rtol", type=float, default=1e-6,
         help="with --op <solver>: relative convergence tolerance (a "
         "DYNAMIC operand — changing it never recompiles)",
+    )
+    p.add_argument(
+        "--rtol-sweep", nargs="+", type=float, default=None,
+        help="with --op <solver>: cycle steady solves across this rtol "
+        "ladder instead of one fixed --rtol — proves compiles_steady=0 "
+        "across the whole ladder (rtol is a dynamic operand)",
     )
     p.add_argument(
         "--maxiter", type=int, default=None,

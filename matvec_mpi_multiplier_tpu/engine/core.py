@@ -409,6 +409,8 @@ class SolverFuture:
         iter_hist=None,
         divergence_counter=None,
         residual_gauge=None,
+        iter_time_hist=None,
+        dispatch_t0: float | None = None,
     ):
         self._res = res
         self.op = op
@@ -423,6 +425,8 @@ class SolverFuture:
         self._iter_hist = iter_hist
         self._divergence_counter = divergence_counter
         self._residual_gauge = residual_gauge
+        self._iter_time_hist = iter_time_hist
+        self._dispatch_t0 = dispatch_t0
 
     @classmethod
     def failed(
@@ -472,6 +476,14 @@ class SolverFuture:
                 self._iter_hist.observe(n_iters)
             if self._residual_gauge is not None:
                 self._residual_gauge.set(rnorm)
+            if self._iter_time_hist is not None and self._dispatch_t0 is not None:
+                # Total solve wall time amortized per iteration — the
+                # number the fused tier exists to lower (device wait
+                # included: result() IS the solve's completion point).
+                self._iter_time_hist.observe(
+                    (time.perf_counter() - self._dispatch_t0)
+                    * 1e3 / max(n_iters, 1)
+                )
             if not np.all(np.isfinite(x)) or not np.isfinite(rnorm):
                 if self._integrity_counter is not None:
                     err = refuse_nonfinite(
@@ -661,6 +673,7 @@ class MatvecEngine:
         *,
         strategy: str | MatvecStrategy = "rowwise",
         kernel: str | Callable = "xla",
+        solver_kernel: str = "xla",
         combine: str | None = None,
         stages: int | str | None = None,
         dtype_storage: str | None = None,
@@ -702,6 +715,22 @@ class MatvecEngine:
                 f"{gather_output!r}"
             )
         self.kernel = kernel
+        # The solver-path iteration tier (docs/SOLVERS.md "Fused iteration
+        # tier"): "xla" is the established per-HLO body, "pallas_fused"
+        # the one-kernel-per-iteration tier (ops/pallas_solver.py), "auto"
+        # the tuner-backed choice (tune_solver_kernel; xla on a cache
+        # miss). Orthogonal to `kernel`, which names the LOCAL GEMV tile
+        # kernel inside the XLA tier's matvec.
+        if solver_kernel not in ("xla", "pallas_fused", "auto"):
+            raise ConfigError(
+                f"solver_kernel must be 'xla', 'pallas_fused' or 'auto'; "
+                f"got {solver_kernel!r}"
+            )
+        self.solver_kernel = solver_kernel
+        # The REQUESTED combine, kept for the fused solver tier: the
+        # fused body owns its own combine spelling, so it must see the
+        # user's ask (None/"auto"/explicit), not the matvec-tuned winner.
+        self._requested_combine = combine
         self.gather_output = gather_output
         self.max_bucket = max_bucket
         self._donate = DONATE_ARGNUMS if donate else ()
@@ -813,6 +842,16 @@ class MatvecEngine:
                 self._matvec_combine = None
             if self._gemm_combine in STORAGE_INCOMPATIBLE_COMBINES:
                 self._gemm_combine = None
+        if self.solver_kernel == "pallas_fused":
+            # Fail the strategy/combine half of the fused-tier contract at
+            # construction (ShardingError), not requests deep; the op half
+            # (cg/chebyshev only) is submit()'s to check — this engine may
+            # legitimately serve matvec traffic alongside fused solves.
+            from ..ops.pallas_solver import check_fused_solver
+
+            check_fused_solver(
+                "cg", self.strategy.name, self._requested_combine, mesh
+            )
         self.stages = self._resolve_stages(stages)
         self.b_star = self._resolve_promotion(promote)
         if max_in_flight is not None and max_in_flight < 1:
@@ -1498,11 +1537,60 @@ class MatvecEngine:
 
         return builder
 
+    def _resolve_solver_kernel(self, op: str) -> str:
+        """The iteration tier one solve of ``op`` runs: "pallas_fused" or
+        "xla". Explicit "pallas_fused" re-raises the fused tier's typed
+        errors (the strategy/combine half already passed at construction;
+        the op half lands here). "auto" asks the tuning cache
+        (``tune_solver_kernel``'s axis) and stays on the established XLA
+        tier on a miss — the tuner, not a default, flips the switch."""
+        sk = self.solver_kernel
+        if sk == "xla" or op not in ("cg", "chebyshev"):
+            if sk == "pallas_fused":
+                from ..ops.pallas_solver import check_fused_solver
+
+                check_fused_solver(
+                    op, self.strategy.name, self._requested_combine,
+                    self.mesh,
+                )
+            return "xla"
+        if sk == "pallas_fused":
+            return "pallas_fused"
+        from ..ops.pallas_solver import fused_solver_supported
+
+        if not fused_solver_supported(
+            op, self.strategy.name, self._requested_combine, self.mesh
+        ):
+            return "xla"
+        from ..tuning import lookup_solver_kernel
+
+        decision = lookup_solver_kernel(
+            op=op, strategy=self.strategy.name, m=self.m, k=self.k,
+            p=mesh_size(self.mesh), dtype=str(self.dtype),
+            storage=self.storage,
+        )
+        if decision is None:
+            return "xla"
+        return decision.get("solver_kernel") or "xla"
+
     def _solver_key(self, op: str, bucket: int) -> ExecKey:
         """A solver executable's cache identity: the matvec key with the
         op swapped in and the op's static shape parameter (GMRES restart,
         Lanczos steps) in the bucket field — differing rtol/maxiter
-        values are dynamic operands, never new keys."""
+        values are dynamic operands, never new keys. A fused-tier solve
+        keys on kernel="pallas_fused" and the fused body's canonical
+        combine — honest identity for the artifact actually compiled."""
+        if self._resolve_solver_kernel(op) == "pallas_fused":
+            from ..ops.pallas_solver import check_fused_solver
+
+            return ExecKey(
+                op, self.strategy.name, "pallas_fused",
+                check_fused_solver(
+                    op, self.strategy.name, self._requested_combine,
+                    self.mesh,
+                ),
+                bucket, str(self.dtype), self.storage,
+            )
         return ExecKey(
             op, self.strategy.name, self._kernel_label(),
             self._combine_label(self._matvec_combine), bucket,
@@ -1603,13 +1691,21 @@ class MatvecEngine:
         if levels is not None:
             return levels
         preferred = self._solver_key(op, bucket)
-        levels = [(
-            preferred,
-            self._solver_builder_for(
+        if self._resolve_solver_kernel(op) == "pallas_fused":
+            # The fused tier: build_solver routes kernel="pallas_fused"
+            # to ops/pallas_solver.py. It sees the REQUESTED combine
+            # (the fused body owns its combine spelling) and no stages
+            # (nothing left to overlap with).
+            preferred_builder = self._solver_builder_for(
+                op, "pallas_fused", self._requested_combine, None,
+                restart=restart, steps=steps,
+            )
+        else:
+            preferred_builder = self._solver_builder_for(
                 op, self.kernel, self._matvec_combine, self.stages,
                 restart=restart, steps=steps,
-            ),
-        )]
+            )
+        levels = [(preferred, preferred_builder)]
         safe_key = ExecKey(
             op, self.strategy.name, SAFE_KERNEL, None, bucket,
             str(self.dtype), NATIVE,
@@ -2293,6 +2389,12 @@ class MatvecEngine:
                     "solver_residual_norm",
                     "true residual norm of the last materialized solve",
                 ),
+                self.metrics.histogram(
+                    "solver_iteration_time",
+                    "per-iteration solve wall time, ms (submit-to-"
+                    "materialize / n_iters) — the fused tier's win, "
+                    "visible in the obs solvers panel",
+                ),
             )
         return self._solver_metrics
 
@@ -2342,15 +2444,25 @@ class MatvecEngine:
                     "'lanczos'; docs/SOLVERS.md)"
                 )
             lo, hi = float(interval[0]), float(interval[1])
-            if not (0.0 < lo <= hi):
+            # Strictly ordered: reversed endpoints flip the recurrence's
+            # sign structure and a zero-width interval makes c = 0 with
+            # d = lo, degenerating the semi-iteration to a fixed-point
+            # scheme the convergence theory doesn't cover — both are
+            # config mistakes, caught here as typed errors rather than
+            # discovered as a maxiter'd divergence.
+            if not (0.0 < lo < hi):
                 raise ConfigError(
-                    f"chebyshev interval needs 0 < lambda_min <= "
-                    f"lambda_max; got ({lo}, {hi})"
+                    f"chebyshev interval needs 0 < lambda_min < "
+                    f"lambda_max (strict: a reversed or zero-width "
+                    f"interval has no convergent semi-iteration); got "
+                    f"({lo}, {hi})"
                 )
         else:
             lo = hi = 0.0
         bucket = solver_bucket(op, restart=restart, steps=steps)
-        c_requests, iter_hist, c_div, g_resid = self._solver_metric_handles()
+        (
+            c_requests, iter_hist, c_div, g_resid, iter_time_hist,
+        ) = self._solver_metric_handles()
         c_requests.inc()
         trace = self.tracer.start(cols=1, kind=op)
 
@@ -2403,6 +2515,8 @@ class MatvecEngine:
                     ),
                     iter_hist=iter_hist, divergence_counter=c_div,
                     residual_gauge=g_resid,
+                    iter_time_hist=iter_time_hist,
+                    dispatch_t0=time.perf_counter(),
                 )
                 self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
                 return fut

@@ -376,6 +376,7 @@ def render_solvers(snapshot: dict) -> str | None:
     hists = snapshot.get("histograms", {})
     gauges = snapshot.get("gauges", {})
     iters = hists.get("solver_iterations", {})
+    iter_time = hists.get("solver_iteration_time", {})
     requests = counters.get("solver_requests_total", 0)
     diverged = counters.get("solver_divergences_total", 0)
     out = [
@@ -384,6 +385,9 @@ def render_solvers(snapshot: dict) -> str | None:
         f"  iterations p50    {iters.get('p50', float('nan')):.0f} "
         f"(p95 {iters.get('p95', float('nan')):.0f}, "
         f"n={iters.get('count', 0)})",
+        f"  iter time p50     {iter_time.get('p50', float('nan')):.3f} ms "
+        f"(p95 {iter_time.get('p95', float('nan')):.3f} — per-iteration "
+        "solve wall time, the fused tier's floor)",
         f"  divergences       {diverged} "
         f"(typed SolverDivergedError; "
         f"{(diverged / requests) if requests else float('nan'):.3f} of "

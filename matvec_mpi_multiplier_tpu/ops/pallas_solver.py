@@ -1,0 +1,462 @@
+"""Fused Pallas solver step: one kernel per CG/Chebyshev iteration.
+
+The served solvers (``solvers/ops.py``) compile to one ``lax.while_loop``,
+but each iteration's body still lowers as separate HLOs — local GEMV,
+collective, two axpys, two dot-reductions — and every one of those pays a
+kernel launch plus an HBM round-trip for ``x``/``r``/``p``, vectors small
+enough to live in VMEM for the whole step. This module is the fused tier:
+the ENTIRE fixed-recurrence iteration (vector updates, residual
+dot-reduction, and the next local GEMV tile loop) folds into ONE
+``pallas_call``, so the while body lowers to exactly one kernel plus the
+strategy's S collective hops — the census ``hlo-fused-solver`` pins
+(docs/STATIC_ANALYSIS.md).
+
+The trick is a loop rotation. The textbook body needs ``A@p`` *before* the
+axpys, which would split the kernel around the collective. Rotated, the
+while carry holds the already-combined ``ap = A@p`` from the previous
+step, and the kernel (a) applies the pending updates at grid step (0, 0) —
+device-local arithmetic on replicated vectors, written once into output
+blocks with constant index maps that stay VMEM-resident across the whole
+grid — then (b) streams the local A tiles against the freshly written
+``p`` block, reading it straight back out of the output ref (the grid is
+sequential and step (0, 0) runs first, so later tiles see the updated
+direction without an HBM round-trip). The partial GEMV leaves the kernel
+once per iteration and meets the body's single collective: ``psum`` for
+colwise shards, a tiled ``all_gather`` for rowwise. The prologue pays one
+extra matvec to seed ``ap``; the honesty rules are unchanged — the loop
+may exit on the recurrence, but ``converged`` is decided by a TRUE
+residual computed after it (``solvers/ops.py``'s verified-exit doctrine).
+
+The quantized variant fuses ``ops/pallas_quant.py``'s scale-and-multiply
+into the same kernel: int8/int8c/fp8 tiles upcast (bm, bk) at a time
+inside VMEM, so a quantized-resident solve never materializes a
+dequantized ``A`` (the ``hlo-early-dequant`` doctrine, extended to the
+fused path).
+
+Off-TPU the kernel runs in interpret mode (same code path, CPU-testable);
+shapes that admit no aligned tiling on TPU fall back to a jnp-bodied step
+with identical rotated arithmetic (the quantized fallback is
+``matvec_quantized``'s scan) — still one collective per body, just no
+fused kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..solvers.common import (
+    SolverResult,
+    convergence_threshold,
+    diverged,
+    keep_iterating,
+    residual_norm,
+)
+from ..utils.compat import shard_map
+from ..utils.errors import ConfigError, ShardingError
+from .pallas_gemv import DEFAULT_BK, DEFAULT_BM, _largest_divisor_leq, _on_tpu
+from .quantize import NATIVE, QuantizedMatrix, matvec_quantized, normalize_storage
+
+# The fixed-recurrence ops the fused tier serves. GMRES's Arnoldi and
+# Lanczos's reorthogonalization need the full basis in the body — no
+# single-kernel rotation exists for them; power's body is already one
+# matvec plus O(n) vector work.
+FUSED_SOLVER_OPS: tuple[str, ...] = ("cg", "chebyshev")
+
+# strategy name -> (canonical combine, other accepted requests). The fused
+# body owns its combine spelling — one psum for colwise shards, one tiled
+# all_gather for rowwise — so only the matching request (or the defaults
+# "auto"/None, which defer) validates. Ring/overlap schedules interleave
+# the collective WITH the GEMV; fusing the GEMV into one kernel removes
+# the thing they overlap with.
+_FUSED_COMBINES: dict[str, str] = {"rowwise": "gather", "colwise": "psum"}
+
+def fused_solver_supported(
+    op: str, strategy_name: str, combine: str | None, mesh: Mesh
+) -> bool:
+    """True when the fused tier can serve (op, strategy, combine) on this
+    mesh — the ``kernel="auto"`` gate."""
+    try:
+        check_fused_solver(op, strategy_name, combine, mesh)
+        return True
+    except (ConfigError, ShardingError):
+        return False
+
+
+def check_fused_solver(
+    op: str, strategy_name: str, combine: str | None, mesh: Mesh
+) -> str:
+    """Validate a fused-tier request; returns the resolved combine label.
+
+    Raises :class:`ConfigError` for an op outside the fixed-recurrence
+    pair and :class:`ShardingError` for a strategy/combine pair the fused
+    body cannot spell — at validate time, per the engine's typed-error
+    doctrine, never as a trace failure inside the artifact build."""
+    if op not in FUSED_SOLVER_OPS:
+        raise ConfigError(
+            f"kernel='pallas_fused' serves the fixed-recurrence ops "
+            f"{FUSED_SOLVER_OPS}; got op={op!r}. Use kernel='xla' (or "
+            f"'auto', which falls back) for the basis-building ops."
+        )
+    canonical = _FUSED_COMBINES.get(strategy_name)
+    if canonical is None:
+        raise ShardingError(
+            f"kernel='pallas_fused' supports the flat-axis "
+            f"{tuple(_FUSED_COMBINES)} strategies; got strategy="
+            f"{strategy_name!r} (blockwise's 2-D shards split the "
+            f"direction vector across both mesh axes — no single-kernel "
+            f"spelling exists)."
+        )
+    if combine not in (None, "auto", canonical):
+        raise ShardingError(
+            f"kernel='pallas_fused' owns the solve body's combine — "
+            f"{strategy_name} lowers exactly one {canonical!r} hop per "
+            f"iteration; combine={combine!r} has no fused spelling. "
+            f"Request combine=None/'auto'/{canonical!r} or kernel='xla'."
+        )
+    return canonical
+
+
+def fused_tiles(
+    m_loc: int, k_loc: int, itemsize: int, *, on_tpu: bool,
+    block: int | None = None,
+) -> tuple[int, int] | None:
+    """(bm, bk) tiling of the LOCAL A shard for the fused step kernel, or
+    None when the TPU lane/sublane alignment admits nothing (the jnp
+    fallback then serves the shape). Interpret mode accepts any divisor —
+    the CPU audit/CI shapes are far below the 128-lane minimum. ``block``
+    (quantized storage's group length) must divide bk so each tile holds
+    whole scale groups."""
+    if on_tpu:
+        bm = _largest_divisor_leq(m_loc, DEFAULT_BM, 8)
+        bk_mult = 128 if block is None else max(128, block)
+    else:
+        bm = _largest_divisor_leq(m_loc, DEFAULT_BM, 1)
+        bk_mult = block or 1
+    if bm is None:
+        return None
+    bk = _largest_divisor_leq(k_loc, DEFAULT_BK, bk_mult)
+    if bk is None:
+        return None
+    return bm, bk
+
+
+def _write_update(op, refs, sin_ref, xo_ref, ro_ref, po_ref, so_ref, acc):
+    """The rotated recurrence update — runs ONCE, at grid step (0, 0),
+    writing the (1, n) vector blocks the rest of the grid reads back."""
+    x_ref, r_ref, p_ref, ap_ref = refs
+    x = x_ref[...].astype(acc)
+    r = r_ref[...].astype(acc)
+    p = p_ref[...].astype(acc)
+    ap = ap_ref[...].astype(acc)
+    if op == "cg":
+        rz = sin_ref[0, 0]
+        # pᵀAp > 0 for SPD A; stall (not inf/NaN) on breakdown, exactly
+        # as the XLA tier does, so the loop exits on maxiter.
+        pap = jnp.sum(p * ap)
+        safe = pap > 0
+        alpha = jnp.where(safe, rz / jnp.where(safe, pap, 1.0), 0.0)
+        x2 = x + alpha * p
+        r2 = r - alpha * ap
+        rz2 = jnp.sum(r2 * r2)
+        beta = jnp.where(safe, rz2 / jnp.where(rz != 0, rz, 1.0), 0.0)
+        p2 = r2 + beta * p
+        s_out = jnp.reshape(rz2, (1, 1))
+    else:  # chebyshev
+        alpha = sin_ref[0, 0]
+        kf = sin_ref[0, 1]
+        d = sin_ref[0, 2]
+        c2 = sin_ref[0, 3]
+        x2 = x + alpha * p
+        r2 = r - alpha * ap
+        # Saad Alg. 12.1 with the β/α division folded away, rotated one
+        # step: this body applies step k's α and builds direction k+1,
+        # whose weight is ½c²α (building direction 1) or ¼c²α (k ≥ 1).
+        factor = jnp.where(kf == 0, 0.5, 0.25) * c2 * alpha
+        alpha_next = 1.0 / (d - factor)
+        beta = factor * alpha
+        p2 = r2 + beta * p
+        s_out = jnp.stack([alpha_next, jnp.sum(r2 * r2)]).reshape(1, 2)
+    xo_ref[...] = x2
+    ro_ref[...] = r2
+    po_ref[...] = p2
+    so_ref[...] = s_out
+
+
+def _make_step_kernel(op: str, *, quant: bool, has_q2: bool, block: int):
+    """Build the fused step kernel. Ref order (after the off ref): the A
+    operand's leaves, then x/r/p/ap/s inputs, then xo/ro/po/so/part
+    outputs."""
+
+    def kernel(off_ref, *refs):
+        if quant:
+            a_leaves, rest = refs[: 4 if has_q2 else 2], refs[4 if has_q2 else 2:]
+        else:
+            a_leaves, rest = refs[:1], refs[1:]
+        x_ref, r_ref, p_ref, ap_ref, sin_ref = rest[:5]
+        xo_ref, ro_ref, po_ref, so_ref, part_ref = rest[5:]
+        acc = part_ref.dtype
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when((i == 0) & (j == 0))
+        def _update():
+            _write_update(
+                op, (x_ref, r_ref, p_ref, ap_ref), sin_ref,
+                xo_ref, ro_ref, po_ref, so_ref, acc,
+            )
+
+        # Stream this A tile against the FRESH direction, read straight
+        # back out of the po output block: the grid is sequential with
+        # (0, 0) first, and po's constant index map keeps the block
+        # VMEM-resident across every step — the double-buffering that
+        # keeps p/x/r out of HBM between iterations.
+        bk = a_leaves[0].shape[1]
+        off = off_ref[0, 0]
+        pseg = po_ref[0, pl.ds(off + j * bk, bk)].astype(acc)
+        if quant:
+            nb = bk // block
+            xt = pseg.reshape(nb, block)
+
+            def level(q_ref, s_ref):
+                # pallas_quant's scale-and-multiply, fused: upcast ONE
+                # (bm, bk) tile in VMEM, never a full dequantized A.
+                qt = q_ref[...].astype(acc).reshape(-1, nb, block)
+                return jnp.sum(
+                    s_ref[...].astype(acc) * jnp.sum(qt * xt[None], axis=2),
+                    axis=1, keepdims=True,
+                )
+
+            partial = level(a_leaves[0], a_leaves[1])
+            if has_q2:
+                partial += level(a_leaves[2], a_leaves[3])
+        else:
+            a_tile = a_leaves[0][...].astype(acc)
+            partial = jnp.sum(a_tile * pseg[None, :], axis=1, keepdims=True)
+
+        @pl.when(j == 0)
+        def _init():
+            part_ref[...] = jnp.zeros_like(part_ref)
+
+        part_ref[...] += partial
+
+    return kernel
+
+
+def _fused_step(
+    op, a_leaves, off, x, r, p, ap, s_in, *,
+    quant, has_q2, block, bm, bk, n, m_loc, acc, interpret,
+):
+    """One fused iteration: ONE pallas_call. Returns (x2, r2, p2, s_out,
+    partial) with partial the UNcombined (m_loc,) local GEMV."""
+    kernel = _make_step_kernel(op, quant=quant, has_q2=has_q2, block=block)
+    k_loc = a_leaves[0].shape[1]
+    grid = (m_loc // bm, k_loc // bk)
+    const = pl.BlockSpec((1, n), lambda i, j: (0, 0))
+    a_specs = [pl.BlockSpec((bm, bk), lambda i, j: (i, j))]
+    if quant:
+        a_specs.append(
+            pl.BlockSpec((bm, bk // block), lambda i, j: (i, j))
+        )
+        if has_q2:
+            a_specs = a_specs * 2
+    s_width = 1 if op == "cg" else 4
+    out_width = 1 if op == "cg" else 2
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (0, 0)),  # off
+            *a_specs,
+            const, const, const, const,  # x r p ap
+            pl.BlockSpec((1, s_width), lambda i, j: (0, 0)),
+        ],
+        out_specs=[
+            const, const, const,  # xo ro po
+            pl.BlockSpec((1, out_width), lambda i, j: (0, 0)),
+            pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, n), acc),
+            jax.ShapeDtypeStruct((1, n), acc),
+            jax.ShapeDtypeStruct((1, n), acc),
+            jax.ShapeDtypeStruct((1, out_width), acc),
+            jax.ShapeDtypeStruct((m_loc, 1), acc),
+        ],
+        interpret=interpret,
+    )(
+        off.reshape(1, 1), *a_leaves,
+        x.reshape(1, n), r.reshape(1, n), p.reshape(1, n), ap.reshape(1, n),
+        s_in.reshape(1, s_width),
+    )
+    xo, ro, po, so, part = outs
+    return xo[0], ro[0], po[0], so[0], part[:, 0]
+
+
+def build_fused_solver(
+    op: str,
+    strategy,
+    mesh: Mesh,
+    *,
+    dtype,
+    combine: str | None = None,
+    dtype_storage=None,
+) -> Callable[..., SolverResult]:
+    """The fused tier's counterpart of ``solvers.ops.build_solver`` —
+    same uniform signature ``fn(a, b, rtol, maxiter, p0, p1)``, same
+    SolverResult contract, one shard_map around prologue + while_loop +
+    true-residual verification."""
+    combine_r = check_fused_solver(op, strategy.name, combine, mesh)
+    storage = normalize_storage(dtype_storage)
+    axis = tuple(mesh.axis_names)  # the flat MPI_COMM_WORLD view
+    acc = jnp.promote_types(dtype, jnp.float32)
+    spec_a, _, _ = strategy.specs(mesh)
+    colwise = strategy.name == "colwise"
+    interpret = not _on_tpu()
+
+    def local(a_loc, b, rtol, maxiter, p0, p1):
+        n = b.shape[0]
+        quant = storage != NATIVE
+        if quant:
+            m_loc, k_loc = a_loc.q.shape
+            leaves = [a_loc.q, a_loc.scales]
+            has_q2 = a_loc.q2 is not None
+            if has_q2:
+                leaves += [a_loc.q2, a_loc.scales2]
+            block = a_loc.block
+            itemsize = a_loc.q.dtype.itemsize
+        else:
+            m_loc, k_loc = a_loc.shape
+            leaves, has_q2, block = [a_loc], False, None
+            itemsize = a_loc.dtype.itemsize
+        tiles = fused_tiles(
+            m_loc, k_loc, itemsize, on_tpu=not interpret, block=block
+        )
+        idx = jax.lax.axis_index(axis)
+        off = (idx * k_loc if colwise else jnp.asarray(0)).astype(jnp.int32)
+
+        def _combine(part):
+            if combine_r == "psum":
+                return jax.lax.psum(part, axis)
+            return jax.lax.all_gather(part, axis, tiled=True)
+
+        def local_gemv(v):
+            # The fallback / prologue / verification local partial: honest
+            # tile-wise scan for quantized storage, one dot for native.
+            seg = (
+                jax.lax.dynamic_slice_in_dim(v, off, k_loc) if colwise else v
+            )
+            if quant:
+                return matvec_quantized(a_loc, seg.astype(a_loc.dtype)).astype(acc)
+            return jnp.matmul(
+                a_loc, seg.astype(a_loc.dtype), preferred_element_type=acc
+            )
+
+        def full_mv(v):
+            return _combine(local_gemv(v))
+
+        b_acc = b.astype(acc)
+        b_rr = jnp.sum(b_acc * b_acc)
+        threshold = convergence_threshold(
+            rtol.astype(acc), jnp.sqrt(b_rr)
+        )
+
+        if op == "chebyshev":
+            lmin = p0.astype(acc)
+            lmax = p1.astype(acc)
+            d = (lmax + lmin) / 2
+            c2 = ((lmax - lmin) / 2) ** 2
+
+        if tiles is not None:
+            bm, bk = tiles
+
+            def step(x, r, p, ap, s_in):
+                x2, r2, p2, s_out, part = _fused_step(
+                    op, leaves, off, x, r, p, ap, s_in,
+                    quant=quant, has_q2=has_q2, block=block or 1,
+                    bm=bm, bk=bk, n=n, m_loc=m_loc, acc=acc,
+                    interpret=interpret,
+                )
+                return x2, r2, p2, s_out, part
+        else:
+
+            def step(x, r, p, ap, s_in):
+                # jnp fallback: identical rotated arithmetic, scan-kernel
+                # GEMV — no fused pallas_call, same single collective.
+                if op == "cg":
+                    rz = s_in[0]
+                    pap = jnp.sum(p * ap)
+                    safe = pap > 0
+                    alpha = jnp.where(
+                        safe, rz / jnp.where(safe, pap, 1.0), 0.0
+                    )
+                    x2 = x + alpha * p
+                    r2 = r - alpha * ap
+                    rz2 = jnp.sum(r2 * r2)
+                    beta = jnp.where(
+                        safe, rz2 / jnp.where(rz != 0, rz, 1.0), 0.0
+                    )
+                    p2 = r2 + beta * p
+                    s_out = jnp.stack([rz2])
+                else:
+                    alpha, kf = s_in[0], s_in[1]
+                    x2 = x + alpha * p
+                    r2 = r - alpha * ap
+                    factor = jnp.where(kf == 0, 0.5, 0.25) * c2 * alpha
+                    alpha_next = 1.0 / (d - factor)
+                    p2 = r2 + factor * alpha * p
+                    s_out = jnp.stack([alpha_next, jnp.sum(r2 * r2)])
+                return x2, r2, p2, s_out, local_gemv(p2)
+
+        x0 = jnp.zeros_like(b_acc)
+        ap0 = full_mv(b_acc)  # prologue matvec seeds the rotation
+        if op == "cg":
+            scal0 = (b_rr,)
+        else:
+            scal0 = (1.0 / d, b_rr)
+        state0 = (x0, b_acc, b_acc, ap0, scal0, jnp.asarray(0, jnp.int32))
+
+        def cond(state):
+            _, _, _, _, scal, k = state
+            rr = scal[0] if op == "cg" else scal[1]
+            ok = keep_iterating(jnp.sqrt(rr), threshold, k, maxiter)
+            if op == "chebyshev":
+                # Early divergence exit: a spectral interval excluding
+                # the spectrum amplifies geometrically (solvers/common.py).
+                ok = ok & ~diverged(rr, b_rr)
+            return ok
+
+        def body(state):
+            x, r, p, ap, scal, k = state
+            if op == "cg":
+                s_in = jnp.stack([scal[0]])
+            else:
+                s_in = jnp.stack([scal[0], k.astype(acc), d, c2])
+            x2, r2, p2, s_out, part = step(x, r, p, ap, s_in)
+            ap2 = _combine(part)  # the body's ONE collective hop
+            scal2 = (s_out[0],) if op == "cg" else (s_out[0], s_out[1])
+            return (x2, r2, p2, ap2, scal2, k + 1)
+
+        x, _, _, _, _, k = jax.lax.while_loop(cond, body, state0)
+        # Verified exit: TRUE residual of the returned iterate, one extra
+        # matvec with the same collective set as the body.
+        rnorm = residual_norm(b_acc - full_mv(x))
+        return SolverResult(
+            x=x,
+            value=jnp.asarray(jnp.nan, acc),
+            n_iters=k,
+            residual_norm=rnorm,
+            converged=rnorm <= threshold,
+        )
+
+    rep = P()
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_a, rep, rep, rep, rep, rep),
+        out_specs=rep,
+        check_vma=False,  # vector math is replicated; the combine is manual
+    )

@@ -9,9 +9,11 @@ tracing and metrics all inherit.
 """
 
 from .common import (
+    DIVERGENCE_GROWTH,
     SolverResult,
     above_tolerance,
     convergence_threshold,
+    diverged,
     host_norm,
     keep_iterating,
     residual_norm,
@@ -27,9 +29,11 @@ from .ops import (
 )
 
 __all__ = [
+    "DIVERGENCE_GROWTH",
     "SolverResult",
     "above_tolerance",
     "convergence_threshold",
+    "diverged",
     "host_norm",
     "keep_iterating",
     "residual_norm",

@@ -26,6 +26,24 @@ import jax.numpy as jnp
 from jax import Array
 
 
+# Chebyshev divergence guard, shared by the XLA and fused tiers: once the
+# recurrence residual-squared grows past this factor over ||b||², the
+# semi-iteration is provably running away (a spectral interval that
+# excludes part of the spectrum amplifies the excluded modes
+# geometrically) and the loop exits early — the engine's SolverFuture
+# then raises the typed SolverDivergedError instead of burning maxiter
+# on garbage (docs/SOLVERS.md).
+DIVERGENCE_GROWTH = 1e12
+
+
+def diverged(rr: Array, b_rr: Array) -> Array:
+    """THE divergence predicate for the fixed-interval recurrences:
+    residual-squared non-finite or past :data:`DIVERGENCE_GROWTH` × ||b||².
+    One copy, so the two chebyshev tiers can never drift onto different
+    blow-up thresholds."""
+    return ~jnp.isfinite(rr) | (rr > b_rr * DIVERGENCE_GROWTH)
+
+
 def residual_norm(v: Array) -> Array:
     """THE Euclidean norm every solver stops on: ``sqrt(sum(v*v))``.
 

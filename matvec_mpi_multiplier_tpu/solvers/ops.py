@@ -50,6 +50,7 @@ from ..models.base import MatvecStrategy
 from .common import (
     SolverResult,
     convergence_threshold,
+    diverged,
     keep_iterating,
     residual_norm,
 )
@@ -140,6 +141,24 @@ def build_solver(
         raise ValueError(f"restart must be >= 1, got {restart}")
     if op == "lanczos" and steps < 2:
         raise ValueError(f"lanczos needs steps >= 2, got {steps}")
+    # The fused iteration tier (ops/pallas_solver.py): the whole
+    # fixed-recurrence body in one pallas_call + S collective hops.
+    # "pallas_fused" demands it (typed ShardingError/ConfigError when the
+    # (op, strategy, combine) triple has no fused spelling); "auto" takes
+    # it when supported and falls back to the XLA tier otherwise. Lazy
+    # import: ops.pallas_solver imports solvers.common, and this module
+    # loads during the solvers package's own __init__.
+    if kernel in ("pallas_fused", "auto"):
+        from ..ops.pallas_solver import build_fused_solver, fused_solver_supported
+
+        if kernel == "pallas_fused" or fused_solver_supported(
+            op, strategy.name, combine, mesh
+        ):
+            return build_fused_solver(
+                op, strategy, mesh, dtype=dtype, combine=combine,
+                dtype_storage=dtype_storage,
+            )
+        kernel = "xla"
     matvec = strategy.build(
         mesh, kernel=kernel, gather_output=True, combine=combine,
         stages=stages, dtype_storage=dtype_storage,
@@ -412,12 +431,20 @@ def build_solver(
         threshold = convergence_threshold(rtol_acc, residual_norm(b_acc))
         x0 = jnp.zeros_like(b_acc)
         r0 = b_acc
+        b_rr = jnp.sum(r0 * r0)
         state0 = (x0, r0, jnp.zeros_like(b_acc), jnp.asarray(0.0, acc),
-                  jnp.sum(r0 * r0), jnp.asarray(0, jnp.int32))
+                  b_rr, jnp.asarray(0, jnp.int32))
 
         def cond(state):
             _, _, _, _, rr, k = state
-            return keep_iterating(jnp.sqrt(rr), threshold, k, maxiter)
+            # Early divergence exit: a spectral interval that excludes
+            # part of the spectrum amplifies the excluded modes
+            # geometrically — stop as soon as the blow-up is provable
+            # (solvers/common.py) rather than looping to maxiter; the
+            # unconverged exit raises the typed SolverDivergedError.
+            return keep_iterating(
+                jnp.sqrt(rr), threshold, k, maxiter
+            ) & ~diverged(rr, b_rr)
 
         def body(state):
             x, r, p, alpha, _, k = state

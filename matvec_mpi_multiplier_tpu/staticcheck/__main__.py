@@ -196,6 +196,7 @@ def main(argv=None) -> int:
             findings.extend(run_hlo_audit(
                 schedule=not run_memory_only,
                 solvers=not run_memory_only,
+                fused_solvers=not run_memory_only,
             ))
         except RuntimeError as e:
             print(f"staticcheck: {e}", file=sys.stderr)

@@ -117,6 +117,12 @@ AUDIT_M = 64
 AUDIT_K = 2048
 AUDIT_DTYPE = "float32"
 GOLDEN_REL = "data/staticcheck/golden_schedule.json"
+# Schema 6 over 5: the table gains a top-level "fused_solvers" section
+# pinning the fused Pallas iteration tier's jaxpr-level census
+# (ops/pallas_solver.py): exactly ONE pallas_call plus the strategy's S
+# collective hops per while body, and — for quantized residents — zero
+# full-shard low-bit converts outside the kernel (the fused-solver audit
+# below; gate ids hlo-fused-solver / hlo-early-dequant).
 # Schema 5 over 4: the table gains a top-level "speculative" section
 # pinning each fused speculative program's census (the int8c counterpart's
 # schedule + at most ONE tiny extra reduction), probe count, and the
@@ -127,13 +133,22 @@ GOLDEN_REL = "data/staticcheck/golden_schedule.json"
 # Schema 3 over 2: every entry additionally pins the compiled-artifact
 # memory audit — RHS donation state ("aliased"/"donated") and the static
 # peak-liveness estimate (peak_bytes / peak_bytes_ratio).
-GOLDEN_SCHEMA = 5
+GOLDEN_SCHEMA = 6
 
 # The solver audit's square operand (the solver ops need m == k). Shares
 # the audit mesh's divisibility needs (8 devices, the 2x4 grid); small on
 # purpose — the census counts are size-independent, and 15 solver
 # lowerings ride every full audit.
 SOLVER_AUDIT_N = 256
+
+# The FUSED-solver audit operand, deliberately larger than the XLA solver
+# audit's: at n = 2048 every strategy's int8c shard holds ≥ 2 full-size
+# quantization groups (ops.quantize.default_block), so a sanctioned
+# per-tile upcast inside the kernel and a full-shard dequant outside it
+# have DIFFERENT shapes — the extended early-dequant gate can tell them
+# apart. (At n = 256 a colwise shard is one block wide and the distinction
+# collapses.) The census counts themselves are size-independent.
+FUSED_SOLVER_AUDIT_N = 2048
 
 # Audit-side override of the engine's dispatch-path donation spec:
 # None means "the engine's own DONATE_ARGNUMS" (engine/executables.py —
@@ -297,6 +312,40 @@ SOLVER_AUDIT_CONFIGS: tuple[SolverAuditConfig, ...] = tuple(
         ("blockwise", "gather"),
     )
     for op in _SOLVER_AUDIT_OPS
+)
+
+
+class FusedSolverAuditConfig(NamedTuple):
+    """One audited FUSED-solver trace: a fixed-recurrence op compiled
+    through the fused Pallas iteration tier
+    (``solvers/ops.py::build_solver(kernel="pallas_fused")`` →
+    ``ops/pallas_solver.py``) at one strategy × canonical combine ×
+    resident storage. Audited at the JAXPR level, not StableHLO: the
+    ``pallas_call`` boundary — the very thing the gate counts — is
+    inlined away by lowering, but ``jax.make_jaxpr`` preserves it."""
+
+    op: str
+    strategy: str
+    combine: str
+    storage: str = "native"
+
+    @property
+    def key(self) -> str:
+        return f"{self.op}|{self.strategy}|{self.combine}|{self.storage}"
+
+
+# Both fused ops across the two supported strategy families (their
+# canonical combines — the only spellings check_fused_solver admits),
+# plus the int8c-resident colwise cell whose census proves the quantized
+# solve never materializes a dequantized A (the PR's acceptance pin).
+FUSED_SOLVER_AUDIT_CONFIGS: tuple[FusedSolverAuditConfig, ...] = tuple(
+    FusedSolverAuditConfig(op, strategy, combine, storage)
+    for op in ("cg", "chebyshev")
+    for strategy, combine, storage in (
+        ("rowwise", "gather", "native"),
+        ("colwise", "psum", "native"),
+        ("colwise", "psum", "int8c"),
+    )
 )
 
 
@@ -1059,6 +1108,188 @@ def solver_findings(
     return findings
 
 
+# -------------------------------------------------- fused-solver audit
+#
+# The fused Pallas iteration tier (ops/pallas_solver.py; the tentpole of
+# docs/SOLVERS.md "Fused iteration tier"): the whole CG/Chebyshev while
+# body — local GEMV tile loop, combine, vector updates, residual
+# reduction — must lower to exactly ONE pallas_call plus the strategy's
+# S collective hops (S = 1 for the canonical gather/psum combines), and
+# an int8c-resident fused solve must upcast per (bm, block) tile INSIDE
+# the kernel, never a full shard outside it. StableHLO inlines the
+# pallas_call boundary, so this layer audits the traced jaxpr instead —
+# the representation where the kernel boundary is a first-class eqn.
+
+# Jaxpr primitive names of the collective kinds a fused body could issue
+# (the jaxpr-level spelling, distinct from the StableHLO _KINDS above).
+_FUSED_COLLECTIVE_PRIMS = (
+    "psum", "all_gather", "ppermute", "all_to_all", "psum_scatter",
+    "reduce_scatter",
+)
+
+# What each canonical fused combine's while body must issue: one hop.
+_FUSED_EXPECTED_CENSUS = {
+    "gather": {"all_gather": 1},
+    "psum": {"psum": 1},
+}
+
+
+def _sub_eqns(jaxpr, *, skip_pallas: bool = False):
+    """Every eqn in ``jaxpr``, recursing into sub-jaxpr params (while and
+    scan bodies, shard_map, cond branches — and pallas_call kernels,
+    unless ``skip_pallas`` excludes the sanctioned kernel interior for
+    the early-dequant walk)."""
+    import jax.core as jcore
+
+    def sub(v):
+        if isinstance(v, jcore.ClosedJaxpr):
+            yield from _sub_eqns(v.jaxpr, skip_pallas=skip_pallas)
+        elif hasattr(v, "eqns"):
+            yield from _sub_eqns(v, skip_pallas=skip_pallas)
+        elif isinstance(v, (tuple, list)):
+            for item in v:
+                yield from sub(item)
+
+    for eqn in jaxpr.eqns:
+        if skip_pallas and eqn.primitive.name == "pallas_call":
+            continue
+        yield eqn
+        for v in eqn.params.values():
+            yield from sub(v)
+
+
+def trace_fused_solver(fcfg: FusedSolverAuditConfig, mesh):
+    """The closed jaxpr of one fused solve at the fused audit operand
+    (trace-only — quantized cells trace against a
+    ``quantized_struct`` layout, no data is quantized)."""
+    import jax
+    import numpy as np
+
+    from ..models import get_strategy
+    from ..solvers import build_solver
+
+    n = FUSED_SOLVER_AUDIT_N
+    dtype = np.dtype(AUDIT_DTYPE)
+    strat = get_strategy(fcfg.strategy)
+    if fcfg.storage == "native":
+        a = jax.ShapeDtypeStruct((n, n), dtype)
+        dtype_storage = None
+    else:
+        from ..ops.quantize import default_block, quantized_struct
+
+        a = quantized_struct(
+            n, n, fcfg.storage, dtype,
+            default_block(n, strat.contraction_shards(mesh)),
+        )
+        dtype_storage = fcfg.storage
+    fn = build_solver(
+        fcfg.op, strat, mesh, dtype=dtype, kernel="pallas_fused",
+        combine=fcfg.combine, dtype_storage=dtype_storage,
+    )
+    b = jax.ShapeDtypeStruct((n,), dtype)
+    f32 = jax.ShapeDtypeStruct((), np.float32)
+    i32 = jax.ShapeDtypeStruct((), np.int32)
+    return jax.make_jaxpr(fn)(a, b, f32, i32, f32, f32)
+
+
+def _lowbit_shard_converts(jaxpr, n: int, p: int) -> int:
+    """Count of converts OUTSIDE any pallas_call that upcast a low-bit
+    tensor of full-A width — global (n, n) or either 1-D strategy's
+    local shard — to float: each one is a dequantized-A materialization
+    the fused tier exists to make impossible. The sanctioned upcasts are
+    (·, block)-tile-shaped (inside the kernel, or in the scan fallback's
+    ``matvec_quantized``) and don't match."""
+    full_shapes = {(n, n), (n // p, n), (n, n // p)}
+    count = 0
+    for eqn in _sub_eqns(jaxpr.jaxpr, skip_pallas=True):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        iv = eqn.invars[0].aval
+        ov = eqn.outvars[0].aval
+        src = str(getattr(iv, "dtype", ""))
+        dst = str(getattr(ov, "dtype", ""))
+        lowbit = src.startswith(("int8", "uint8", "float8"))
+        if lowbit and dst.startswith(("float", "bfloat"))                 and tuple(getattr(iv, "shape", ())) in full_shapes:
+            count += 1
+    return count
+
+
+def fused_solver_audit_entry(
+    fcfg: FusedSolverAuditConfig, mesh, jaxpr=None
+) -> dict:
+    """One fused config's observed iteration structure: the while count,
+    the per-body pallas_call count, the per-body collective census, and
+    the whole-program full-shard low-bit convert count (0 is the pin)."""
+    if jaxpr is None:
+        jaxpr = trace_fused_solver(fcfg, mesh)
+    whiles = [
+        e for e in _sub_eqns(jaxpr.jaxpr) if e.primitive.name == "while"
+    ]
+    body_prims: list[str] = []
+    for w in whiles:
+        body_prims.extend(
+            e.primitive.name
+            for e in _sub_eqns(w.params["body_jaxpr"].jaxpr)
+        )
+    census = {
+        k: body_prims.count(k)
+        for k in _FUSED_COLLECTIVE_PRIMS if k in body_prims
+    }
+    p = int(mesh.devices.size)
+    return {
+        "while_ops": len(whiles),
+        "pallas_calls": body_prims.count("pallas_call"),
+        "census": dict(sorted(census.items())),
+        "lowbit_shard_converts": _lowbit_shard_converts(
+            jaxpr, FUSED_SOLVER_AUDIT_N, p
+        ),
+    }
+
+
+def fused_solver_findings(
+    fcfg: FusedSolverAuditConfig, entry: dict
+) -> list[Finding]:
+    """The structural (golden-independent) gates for one fused entry."""
+    findings: list[Finding] = []
+    if entry["while_ops"] != 1:
+        findings.append(Finding(
+            f"<hlo:fused:{fcfg.key}>", 0, "hlo-fused-solver",
+            f"fused solve traced {entry['while_ops']} while loops, "
+            "expected exactly 1: the iteration either left the device or "
+            "was unrolled/nested (ops/pallas_solver.py compiles ONE "
+            "rotated while loop)",
+        ))
+    if entry["pallas_calls"] != 1:
+        findings.append(Finding(
+            f"<hlo:fused:{fcfg.key}>", 0, "hlo-fused-solver",
+            f"fused iteration body contains {entry['pallas_calls']} "
+            "pallas_call eqns, expected exactly 1 — the tier's whole "
+            "claim is the entire recurrence (GEMV tiles + vector updates "
+            "+ residual reduction) in ONE kernel so p/x/r never "
+            "round-trip through HBM; an unfused body pays the XLA tier's "
+            "per-iteration launches while reporting the fused ExecKey",
+        ))
+    expected = _FUSED_EXPECTED_CENSUS[fcfg.combine]
+    if entry["census"] != expected:
+        findings.append(Finding(
+            f"<hlo:fused:{fcfg.key}>", 0, "hlo-fused-solver",
+            f"fused iteration body's collective census {entry['census']} "
+            f"!= the canonical {fcfg.combine} combine's {expected} — a "
+            "stray collective inside the loop multiplies per-iteration "
+            "latency by its launch cost",
+        ))
+    if fcfg.storage != "native" and entry["lowbit_shard_converts"]:
+        findings.append(Finding(
+            f"<hlo:fused:{fcfg.key}>", 0, "hlo-early-dequant",
+            f"quantized fused solve materializes "
+            f"{entry['lowbit_shard_converts']} full-shard dequantized A "
+            "tensor(s) outside the kernel: the int8c-resident tier must "
+            "upcast per (bm, block) tile inside the pallas_call "
+            "(ops/pallas_solver.py; docs/QUANTIZATION.md)",
+        ))
+    return findings
+
+
 # ----------------------------------------------------- speculative audit
 #
 # The speculative-dispatch layer (ops/speculative.py; the engine's
@@ -1242,12 +1473,15 @@ def build_schedule_table(
     configs: Iterable[AuditConfig] | None = None,
     solver_configs: Iterable[SolverAuditConfig] | None = None,
     spec_configs: Iterable[SpecAuditConfig] | None = None,
+    fused_solver_configs: Iterable[FusedSolverAuditConfig] | None = None,
 ) -> dict:
     """The full golden-table payload for the current tree: the schedule
     census (plain-struct lowering) merged with the compiled-artifact
     memory audit (engine-recipe lowering) per config, plus the served
     solver loops' census/while pins per strategy × op, plus the fused
-    speculative programs' census/predicate pins per strategy family."""
+    speculative programs' census/predicate pins per strategy family,
+    plus the fused solver tier's jaxpr census pins per op × strategy ×
+    storage (schema 6)."""
     import jax
 
     mesh = _audit_mesh()
@@ -1269,6 +1503,13 @@ def build_schedule_table(
             else tuple(spec_configs)
         )
     }
+    fused_entries = {
+        fcfg.key: fused_solver_audit_entry(fcfg, mesh)
+        for fcfg in (
+            FUSED_SOLVER_AUDIT_CONFIGS if fused_solver_configs is None
+            else tuple(fused_solver_configs)
+        )
+    }
     return {
         "schema": GOLDEN_SCHEMA,
         "mesh": {
@@ -1277,10 +1518,14 @@ def build_schedule_table(
         },
         "operand": {"m": AUDIT_M, "k": AUDIT_K, "dtype": AUDIT_DTYPE},
         "solver_operand": {"n": SOLVER_AUDIT_N, "dtype": AUDIT_DTYPE},
+        "fused_solver_operand": {
+            "n": FUSED_SOLVER_AUDIT_N, "dtype": AUDIT_DTYPE,
+        },
         "jax_version_at_capture": jax.__version__,
         "configs": entries,
         "solvers": solver_entries,
         "speculative": spec_entries,
+        "fused_solvers": fused_entries,
     }
 
 
@@ -1305,6 +1550,8 @@ def run_hlo_audit(
     solver_configs: Iterable[SolverAuditConfig] | None = None,
     speculative: bool | None = None,
     spec_configs: Iterable[SpecAuditConfig] | None = None,
+    fused_solvers: bool | None = None,
+    fused_solver_configs: Iterable[FusedSolverAuditConfig] | None = None,
 ) -> list[Finding]:
     """The full lowered-artifact audit: the collective-schedule layer
     (census + bytes vs formula and golden, the overlap chunking gate,
@@ -1315,7 +1562,10 @@ def run_hlo_audit(
     set vs the matvec counterpart, the on-device while pin, golden count
     pins — ``solvers=True``), and the speculative-dispatch layer (fused
     check census vs the int8c counterpart + one probe-vector reduction,
-    the hlo-spec-host-sync device-predicate pin — ``speculative=True``).
+    the hlo-spec-host-sync device-predicate pin — ``speculative=True``),
+    and the fused solver tier's jaxpr census (exactly one pallas_call +
+    S collective hops per while body, no full-shard dequant outside the
+    kernel — ``fused_solvers=True``; gate hlo-fused-solver).
     All compare against the golden table over whichever fields they
     computed. Returns findings; empty means every config lowers as
     pinned."""
@@ -1331,6 +1581,9 @@ def run_hlo_audit(
     if speculative is None:
         # Same narrowing rule as the solver layer.
         speculative = configs is None or spec_configs is not None
+    if fused_solvers is None:
+        # Same narrowing rule again.
+        fused_solvers = configs is None or fused_solver_configs is not None
     configs = _supported_configs(configs or AUDIT_CONFIGS)
     findings: list[Finding] = []
 
@@ -1537,6 +1790,39 @@ def run_hlo_audit(
                 findings.append(Finding(
                     GOLDEN_REL, 0, "hlo-golden",
                     f"golden table pins unknown speculative config "
+                    f"{stale}; regenerate with --write-golden",
+                ))
+
+    if fused_solvers:
+        golden_fused = golden.get("fused_solvers", {}) if have_golden else {}
+        for fcfg in (
+            FUSED_SOLVER_AUDIT_CONFIGS if fused_solver_configs is None
+            else tuple(fused_solver_configs)
+        ):
+            entry = fused_solver_audit_entry(fcfg, mesh)
+            findings.extend(fused_solver_findings(fcfg, entry))
+            if have_golden:
+                pinned = golden_fused.get(fcfg.key)
+                if pinned is None:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-golden",
+                        f"fused solver config {fcfg.key} missing from "
+                        "the golden table; bless it with --write-golden",
+                    ))
+                elif pinned != entry:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-census",
+                        f"{fcfg.key}: traced fused solve {entry} != "
+                        f"golden {pinned}; a kernel-count, collective or "
+                        "dequant change inside the fused iteration — if "
+                        "deliberate, bless it with --write-golden",
+                    ))
+        if have_golden and fused_solver_configs is None:
+            audited_fused = {f.key for f in FUSED_SOLVER_AUDIT_CONFIGS}
+            for stale in sorted(set(golden_fused) - audited_fused):
+                findings.append(Finding(
+                    GOLDEN_REL, 0, "hlo-golden",
+                    f"golden table pins unknown fused solver config "
                     f"{stale}; regenerate with --write-golden",
                 ))
 

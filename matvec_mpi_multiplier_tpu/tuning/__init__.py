@@ -38,6 +38,7 @@ from .cache import (
     overlap_key,
     platform_fingerprint,
     promote_key,
+    solver_kernel_key,
     storage_key,
 )
 
@@ -54,6 +55,7 @@ __all__ = [
     "overlap_key",
     "platform_fingerprint",
     "promote_key",
+    "solver_kernel_key",
     "storage_key",
     "get_cache",
     "reset_cache",
@@ -63,6 +65,7 @@ __all__ = [
     "lookup_promotion",
     "lookup_overlap",
     "lookup_storage",
+    "lookup_solver_kernel",
     "lookup_calibration",
 ]
 
@@ -150,6 +153,22 @@ def lookup_storage(
     ``storage`` names the measured winner; ``resident_bytes`` and
     ``bandwidth_gbps`` record why."""
     return get_cache().lookup(storage_key(strategy, m, k, p, dtype))
+
+
+def lookup_solver_kernel(
+    *, op: str, strategy: str, m: int, k: int, p: int, dtype: str,
+    storage: str,
+) -> dict[str, Any] | None:
+    """The recorded solver iteration-tier decision for this (op, GLOBAL
+    shape, mesh size, resident storage), or None — the serving engine's
+    ``solver_kernel="auto"`` question (``engine/core.py``; a miss keeps
+    the established XLA tier). The decision's ``solver_kernel`` names the
+    measured winner (``xla`` | ``pallas_fused``); ``candidates`` records
+    each tier's measured per-iteration seconds and the cost model's
+    prediction."""
+    return get_cache().lookup(
+        solver_kernel_key(op, strategy, m, k, p, dtype, storage)
+    )
 
 
 def lookup_calibration(*, p: int) -> dict[str, Any] | None:

@@ -9,9 +9,13 @@ Usage::
     python -m matvec_mpi_multiplier_tpu.tuning --platform cpu \
         --host-devices 8 --sizes 1024 --strategy colwise rowwise
 
-Measures the kernel/tile/combine candidates for every config in the grid
-(the same grid ``bench.sweep`` runs) and persists the winners to the JSON
-cache (``tuning/cache.py``; ``--cache`` / ``MATVEC_TUNING_CACHE`` override
+Measures every tuning axis for every config in the grid (the same grid
+``bench.sweep`` runs) — local kernel/tiles, combine schedule, promotion,
+overlap stages, resident storage, and on square shapes the solver
+iteration tier (``xla`` vs ``pallas_fused`` per CG/Chebyshev op;
+``tune_solver_kernel``, consulted by the engine's
+``solver_kernel="auto"``) — and persists the winners to the JSON cache
+(``tuning/cache.py``; ``--cache`` / ``MATVEC_TUNING_CACHE`` override
 the path). A subsequent ``bench.sweep --kernel auto`` / ``--combine auto``
 run consults the cache without re-measuring; ``bench.sweep --tune`` runs
 this same population pass inline before sweeping.
@@ -28,7 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m matvec_mpi_multiplier_tpu.tuning",
         description="Populate the autotuner cache: measure kernel/tile/"
-        "combine candidates for a sweep grid and persist the winners.",
+        "combine/storage/solver-kernel candidates for a sweep grid and "
+        "persist the winners.",
     )
     p.add_argument("--strategy", nargs="+", default=["all"])
     p.add_argument("--op", choices=["matvec", "gemm"], default="matvec")

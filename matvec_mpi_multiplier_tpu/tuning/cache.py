@@ -39,6 +39,14 @@ Schema (version 5)::
       }
     }
 
+Version 6 over 5: the ``solver_kernel`` kind records the measured solver
+iteration tier — ``xla`` (one HLO per body stage) vs ``pallas_fused``
+(the whole CG/Chebyshev iteration in one kernel, ``ops/pallas_solver.py``)
+— raced per (op, strategy, shape, mesh size, resident storage) by
+``search.tune_solver_kernel`` under the predicted-then-measured protocol,
+with each candidate's measured per-iteration time and the cost model's
+prediction recorded alongside; the engine's ``solver_kernel="auto"``
+consults it and stays on the XLA tier on a miss.
 Version 5 over 4: the ``calibration`` kind records the analytic cost
 model's machine constants — achievable FLOP/s, local resident-stream
 bandwidth, and the per-collective α (launch latency) / β (link
@@ -84,12 +92,13 @@ import tempfile
 from pathlib import Path
 from typing import Any
 
-CACHE_VERSION = 5
-# Versions load() accepts: v1-v4 entries are strict subsets of v5's (no
-# calibration kind; v1-v3 also no storage kind; v1/v2 no overlap/promote
-# kinds or gemm tile fields), so an old cache keeps serving its decisions
-# after the upgrade instead of forcing a silent full re-tune.
-COMPATIBLE_VERSIONS = (1, 2, 3, 4, CACHE_VERSION)
+CACHE_VERSION = 6
+# Versions load() accepts: v1-v5 entries are strict subsets of v6's (no
+# solver_kernel kind; v1-v4 also no calibration kind; v1-v3 no storage
+# kind; v1/v2 no overlap/promote kinds or gemm tile fields), so an old
+# cache keeps serving its decisions after the upgrade instead of forcing
+# a silent full re-tune.
+COMPATIBLE_VERSIONS = (1, 2, 3, 4, 5, CACHE_VERSION)
 CACHE_ENV = "MATVEC_TUNING_CACHE"
 CACHE_FILENAME = "tuning_cache.json"
 
@@ -197,6 +206,27 @@ def storage_key(
     residency."""
     fp = fingerprint if fingerprint is not None else platform_fingerprint()
     return f"{fp}|storage|{strategy}|{m}x{k}|p{p}|{dtype}"
+
+
+def solver_kernel_key(
+    op: str,
+    strategy: str,
+    m: int,
+    k: int,
+    p: int,
+    dtype: str,
+    storage: str,
+    fingerprint: str | None = None,
+) -> str:
+    """Key for a solver iteration-tier decision (the eighth cache kind —
+    schema v6): ``xla`` vs ``pallas_fused`` per (op, strategy, GLOBAL
+    shape, mesh size, resident storage). Unlike ``storage``/``promote``
+    the key DOES carry the op — CG's body (two dots, a conditional) and
+    Chebyshev's (pure recurrence) amortize the fused kernel differently —
+    and the storage format, because the fused quantized kernel folds the
+    scale-and-multiply in while the XLA tier runs the scan kernel."""
+    fp = fingerprint if fingerprint is not None else platform_fingerprint()
+    return f"{fp}|solver_kernel|{op}|{strategy}|{m}x{k}|p{p}|{dtype}|{storage}"
 
 
 def calibration_key(p: int, fingerprint: str | None = None) -> str:

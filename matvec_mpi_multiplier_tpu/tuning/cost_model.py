@@ -102,6 +102,19 @@ PRUNED_COUNTER = "tuning_pruned_candidates_total"
 
 _PERMUTE = "collective-permute"
 
+# Per-iteration kernel-launch census of the two solver iteration tiers
+# (ops/pallas_solver.py; docs/SOLVERS.md "Fused iteration tier"),
+# COUNTING ONLY the launches :meth:`CostModel.predict` does not already
+# price — the body's collective hop is in the matvec census. The XLA
+# tier's while body dispatches the local GEMV plus the vector updates
+# (two axpy/xpay), the residual dot-reduction and the scalar recurrence
+# as separate fusions (~5 extra launches/iteration); the fused tier's
+# entire body is ONE ``pallas_call`` (1 extra launch). Each launch is
+# charged at the calibrated collective launch latency α — the one
+# measured per-dispatch overhead constant the probe pass produces, and
+# the right order of magnitude for any launch on the same runtime.
+SOLVER_KERNEL_LAUNCHES = {"xla": 5, "pallas_fused": 1}
+
 # Probe shapes (full calibration = 6 probes). Local probes sized to
 # dominate per-dispatch overhead without stretching a 1-core CI host;
 # collective probes small/large pairs so α and β separate.
@@ -469,6 +482,7 @@ class CostModel:
         r: int | None = None,
         restart: int | None = None,
         steps: int | None = None,
+        kernel: str = "xla",
     ) -> Prediction:
         """One served solve (``engine.submit(op="cg"|...)``): ``k_est``
         iterations × the one-matvec prediction, with each op's iteration
@@ -482,7 +496,17 @@ class CostModel:
         ETA; docs/SCHEDULING.md). The per-iteration replicated vector
         work is uncounted (see the count's docstring), so predictions
         are matvec-dominated estimates — exactly as good as the matvec
-        model underneath."""
+        model underneath.
+
+        ``kernel`` selects the iteration tier's launch structure
+        (:data:`SOLVER_KERNEL_LAUNCHES`): beyond the matvec terms, each
+        iteration pays an explicit per-launch overhead
+        ``launches(kernel) × α`` — the term the fused Pallas tier
+        exists to shrink, and the axis ``search.tune_solver_kernel``
+        races. At large shapes the α term vanishes against the matvec
+        stream and both tiers predict alike; the model's crossover is
+        therefore at SMALL per-iteration work, matching the measured
+        iteration-latency floor (``data/fused_solver_demo/``)."""
         from ..solvers import (
             DEFAULT_RESTART, DEFAULT_STEPS, SOLVER_OPS, solver_matvec_count,
         )
@@ -493,6 +517,11 @@ class CostModel:
             )
         if k_est < 1:
             raise ValueError(f"k_est must be >= 1, got {k_est}")
+        if kernel not in SOLVER_KERNEL_LAUNCHES:
+            raise ValueError(
+                f"unknown solver kernel {kernel!r}; expected one of "
+                f"{tuple(SOLVER_KERNEL_LAUNCHES)}"
+            )
         per = self.predict(
             strategy, combine, m=m, k=k, p=p, dtype=dtype, stages=stages,
             b=1, storage=storage, r=r,
@@ -502,11 +531,19 @@ class CostModel:
             restart=restart if restart is not None else DEFAULT_RESTART,
             steps=steps if steps is not None else DEFAULT_STEPS,
         )
+        # Per-iteration launch overhead (module constant above): charged
+        # once per ITERATION, not per matvec — the launch structure
+        # belongs to the while body, and the extra prologue/verification
+        # matvecs in n_mv launch once per solve, in the noise.
+        launch_s = (
+            float(k_est) * SOLVER_KERNEL_LAUNCHES[kernel]
+            * self.calibration.alpha_s["collective"]
+        )
         return Prediction(
-            total_s=n_mv * per.total_s,
+            total_s=n_mv * per.total_s + launch_s,
             compute_s=n_mv * per.compute_s,
             wire_s=n_mv * per.wire_s,
-            latency_s=n_mv * per.latency_s,
+            latency_s=n_mv * per.latency_s + launch_s,
             flops=n_mv * per.flops,
             a_bytes=per.a_bytes,
             wire_bytes=n_mv * per.wire_bytes,

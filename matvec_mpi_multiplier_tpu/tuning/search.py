@@ -1,6 +1,6 @@
 """Candidate enumeration + measurement for the autotuner.
 
-Six measured axes, mirroring the repo's static perf choices:
+Seven measured axes, mirroring the repo's static perf choices:
 
 * **local kernel** — ``xla`` / ``pallas`` / ``native`` (when its .so is
   built), measured as the bare per-device kernel on one device;
@@ -20,7 +20,12 @@ Six measured axes, mirroring the repo's static perf choices:
 * **resident storage format** — the quantized-storage ladder
   ``native`` / ``int8`` / ``int8c`` / ``fp8`` (``tune_storage``), raced as
   full distributed matvecs with resident bytes + achieved bandwidth
-  recorded; the serving engine's ``dtype_storage="auto"`` consults it.
+  recorded; the serving engine's ``dtype_storage="auto"`` consults it;
+* **solver iteration tier** — ``xla`` vs ``pallas_fused``
+  (``tune_solver_kernel``): the whole CG/Chebyshev iteration body raced
+  as full fixed-iteration solves per (op, strategy, storage), with the
+  cost model's launch-α predictions recorded alongside; the engine's
+  ``solver_kernel="auto"`` consults it.
 
 All measurements ride the existing benchmark protocol (``bench.timing``):
 device-looped slope timing with median-of-samples, the same numbers the
@@ -59,6 +64,7 @@ from .cache import (
     gemv_key,
     overlap_key,
     promote_key,
+    solver_kernel_key,
     storage_key,
 )
 
@@ -1386,6 +1392,215 @@ def tune_storage(
     return best
 
 
+# Fixed-iteration race depth for the solver-kernel axis: rtol=0 means the
+# convergence predicate can never fire, so BOTH tiers execute exactly this
+# many while-body iterations — equal work by construction, and enough
+# iterations that the per-iteration launch overhead (the axis's whole
+# question) dominates the one-off prologue/verification matvecs.
+SOLVER_RACE_ITERS = 16
+
+
+def tune_solver_kernel(
+    op: str,
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    dtype: str,
+    cache: TuningCache,
+    *,
+    storage: str = "native",
+    n_reps: int = TUNE_N_REPS,
+    samples: int = TUNE_SAMPLES,
+    force: bool = False,
+    seed: int = 0,
+    min_gain: float = TUNE_MIN_GAIN,
+    prune_margin: float | None = None,
+    measure: str = "loop",
+    log: Callable[[str], None] = print,
+) -> dict[str, Any] | None:
+    """The seventh autotuner axis: the solver ITERATION tier — the whole
+    CG/Chebyshev while body as XLA's fusion schedule vs ONE fused Pallas
+    kernel (``ops/pallas_solver.py``; docs/SOLVERS.md).
+
+    For one (op, strategy, GLOBAL square shape, mesh, dtype, resident
+    storage), build both tiers through the one shared constructor
+    (``solvers.build_solver``) and race FULL fixed-iteration solves:
+    ``rtol=0`` pins both programs to exactly :data:`SOLVER_RACE_ITERS`
+    while-body iterations, so the race measures the per-iteration floor —
+    launch overhead + HBM round-trips of the iteration vectors — which is
+    the only thing the tiers differ in (their matvec work is identical by
+    the fused census pin, ``hlo-fused-solver``). The cost model's
+    launch-α predictions (``CostModel.predict_solver(kernel=...)``) are
+    recorded per candidate under the predicted-then-measured protocol;
+    the XLA tier holds the hysteresis seat. The engine's
+    ``solver_kernel="auto"`` consults the decision per submitted op
+    (``tuning.lookup_solver_kernel``).
+
+    The fused candidate is only offered on a real TPU (elsewhere it runs
+    in interpret mode — it can never win and would dominate the pass);
+    ``MATVEC_TUNE_PALLAS=1`` forces it in, exactly as for the local
+    kernel axis. An unsupported (op, strategy) pair — eigen ops, the
+    blockwise grid — records nothing: no key IS the decision, and the
+    ``auto`` tier's miss keeps XLA.
+    """
+    import os
+
+    from ..ops.pallas_gemv import _on_tpu
+    from ..ops.pallas_solver import FUSED_SOLVER_OPS, fused_solver_supported
+    from ..ops.quantize import quantize_matrix
+    from ..solvers import build_solver
+    from .cost_model import model_from_cache
+
+    if op not in FUSED_SOLVER_OPS or m != k:
+        return None
+    p = int(mesh.devices.size)
+    key = solver_kernel_key(op, strategy_name, m, k, p, dtype, storage)
+    existing = cache.lookup(key)
+    if existing is not None:
+        if not force:
+            return existing
+        _record_stale("solver_kernel", key, log)
+    strat = get_strategy(strategy_name)
+    try:
+        strat.validate(m, k, mesh)
+    except MatvecError:
+        return None
+    if not fused_solver_supported(op, strategy_name, None, mesh):
+        return None
+    if storage != "native" and not strat.storage_combine_ok(None):
+        return None
+    candidates = ["xla"]
+    if _on_tpu() or os.environ.get("MATVEC_TUNE_PALLAS") == "1":
+        candidates.append("pallas_fused")
+    if len(candidates) == 1:
+        # One candidate is no race: leave no key (the auto tier's miss
+        # already answers "xla"), and say so — no silent caps.
+        log(f"  solver_kernel {op} {strategy_name} {m}x{k} p={p}: "
+            "fused tier not offered off-TPU (MATVEC_TUNE_PALLAS=1 forces "
+            "it) - nothing to race")
+        return None
+
+    # Predictions (docs/COST_MODEL.md): both tiers share the matvec
+    # terms; only the per-iteration launch count differs
+    # (cost_model.SOLVER_KERNEL_LAUNCHES) — so the prediction gap IS the
+    # modeled launch-overhead delta the measurement checks.
+    from ..ops.pallas_solver import check_fused_solver
+
+    predictions: dict[str, float] = {}
+    measure_set: set[str] | None = None
+    pruned: list[str] = []
+    model = model_from_cache(cache, p)
+    if model is not None:
+        r_, _c = mesh_grid_shape(mesh)
+        for cand in candidates:
+            comb = (
+                check_fused_solver(op, strategy_name, None, mesh)
+                if cand == "pallas_fused"
+                else strat.default_combine(mesh)
+            )
+            try:
+                pred = model.predict_solver(
+                    op, strategy_name, comb, m=m, k=k, p=p, dtype=dtype,
+                    k_est=SOLVER_RACE_ITERS, storage=storage, r=r_,
+                    kernel=cand,
+                )
+            except KeyError:
+                predictions = {}
+                break
+            predictions[cand] = pred.total_s
+    if prune_margin is not None and predictions:
+        measure_set = _plan_pruning(
+            f"solver_kernel {op} {strategy_name} {m}x{k} p={p}",
+            predictions, keep={"xla"}, margin=prune_margin, log=log,
+        )
+        pruned = sorted(set(predictions) - measure_set)
+    plan = _measure_plan(candidates, predictions, measure_set)
+
+    from ..bench.serve import gershgorin_interval, solver_operand
+
+    a = np.asarray(solver_operand(m, dtype, seed=seed), dtype=dtype)
+    b = np.asarray(
+        np.random.default_rng(seed + 1).standard_normal(m), dtype=dtype
+    )
+    if op == "chebyshev":
+        p0, p1 = gershgorin_interval(a)
+    else:
+        p0 = p1 = 0.0
+    sh_a, sh_x = strat.shardings(mesh)
+    if storage == "native":
+        a_dev = jax.device_put(a, sh_a)
+        dtype_storage = None
+    else:
+        qa = quantize_matrix(
+            a, storage, contraction_shards=strat.contraction_shards(mesh)
+        )
+        a_dev = jax.device_put(qa, sh_a)
+        dtype_storage = storage
+    b_dev = jax.device_put(b, sh_x)
+
+    def _candidate(kern: str) -> Callable:
+        """One tier's jitted fixed-iteration solve. The timed output is
+        the iterate x alone — a data dependence on the entire while loop,
+        nothing more (fetching the scalar diagnostics would add a host
+        sync the race shouldn't time)."""
+        fn = build_solver(
+            op, strat, mesh, dtype=jnp.dtype(dtype), kernel=kern,
+            dtype_storage=dtype_storage,
+        )
+        return jax.jit(
+            lambda a_, b_: fn(
+                a_, b_, jnp.float32(0.0),
+                jnp.int32(SOLVER_RACE_ITERS), jnp.float32(p0),
+                jnp.float32(p1),
+            ).x
+        )
+
+    measured: dict[str, float] = {}
+    warmed = False
+    for kern in plan:
+        try:
+            fn = _candidate(kern)
+        except MatvecError as e:
+            log(f"  solver_kernel {op} {strategy_name} {m}x{k} p={p} "
+                f"{kern}: skip ({e})")
+            continue
+        if not warmed:
+            _measure_fn(
+                fn, (a_dev, b_dev), n_reps=max(1, n_reps // 4),
+                samples=1, measure=measure,
+            )
+            warmed = True
+        t = _measure_fn(
+            fn, (a_dev, b_dev), n_reps=n_reps, samples=samples,
+            measure=measure,
+        )
+        _record_candidate("solver_kernel", t, predicted=predictions.get(kern))
+        if t is None:
+            log(f"  solver_kernel {op} {strategy_name} {m}x{k} p={p} "
+                f"{kern}: unmeasurable")
+            continue
+        measured[kern] = t
+        log(f"  solver_kernel {op} {strategy_name} {m}x{k} p={p} {kern}: "
+            f"{t * 1e6:.1f} us ({t / SOLVER_RACE_ITERS * 1e6:.2f} us/iter)")
+    winner = _pick_winner(measured, default="xla", min_gain=min_gain)
+    if winner is None:
+        return None
+    best: dict[str, Any] = {
+        "solver_kernel": winner,
+        "time_s": measured[winner],
+        "iter_s": measured[winner] / SOLVER_RACE_ITERS,
+        "race_iters": SOLVER_RACE_ITERS,
+        "candidates": measured,
+    }
+    if predictions:
+        best["predicted_s"] = predictions
+    if pruned:
+        best["pruned"] = pruned
+    cache.record(key, best)
+    return best
+
+
 # ------------------------------------------------------------ sweep-level
 
 
@@ -1512,12 +1727,33 @@ def tune_config(
         seed=seed, min_gain=min_gain, memo=memo, prune_margin=prune_margin,
         log=log, stages=(ov or {}).get("stages"),
     )
-    tune_storage(
+    st = tune_storage(
         strategy_name, mesh, m, k, dtype, cache, kernel=kernel,
         n_reps=n_reps, samples=samples, force=force, seed=seed,
         min_gain=min_gain, prune_margin=prune_margin, measure=measure,
         log=log,
     )
+    # Solver iteration tier (square shapes only — the served solvers'
+    # domain): race each fused-capable op at native storage plus the
+    # storage winner just recorded, so an ``auto`` engine that follows
+    # BOTH tuned decisions finds a key for the combination it will
+    # actually serve. The axis itself skips unsupported (op, strategy)
+    # pairs; ``speculate`` is a dispatch policy, not a resident format
+    # a solver loop can hold.
+    if m == k:
+        formats = {"native"}
+        if st and st.get("storage") not in (None, "native", "speculate"):
+            formats.add(st["storage"])
+        from ..ops.pallas_solver import FUSED_SOLVER_OPS
+
+        for solver_op in FUSED_SOLVER_OPS:
+            for fmt in sorted(formats):
+                tune_solver_kernel(
+                    solver_op, strategy_name, mesh, m, k, dtype, cache,
+                    storage=fmt, n_reps=n_reps, samples=samples,
+                    force=force, seed=seed, min_gain=min_gain,
+                    prune_margin=prune_margin, measure=measure, log=log,
+                )
 
 
 def tune_sweep(

@@ -255,6 +255,57 @@ print(f"solver smoke ok: cg relres {relres:.2e} in {res.n_iters} iters, "
       f"{compiles} compile(s) across the sweep, 1 typed divergence")
 PY
 
+# Fused-solver smoke: the pallas_fused iteration tier (interpret mode on
+# CPU; ops/pallas_solver.py, docs/SOLVERS.md "Fused iteration tier")
+# against the XLA tier through the ONE shared constructor. rtol=0 pins
+# both programs to exactly maxiter while-body iterations, so the two
+# residual TRAJECTORIES are compared point-for-point — a fused body that
+# drifts from the reference recurrence fails here in seconds, before the
+# full parity gate in tests/test_solvers.py.
+echo "fused-solver smoke: pallas_fused trajectory matches the XLA tier"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import jax
+import jax.numpy as jnp
+import numpy as np
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.bench.serve import solver_operand
+from matvec_mpi_multiplier_tpu.models import get_strategy
+from matvec_mpi_multiplier_tpu.solvers import build_solver
+
+mesh = make_mesh(8)
+n = 96
+a = solver_operand(n, "float32", seed=0)
+b = np.random.default_rng(1).standard_normal(n).astype(np.float32)
+strat = get_strategy("rowwise")
+fns = {
+    kern: jax.jit(build_solver("cg", strat, mesh, dtype=jnp.float32,
+                               kernel=kern))
+    for kern in ("xla", "pallas_fused")
+}
+traj = {kern: [] for kern in fns}
+for k in (1, 2, 4, 8):  # fixed-iteration ladder: rtol=0 never fires
+    for kern, fn in fns.items():
+        res = fn(a, b, jnp.float32(0.0), jnp.int32(k),
+                 jnp.float32(0.0), jnp.float32(0.0))
+        assert int(res.n_iters) == k, (kern, k, int(res.n_iters))
+        traj[kern].append(float(np.linalg.norm(b - a @ np.asarray(res.x))))
+xla_t, fused_t = np.array(traj["xla"]), np.array(traj["pallas_fused"])
+assert np.all(np.diff(xla_t) < 0), f"xla residuals not decreasing: {xla_t}"
+assert np.allclose(fused_t, xla_t, rtol=5e-3, atol=1e-6), (
+    f"fused trajectory drifts from XLA: {fused_t} vs {xla_t}")
+conv = {
+    kern: fn(a, b, jnp.float32(1e-5), jnp.int32(400),
+             jnp.float32(0.0), jnp.float32(0.0))
+    for kern, fn in fns.items()
+}
+assert all(bool(r.converged) for r in conv.values())
+assert int(conv["xla"].n_iters) == int(conv["pallas_fused"].n_iters)
+print(f"fused-solver smoke ok: trajectories agree over {len(xla_t)} "
+      f"ladder points, both tiers converge in "
+      f"{int(conv['xla'].n_iters)} iters")
+PY
+
 # Speculative smoke: both verdicts of the two-tier dispatch through a
 # real 8-device distributed build (ops/speculative.py + engine rtol
 # routing; docs/QUANTIZATION.md "speculative serving"). A well-

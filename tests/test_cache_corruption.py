@@ -107,11 +107,11 @@ def test_save_survives_corrupt_file_vanishing(cache_file):
     assert json.loads(cache_file.read_text())["version"] == CACHE_VERSION
 
 
-def test_v1_through_v4_caches_still_load_under_v5(cache_file):
-    """Schema-bump back-compat (ISSUE 8, extended by ISSUE 10's v5): every
-    historical version's entries are strict subsets of v5's — an old
-    cache keeps serving its decisions instead of forcing a silent full
-    re-tune."""
+def test_v1_through_v5_caches_still_load_under_v6(cache_file):
+    """Schema-bump back-compat (ISSUE 8, extended by ISSUE 10's v5 and
+    ISSUE 17's v6): every historical version's entries are strict
+    subsets of v6's — an old cache keeps serving its decisions instead
+    of forcing a silent full re-tune."""
     old_entries = {
         1: {"fp|gemv|8x8|float32": {"kernel": "xla", "time_s": 1e-5}},
         2: {"fp|promote|rowwise|8x8|p2|float32": {"b_star": 4}},
@@ -119,8 +119,9 @@ def test_v1_through_v4_caches_still_load_under_v5(cache_file):
         4: {"fp|storage|rowwise|8x8|p2|float32": {
             "storage": "int8", "resident_bytes": {"int8": 80},
         }},
+        5: {"fp|calibration|p2": {"flops": 1e10}},
     }
-    assert CACHE_VERSION == 5
+    assert CACHE_VERSION == 6
     for version, entries in old_entries.items():
         cache_file.write_text(
             json.dumps({"version": version, "entries": entries})

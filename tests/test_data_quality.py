@@ -909,6 +909,47 @@ def test_golden_schedule_pins_speculative_lowering():
     assert spec["speculate|rowwise|gather"]["census"] == {}
 
 
+def test_golden_schedule_pins_fused_solver_census():
+    """The fused-iteration-tier pins (schema 6, docs/SOLVERS.md "Fused
+    iteration tier"): every op×strategy×combine×storage in the fused
+    audit table is pinned, and each entry captures the tentpole's whole
+    claim — exactly ONE while loop whose body holds exactly ONE
+    pallas_call plus the canonical combine's single collective hop, and
+    zero full-shard low-bit converts outside the kernel (the quantized
+    fused solve never materializes a dequantized A)."""
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        FUSED_SOLVER_AUDIT_CONFIGS,
+    )
+
+    payload = _golden()
+    operand = payload["fused_solver_operand"]
+    # The fused audit operand is wider than the XLA solver audit's on
+    # purpose: quantized shards must hold ≥ 2 blocks so a tile upcast
+    # and a full-shard dequant are shape-distinguishable.
+    assert operand["n"] >= 2048
+    fused = payload["fused_solvers"]
+    assert set(fused) == {cfg.key for cfg in FUSED_SOLVER_AUDIT_CONFIGS}
+    for key, entry in fused.items():
+        _op, _strategy, combine, storage = key.split("|")
+        assert entry["while_ops"] == 1, key
+        assert entry["pallas_calls"] == 1, (
+            f"{key}: the fused body must be ONE kernel"
+        )
+        expected = (
+            {"all_gather": 1} if combine == "gather" else {"psum": 1}
+        )
+        assert entry["census"] == expected, key
+        assert entry["lowbit_shard_converts"] == 0, (
+            f"{key}: a {storage} fused solve materialized a dequantized "
+            "full shard"
+        )
+    # Both storage faces of the colwise family are pinned: the census
+    # equality between them IS the never-materializes-A claim (the
+    # quantized body adds scale math, not collectives or kernels).
+    assert fused["cg|colwise|psum|native"]["census"] == \
+        fused["cg|colwise|psum|int8c"]["census"]
+
+
 # ---- quantized_demo: the committed storage-axis capture (ISSUE 8) ----
 #
 # Artifacts: tuning_cache.json (the v4 sixth-axis race: winners +
@@ -1495,3 +1536,76 @@ def test_solver_demo_trace_pins_zero_steady_recompiles():
         outcomes.append(children["exec_lookup"]["attrs"]["outcome"])
     assert outcomes[0] == "compile"
     assert all(o == "hit" for o in outcomes[1:]), outcomes
+
+
+# ---- fused_solver_demo: the committed iteration-tier comparison
+# (ISSUE 17, docs/SOLVERS.md "Fused iteration tier"). One CG config run
+# once per iteration tier with an rtol sweep INSIDE the steady phase —
+# the capture's claims are tier identity, answer parity and the
+# zero-recompile contract surviving the tier swap, regression-tested on
+# the committed bytes (CPU interpret: contracts, not TPU speed).
+
+FUSED_SOLVER_DEMO = REPO / "data" / "fused_solver_demo"
+
+
+def _fused_solver_demo_rows() -> dict[str, dict]:
+    rows = _rows(FUSED_SOLVER_DEMO / "out" / "serve_solver_rowwise.csv")
+    by_tier = {row["solver_kernel"]: row for row in rows}
+    assert set(by_tier) == {"xla", "pallas_fused"}, (
+        f"fused demo must hold one row per iteration tier: {sorted(by_tier)}"
+    )
+    assert len(rows) == len(by_tier), "duplicate tier rows"
+    return by_tier
+
+
+def test_fused_solver_demo_tiers_agree_compile_free():
+    """The acceptance pins, row by row: both tiers ran the same config
+    (shape/op/rtol), took the SAME number of iterations (the recurrence
+    is tier-invariant — the tiers differ in fusion schedule, not math),
+    converged within the sweep's tightest rtol budget, and held
+    compiles_steady == 0 ACROSS the rtol sweep — the tolerance is a
+    dynamic operand on the fused tier too, never a new executable."""
+    rows = _fused_solver_demo_rows()
+    xla, fused = rows["xla"], rows["pallas_fused"]
+    for tier, row in rows.items():
+        assert row["op"] == "cg", tier
+        assert row["n"] == 256 and row["n_devices"] == 8, tier
+        assert row["n_solves"] >= 10, tier
+        assert row["divergences"] == 0, tier
+        assert row["time_per_iter_ms"] > 0, tier
+        assert row["compiles_warmup"] >= 1, tier
+        assert row["compiles_steady"] == 0, (
+            f"{tier}: the rtol sweep recompiled"
+        )
+        # rtol column records the sweep's tightest tolerance; the final
+        # residual must sit within it (float32: modest slack on n=256).
+        assert 0 < row["final_residual"] < row["rtol"] * np.sqrt(256) * 2
+    assert xla["iterations"] == fused["iterations"], (
+        "iteration tiers disagree on the iteration count"
+    )
+    assert fused["final_residual"] == pytest.approx(
+        xla["final_residual"], rel=0.25
+    )
+
+
+def test_fused_solver_demo_metrics_pin_iteration_time():
+    """The fused run's snapshot carries the `solver_iteration_time`
+    histogram the obs panel's `iter time p50` line reads — one sample
+    per materialized solve, quantiles consistent with the CSV row's
+    per-iteration floor."""
+    import json
+
+    path = FUSED_SOLVER_DEMO / "metrics.json"
+    if not path.exists():
+        pytest.skip(f"{path} not committed")
+    snap = json.loads(path.read_text())
+    fused = _fused_solver_demo_rows()["pallas_fused"]
+    c = snap["counters"]
+    assert c["solver_requests_total"] == fused["n_solves"] + 1
+    assert c["solver_divergences_total"] == 0
+    it = snap["histograms"]["solver_iteration_time"]
+    assert it["count"] == c["solver_requests_total"]
+    assert 0 < it["p50"] <= it["p95"]
+    # Histogram samples are per-iteration milliseconds: the p50 sits in
+    # the same decade as the CSV's steady-phase per-iteration time.
+    assert it["p50"] < 10 * fused["time_per_iter_ms"]

@@ -583,10 +583,10 @@ def test_tune_storage_records_decision_and_lookup(tmp_path, monkeypatch):
         assert rb["int8c"] < rb["native"] * 0.55
         assert set(decision["bandwidth_gbps"]) == set(decision["candidates"])
         cache.save()
-        # The JSON file is the current schema (v5 since the cost model's
-        # calibration kind) and the dispatch-side lookup sees it.
+        # The JSON file is the current schema (v6 since the solver
+        # iteration-tier kind) and the dispatch-side lookup sees it.
         raw = json.loads(path.read_text())
-        assert raw["version"] == 5
+        assert raw["version"] == 6
         reset_cache()
         assert lookup_storage(
             strategy="rowwise", m=64, k=512, p=8, dtype="float32"

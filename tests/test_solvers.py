@@ -42,6 +42,7 @@ from matvec_mpi_multiplier_tpu.solvers import (
 )
 from matvec_mpi_multiplier_tpu.utils.errors import (
     ConfigError,
+    ShardingError,
     SolverDivergedError,
 )
 
@@ -268,6 +269,107 @@ def test_acceptance_4096_spd_50_solves_compile_free(mesh):
         assert res.converged
         assert res.residual_norm <= 1e-6 * np.sqrt(4096) * 2
     assert engine.stats.compiles == compiles_warm
+
+
+# ------------------------------------------------- fused iteration tier
+#
+# ops/pallas_solver.py (docs/SOLVERS.md "Fused iteration tier"): the
+# whole while body as ONE pallas_call per iteration, served through the
+# same engine face. Interpret mode on the CPU mesh — numerics and typed
+# contracts, not speed (the race lives in tune_solver_kernel).
+
+
+@pytest.mark.parametrize("op", ["cg", "chebyshev"])
+@pytest.mark.parametrize("strategy", ["rowwise", "colwise"])
+def test_fused_tier_matches_xla_tier_and_numpy(mesh, op, strategy):
+    a = solver_operand(N, "float32", seed=43)
+    b = _rhs(N, dtype="float32")
+    kw = {"interval": gershgorin_interval(a)} if op == "chebyshev" else {}
+    res = {
+        kern: _engine(mesh, a, strategy, solver_kernel=kern).submit(
+            op=op, rhs=b, rtol=1e-5, **kw
+        ).result()
+        for kern in ("xla", "pallas_fused")
+    }
+    fused, xla = res["pallas_fused"], res["xla"]
+    assert fused.converged and xla.converged
+    # Same recurrence, same answer: the tiers differ in fusion schedule,
+    # not math (the tier1.sh smoke pins the full residual trajectory).
+    np.testing.assert_allclose(fused.x, xla.x, rtol=1e-3, atol=1e-5)
+    ref = np.linalg.solve(a.astype("float64"), b.astype("float64"))
+    np.testing.assert_allclose(fused.x, ref, rtol=1e-2, atol=1e-3)
+    # Verified exit survives the tier swap: the reported residual is the
+    # TRUE one, recomputable on host.
+    assert fused.residual_norm == pytest.approx(
+        np.linalg.norm(b - a @ fused.x), rel=1e-3, abs=1e-5
+    )
+
+
+def test_fused_quantized_tier_matches_xla_quantized_tier(mesh):
+    """The int8c-resident fused solve (tile dequant inside the kernel,
+    never a materialized float A — the ``hlo-early-dequant`` gate) lands
+    on the same answer the XLA quantized tier does, within the int8c
+    budget of the native solve."""
+    a = solver_operand(N, "float32", seed=47)
+    b = _rhs(N, dtype="float32")
+    res = {
+        kern: _engine(
+            mesh, a, "colwise", solver_kernel=kern, dtype_storage="int8c"
+        ).submit(op="cg", rhs=b, rtol=1e-5).result()
+        for kern in ("xla", "pallas_fused")
+    }
+    fused, xla = res["pallas_fused"], res["xla"]
+    assert fused.converged and xla.converged
+    # Both tiers solve the SAME quantized operator: tight agreement.
+    np.testing.assert_allclose(fused.x, xla.x, rtol=1e-3, atol=1e-4)
+    # And both sit within the int8c budget of the native solution.
+    ref = np.linalg.solve(a.astype("float64"), b.astype("float64"))
+    np.testing.assert_allclose(fused.x, ref, rtol=5e-2, atol=1e-2)
+
+
+def test_fused_tier_errors_are_typed(mesh):
+    a = solver_operand(N, "float32", seed=53)
+    # Strategy/combine half: at engine CONSTRUCTION, not requests deep.
+    with pytest.raises(ShardingError, match="flat-axis"):
+        _engine(mesh, a, "blockwise", solver_kernel="pallas_fused")
+    with pytest.raises(ShardingError, match="owns the solve body's"):
+        _engine(mesh, a, "colwise", solver_kernel="pallas_fused",
+                combine="ring")
+    with pytest.raises(ConfigError, match="solver_kernel"):
+        _engine(mesh, a, "rowwise", solver_kernel="warp")
+    # Op half: at submit — the engine may serve matvecs and basis-
+    # building ops alongside fused solves.
+    engine = _engine(mesh, a, "rowwise", solver_kernel="pallas_fused")
+    with pytest.raises(ConfigError, match="fixed-recurrence"):
+        engine.submit(op="gmres", rhs=_rhs(N, dtype="float32"))
+
+
+@pytest.mark.parametrize("kern", ["xla", "pallas_fused"])
+def test_chebyshev_interval_edges_are_typed(mesh, kern):
+    """Satellite contract: a reversed, zero-width, or nonpositive
+    interval is a CONFIG mistake (typed at submit), and an interval that
+    excludes the spectrum diverges TYPED — never a silent maxiter'd loop
+    returning a wrong x. Identical on both iteration tiers."""
+    a = solver_operand(N, "float32", seed=59)
+    b = _rhs(N, dtype="float32")
+    engine = _engine(mesh, a, "colwise", solver_kernel=kern)
+    for interval in ((10.0, 0.5), (3.0, 3.0), (0.0, 5.0)):
+        with pytest.raises(ConfigError, match="interval"):
+            engine.submit(op="chebyshev", rhs=b, interval=interval)
+    # Spectrum of the seeded operand lives in [24.5, 57.4], entirely
+    # ABOVE lambda_max=10: the Chebyshev polynomials explode on every
+    # eigenvalue and the growth predicate (DIVERGENCE_GROWTH) exits
+    # typed long before the cap.
+    with pytest.raises(SolverDivergedError):
+        engine.submit(
+            op="chebyshev", rhs=b, rtol=1e-5, interval=(1.0, 10.0)
+        ).result()
+    # The engine is unharmed: a sound interval converges next solve.
+    res = engine.submit(
+        op="chebyshev", rhs=b, rtol=1e-5,
+        interval=gershgorin_interval(a),
+    ).result()
+    assert res.converged
 
 
 # ------------------------------------------- iteration-structure formulas

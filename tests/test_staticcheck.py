@@ -1408,3 +1408,152 @@ def test_mutation_stray_collective_fails_solver_kind_gate(devices):
     findings = solver_findings(scfg, bad, mesh)
     assert any(f.rule == "hlo-solver-schedule" for f in findings), findings
     assert any("all_gather" in f.message for f in findings)
+
+
+# ------------------------------------------------- fused-solver audit
+
+
+def test_fused_solver_audit_covers_both_ops_and_the_quantized_cell():
+    """The schema-6 coverage contract: both fixed-recurrence ops across
+    both supported strategy faces, plus the int8c-resident cell whose
+    zero-dequant pin is the quantized tier's acceptance criterion."""
+    from matvec_mpi_multiplier_tpu.ops.pallas_solver import (
+        FUSED_SOLVER_OPS,
+    )
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        FUSED_SOLVER_AUDIT_CONFIGS,
+    )
+
+    assert {c.op for c in FUSED_SOLVER_AUDIT_CONFIGS} == set(
+        FUSED_SOLVER_OPS
+    )
+    faces = {
+        (c.strategy, c.combine, c.storage)
+        for c in FUSED_SOLVER_AUDIT_CONFIGS
+    }
+    assert faces == {
+        ("rowwise", "gather", "native"),
+        ("colwise", "psum", "native"),
+        ("colwise", "psum", "int8c"),
+    }
+
+
+def test_fused_solver_trace_passes_structural_gates(devices):
+    """One real fused trace per storage face: exactly one while loop,
+    exactly ONE pallas_call in its body, exactly the canonical combine's
+    single collective hop, and — on the int8c cell — zero full-shard
+    dequant converts outside the kernel. This is the tentpole's census
+    pin exercised end-to-end, not against the golden file."""
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        FUSED_SOLVER_AUDIT_CONFIGS,
+        fused_solver_audit_entry,
+        fused_solver_findings,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    for storage in ("native", "int8c"):
+        fcfg = next(
+            c for c in FUSED_SOLVER_AUDIT_CONFIGS
+            if c.op == "cg" and c.strategy == "colwise"
+            and c.storage == storage
+        )
+        entry = fused_solver_audit_entry(fcfg, mesh)
+        assert entry["while_ops"] == 1, entry
+        assert entry["pallas_calls"] == 1, entry
+        assert entry["census"] == {"psum": 1}, entry
+        assert entry["lowbit_shard_converts"] == 0, entry
+        assert fused_solver_findings(fcfg, entry) == []
+
+
+def test_mutation_unfused_body_fails_fused_census(devices):
+    """Mutation direction 1 (the acceptance criterion's first red): a
+    deliberately UNFUSED body — the XLA tier's real lowering traced
+    through the fused census — has zero pallas_calls and trips
+    hlo-fused-solver. Guards against the tier silently degrading to the
+    launch structure it exists to collapse."""
+    import jax
+    import numpy as np
+
+    from matvec_mpi_multiplier_tpu.models import get_strategy
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.solvers import build_solver
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        FUSED_SOLVER_AUDIT_CONFIGS,
+        FUSED_SOLVER_AUDIT_N,
+        fused_solver_audit_entry,
+        fused_solver_findings,
+    )
+
+    mesh = make_mesh(AUDIT_DEVICES)
+    fcfg = next(
+        c for c in FUSED_SOLVER_AUDIT_CONFIGS
+        if c.op == "cg" and c.strategy == "colwise"
+        and c.storage == "native"
+    )
+    n = FUSED_SOLVER_AUDIT_N
+    dt = np.dtype(np.float32)
+    fn = build_solver(
+        fcfg.op, get_strategy(fcfg.strategy), mesh, dtype=dt,
+        kernel="xla", combine=fcfg.combine,
+    )
+    f32 = jax.ShapeDtypeStruct((), np.float32)
+    i32 = jax.ShapeDtypeStruct((), np.int32)
+    jaxpr = jax.make_jaxpr(fn)(
+        jax.ShapeDtypeStruct((n, n), dt), jax.ShapeDtypeStruct((n,), dt),
+        f32, i32, f32, f32,
+    )
+    entry = fused_solver_audit_entry(fcfg, mesh, jaxpr=jaxpr)
+    assert entry["pallas_calls"] == 0
+    findings = fused_solver_findings(fcfg, entry)
+    assert any(
+        f.rule == "hlo-fused-solver" and "pallas_call" in f.message
+        for f in findings
+    ), findings
+
+
+def test_mutation_stray_collective_fails_fused_census(devices):
+    """Mutation direction 2: a second collective smuggled into the fused
+    body (census {psum, all_gather}) trips hlo-fused-solver — fabricated
+    entry, same precedent as the XLA solver audit's stray-kind test."""
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        FUSED_SOLVER_AUDIT_CONFIGS,
+        fused_solver_findings,
+    )
+
+    fcfg = next(
+        c for c in FUSED_SOLVER_AUDIT_CONFIGS
+        if c.op == "cg" and c.strategy == "colwise"
+        and c.storage == "native"
+    )
+    bad = {
+        "while_ops": 1, "pallas_calls": 1,
+        "census": {"psum": 1, "all_gather": 1},
+        "lowbit_shard_converts": 0,
+    }
+    findings = fused_solver_findings(fcfg, bad)
+    assert any(
+        f.rule == "hlo-fused-solver" and "stray" in f.message
+        for f in findings
+    ), findings
+
+
+def test_mutation_full_shard_dequant_fails_fused_quant_gate(devices):
+    """The extended early-dequant gate: an int8c fused entry reporting a
+    full-shard low-bit convert outside the kernel trips
+    hlo-early-dequant — the quantized fused tier must never materialize
+    a dequantized A."""
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        FUSED_SOLVER_AUDIT_CONFIGS,
+        fused_solver_findings,
+    )
+
+    fcfg = next(
+        c for c in FUSED_SOLVER_AUDIT_CONFIGS if c.storage == "int8c"
+    )
+    bad = {
+        "while_ops": 1, "pallas_calls": 1, "census": {"psum": 1},
+        "lowbit_shard_converts": 1,
+    }
+    findings = fused_solver_findings(fcfg, bad)
+    assert any(f.rule == "hlo-early-dequant" for f in findings), findings

@@ -607,7 +607,86 @@ def test_tune_promotion_smoke(devices, cache_path):
     ) is None
 
 
+# ------------------------------------------------ solver iteration tier
+
+
+def test_tune_solver_kernel_smoke(devices, cache_path, monkeypatch):
+    """One real (tiny, interpret-gated) solver-tier race: both tiers run
+    the SAME fixed-iteration solve (rtol=0 pins SOLVER_RACE_ITERS
+    while-body trips), the winner and both candidates' per-iteration
+    times are recorded, and lookup_solver_kernel serves the decision —
+    which the engine's solver_kernel="auto" then consumes."""
+    from matvec_mpi_multiplier_tpu.engine import MatvecEngine
+    from matvec_mpi_multiplier_tpu.tuning import lookup_solver_kernel
+    from matvec_mpi_multiplier_tpu.tuning.search import (
+        SOLVER_RACE_ITERS,
+        tune_solver_kernel,
+    )
+
+    # Off-TPU the fused candidate runs in interpret mode — never a fair
+    # race, so it is gated out of tuning unless explicitly opted in.
+    monkeypatch.setenv("MATVEC_TUNE_PALLAS", "1")
+    mesh = make_mesh(8)
+    cache = TuningCache.load(cache_path)
+    decision = tune_solver_kernel(
+        "cg", "rowwise", mesh, 64, 64, "float32", cache,
+        n_reps=2, samples=1, measure="sync", log=lambda *_: None,
+    )
+    assert decision is not None
+    assert decision["solver_kernel"] in ("xla", "pallas_fused")
+    assert set(decision["candidates"]) == {"xla", "pallas_fused"}
+    assert decision["race_iters"] == SOLVER_RACE_ITERS
+    assert decision["iter_s"] == pytest.approx(
+        decision["time_s"] / SOLVER_RACE_ITERS
+    )
+    cache.save()
+    reset_cache()
+    assert lookup_solver_kernel(
+        op="cg", strategy="rowwise", m=64, k=64, p=8, dtype="float32",
+        storage="native",
+    ) == decision
+    a = np.random.default_rng(0).standard_normal((64, 64)).astype("float32")
+    a = a @ a.T + 64 * np.eye(64, dtype="float32")
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=None,
+                          solver_kernel="auto")
+    assert engine._resolve_solver_kernel("cg") == decision["solver_kernel"]
+    # auto never routes a basis-building op at the fused tier.
+    assert engine._resolve_solver_kernel("gmres") == "xla"
+
+
+def test_tune_solver_kernel_skips_untunable_cells(devices, cache_path):
+    """No silent work on cells the fused tier cannot serve: non-square
+    shapes, basis-building ops, and 2-D-sharded strategies return None
+    without racing anything."""
+    from matvec_mpi_multiplier_tpu.tuning.search import tune_solver_kernel
+
+    mesh = make_mesh(8)
+    cache = TuningCache.load(cache_path)
+    kw = dict(n_reps=2, samples=1, log=lambda *_: None)
+    assert tune_solver_kernel(
+        "cg", "rowwise", mesh, 64, 128, "float32", cache, **kw
+    ) is None
+    assert tune_solver_kernel(
+        "gmres", "rowwise", mesh, 64, 64, "float32", cache, **kw
+    ) is None
+    assert tune_solver_kernel(
+        "cg", "blockwise", mesh, 64, 64, "float32", cache, **kw
+    ) is None
+    assert len(cache) == 0
+
+
 # ------------------------------------------------- multi-host broadcast
+
+
+@pytest.mark.parametrize("version", [4, 5])
+def test_prior_schema_files_still_load(cache_path, version):
+    """v6 bump compatibility: v4/v5 files (pre-solver-kernel entries)
+    keep serving their decisions instead of forcing a silent re-tune."""
+    key = gemv_key(8, 8, "float32")
+    cache_path.write_text(json.dumps({
+        "version": version, "entries": {key: {"kernel": "xla"}},
+    }))
+    assert TuningCache.load(cache_path).lookup(key) == {"kernel": "xla"}
 
 
 def test_cache_v1_file_still_loads(cache_path):
