@@ -1028,6 +1028,9 @@ def run_serve_multitenant(
     demand_weight: float = 0.0,
     deadline_margin: float = 1.0,
     decision_jsonl: str | None = None,
+    reshard: str = "off",
+    reshard_cooldown_s: float = 30.0,
+    reshard_horizon_s: float = 30.0,
 ) -> MultiTenantResult:
     """Run the multi-tenant trace protocol for one (strategy, shape,
     mesh) config: ``n_tenants`` seeded matrices registered against
@@ -1063,7 +1066,10 @@ def run_serve_multitenant(
     carries end-to-end p50/p99 over served requests plus the
     ``deadline_expires``/``rejected`` split. ``max_in_flight`` arms the
     engines' backpressure gate so overload queues instead of enqueueing
-    unboundedly — the greedy failure mode admission control deletes."""
+    unboundedly — the greedy failure mode admission control deletes.
+    ``reshard="auto"`` additionally arms the scheduler's online-
+    resharding crossover trigger (docs/RESHARDING.md); the dedicated
+    drifting-shape A/B protocol lives in :func:`run_reshard_drift`."""
     from ..utils.io import generate_matrix
 
     if n_tenants < 1:
@@ -1188,6 +1194,9 @@ def run_serve_multitenant(
                 registry, cost_model="auto",
                 deadline_margin=deadline_margin,
                 decision_jsonl=decision_jsonl,
+                reshard=reshard,
+                reshard_cooldown_s=reshard_cooldown_s,
+                reshard_horizon_s=reshard_horizon_s,
             )
         submit = (
             gs.submit if gs is not None
@@ -1351,6 +1360,230 @@ def run_serve_multitenant(
         p50_e2e_ms=e2e_hist.percentile(50),
         p99_e2e_ms=e2e_hist.percentile(99),
     )
+
+
+# ---- the drifting-shape online-resharding A/B (docs/RESHARDING.md) ----
+
+RESHARD_AB_CSV_HEADER = (
+    "m, k, p, strategy, dtype, reshard, n_tenants, zipf_a, n_requests, "
+    "rollover, steady_skip, width_steady, wall_s, p50_pre_ms, "
+    "p99_pre_ms, p50_steady_ms, p99_steady_ms, reshards, reshard_bytes, "
+    "compiles_total, compiles_steady, last_reshard_at, final_strategies"
+)
+
+
+def reshard_csv_path(root=None):
+    from .metrics import out_dir
+
+    return out_dir(root) / "reshard_ab.csv"
+
+
+def append_reshard_result(result: dict, root=None):
+    from ..parallel.distributed import is_main_process
+    from .metrics import _append_row
+
+    path = reshard_csv_path(root)
+    if not is_main_process():
+        return path
+    r = result
+    finals = "|".join(
+        f"{tid}:{s}" for tid, s in sorted(r["final_strategies"].items())
+    )
+    _append_row(
+        path, RESHARD_AB_CSV_HEADER,
+        f"{r['m']}, {r['k']}, {r['p']}, {r['strategy']}, {r['dtype']}, "
+        f"{r['reshard']}, {r['n_tenants']}, {r['zipf_a']:.3f}, "
+        f"{r['n_requests']}, {r['rollover']}, {r['steady_skip']}, "
+        f"{r['width_steady']}, {r['wall_s']:.6f}, "
+        f"{r['p50_pre_ms']:.4f}, {r['p99_pre_ms']:.4f}, "
+        f"{r['p50_steady_ms']:.4f}, {r['p99_steady_ms']:.4f}, "
+        f"{r['reshards']}, {r['reshard_bytes']}, {r['compiles_total']}, "
+        f"{r['compiles_steady']}, {r['last_reshard_at']}, {finals}",
+    )
+    return path
+
+
+def run_reshard_drift(
+    strategy_name: str,
+    mesh,
+    m: int,
+    k: int,
+    *,
+    dtype: str = "float32",
+    kernel: str = "xla",
+    n_tenants: int = 3,
+    zipf_a: float = 1.1,
+    n_requests: int = 200,
+    rollover: int = 24,
+    width_steady: int = 8,
+    pre_rate: float = 6.0,
+    steady_skip: int = 48,
+    seed: int = 0,
+    reshard: str = "off",
+    reshard_cooldown_s: float = 30.0,
+    reshard_horizon_s: float = 0.5,
+    rate_tau_s: float = 0.1,
+    metrics_out: str | None = None,
+    decision_jsonl: str | None = None,
+) -> dict:
+    """The ``--reshard auto|off`` A/B protocol (docs/RESHARDING.md): a
+    Zipf fleet registered in ``strategy_name`` serves a trace whose
+    SHAPE drifts at the ``rollover`` index — width-1 vector requests
+    trickling at ``pre_rate`` req/s before it, closed-loop
+    ``width_steady``-column blocks after it. Registering in a layout
+    the cost model scores poorly for the steady shape (the study script
+    picks the predicted-worst) puts the fleet on the wrong side of the
+    crossover surface the moment the shape drifts; with
+    ``reshard="auto"`` the :class:`~..engine.GlobalScheduler` trigger
+    migrates each tenant on-device once its EWMA demand amortizes the
+    collectives, with ``"off"`` the fleet stays frozen in the
+    registered layout — same seeded trace, so the steady-state
+    percentile columns are directly comparable.
+
+    Measurement discipline: every request is closed-loop (submit then
+    materialize), so per-request e2e latency is service time, not
+    drain-order artifact. The steady window opens ``steady_skip``
+    requests after the rollover — wide enough that the one-time
+    migration (and its ``warm_widths`` new-layout compile) lands inside
+    the skip, which the ``compiles_steady == 0`` gate then enforces:
+    post-migration steady state must replay warm executables only.
+    ``last_reshard_at`` (request index of the last migration, -1 when
+    none) lets the caller assert the migrations really did land before
+    the window. The pre-phase trickle is the drift's OTHER half: at
+    ``pre_rate`` below ``1 / reshard_horizon_s`` the amortization
+    damper holds the trigger off, so the migration is attributable to
+    the demand+shape drift, not to registration-time misprediction."""
+    from ..utils.io import generate_matrix
+
+    if reshard not in ("auto", "off"):
+        raise ConfigError(
+            f"reshard must be 'auto' or 'off', got {reshard!r}"
+        )
+    if not (0 < rollover < n_requests):
+        raise ConfigError(
+            f"rollover must be in (0, {n_requests}), got {rollover}"
+        )
+    if rollover + steady_skip >= n_requests:
+        raise ConfigError(
+            f"steady window is empty: rollover={rollover} + "
+            f"steady_skip={steady_skip} >= n_requests={n_requests}"
+        )
+    registry_metrics = MetricsRegistry()
+    registry = MatrixRegistry(
+        mesh,
+        metrics=registry_metrics,
+        rate_tau_s=rate_tau_s,
+        strategy=strategy_name, kernel=kernel, dtype=dtype,
+        max_bucket=max(width_steady, 1),
+    )
+    tenant_ids = [f"tenant-{i}" for i in range(n_tenants)]
+    gs = None
+    try:
+        for i, tid in enumerate(tenant_ids):
+            registry.register(
+                tid, generate_matrix(m, k, seed=seed + i).astype(dtype)
+            )
+        # Warmup covers BOTH trace widths in the REGISTERED layout, so
+        # the frozen arm's wide compile lands here, not in its steady
+        # window — the compiles_steady gate must be symmetric.
+        registry.warmup(widths=[1, width_steady])
+
+        from ..engine import GlobalScheduler
+
+        gs = GlobalScheduler(
+            registry, cost_model="auto",
+            decision_jsonl=decision_jsonl,
+            reshard=reshard,
+            reshard_cooldown_s=reshard_cooldown_s,
+            reshard_horizon_s=reshard_horizon_s,
+        )
+        rng = np.random.default_rng(seed + 2)
+        tenant_seq = rng.choice(
+            n_tenants, size=n_requests, p=_zipf_probs(n_tenants, zipf_a)
+        )
+        xpool = [rng.standard_normal(k).astype(dtype) for _ in range(4)]
+        xbpool = [
+            rng.standard_normal((k, width_steady)).astype(dtype)
+            for _ in range(4)
+        ]
+        counters0 = registry_metrics.snapshot()["counters"]
+        compiles_warm = counters0.get("engine_compiles_total", 0)
+        compiles_at_window = None
+        lat_ms = np.zeros(n_requests)
+        reshards_seen = 0
+        last_reshard_at = -1
+        gap_s = (1.0 / pre_rate) if pre_rate else 0.0
+        start = time.perf_counter()
+        for j, t in enumerate(tenant_seq):
+            if j < rollover:
+                # Pre-drift trickle: paced arrivals hold the EWMA
+                # below the amortization threshold.
+                arrival = start + j * gap_s
+                while True:
+                    now = time.perf_counter()
+                    if now >= arrival:
+                        break
+                    time.sleep(min(arrival - now, 5e-4))
+                x = xpool[j % len(xpool)]
+            else:
+                x = xbpool[j % len(xbpool)]
+            if j == rollover + steady_skip:
+                compiles_at_window = registry_metrics.snapshot()[
+                    "counters"
+                ].get("engine_compiles_total", 0)
+            t0 = time.perf_counter()
+            y = gs.submit(tenant_ids[t], x)
+            np.asarray(y.result())  # closed loop: e2e IS service time
+            lat_ms[j] = (time.perf_counter() - t0) * 1e3
+            n_resh = registry_metrics.snapshot()["counters"].get(
+                "registry_reshards_total", 0
+            )
+            if n_resh > reshards_seen:
+                reshards_seen = n_resh
+                last_reshard_at = j
+        wall = time.perf_counter() - start
+        counters = registry_metrics.snapshot()["counters"]
+        compiles_total = counters.get(
+            "engine_compiles_total", 0
+        ) - compiles_warm
+        if compiles_at_window is None:  # degenerate: window at trace end
+            compiles_at_window = counters.get("engine_compiles_total", 0)
+        health = registry.health()
+        finals = {
+            tid: health["tenants"][tid]["strategy"] for tid in tenant_ids
+        }
+        if metrics_out is not None:
+            path = Path(metrics_out)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(
+                json.dumps(registry_metrics.snapshot(), indent=2) + "\n"
+            )
+    finally:
+        if gs is not None:
+            gs.close()
+        registry.close()
+
+    pre = lat_ms[:rollover]
+    steady = lat_ms[rollover + steady_skip:]
+    return {
+        "m": m, "k": k, "p": int(mesh.devices.size),
+        "strategy": strategy_name, "dtype": dtype, "reshard": reshard,
+        "n_tenants": n_tenants, "zipf_a": float(zipf_a),
+        "n_requests": n_requests, "rollover": rollover,
+        "steady_skip": steady_skip, "width_steady": width_steady,
+        "wall_s": wall,
+        "p50_pre_ms": float(np.percentile(pre, 50)),
+        "p99_pre_ms": float(np.percentile(pre, 99)),
+        "p50_steady_ms": float(np.percentile(steady, 50)),
+        "p99_steady_ms": float(np.percentile(steady, 99)),
+        "reshards": counters.get("registry_reshards_total", 0),
+        "reshard_bytes": counters.get("reshard_bytes_total", 0),
+        "compiles_total": compiles_total,
+        "compiles_steady": counters.get("engine_compiles_total", 0)
+        - compiles_at_window,
+        "last_reshard_at": last_reshard_at,
+        "final_strategies": finals,
+    }
 
 
 def run_serve(
@@ -2001,6 +2234,9 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                                 decision_jsonl=getattr(
                                     args, "decision_jsonl", None
                                 ) if gsched_on else None,
+                                reshard=getattr(
+                                    args, "reshard", "off"
+                                ) if gsched_on else "off",
                             )
                         except MatvecError as e:
                             print(f"skip {name} {m}x{k} p={n_dev}: {e}")
@@ -2348,6 +2584,18 @@ def build_parser() -> argparse.ArgumentParser:
         "interleaving/coalescing, demand-aware eviction. 'both' runs "
         "the greedy baseline then the scheduled run on the SAME seeded "
         "trace (the A/B protocol of data/gsched_demo/)",
+    )
+    p.add_argument(
+        "--reshard", choices=["auto", "off"], default="off",
+        help="with --tenants --global-sched on: arm the online-"
+        "resharding crossover trigger (docs/RESHARDING.md) — when the "
+        "cost model predicts another layout beats a tenant's current "
+        "one by more than the amortized migration collectives over its "
+        "EWMA demand horizon, the scheduler migrates the resident A "
+        "on-device (MatrixRegistry.reshard). 'off' keeps every tenant "
+        "frozen in its registered layout — the baseline arm of the "
+        "data/reshard_demo/ drifting-shape A/B "
+        "(scripts/reshard_study.py)",
     )
     p.add_argument(
         "--deadline-ms", type=float, default=None, dest="deadline_ms",

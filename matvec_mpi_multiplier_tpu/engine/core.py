@@ -118,6 +118,7 @@ from ..models.base import (
 from ..obs.registry import MetricsRegistry
 from ..ops.quantize import (
     NATIVE,
+    default_block,
     fp8_supported,
     normalize_storage,
     quantize_matrix,
@@ -739,7 +740,7 @@ class MatvecEngine:
         # Replicated sharding for the solver path's RHS and scalar operands
         # (rtol/maxiter/interval ride as dynamic scalars — docs/SOLVERS.md).
         self._sh_rep = NamedSharding(mesh, PartitionSpec())
-        self.storage = self._resolve_storage(dtype_storage)
+        self.storage = self._resolve_storage_locked(dtype_storage)
         self._a_native = None  # lazy native residency (the ladder's safe tier)
         self.retain_host = bool(retain_host)
         if defer_placement and not self.retain_host:
@@ -756,6 +757,29 @@ class MatvecEngine:
         # lock) — the device-transfer-under-registry-lock rule's
         # discipline.
         self._residency_lock = threading.Lock()
+        # Online-reshard fence (docs/RESHARDING.md): each dispatch region
+        # holds it so one request sees ONE consistent
+        # (strategy, shardings, residency) tuple; reshard() holds it only
+        # for the pointer swap, so in-flight dispatches finish on the old
+        # layout and new submits wait out at most the swap itself — never
+        # the migration collectives. RLock: the dispatch region may
+        # re-enter through the resilience ladder. Ordering: _swap_lock ->
+        # _residency_lock -> registry lock (via the residency listener);
+        # the registry never holds its own lock across engine calls, so
+        # the chain is acyclic.
+        self._swap_lock = threading.RLock()
+        # Serializes whole reshard() calls (build + migrate + commit) —
+        # distinct from the brief commit fence above.
+        self._reshard_lock = threading.Lock()
+        # Bumped at every committed layout swap; stale-placement guard for
+        # the enqueue-only residency paths (they stage device_puts OUTSIDE
+        # the locks, so a swap mid-placement must invalidate the staged
+        # old-layout buffer, not install it).
+        self._layout_epoch = 0
+        # Test seam: called between migration build and commit so the
+        # eviction-races-reshard test can inject a release_residency at
+        # the worst moment (tests/test_reshard.py).
+        self._reshard_pre_commit: Callable[[], None] | None = None
         self._a = None  # device residency; placed below unless deferred
         if self.storage != NATIVE:
             # Quantize ONCE at residency: payload + per-block scales (+ the
@@ -830,14 +854,14 @@ class MatvecEngine:
             self.spec_storage_block = None
             self.spec_resident_bytes = 0
         self._spec_qa = self._spec_p = self._spec_u = None
-        self._matvec_combine, self._gemm_combine = self._resolve_combine(
+        self._matvec_combine, self._gemm_combine = self._resolve_combine_locked(
             combine
         )
         if self.storage != NATIVE:
             # Auto-resolved combine winners from the A-tiling family cannot
             # consume the payload pytree: drop to the static default (the
             # same filter the build layer's auto tier applies). Explicit
-            # incompatible names already failed in _resolve_combine.
+            # incompatible names already failed in _resolve_combine_locked.
             if self._matvec_combine in STORAGE_INCOMPATIBLE_COMBINES:
                 self._matvec_combine = None
             if self._gemm_combine in STORAGE_INCOMPATIBLE_COMBINES:
@@ -852,8 +876,13 @@ class MatvecEngine:
             check_fused_solver(
                 "cg", self.strategy.name, self._requested_combine, mesh
             )
-        self.stages = self._resolve_stages(stages)
-        self.b_star = self._resolve_promotion(promote)
+        # The REQUESTED stage/promotion asks, kept so a reshard can
+        # re-resolve them against the destination strategy exactly as a
+        # fresh construction would (same tuning lookups, same clamps).
+        self._requested_stages = stages
+        self._requested_promote = promote
+        self.stages = self._resolve_stages_locked(stages)
+        self.b_star = self._resolve_promotion_locked(promote)
         if max_in_flight is not None and max_in_flight < 1:
             raise ConfigError(
                 f"max_in_flight must be >= 1, got {max_in_flight}"
@@ -1066,32 +1095,44 @@ class MatvecEngine:
         without ``retain_host`` (no payload to place from)."""
         if self._a is not None:  # unguarded-ok: double-checked placement — the decisive re-check runs under _residency_lock below; this bare read only skips staging work
             return False
-        payload = self._qa_host if self.storage != NATIVE else self._a_host
-        if payload is None:
-            raise ResidencyError(
-                "resident A was released and the engine retains no host "
-                "payload (construct with retain_host=True for releasable "
-                "residency)"
+        while True:
+            # Layout-epoch guard: the staging below reads the host payload
+            # and sharding OUTSIDE the lock (device_put must not run under
+            # it), so a reshard commit in between would otherwise install
+            # an old-layout buffer over the new config. A bumped epoch
+            # restages against the post-swap sharding instead.
+            epoch = self._layout_epoch  # unguarded-ok: deliberate stage-outside-lock read; the epoch re-check under _residency_lock below is decisive, and a lost race is a dropped buffer, not corruption
+            payload = (
+                self._qa_host if self.storage != NATIVE else self._a_host  # unguarded-ok: deliberate stage-outside-lock read; the epoch re-check under _residency_lock below is decisive, and a lost race is a dropped buffer, not corruption
             )
-        placed = jax.device_put(payload, self._sh_a)
-        spec = None
-        if self.speculative:
-            # The speculative set rides the payload residency: placed
-            # together, accounted together (resident_bytes includes it),
-            # re-placed bitwise-identically from the same host arrays on
-            # a registry swap-in.
-            spec = (
-                jax.device_put(self._spec_qa_host, self._sh_a),
-                jax.device_put(self._spec_p_host, self._sh_p),
-                jax.device_put(self._spec_u_host, self._sh_rep),
-            )
-        with self._residency_lock:
-            if self._a is not None:
-                return False  # lost a concurrent placement race
-            self._a = placed
-            if spec is not None:
-                self._spec_qa, self._spec_p, self._spec_u = spec
-        self._notify_residency(self.resident_bytes, "resident")
+            if payload is None:
+                raise ResidencyError(
+                    "resident A was released and the engine retains no host "
+                    "payload (construct with retain_host=True for releasable "
+                    "residency)"
+                )
+            placed = jax.device_put(payload, self._sh_a)  # unguarded-ok: deliberate stage-outside-lock read; the epoch re-check under _residency_lock below is decisive, and a lost race is a dropped buffer, not corruption
+            spec = None
+            if self.speculative:  # unguarded-ok: deliberate stage-outside-lock read; the epoch re-check under _residency_lock below is decisive, and a lost race is a dropped buffer, not corruption
+                # The speculative set rides the payload residency: placed
+                # together, accounted together (resident_bytes includes it),
+                # re-placed bitwise-identically from the same host arrays on
+                # a registry swap-in.
+                spec = (
+                    jax.device_put(self._spec_qa_host, self._sh_a),  # unguarded-ok: deliberate stage-outside-lock read; the epoch re-check under _residency_lock below is decisive, and a lost race is a dropped buffer, not corruption
+                    jax.device_put(self._spec_p_host, self._sh_p),  # unguarded-ok: deliberate stage-outside-lock read; the epoch re-check under _residency_lock below is decisive, and a lost race is a dropped buffer, not corruption
+                    jax.device_put(self._spec_u_host, self._sh_rep),
+                )
+            with self._residency_lock:
+                if self._layout_epoch != epoch:
+                    continue  # resharded mid-placement: restage
+                if self._a is not None:
+                    return False  # lost a concurrent placement race
+                self._a = placed
+                if spec is not None:
+                    self._spec_qa, self._spec_p, self._spec_u = spec
+            break
+        self._notify_residency(self.resident_bytes, "resident")  # unguarded-ok: accounting snapshot taken after the commit; the listener reconciles against the ledger
         return True
 
     def release_residency(self) -> int:
@@ -1120,6 +1161,249 @@ class MatvecEngine:
         self._notify_residency(-released, "released")
         return released
 
+    def reshard(self, strategy, *, warm_widths=None) -> dict:
+        """Migrate the resident operand set to another strategy ON-DEVICE
+        (docs/RESHARDING.md): the payload — and a quantized resident's
+        payload+scale leaves — move between layouts as the minimal
+        ``all_to_all``/``ppermute`` program (``parallel/reshard.py``),
+        never a host gather, and the engine's config (shardings, combine,
+        stages, b*) re-resolves against the destination exactly as a
+        fresh construction would. In-flight dispatches finish on the old
+        layout; a submit racing the commit waits out only the pointer
+        swap (``_swap_lock``), never the migration collectives. The
+        migrated resident is bitwise-identical to a fresh registration in
+        the destination layout (each device shard equal; tests pin it).
+
+        Per-block scales are recomputed from the retained host ``A``
+        ONLY when the block→shard mapping changes between the layouts
+        (the destination's contraction split forces a different block
+        size); same-block migrations move the existing scale leaves with
+        the payload, bitwise.
+
+        An eviction that lands mid-migration aborts cleanly: the commit
+        swaps the CONFIG only (the next ``ensure_resident`` places in
+        the destination layout from host), so the HBM ledger never holds
+        a double footprint. Returns a summary dict —
+        ``{src, dst, migrated, aborted, requantized, bytes_moved}`` —
+        ``bytes_moved`` being the per-mesh payload bytes the collective
+        program redistributed (0 for a config-only or host-fallback
+        swap). ``warm_widths`` forwards to :meth:`warmup` after the
+        swap: the one-time new-layout compile, off the request path.
+        """
+        from ..parallel.reshard import (
+            RESHARD_STRATEGIES,
+            build_reshard,
+            validate_reshard,
+        )
+
+        dst = (
+            get_strategy(strategy) if isinstance(strategy, str) else strategy
+        )
+        with self._reshard_lock:  # serialize whole migrations
+            src = self.strategy
+            result = dict(
+                src=src.name, dst=dst.name, migrated=False, aborted=False,
+                requantized=False, bytes_moved=0,
+            )
+            if dst.name == src.name:
+                return result
+            for name in (src.name, dst.name):
+                if name not in RESHARD_STRATEGIES:
+                    raise ConfigError(
+                        f"online reshard covers {RESHARD_STRATEGIES}; "
+                        f"asked for {src.name!r} -> {dst.name!r}"
+                    )
+            dst.validate(self.m, self.k, self.mesh)
+            validate_reshard((self.m, self.k), self.mesh)
+            if self.storage != NATIVE and not dst.storage_combine_ok(None):
+                raise ConfigError(
+                    f"strategy {dst.name!r} binds an A-tiling combine and "
+                    f"cannot host the quantized resident (storage="
+                    f"{self.storage!r})"
+                )
+            # The explicit combine ask re-validates against the destination;
+            # one with no destination spelling degrades to the static default
+            # (a reshard must not fail a tenant over a schedule name).
+            req = self._requested_combine
+            if req not in (None, "auto") and (
+                not dst.supports_combine(req)
+                or (
+                    self.storage != NATIVE
+                    and not dst.storage_combine_ok(req)
+                )
+            ):
+                req = None
+
+            with self._residency_lock:
+                src_a = self._a
+                src_spec_qa = self._spec_qa
+                src_spec_p = self._spec_p
+                src_spec_u = self._spec_u
+            resident = src_a is not None
+            dst_shards = dst.contraction_shards(self.mesh)
+            new_sh_a, new_sh_x = dst.shardings(self.mesh)
+            _, new_sh_b = dst.batched_shardings(self.mesh)
+            new_sh_p = None
+            if self.speculative:
+                spec_x = dst.specs(self.mesh)[1]
+                new_sh_p = NamedSharding(
+                    self.mesh, PartitionSpec(None, *tuple(spec_x))
+                )
+
+            # ---- payload migration plan (outside every lock: builds,
+            # collectives and device_puts are all enqueue-only).
+            requant = None
+            new_block = self.storage_block
+            fn = None
+            new_a = None
+            bytes_moved = 0
+            if self.storage != NATIVE:
+                new_block = default_block(self.k, dst_shards)
+                scales_shape = (self.m, self.k // new_block)
+                try:
+                    if new_block != self.storage_block:
+                        raise ConfigError("block→shard mapping changed")
+                    validate_reshard(scales_shape, self.mesh, what="scales")
+                except ConfigError:
+                    # Scales must be recomputed (or cannot split across
+                    # the mesh): re-quantize from the retained host A —
+                    # quantized engines always keep it (the native safe
+                    # tier's source).
+                    if self._a_host is None:
+                        raise ResidencyError(
+                            "reshard needs the host A to recompute "
+                            "per-block scales, and this engine retains "
+                            "none"
+                        )
+                    requant = quantize_matrix(
+                        self._a_host, self.storage,
+                        contraction_shards=dst_shards,
+                    )
+                    new_block = requant.block
+            if resident:
+                if requant is not None:
+                    new_a = jax.device_put(requant, new_sh_a)  # registry-ok: enqueue-only placement under the per-engine migration serializer, not the registry mutex — no tenant admission waits on _reshard_lock
+                else:
+                    fn = build_reshard(self.mesh, src.name, dst.name)
+                    new_a = fn(src_a)
+                    bytes_moved = sum(
+                        leaf.nbytes
+                        for leaf in jax.tree_util.tree_leaves(src_a)
+                    )
+            new_spec = (None, None, None)
+            if self.speculative and resident:
+                # The speculative set rides along: the int8c candidate
+                # payload takes the same collective program when its
+                # block survives the move; the probe projection P only
+                # changes SHARDING (its values are layout-free), and U
+                # stays replicated.
+                spec_block = default_block(self.k, dst_shards)
+                spec_scales = (self.m, self.k // spec_block)
+                try:
+                    if spec_block != self.spec_storage_block:
+                        raise ConfigError("spec block changed")
+                    validate_reshard(spec_scales, self.mesh, what="scales")
+                    if fn is None:
+                        fn = build_reshard(self.mesh, src.name, dst.name)
+                    new_spec_qa = fn(src_spec_qa)
+                    bytes_moved += sum(
+                        leaf.nbytes
+                        for leaf in jax.tree_util.tree_leaves(src_spec_qa)
+                    )
+                except ConfigError:
+                    if self._a_host is None:
+                        raise ResidencyError(
+                            "reshard needs the host A to recompute the "
+                            "speculative int8c scales, and this engine "
+                            "retains none"
+                        )
+                    sq = quantize_matrix(
+                        self._a_host, SPEC_STORAGE,
+                        contraction_shards=dst_shards,
+                    )
+                    self.spec_storage_block = sq.block
+                    if self.retain_host:
+                        self._spec_qa_host = sq
+                    new_spec_qa = jax.device_put(sq, new_sh_a)  # registry-ok: enqueue-only placement under the per-engine migration serializer, not the registry mutex — no tenant admission waits on _reshard_lock
+                new_spec = (
+                    new_spec_qa,
+                    jax.device_put(src_spec_p, new_sh_p),  # registry-ok: enqueue-only placement under the per-engine migration serializer, not the registry mutex — no tenant admission waits on _reshard_lock
+                    src_spec_u,
+                )
+
+            if self._reshard_pre_commit is not None:
+                self._reshard_pre_commit()  # test seam (docstring above)
+
+            # ---- commit: the only window a submit ever waits on.
+            with self._swap_lock:
+                with self._residency_lock:
+                    before = self.device_resident_bytes
+                    aborted = resident and self._a is not src_a
+                    if aborted:
+                        # Evicted (or re-placed) mid-build: drop every
+                        # migrated buffer and swap CONFIG only — never
+                        # two payload footprints. A racing re-placement
+                        # is old-layout, so it is dropped too; the next
+                        # ensure_resident heals in the new layout.
+                        self._a = None
+                        self._spec_qa = self._spec_p = self._spec_u = None
+                        bytes_moved = 0
+                    else:
+                        self._a = new_a
+                        if self.speculative and resident:
+                            (
+                                self._spec_qa, self._spec_p, self._spec_u,
+                            ) = new_spec
+                    # The native safe tier is sharded by the OLD layout:
+                    # drop it; a degraded dispatch re-places lazily.
+                    self._a_native = None
+                    self._layout_epoch += 1
+                    # Config swap — still under the fence, so a dispatch sees
+                    # old-everything or new-everything, never a mix.
+                    self.strategy = dst
+                    self._sh_a, self._sh_x = new_sh_a, new_sh_x
+                    self._sh_b = new_sh_b
+                    if self.speculative:
+                        self._sh_p = new_sh_p
+                    if requant is not None:
+                        self.storage_block = requant.block
+                        self._qa_host = requant if self.retain_host else None
+                        self._qa_template = quantized_like(
+                            requant,
+                            lambda leaf: jax.ShapeDtypeStruct(
+                                leaf.shape, leaf.dtype
+                            ),
+                        )
+                        self.resident_bytes = (
+                            requant.nbytes + self.spec_resident_bytes
+                        )
+                    self._matvec_combine, self._gemm_combine = (
+                        self._resolve_combine_locked(req)
+                    )
+                    if self.storage != NATIVE:
+                        if self._matvec_combine in STORAGE_INCOMPATIBLE_COMBINES:
+                            self._matvec_combine = None
+                        if self._gemm_combine in STORAGE_INCOMPATIBLE_COMBINES:
+                            self._gemm_combine = None
+                    self.stages = self._resolve_stages_locked(self._requested_stages)
+                    self.b_star = self._resolve_promotion_locked(
+                        self._requested_promote
+                    )
+                    # Degradation ladders embed old-layout ExecKeys.
+                    self._ladders.clear()
+                    delta = self.device_resident_bytes - before
+            result.update(
+                migrated=resident and not aborted,
+                aborted=bool(aborted),
+                requantized=requant is not None,
+                bytes_moved=int(bytes_moved),
+            )
+        self._notify_residency(delta, "reshard")  # callback-ok: fired after every engine lock is released (the PR 9 rule); the ledger reconciles, so ordering vs a racing placement is benign
+        if warm_widths is not None:
+            # The one-time destination-layout compile, off the hot path.
+            self.warmup(widths=warm_widths)
+        return result
+
     def exec_signature(self) -> tuple:
         """Identity of this engine's compiled-program space. Executables
         depend on shapes, shardings and config — never on ``A``'s values
@@ -1128,14 +1412,14 @@ class MatvecEngine:
         each ExecKey once across N same-shaped tenants."""
         return (
             self.mesh,
-            self.strategy.name,
+            self.strategy.name,  # unguarded-ok: stable config snapshot — the registry re-homes exec caches under its own lock only after reshard() returns, and taking _swap_lock here would invert the registry->engine lock order
             # The kernel OBJECT for callables (two different callables
             # that share a __name__ must not share compiled programs);
             # strings compare by value as before.
             self.kernel,
-            self._combine_label(self._matvec_combine),
-            self._combine_label(self._gemm_combine),
-            self.stages,
+            self._combine_label(self._matvec_combine),  # unguarded-ok: stable config snapshot — the registry re-homes exec caches under its own lock only after reshard() returns, and taking _swap_lock here would invert the registry->engine lock order
+            self._combine_label(self._gemm_combine),  # unguarded-ok: stable config snapshot — the registry re-homes exec caches under its own lock only after reshard() returns, and taking _swap_lock here would invert the registry->engine lock order
+            self.stages,  # unguarded-ok: stable config snapshot — the registry re-homes exec caches under its own lock only after reshard() returns, and taking _swap_lock here would invert the registry->engine lock order
             self.m,
             self.k,
             str(self.dtype),
@@ -1148,7 +1432,7 @@ class MatvecEngine:
             # fused check programs); a plain engine's signature is
             # byte-identical to pre-speculation, so existing shared
             # caches keep sharing.
-        ) + ((SPECULATE, self._spec_probes) if self.speculative else ())
+        ) + ((SPECULATE, self._spec_probes) if self.speculative else ())  # unguarded-ok: stable config snapshot — the registry re-homes exec caches under its own lock only after reshard() returns, and taking _swap_lock here would invert the registry->engine lock order
 
     def prediction_config(self, b: int = 1, rtol: float | None = None) -> dict:
         """The cost model's view of one dispatch through this engine's
@@ -1166,19 +1450,19 @@ class MatvecEngine:
         Degradation-ladder fallbacks are deliberately not modeled —
         admission predicts the healthy path, and sustained divergence is
         the cost model's own regression signal (docs/COST_MODEL.md)."""
-        gemm = self.b_star is not None and b >= self.b_star
+        gemm = self.b_star is not None and b >= self.b_star  # unguarded-ok: advisory cost-model snapshot; a racing reshard yields one stale prediction, never corruption
         combine = self._effective_combine(
-            self._gemm_combine if gemm else self._matvec_combine
+            self._gemm_combine if gemm else self._matvec_combine  # unguarded-ok: advisory cost-model snapshot; a racing reshard yields one stale prediction, never corruption
         )
         if combine is None:
-            combine = self.strategy.default_combine(self.mesh)
+            combine = self.strategy.default_combine(self.mesh)  # unguarded-ok: advisory cost-model snapshot; a racing reshard yields one stale prediction, never corruption
         storage = self.storage
-        if self.speculative and spec_eligible(rtol):
+        if self.speculative and spec_eligible(rtol):  # unguarded-ok: advisory cost-model snapshot; a racing reshard yields one stale prediction, never corruption
             storage = SPECULATE
         return dict(
-            strategy=self.strategy.name,
+            strategy=self.strategy.name,  # unguarded-ok: advisory cost-model snapshot; a racing reshard yields one stale prediction, never corruption
             combine=combine,
-            stages=self.stages,
+            stages=self.stages,  # unguarded-ok: advisory cost-model snapshot; a racing reshard yields one stale prediction, never corruption
             m=self.m,
             k=self.k,
             p=mesh_size(self.mesh),
@@ -1189,7 +1473,7 @@ class MatvecEngine:
 
     # ---- construction-time resolution ----
 
-    def _resolve_storage(self, dtype_storage: str | None) -> str:
+    def _resolve_storage_locked(self, dtype_storage: str | None) -> str:
         """Pin the resident-A storage format at construction (the quantize
         step is once-at-residency by doctrine). ``"auto"`` consults the
         tuned sixth axis and degrades to native on a miss, an
@@ -1262,7 +1546,7 @@ class MatvecEngine:
             )
         return fmt
 
-    def _resolve_combine(
+    def _resolve_combine_locked(
         self, combine: str | None
     ) -> tuple[str | None, str | None]:
         """Pin the combine schedule for both paths at construction.
@@ -1322,13 +1606,13 @@ class MatvecEngine:
         when none was given."""
         if combine is not None:
             return combine
-        return getattr(self.strategy, "combine", None)
+        return getattr(self.strategy, "combine", None)  # unguarded-ok: label helper serves both fenced dispatches and snapshot paths; readers tolerate a one-transition-stale name
 
     def _is_overlap(self, combine: str | None) -> bool:
         c = self._effective_combine(combine)
         return c is not None and c.startswith("overlap")
 
-    def _resolve_stages(self, stages: int | str | None) -> int | None:
+    def _resolve_stages_locked(self, stages: int | str | None) -> int | None:
         """Pin the overlap stage count S at construction (None when no
         path runs an overlap schedule — explicitly, via the auto tier, or
         through the strategy instance's own binding): the engine's shapes
@@ -1345,7 +1629,7 @@ class MatvecEngine:
             self.strategy.overlap_chunk_devices(self.mesh), self.dtype,
         )
 
-    def _resolve_promotion(self, promote: str | int | None) -> int | None:
+    def _resolve_promotion_locked(self, promote: str | int | None) -> int | None:
         """The crossover ``b*``: requests of ``b >= b_star`` columns take
         the single-GEMM path; below it, per-column GEMV dispatches. None
         disables promotion entirely."""
@@ -1380,25 +1664,25 @@ class MatvecEngine:
         schedules embed their pinned S (`overlap@4`) — a different stage
         count is a different compiled program. Strategy-bound overlap
         (colwise_overlap with combine=None) labels the same way."""
-        if self.stages is not None and self._is_overlap(combine):
-            return f"{self._effective_combine(combine)}@{self.stages}"
+        if self.stages is not None and self._is_overlap(combine):  # unguarded-ok: label helper serves both fenced dispatches and snapshot paths; readers tolerate a one-transition-stale name
+            return f"{self._effective_combine(combine)}@{self.stages}"  # unguarded-ok: label helper serves both fenced dispatches and snapshot paths; readers tolerate a one-transition-stale name
         return combine
 
-    def _matvec_key(self) -> ExecKey:
+    def _matvec_key_locked(self) -> ExecKey:
         return ExecKey(
             "matvec", self.strategy.name, self._kernel_label(),
             self._combine_label(self._matvec_combine), 1, str(self.dtype),
             self.storage,
         )
 
-    def _gemm_key(self, bucket: int) -> ExecKey:
+    def _gemm_key_locked(self, bucket: int) -> ExecKey:
         return ExecKey(
             "gemm", self.strategy.name, self._kernel_label(),
             self._combine_label(self._gemm_combine), bucket,
             str(self.dtype), self.storage,
         )
 
-    def _a_struct(self, storage: str):
+    def _a_struct_locked(self, storage: str):
         """The A-side argument struct for one storage format: the plain
         (m, k) array, or the quantized pytree's leaf structs — all carrying
         A's own sharding (the scales shard alongside their blocks)."""
@@ -1424,7 +1708,7 @@ class MatvecEngine:
                 dtype_storage=None if storage == NATIVE else storage,
             )
             structs = (
-                self._a_struct(storage),
+                self._a_struct_locked(storage),
                 jax.ShapeDtypeStruct(
                     (self.k,), self.dtype, sharding=self._sh_x
                 ),
@@ -1433,7 +1717,7 @@ class MatvecEngine:
 
         return builder
 
-    def _matvec_builder(self):
+    def _matvec_builder_locked(self):
         return self._matvec_builder_for(
             self.kernel, self._matvec_combine, self.stages
         )()
@@ -1450,7 +1734,7 @@ class MatvecEngine:
                 dtype_storage=None if storage == NATIVE else storage,
             )
             structs = (
-                self._a_struct(storage),
+                self._a_struct_locked(storage),
                 jax.ShapeDtypeStruct(
                     (self.k, bucket), self.dtype, sharding=self._sh_b
                 ),
@@ -1459,7 +1743,7 @@ class MatvecEngine:
 
         return builder
 
-    def _gemm_builder(self, bucket: int):
+    def _gemm_builder_locked(self, bucket: int):
         return self._gemm_builder_for(
             bucket, self.kernel, self._gemm_combine, self.stages
         )
@@ -1478,19 +1762,19 @@ class MatvecEngine:
 
     def _spec_matvec_key(self) -> ExecKey:
         return ExecKey(
-            "matvec", self.strategy.name, self._kernel_label(),
-            self._spec_combine(self._matvec_combine), 1, str(self.dtype),
+            "matvec", self.strategy.name, self._kernel_label(),  # unguarded-ok: breaker-identity key; outside the fence only breaker admission/settlement reads it, and a stale key touches the old config's breaker once — benign
+            self._spec_combine(self._matvec_combine), 1, str(self.dtype),  # unguarded-ok: breaker-identity key; outside the fence only breaker admission/settlement reads it, and a stale key touches the old config's breaker once — benign
             SPECULATE,
         )
 
     def _spec_gemm_key(self, bucket: int) -> ExecKey:
         return ExecKey(
-            "gemm", self.strategy.name, self._kernel_label(),
-            self._spec_combine(self._gemm_combine), bucket,
+            "gemm", self.strategy.name, self._kernel_label(),  # unguarded-ok: breaker-identity key; outside the fence only breaker admission/settlement reads it, and a stale key touches the old config's breaker once — benign
+            self._spec_combine(self._gemm_combine), bucket,  # unguarded-ok: breaker-identity key; outside the fence only breaker admission/settlement reads it, and a stale key touches the old config's breaker once — benign
             str(self.dtype), SPECULATE,
         )
 
-    def _spec_builder_for(self, bucket: int | None = None):
+    def _spec_builder_for_locked(self, bucket: int | None = None):
         """Builder for the fused speculative program
         (``ops/speculative.py::build_speculative``). Operands are
         ``(aq, p, u, x, rtol)`` — the request ``x`` is python-arg 3, so
@@ -1537,7 +1821,7 @@ class MatvecEngine:
 
         return builder
 
-    def _resolve_solver_kernel(self, op: str) -> str:
+    def _resolve_solver_kernel_locked(self, op: str) -> str:
         """The iteration tier one solve of ``op`` runs: "pallas_fused" or
         "xla". Explicit "pallas_fused" re-raises the fused tier's typed
         errors (the strategy/combine half already passed at construction;
@@ -1573,14 +1857,14 @@ class MatvecEngine:
             return "xla"
         return decision.get("solver_kernel") or "xla"
 
-    def _solver_key(self, op: str, bucket: int) -> ExecKey:
+    def _solver_key_locked(self, op: str, bucket: int) -> ExecKey:
         """A solver executable's cache identity: the matvec key with the
         op swapped in and the op's static shape parameter (GMRES restart,
         Lanczos steps) in the bucket field — differing rtol/maxiter
         values are dynamic operands, never new keys. A fused-tier solve
         keys on kernel="pallas_fused" and the fused body's canonical
         combine — honest identity for the artifact actually compiled."""
-        if self._resolve_solver_kernel(op) == "pallas_fused":
+        if self._resolve_solver_kernel_locked(op) == "pallas_fused":
             from ..ops.pallas_solver import check_fused_solver
 
             return ExecKey(
@@ -1612,7 +1896,7 @@ class MatvecEngine:
                 (), np.float32, sharding=self._sh_rep
             )
             structs = (
-                self._a_struct(storage),
+                self._a_struct_locked(storage),
                 # The RHS rides replicated (the solver constrains it there
                 # anyway; re-slicing a replicated vector to a strategy's
                 # sharded x spec is a local slice, no collective).
@@ -1640,15 +1924,15 @@ class MatvecEngine:
     # "safe" level is the same schedule under a different key — the
     # ladder still converges, it just cannot un-bind the instance.
 
-    def _matvec_levels(self) -> list[tuple[ExecKey, Callable]]:
+    def _matvec_levels_locked(self) -> list[tuple[ExecKey, Callable]]:
         levels = self._ladders.get("matvec")
         if levels is not None:
             return levels
-        levels = [(self._matvec_key(), self._matvec_builder)]
+        levels = [(self._matvec_key_locked(), self._matvec_builder_locked)]
         # The safe tier is NATIVE storage by doctrine: a quantized config
         # that keeps failing should not be retried through another
         # quantized program — the unquantized original A (placed lazily,
-        # _a_for) is the known-good floor.
+        # _a_for_locked) is the known-good floor.
         safe_key = ExecKey(
             "matvec", self.strategy.name, SAFE_KERNEL, None, 1,
             str(self.dtype), NATIVE,
@@ -1661,11 +1945,11 @@ class MatvecEngine:
         self._ladders["matvec"] = levels
         return levels
 
-    def _gemm_levels(self, bucket: int) -> list[tuple[ExecKey, Callable]]:
+    def _gemm_levels_locked(self, bucket: int) -> list[tuple[ExecKey, Callable]]:
         levels = self._ladders.get(bucket)
         if levels is not None:
             return levels
-        levels = [(self._gemm_key(bucket), self._gemm_builder(bucket))]
+        levels = [(self._gemm_key_locked(bucket), self._gemm_builder_locked(bucket))]
         safe_key = ExecKey(
             "gemm", self.strategy.name, SAFE_KERNEL, None, bucket,
             str(self.dtype), NATIVE,
@@ -1678,7 +1962,7 @@ class MatvecEngine:
         self._ladders[bucket] = levels
         return levels
 
-    def _solver_levels(
+    def _solver_levels_locked(
         self, op: str, bucket: int, restart: int, steps: int
     ) -> list[tuple[ExecKey, Callable]]:
         """The solver's degradation ladder: the engine's preferred
@@ -1690,8 +1974,8 @@ class MatvecEngine:
         levels = self._ladders.get(cache_key)
         if levels is not None:
             return levels
-        preferred = self._solver_key(op, bucket)
-        if self._resolve_solver_kernel(op) == "pallas_fused":
+        preferred = self._solver_key_locked(op, bucket)
+        if self._resolve_solver_kernel_locked(op) == "pallas_fused":
             # The fused tier: build_solver routes kernel="pallas_fused"
             # to ops/pallas_solver.py. It sees the REQUESTED combine
             # (the fused body owns its combine spelling) and no stages
@@ -1755,7 +2039,7 @@ class MatvecEngine:
             self._outstanding.append(arr)
         return arr
 
-    def _a_for(self, key: ExecKey):
+    def _a_for_locked(self, key: ExecKey):
         """The resident A operand matching one config level's storage
         format. Under quantized residency the native safe tier places the
         retained host A lazily on its FIRST degraded dispatch and keeps
@@ -1770,16 +2054,24 @@ class MatvecEngine:
             if self._a is None:  # unguarded-ok: self-heal probe; ensure_resident re-checks under _residency_lock and a lost race is a dropped buffer, not corruption
                 # Transparent re-admission: enqueue-only, accounted, and
                 # bitwise-identical to the pre-eviction residency.
-                self.ensure_resident()
+                self.ensure_resident()  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence; callback-ok: the residency listener reconciles the registry ledger, which never re-enters engine locks, so firing here cannot deadlock
             return self._a  # unguarded-ok: the dispatch captures its own reference; refcounted residency keeps a concurrently evicted buffer alive for this dispatch
         if self._a_native is None:  # unguarded-ok: double-checked lazy placement — the decisive re-check runs under _residency_lock below
-            # Enqueue-only placement (device_put is async), not a sync.
-            placed = jax.device_put(self._a_host, self._sh_a)
-            with self._residency_lock:
-                if self._a_native is not None:
-                    return self._a_native  # lost a concurrent race
-                self._a_native = placed
-            self._notify_residency(
+            while True:
+                # Same layout-epoch guard as ensure_resident: never
+                # install a pre-reshard-sharded safe tier over the
+                # post-swap config.
+                epoch = self._layout_epoch
+                # Enqueue-only placement (device_put is async), not a sync.
+                placed = jax.device_put(self._a_host, self._sh_a)
+                with self._residency_lock:  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
+                    if self._layout_epoch != epoch:
+                        continue  # resharded mid-placement: restage
+                    if self._a_native is not None:
+                        return self._a_native  # lost a concurrent race
+                    self._a_native = placed
+                break
+            self._notify_residency(  # callback-ok: the residency listener reconciles the registry ledger, which never re-enters engine locks, so firing here cannot deadlock
                 int(self._a_host.nbytes), "native_fallback"
             )
         return self._a_native  # unguarded-ok: same refcounted-capture tolerance as the payload return above
@@ -1826,41 +2118,41 @@ class MatvecEngine:
             return False
         return action.corrupt
 
-    def _exec_matvec(
+    def _exec_matvec_locked(
         self, col: np.ndarray, trace: ActiveTrace,
         key: ExecKey | None = None, builder=None,
     ) -> tuple[jax.Array, bool]:
         """One single-column dispatch at one config level. Returns the
         tracked device array plus the injected-corruption flag."""
         if key is None:
-            key, builder = self._matvec_key(), self._matvec_builder
+            key, builder = self._matvec_key_locked(), self._matvec_builder_locked
         if self._fault_plan is not None and key not in self._cache:
             self._check_faults("compile", key)
         exe = self._get_traced(trace, key, builder)
         corrupt = self._check_faults("dispatch", key, block=col)
         self._c_dispatches.inc()
         with trace.span("dispatch", op="matvec"):
-            out = exe(self._a_for(key), jax.device_put(col, self._sh_x))
+            out = exe(self._a_for_locked(key), jax.device_put(col, self._sh_x))  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
         return self._track(out), corrupt
 
-    def _exec_gemm(
+    def _exec_gemm_locked(
         self, padded: np.ndarray, trace: ActiveTrace,
         key: ExecKey | None = None, builder=None,
     ) -> tuple[jax.Array, bool]:
         """One bucket-padded block dispatch at one config level."""
         bucket = padded.shape[1]
         if key is None:
-            key, builder = self._gemm_key(bucket), self._gemm_builder(bucket)
+            key, builder = self._gemm_key_locked(bucket), self._gemm_builder_locked(bucket)
         if self._fault_plan is not None and key not in self._cache:
             self._check_faults("compile", key)
         exe = self._get_traced(trace, key, builder)
         corrupt = self._check_faults("dispatch", key, block=padded)
         self._c_dispatches.inc()
         with trace.span("dispatch", op="gemm", bucket=bucket):
-            out = exe(self._a_for(key), jax.device_put(padded, self._sh_b))
+            out = exe(self._a_for_locked(key), jax.device_put(padded, self._sh_b))  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
         return self._track(out), corrupt
 
-    def _exec_solver(
+    def _exec_solver_locked(
         self, op: str, rhs: np.ndarray, rtol: float, maxiter: int,
         lo: float, hi: float, trace: ActiveTrace,
         key: ExecKey, builder,
@@ -1881,7 +2173,7 @@ class MatvecEngine:
         rep = self._sh_rep
         with trace.span("dispatch", op=op, bucket=key.bucket):
             out = exe(
-                self._a_for(key),
+                self._a_for_locked(key),
                 jax.device_put(rhs, rep),
                 jax.device_put(np.float32(rtol), rep),
                 jax.device_put(np.int32(maxiter), rep),
@@ -1965,19 +2257,19 @@ class MatvecEngine:
             return out
         raise last_exc  # every level failed: the request's real fate
 
-    def _dispatch_matvec(self, col: np.ndarray, trace: ActiveTrace) -> tuple:
+    def _dispatch_matvec_locked(self, col: np.ndarray, trace: ActiveTrace) -> tuple:
         """One column -> one result part ``(array, None, corrupt)``."""
         if self._resilience is None:
-            arr, corrupt = self._exec_matvec(col, trace)
+            arr, corrupt = self._exec_matvec_locked(col, trace)  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
             return (arr, None, corrupt)
 
         def attempt(key, builder):
-            return self._exec_matvec(col, trace, key, builder)
+            return self._exec_matvec_locked(col, trace, key, builder)
 
-        arr, corrupt = self._walk_ladder(self._matvec_levels(), attempt)
+        arr, corrupt = self._walk_ladder(self._matvec_levels_locked(), attempt)  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence; callback-ok: the breaker's open callback is a metrics counter inc — no locks, no ledger re-entry
         return (arr, None, corrupt)
 
-    def _dispatch_block(self, chunk: np.ndarray, trace: ActiveTrace) -> list:
+    def _dispatch_block_locked(self, chunk: np.ndarray, trace: ActiveTrace) -> list:
         """One <= max_bucket-wide chunk of real columns -> its dispatched
         parts: one bucket-padded GEMM part on the happy path; several
         under degradation (shrunken buckets on RESOURCE_EXHAUSTED, or the
@@ -1996,14 +2288,14 @@ class MatvecEngine:
         with trace.span("bucket_pad", width=width, bucket=bucket):
             padded = pad_columns(chunk, bucket)
         if self._resilience is None:
-            arr, corrupt = self._exec_gemm(padded, trace)
+            arr, corrupt = self._exec_gemm_locked(padded, trace)  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
             return [(arr, width, corrupt)]
 
         def attempt(key, builder):
-            return self._exec_gemm(padded, trace, key, builder)
+            return self._exec_gemm_locked(padded, trace, key, builder)
 
         try:
-            arr, corrupt = self._walk_ladder(self._gemm_levels(bucket), attempt)
+            arr, corrupt = self._walk_ladder(self._gemm_levels_locked(bucket), attempt)  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence; callback-ok: the breaker's open callback is a metrics counter inc — no locks, no ledger re-entry
             return [(arr, width, corrupt)]
         except Exception as exc:
             _, exhausted = classify_failure(exc)
@@ -2014,8 +2306,8 @@ class MatvecEngine:
                 self._c_downgrades.inc()
                 mid = (width + 1) // 2
                 return (
-                    self._dispatch_block(chunk[:, :mid], trace)
-                    + self._dispatch_block(chunk[:, mid:], trace)
+                    self._dispatch_block_locked(chunk[:, :mid], trace)  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
+                    + self._dispatch_block_locked(chunk[:, mid:], trace)  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
                 )
             # The GEMV floor: the promotion decision itself degrades —
             # serve the chunk per column through the matvec ladder. A
@@ -2023,7 +2315,7 @@ class MatvecEngine:
             # key="*") still fails loudly here, as it must.
             self._c_downgrades.inc()
             return [
-                self._dispatch_matvec(chunk[:, j], trace)
+                self._dispatch_matvec_locked(chunk[:, j], trace)  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence
                 for j in range(width)
             ]
 
@@ -2033,7 +2325,7 @@ class MatvecEngine:
     def _spec_operands(self):
         """The speculative tier's device operands (quantized payload,
         projection P, probes U), self-healing residency exactly like
-        :meth:`_a_for`: an evicted registry tenant re-places
+        :meth:`_a_for_locked`: an evicted registry tenant re-places
         transparently, enqueue-only, accounted under the payload
         residency."""
         if self._spec_qa is None:  # unguarded-ok: self-heal probe; ensure_resident re-checks under _residency_lock and a lost race is a dropped buffer, not corruption
@@ -2062,7 +2354,7 @@ class MatvecEngine:
         rtol = float(rtol)
         if not (rtol > 0.0):
             raise ConfigError(f"rtol must be > 0, got {rtol}")
-        if not self.speculative:
+        if not self.speculative:  # unguarded-ok: routing probe outside the fence; a stale read routes one request to the old tier, and the fenced dispatch itself sees one consistent layout
             return None
         if not spec_eligible(rtol) or not self._spec_allowed():
             self._c_storage_fallbacks.inc()
@@ -2084,7 +2376,7 @@ class MatvecEngine:
             br = self._breaker_for(self._spec_matvec_key())
             (br.record_success if accepted else br.record_failure)()
 
-    def _exec_spec(self, x, rtol, trace, key, builder, bucket=None):
+    def _exec_spec_locked(self, x, rtol, trace, key, builder, bucket=None):
         """One speculative dispatch: candidate + fused check, ONE enqueue
         (the accept predicate is a device output of the same program —
         nothing here syncs; the verdict settles at materialization)."""
@@ -2094,7 +2386,7 @@ class MatvecEngine:
         corrupt = self._check_faults("dispatch", key, block=x)
         self._c_dispatches.inc()
         self._c_speculative.inc()
-        qa, p, u = self._spec_operands()
+        qa, p, u = self._spec_operands()  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence; callback-ok: the residency listener reconciles the registry ledger, which never re-enters engine locks, so firing here cannot deadlock
         attrs = {"op": "matvec"} if bucket is None else {
             "op": "gemm", "bucket": bucket,
         }
@@ -2123,7 +2415,7 @@ class MatvecEngine:
                 br.record_failure()
         self._c_storage_fallbacks.inc()
 
-    def _spec_part_matvec(self, col: np.ndarray, rtol: float,
+    def _spec_part_matvec_locked(self, col: np.ndarray, rtol: float,
                           trace: ActiveTrace) -> tuple:
         """One column through the speculative tier -> one 5-part
         ``(candidate, None, corrupt, accept, resolve)``. ``resolve``
@@ -2131,24 +2423,28 @@ class MatvecEngine:
         escalation — a traced native re-dispatch (span kind=escalate)
         through the regular ladder, never a silent wrong answer."""
         try:
-            y, accept, corrupt = self._exec_spec(
+            y, accept, corrupt = self._exec_spec_locked(
                 col, rtol, trace, self._spec_matvec_key(),
-                self._spec_builder_for(),
+                self._spec_builder_for_locked(),
             )
         except Exception as exc:  # swallow-ok: _spec_fallback records it (breaker + fallbacks counter); the request rides the native ladder, which owns recovery
-            self._spec_fallback(exc)
-            return self._dispatch_matvec(col, trace)
+            self._spec_fallback(exc)  # callback-ok: the breaker's open callback is a metrics counter inc — no locks, no ledger re-entry
+            return self._dispatch_matvec_locked(col, trace)
 
         def resolve(accepted: bool) -> list:
             self._spec_record(accepted)
             if accepted:
                 return []
-            with trace.span("escalate", op="matvec", kind="escalate"):
-                return [self._dispatch_matvec(col, trace)]
+            # Settlement-time escalation is a dispatch like any other: it
+            # must see ONE layout under the swap fence (a reshard may have
+            # committed between the speculative enqueue and this verdict).
+            with self._swap_lock:
+                with trace.span("escalate", op="matvec", kind="escalate"):
+                    return [self._dispatch_matvec_locked(col, trace)]
 
         return (y, None, corrupt, accept, resolve)
 
-    def _spec_part_block(self, chunk: np.ndarray, rtol: float,
+    def _spec_part_block_locked(self, chunk: np.ndarray, rtol: float,
                          trace: ActiveTrace) -> list:
         """One <= max_bucket-wide chunk through the speculative GEMM
         tier; the batched check accepts only when EVERY real column
@@ -2159,20 +2455,22 @@ class MatvecEngine:
         with trace.span("bucket_pad", width=width, bucket=bucket):
             padded = pad_columns(chunk, bucket)
         try:
-            y, accept, corrupt = self._exec_spec(
+            y, accept, corrupt = self._exec_spec_locked(
                 padded, rtol, trace, self._spec_gemm_key(bucket),
-                self._spec_builder_for(bucket), bucket=bucket,
+                self._spec_builder_for_locked(bucket), bucket=bucket,
             )
         except Exception as exc:  # swallow-ok: _spec_fallback records it (breaker + fallbacks counter); the chunk rides the native block path, which owns recovery
-            self._spec_fallback(exc)
-            return self._dispatch_block(chunk, trace)
+            self._spec_fallback(exc)  # callback-ok: the breaker's open callback is a metrics counter inc — no locks, no ledger re-entry
+            return self._dispatch_block_locked(chunk, trace)
 
         def resolve(accepted: bool) -> list:
             self._spec_record(accepted)
             if accepted:
                 return []
-            with trace.span("escalate", op="gemm", kind="escalate"):
-                return self._dispatch_block(chunk, trace)
+            # Same swap-fence rule as the matvec escalation above.
+            with self._swap_lock:
+                with trace.span("escalate", op="gemm", kind="escalate"):
+                    return self._dispatch_block_locked(chunk, trace)
 
         return [(y, width, corrupt, accept, resolve)]
 
@@ -2313,15 +2611,55 @@ class MatvecEngine:
             if _expired():
                 return _fail()
             try:
-                if x.ndim == 1:
-                    self._c_cols.inc()
-                    part = (
-                        self._spec_part_matvec(x, spec_rtol, trace)
-                        if spec_rtol is not None
-                        else self._dispatch_matvec(x, trace)
-                    )
+                # swap fence: the whole dispatch sees one layout; a
+                # concurrent reshard commits strictly before or after it
+                # (docs/RESHARDING.md). The backpressure drain above
+                # stays OUTSIDE the fence — a blocked drain must not
+                # stall a migration commit.
+                with self._swap_lock:
+                    if x.ndim == 1:
+                        self._c_cols.inc()
+                        part = (
+                            self._spec_part_matvec_locked(x, spec_rtol, trace)
+                            if spec_rtol is not None
+                            else self._dispatch_matvec_locked(x, trace)
+                        )
+                        fut = MatvecFuture(
+                            [part], vector=True,
+                            trace=trace,
+                            materialize_hist=self._h_materialize,
+                            integrity_counter=integrity_counter,
+                        )
+                        self._h_submit.observe(
+                            (time.perf_counter() - t0_perf) * 1e3
+                        )
+                        return fut
+                    b = x.shape[1]
+                    self._c_cols.inc(b)
+                    parts: list[tuple] = []
+                    if self.b_star is not None and b >= self.b_star:
+                        offset = 0
+                        for width in split_widths(b, self.max_bucket):
+                            chunk = x[:, offset:offset + width]
+                            offset += width
+                            parts.extend(
+                                self._spec_part_block_locked(
+                                    chunk, spec_rtol, trace
+                                )
+                                if spec_rtol is not None
+                                else self._dispatch_block_locked(chunk, trace)
+                            )
+                    else:
+                        for j in range(b):
+                            parts.append(
+                                self._spec_part_matvec_locked(
+                                    x[:, j], spec_rtol, trace
+                                )
+                                if spec_rtol is not None
+                                else self._dispatch_matvec_locked(x[:, j], trace)
+                            )
                     fut = MatvecFuture(
-                        [part], vector=True,
+                        parts, vector=False,
                         trace=trace, materialize_hist=self._h_materialize,
                         integrity_counter=integrity_counter,
                     )
@@ -2329,35 +2667,6 @@ class MatvecEngine:
                         (time.perf_counter() - t0_perf) * 1e3
                     )
                     return fut
-                b = x.shape[1]
-                self._c_cols.inc(b)
-                parts: list[tuple] = []
-                if self.b_star is not None and b >= self.b_star:
-                    offset = 0
-                    for width in split_widths(b, self.max_bucket):
-                        chunk = x[:, offset:offset + width]
-                        offset += width
-                        parts.extend(
-                            self._spec_part_block(chunk, spec_rtol, trace)
-                            if spec_rtol is not None
-                            else self._dispatch_block(chunk, trace)
-                        )
-                else:
-                    for j in range(b):
-                        parts.append(
-                            self._spec_part_matvec(
-                                x[:, j], spec_rtol, trace
-                            )
-                            if spec_rtol is not None
-                            else self._dispatch_matvec(x[:, j], trace)
-                        )
-                fut = MatvecFuture(
-                    parts, vector=False,
-                    trace=trace, materialize_hist=self._h_materialize,
-                    integrity_counter=integrity_counter,
-                )
-                self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
-                return fut
             except BaseException:
                 # The dispatch failed past every configured recovery: the
                 # request's trace must close (status says why) and the
@@ -2490,20 +2799,24 @@ class MatvecEngine:
                 return _fail()
             try:
                 self._c_cols.inc()
-                levels = self._solver_levels(op, bucket, restart, steps)
-                if self._resilience is None:
-                    key, builder = levels[0]
-                    res, corrupt = self._exec_solver(
-                        op, rhs, rtol, maxiter, lo, hi, trace, key, builder
-                    )
-                else:
-                    def attempt(key, builder):
-                        return self._exec_solver(
+                # swap fence: same one-layout-per-dispatch rule as the
+                # matvec path (docs/RESHARDING.md).
+                with self._swap_lock:
+                    levels = self._solver_levels_locked(op, bucket, restart, steps)
+                    if self._resilience is None:
+                        key, builder = levels[0]
+                        res, corrupt = self._exec_solver_locked(
                             op, rhs, rtol, maxiter, lo, hi, trace,
                             key, builder,
                         )
+                    else:
+                        def attempt(key, builder):
+                            return self._exec_solver_locked(
+                                op, rhs, rtol, maxiter, lo, hi, trace,
+                                key, builder,
+                            )
 
-                    res, corrupt = self._walk_ladder(levels, attempt)
+                        res, corrupt = self._walk_ladder(levels, attempt)  # callback-ok: the breaker's open callback is a metrics counter inc — no locks, no ledger re-entry
                 fut = SolverFuture(
                     res, op=op, rtol=rtol,
                     cap=steps if op == "lanczos" else maxiter,
@@ -2544,32 +2857,35 @@ class MatvecEngine:
         A speculative-armed engine warms BOTH tiers (the fused check
         programs alongside the native ones), so a mixed rtol/exact
         stream — escalations included — runs compile-free."""
-        before = self._cache.stats.compiles
-        self._cache.get(self._matvec_key(), self._matvec_builder)
-        if self.speculative:
-            self._cache.get(
-                self._spec_matvec_key(), self._spec_builder_for()
-            )
-        if self.b_star is not None:
-            if widths is None:
-                buckets = set(bucket_ladder(self.max_bucket))
-            else:
-                buckets = set()
-                for w in widths:
-                    if w < self.b_star:
-                        continue  # submit() serves these per column
-                    for chunk in split_widths(w, self.max_bucket):
-                        buckets.add(bucket_for(chunk, self.max_bucket))
-            for bucket in sorted(buckets):
+        with self._swap_lock:
+            # Fence: a warm compiles against ONE layout — a racing
+            # reshard commit waits for it, exactly like a dispatch.
+            before = self._cache.stats.compiles
+            self._cache.get(self._matvec_key_locked(), self._matvec_builder_locked)
+            if self.speculative:
                 self._cache.get(
-                    self._gemm_key(bucket), self._gemm_builder(bucket)
+                    self._spec_matvec_key(), self._spec_builder_for_locked()
                 )
-                if self.speculative:
+            if self.b_star is not None:
+                if widths is None:
+                    buckets = set(bucket_ladder(self.max_bucket))
+                else:
+                    buckets = set()
+                    for w in widths:
+                        if w < self.b_star:
+                            continue  # submit() serves these per column
+                        for chunk in split_widths(w, self.max_bucket):
+                            buckets.add(bucket_for(chunk, self.max_bucket))
+                for bucket in sorted(buckets):
                     self._cache.get(
-                        self._spec_gemm_key(bucket),
-                        self._spec_builder_for(bucket),
+                        self._gemm_key_locked(bucket), self._gemm_builder_locked(bucket)
                     )
-        return self._cache.stats.compiles - before
+                    if self.speculative:
+                        self._cache.get(
+                            self._spec_gemm_key(bucket),
+                            self._spec_builder_for_locked(bucket),
+                        )
+            return self._cache.stats.compiles - before
 
     def _integrity_counter(self):
         """Get-or-create the integrity-failure counter (lazy so a plain
@@ -2624,16 +2940,16 @@ class MatvecEngine:
                 # "auto_degraded"/"auto_miss"/"default" — the field that
                 # makes an auto-degrade distinguishable from a caller's
                 # own native ask (the satellite fix).
-                "reason": self.storage_reason,
+                "reason": self.storage_reason,  # unguarded-ok: health() is a monotone point-in-time probe; staleness by one transition is its contract
                 "resident": self.resident,
-                "resident_bytes": self.resident_bytes,
+                "resident_bytes": self.resident_bytes,  # unguarded-ok: health() is a monotone point-in-time probe; staleness by one transition is its contract
                 "device_resident_bytes": self.device_resident_bytes,
                 "block": self.storage_block,
                 # True once the native safe tier has been placed (HBM is
                 # then holding BOTH residencies — a degraded quantized
                 # engine costs more than either alone).
                 "native_fallback_resident": self._a_native is not None,  # unguarded-ok: health() is a monotone point-in-time probe; staleness by one transition is its contract
-                "speculative": self.speculative,
+                "speculative": self.speculative,  # unguarded-ok: health() is a monotone point-in-time probe; staleness by one transition is its contract
                 "escalation_rate": (
                     self._g_escalation_rate.value
                     if self._g_escalation_rate is not None else 0.0

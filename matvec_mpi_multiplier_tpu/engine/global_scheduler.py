@@ -93,7 +93,7 @@ from .scheduler import QOS_TIERS, _SharedResult
 
 # Decision vocabulary (the ring's `decision` field and the gsched_*
 # counter suffixes).
-DECISIONS = ("admit", "reject", "interleave", "evict", "flush")
+DECISIONS = ("admit", "reject", "interleave", "evict", "flush", "reshard")
 
 # Bounded decision ring: enough to hold a whole bench trace's decisions
 # without growing with uptime.
@@ -185,6 +185,20 @@ class GlobalScheduler:
     coalesce : allow same-group cross-tenant coalescing (default True;
         the A/B bench's ``off`` mode disables the whole layer, not this
         flag).
+    reshard : ``"auto"`` arms the online-resharding trigger
+        (docs/RESHARDING.md): after each admission the scheduler asks
+        whether a candidate layout's predicted dispatch time, PLUS the
+        migration cost amortized over the tenant's EWMA demand horizon,
+        beats the current layout — and if so migrates the resident ``A``
+        on-device (``MatrixRegistry.reshard``). The decision is pure
+        prediction (``CostModel.predict_reshard``), never a
+        re-measurement, and enters the decision trace with its crossover
+        arithmetic. ``"off"`` (default) never migrates. Requires a
+        calibrated model — greedy mode never reshards.
+    reshard_cooldown_s : per-tenant minimum seconds between migrations
+        (thrash damper on oscillating demand).
+    reshard_horizon_s : the EWMA demand window the migration cost
+        amortizes over: expected requests = rate · horizon.
     flush_width : open-batch width that forces a flush — ``None`` uses
         the fleet's tuned promotion point ``b*`` (static default on a
         cold cache).
@@ -205,6 +219,9 @@ class GlobalScheduler:
         deadline_margin: float = 1.0,
         interleave_threshold_s: float | None = None,
         coalesce: bool = True,
+        reshard: str = "off",
+        reshard_cooldown_s: float = 30.0,
+        reshard_horizon_s: float = 30.0,
         flush_width: int | None = None,
         decision_jsonl=None,
         decision_capacity: int = DEFAULT_DECISION_CAPACITY,
@@ -215,10 +232,18 @@ class GlobalScheduler:
             raise ConfigError(
                 f"deadline_margin must be > 0, got {deadline_margin}"
             )
+        if reshard not in ("auto", "off"):
+            raise ConfigError(
+                f"reshard must be 'auto' or 'off', got {reshard!r}"
+            )
         self.registry = registry
         self.deadline_margin = float(deadline_margin)
         self._interleave_threshold_s = interleave_threshold_s
         self._coalesce = bool(coalesce)
+        self._reshard = reshard
+        self._reshard_cooldown_s = float(reshard_cooldown_s)
+        self._reshard_horizon_s = float(reshard_horizon_s)
+        self._last_reshard: dict[str, float] = {}
         self._flush_width = flush_width
         self._clock = clock
         self._log = log if log is not None else (
@@ -282,6 +307,12 @@ class GlobalScheduler:
         )
         self._c_flushes = metrics.counter(
             "gsched_flushes_total", "coalesced flushes dispatched"
+        )
+        self._c_reshard_decisions = metrics.counter(
+            "gsched_reshards_total",
+            "cost-model crossover migrations triggered (predicted "
+            "new-layout dispatch + amortized migration < old layout "
+            "over the EWMA demand horizon)",
         )
         self._c_cross_tenant = metrics.counter(
             "sched_cross_tenant_coalesced_total",
@@ -504,6 +535,111 @@ class GlobalScheduler:
             return None  # the tenant was unregistered mid-decision
         return best
 
+    # ---- online resharding ----
+
+    def _maybe_reshard(self, tenant_id: str, width: int,
+                       dispatch_s: float | None) -> str | None:
+        """The ``reshard="auto"`` crossover trigger (docs/RESHARDING.md):
+        migrate ``tenant_id``'s resident ``A`` to the layout whose
+        predicted per-request dispatch, plus the migration cost
+        amortized over the EWMA demand horizon, beats the current
+        layout's. Pure prediction — the candidate times come from
+        ``CostModel.predict`` and the migration from
+        ``predict_reshard``; nothing is measured. Returns the
+        destination strategy name when a migration was triggered.
+
+        Damped three ways: a per-tenant cooldown (oscillating demand
+        must not thrash layouts), the amortization itself (a cold
+        tenant's horizon carries too few requests to pay for the
+        collectives), and the strict inequality (ties keep the current
+        layout). The migration runs synchronously on THIS admission's
+        thread — one request pays the swap latency, and the trace shows
+        exactly which one — with ``warm_widths`` forwarding so the
+        new-layout compile also lands here, never on steady-state
+        requests."""
+        if self._reshard != "auto" or self.model is None:
+            return None
+        if dispatch_s is None:
+            return None  # formula-less config: nothing to compare
+        entry = self.registry._tenants.get(tenant_id)
+        if entry is None:
+            return None
+        engine = entry.engine
+        if not engine.resident or getattr(engine, "resharding", False):
+            return None
+        now = self._clock()
+        with self._lock:
+            last = self._last_reshard.get(tenant_id)
+            if last is not None and now - last < self._reshard_cooldown_s:
+                return None
+        rate = entry.rate.rate_per_s()
+        horizon_n = rate * self._reshard_horizon_s
+        if horizon_n < 1.0:
+            return None  # no demand to amortize the collectives over
+        from ..models import get_strategy
+        from ..parallel.reshard import RESHARD_STRATEGIES
+
+        cfg = engine.prediction_config(width)
+        src = cfg["strategy"]
+        if src not in RESHARD_STRATEGIES:
+            return None  # custom strategy instance: no migration program
+        best = None  # (total_s, dst, new_s, migrate_s)
+        for dst in RESHARD_STRATEGIES:
+            if dst == src:
+                continue
+            try:
+                combine = get_strategy(dst).default_combine(engine.mesh)
+                base = self.model.predict(
+                    dst, combine, m=cfg["m"], k=cfg["k"], p=cfg["p"],
+                    dtype=cfg["dtype"], b=cfg["b"], storage=cfg["storage"],
+                ).total_s
+                migrate_s = self.model.predict_reshard(
+                    src, dst, m=cfg["m"], k=cfg["k"], p=cfg["p"],
+                    dtype=cfg["dtype"],
+                ).total_s
+            except Exception:  # swallow-ok: a formula-less candidate honestly drops out of the comparison, exactly like _predict_dispatch_s's None
+                continue
+            new_s = base * (width if cfg["b"] == 1 else 1)
+            total = new_s + migrate_s / horizon_n
+            if best is None or total < best[0]:
+                best = (total, dst, new_s, migrate_s)
+        if best is None or best[0] >= dispatch_s:
+            return None  # current layout already wins the horizon
+        _total, dst, new_s, migrate_s = best
+        with self._lock:
+            self._last_reshard[tenant_id] = now
+        self._c_reshard_decisions.inc()
+        self._record(
+            "reshard", tenant_id,
+            predicted_s=migrate_s,
+            reason=(
+                f"crossover: {dst} predicts {new_s * 1e3:.3f} ms/req vs "
+                f"{src} {dispatch_s * 1e3:.3f} ms, and the "
+                f"{migrate_s * 1e3:.3f} ms migration amortizes over "
+                f"~{horizon_n:.0f} requests ({rate:.2f} req/s x "
+                f"{self._reshard_horizon_s:.0f} s horizon)"
+            ),
+            src=src, dst=dst, old_s=dispatch_s, new_s=new_s,
+            migrate_s=migrate_s, horizon_requests=horizon_n,
+        )
+        try:
+            self.registry.reshard(
+                tenant_id, dst,
+                warm_widths=(1,) if width == 1 else (1, width),
+            )
+        except ConfigError:
+            return None  # unregistered/evicted mid-decision: traced, not fatal
+        finally:
+            # The memo keys omit the strategy on purpose (one seat per
+            # engine identity); a migration makes them stale, so the
+            # engine's seats drop and re-predict under the new layout.
+            with self._lock:
+                self._predict_memo = {
+                    key: s for key, s in self._predict_memo.items()
+                    if key[0] != id(engine)
+                }
+        return dst
+
     # ---- admission & dispatch ----
 
     def submit(
@@ -634,6 +770,10 @@ class GlobalScheduler:
                 eta_s=eta_s, queue_s=queue_s, deadline_ms=deadline_ms,
             )
             self._maybe_interleave(tenant_id, dispatch_s)
+            if self._maybe_reshard(tenant_id, width, dispatch_s):
+                # The migrated layout serves THIS request too: re-predict
+                # so the backlog window charges the new config's time.
+                dispatch_s = self._predict_dispatch_s(engine, width, rtol)
             # Admission owns the deadline from here (module docstring).
             engine_deadline = None
         else:

@@ -204,8 +204,9 @@ class _Tenant:
         "tenant_id", "engine", "quota", "pinned", "last_used", "active",
         "outstanding", "charged_bytes", "requests", "hits", "evictions",
         "evictions_caused", "quota_rejections", "swap_ins", "payload_sha",
-        "rate", "g_resident_bytes", "g_pinned", "c_requests", "c_hits",
-        "c_evictions", "c_evictions_caused", "c_quota_rejections",
+        "rate", "resharding", "reshards", "g_resident_bytes", "g_pinned",
+        "g_strategy", "c_requests", "c_hits", "c_evictions",
+        "c_evictions_caused", "c_quota_rejections",
     )
 
     def __init__(self, tenant_id: str, engine: MatvecEngine,
@@ -226,6 +227,9 @@ class _Tenant:
         self.swap_ins = 0
         self.payload_sha = ""    # host-A content hash, lazy (coalesce groups)
         self.rate = None         # per-tenant arrival RateEstimator
+        self.resharding = False  # one online migration at a time per tenant
+        self.reshards = 0        # completed strategy migrations
+        self.g_strategy = None   # current tenant_strategy{...} info gauge
 
     def sweep(self) -> None:
         """Drop consumed futures from the outstanding window (the quota
@@ -263,6 +267,13 @@ class TenantHandle:
 
     def unpin(self) -> None:
         self._registry.unpin(self.tenant_id)
+
+    def reshard(self, strategy, *, warm_widths=None) -> dict | None:
+        """Migrate this tenant's resident ``A`` to another strategy
+        on-device (:meth:`MatrixRegistry.reshard`)."""
+        return self._registry.reshard(
+            self.tenant_id, strategy, warm_widths=warm_widths
+        )
 
     @property
     def engine(self) -> MatvecEngine:
@@ -433,6 +444,11 @@ class MatrixRegistry:
             "overlap under another tenant's dispatch — the global "
             "scheduler's interleaving)",
         )
+        # Reshard counters are created on the FIRST migration (the
+        # pay-for-what-you-use doctrine: a fleet that never reshards
+        # carries no reshard vocabulary in its snapshot).
+        self._c_reshards = None
+        self._c_reshard_bytes = None
 
     # ---- registration ----
 
@@ -444,6 +460,14 @@ class MatrixRegistry:
     def _tenant_counter(self, tenant_id: str, what: str, help_: str):
         return self.metrics.counter(
             f'tenant_{what}{{tenant="{tenant_id}"}}', help_
+        )
+
+    def _strategy_gauge(self, tenant_id: str, strategy: str):
+        return self.metrics.gauge(
+            f'tenant_strategy{{tenant="{tenant_id}",'
+            f'strategy="{strategy}"}}',
+            "tenant's current partitioning strategy (info metric; the "
+            "active strategy label reads 1)",
         )
 
     def register(
@@ -538,6 +562,13 @@ class MatrixRegistry:
             tenant_id, "quota_rejections_total",
             "submits refused by this tenant's quota",
         )
+        # Info gauge, Prometheus-style: the label set carries the fact
+        # (the obs `tenants` panel's strategy column); a reshard flips
+        # the old label to 0 and the new one to 1.
+        entry.g_strategy = self._strategy_gauge(
+            tenant_id, engine.strategy.name
+        )
+        entry.g_strategy.set(1)
         with self._lock:
             if self._closed:
                 raise ConfigError("registry is closed")
@@ -870,6 +901,77 @@ class MatrixRegistry:
             self._c_prefetches.inc()
         return placed
 
+    def reshard(
+        self, tenant_id: str, strategy, *, warm_widths=None
+    ) -> dict | None:
+        """Migrate one tenant's resident ``A`` to another strategy
+        ON-DEVICE (``MatvecEngine.reshard``; docs/RESHARDING.md) and
+        re-home its executable cache under the new exec signature — the
+        same first-donates/later-adopts idiom as :meth:`register`, so
+        same-shaped tenants already serving in the destination layout
+        hand this one their compiled programs (often making the
+        migration compile-free). The migration itself runs OUTSIDE the
+        registry lock (collectives are enqueue-only, and in-flight
+        dispatches keep serving the old layout); eviction stays legal
+        throughout — an eviction landing mid-migration aborts the array
+        swap cleanly at the engine commit, so the HBM ledger never
+        carries a double footprint (the residency listener reconciles as
+        usual). Returns the engine's migration summary, or None when the
+        tenant is already mid-reshard or already in the destination
+        layout. ``warm_widths`` compiles the destination executable set
+        AFTER the cache re-home — the one-time new-layout compile."""
+        with self._lock:
+            if self._closed:
+                raise ConfigError("registry is closed")
+            entry = self._entry(tenant_id)
+            engine = entry.engine
+            dst_name = (
+                strategy if isinstance(strategy, str) else strategy.name
+            )
+            if entry.resharding or engine.strategy.name == dst_name:
+                return None
+            entry.resharding = True
+        try:
+            # registry-ok: the engine migration (collective build +
+            # enqueue + commit) never runs under the registry lock.
+            result = engine.reshard(strategy)
+        finally:
+            with self._lock:
+                entry.resharding = False
+        with self._lock:
+            # Re-home the exec cache under the NEW signature before any
+            # destination-layout compile, so warmup lands in the shared
+            # cache (or adopts a sibling's compiled programs wholesale).
+            sig = engine.exec_signature()
+            cache = self._exec_caches.get(sig)
+            if cache is None:
+                self._exec_caches[sig] = engine._cache
+            else:
+                engine._cache = cache
+            entry.reshards += 1
+            if entry.g_strategy is not None:
+                entry.g_strategy.set(0)
+            entry.g_strategy = self._strategy_gauge(
+                tenant_id, engine.strategy.name
+            )
+            entry.g_strategy.set(1)
+            if self._c_reshards is None:
+                self._c_reshards = self.metrics.counter(
+                    "registry_reshards_total",
+                    "completed online strategy migrations (config-only "
+                    "and aborted-array swaps included)",
+                )
+                self._c_reshard_bytes = self.metrics.counter(
+                    "reshard_bytes_total",
+                    "payload bytes redistributed by reshard collective "
+                    "programs (host-fallback and aborted swaps move 0)",
+                )
+            self._c_reshards.inc()
+            self._c_reshard_bytes.inc(int(result.get("bytes_moved", 0)))
+        if warm_widths is not None:
+            engine.warmup(widths=warm_widths)
+        return result
+
     # ---- warmup, stats, health ----
 
     def warmup(self, widths: Sequence[int] | None = None) -> int:
@@ -897,13 +999,16 @@ class MatrixRegistry:
             e = self._entry(tenant_id)
             return {
                 "tenant": tenant_id,
+                "strategy": e.engine.strategy.name,
                 "resident": e.engine.resident,
+                "resharding": e.resharding,
                 "resident_bytes": e.charged_bytes,
                 "payload_bytes": e.engine.resident_bytes,
                 "pinned": e.pinned,
                 "requests": e.requests,
                 "hits": e.hits,
                 "swap_ins": e.swap_ins,
+                "reshards": e.reshards,
                 "evictions": e.evictions,
                 "evictions_caused": e.evictions_caused,
                 "quota_rejections": e.quota_rejections,

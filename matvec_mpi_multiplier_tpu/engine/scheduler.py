@@ -94,7 +94,7 @@ Multi-tenant note (``registry.py``): a scheduler wraps ONE engine, so
 under the matrix registry coalescing is per-tenant by construction
 (batches never mix tenants' matrices). A flush racing that tenant's
 eviction is safe: a registry-managed engine re-places its retained host
-payload transparently inside the dispatch (``MatvecEngine._a_for``),
+payload transparently inside the dispatch (``MatvecEngine._a_for_locked``),
 accounted through the residency listener — the flusher thread needs no
 registry coordination. CROSS-tenant coalescing — tenants sharing an
 exec signature AND payload bytes contributing columns to one flush,

@@ -176,20 +176,44 @@ def render_tenants(snapshot: dict) -> str | None:
         f"  native fallbacks  "
         f"{counters.get('registry_native_fallback_charges_total', 0)} "
         "(degraded-tier placements charged to their tenant)",
+        f"  reshards          "
+        f"{counters.get('registry_reshards_total', 0)} "
+        f"({counters.get('reshard_bytes_total', 0):.3e} payload bytes "
+        "migrated on-device; docs/RESHARDING.md)",
     ]
     per = _labeled(counters, "tenant_")
     for tenant, vals in _labeled(gauges, "tenant_").items():
         per.setdefault(tenant, {}).update(vals)
+    # Each tenant's CURRENT layout: tenant_strategy{tenant=...,strategy=...}
+    # is a one-hot gauge family (1 on the live layout, 0 on layouts the
+    # tenant migrated away from — engine/registry.py), so the column shows
+    # the strategy label whose gauge reads 1.
+    strategy_of: dict[str, str] = {}
+    for name, value in gauges.items():
+        if not name.startswith("tenant_strategy{") or not value:
+            continue
+        labels = dict(
+            part.split("=", 1)
+            for part in name[name.index("{") + 1:name.rindex("}")].split(",")
+        )
+        strategy_of[labels.get("tenant", "?").strip('"')] = labels.get(
+            "strategy", "?"
+        ).strip('"')
     if per:
         width = max(len(t) for t in per)
+        swidth = max(
+            [len("strategy")] + [len(s) for s in strategy_of.values()]
+        )
         out.append(
-            f"  {'tenant':<{width}}  resident_bytes  requests  hits  "
-            "evicted  caused  quota_rej  pinned"
+            f"  {'tenant':<{width}}  {'strategy':<{swidth}}  "
+            "resident_bytes  requests  hits  evicted  caused  "
+            "quota_rej  pinned"
         )
         for tenant in sorted(per):
             v = per[tenant]
             out.append(
                 f"  {tenant:<{width}}  "
+                f"{strategy_of.get(tenant, '-'):<{swidth}}  "
                 f"{v.get('resident_bytes', 0):>14.3e}  "
                 f"{v.get('requests_total', 0):>8.0f}  "
                 f"{v.get('hits_total', 0):>4.0f}  "

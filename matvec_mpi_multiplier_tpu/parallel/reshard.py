@@ -1,0 +1,241 @@
+"""On-device resharding: migrate a resident operand between strategies.
+
+GSPMD's view of resharding is a collective program: a layout change is a
+redistribution of the same bytes across the same devices, so the minimal
+migration between two of our partitionings is a short ``all_to_all`` /
+``ppermute`` sequence — never a host gather. Each device holds exactly
+1/p of ``A`` before, during, and after every step (the constant-footprint
+invariant the ``hlo-reshard-schedule`` audit pins), so migrating an
+``m x k`` resident moves at most a handful of local-shard-sized payloads
+over the interconnect instead of streaming the whole matrix through the
+host and recompiling from scratch.
+
+The per-pair programs, on an ``(r, c)`` mesh grid with ``p = r * c``
+devices and the flat device order ``d = i * c + j``:
+
+==========  ==========  ==================================================
+src         dst         program
+==========  ==========  ==================================================
+rowwise     colwise     all_to_all over the flat axis (split 1, concat 0)
+colwise     rowwise     all_to_all over the flat axis (split 0, concat 1)
+rowwise     blockwise   all_to_all over 'cols' (split 1, concat 0)
+blockwise   rowwise     all_to_all over 'cols' (split 0, concat 1)
+colwise     blockwise   grid-transpose ppermute, then all_to_all over
+                        'rows' (split 0, concat 1)
+blockwise   colwise     all_to_all over 'rows' (split 1, concat 0), then
+                        inverse grid-transpose ppermute
+==========  ==========  ==================================================
+
+:func:`reshard_program` is the single symbolic source of truth for these
+step sequences: :func:`build_reshard` executes it, the staticcheck
+audit's ``reshard_formula`` prices it (census + payload bytes), and the
+cost model's ``predict_reshard`` consumes that same formula — so a
+perturbation here reddens the audit and the migration trigger together.
+
+The built callable maps an arbitrary pytree of identically-sharded
+arrays, so a quantized resident's ``(q, scales)`` leaves ride the same
+program as a native ``A`` — per-block scales migrate bitwise whenever
+the block size (a pure function of ``k`` and the contraction shard
+count) agrees between the two layouts, which the engine checks before
+choosing device migration over host requantization.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec
+
+from ..utils.compat import shard_map
+from ..utils.constants import MESH_AXIS_COLS, MESH_AXIS_ROWS
+from ..utils.errors import ConfigError
+from .mesh import mesh_grid_shape
+
+__all__ = [
+    "RESHARD_STRATEGIES",
+    "payload_spec",
+    "reshard_program",
+    "build_reshard",
+    "validate_reshard",
+]
+
+#: The strategies the on-device migration covers, in canonical order.
+RESHARD_STRATEGIES = ("rowwise", "colwise", "blockwise")
+
+_FLAT = (MESH_AXIS_ROWS, MESH_AXIS_COLS)
+
+# Audit mutation seam (tests/test_staticcheck.py): None runs the real
+# program; "host" swaps in a gather-everything-then-slice lowering (the
+# on-device stand-in for a host round-trip — a literal host transfer
+# cannot appear in a lowered module, but the full-``A`` all-gather it
+# would imply can, and that is what the audit catches); "redundant"
+# appends a rotate/unrotate ppermute pair (correct result, two extra
+# collective-permutes in the census). Either must turn
+# ``hlo-reshard-schedule`` red.
+_MUTATION: str | None = None
+
+
+def payload_spec(strategy: str) -> PartitionSpec:
+    """The ``PartitionSpec`` a strategy's resident ``A`` payload lives
+    under — also the pytree-prefix spec every leaf of a quantized
+    resident shares (q and scales shard identically along both axes)."""
+    if strategy == "rowwise":
+        return PartitionSpec(_FLAT, None)
+    if strategy == "colwise":
+        return PartitionSpec(None, _FLAT)
+    if strategy == "blockwise":
+        return PartitionSpec(MESH_AXIS_ROWS, MESH_AXIS_COLS)
+    raise ConfigError(
+        f"reshard covers {RESHARD_STRATEGIES}, got {strategy!r}"
+    )
+
+
+def _transpose_perm(r: int, c: int) -> list[tuple[int, int]]:
+    # Flat-order grid transpose: device (i, j) sends to device (j, i) of
+    # the transposed grid, i.e. flat d = i*c + j -> (d % r) * c + d // r
+    # on the (r, c) grid read column-major.
+    p = r * c
+    return [(d, (d % r) * c + d // r) for d in range(p)]
+
+
+def _transpose_inv_perm(r: int, c: int) -> list[tuple[int, int]]:
+    p = r * c
+    return [(e, (e % c) * r + e // c) for e in range(p)]
+
+
+def reshard_program(
+    src: str, dst: str, r: int, c: int
+) -> tuple[tuple, ...]:
+    """The effective step sequence migrating ``src`` -> ``dst`` on an
+    ``(r, c)`` grid: ``("a2a", axis, split, concat)`` and
+    ``("perm", which)`` tuples, with degenerate steps (size-1 collective
+    groups, fixed-point permutes) already elided so the census formula
+    and the built program agree on every mesh shape."""
+    for name in (src, dst):
+        if name not in RESHARD_STRATEGIES:
+            raise ConfigError(
+                f"reshard covers {RESHARD_STRATEGIES}, got {name!r}"
+            )
+    if src == dst:
+        return ()
+    programs = {
+        ("rowwise", "colwise"): (("a2a", "flat", 1, 0),),
+        ("colwise", "rowwise"): (("a2a", "flat", 0, 1),),
+        ("rowwise", "blockwise"): (("a2a", "cols", 1, 0),),
+        ("blockwise", "rowwise"): (("a2a", "cols", 0, 1),),
+        ("colwise", "blockwise"): (("perm", "t"), ("a2a", "rows", 0, 1)),
+        ("blockwise", "colwise"): (("a2a", "rows", 1, 0), ("perm", "t_inv")),
+    }
+    sizes = {"flat": r * c, "rows": r, "cols": c}
+    steps = []
+    for step in programs[(src, dst)]:
+        if step[0] == "a2a" and sizes[step[1]] == 1:
+            continue  # size-1 group: the all_to_all is an identity
+        if step[0] == "perm":
+            perm = (
+                _transpose_perm(r, c)
+                if step[1] == "t"
+                else _transpose_inv_perm(r, c)
+            )
+            if all(a == b for a, b in perm):
+                continue  # degenerate grid: the transpose is a no-op
+        steps.append(step)
+    return tuple(steps)
+
+
+def validate_reshard(shape, mesh, *, what: str = "A") -> None:
+    """Conservative divisibility gate: every migration step splits a
+    local shard by a collective-group size, so requiring both global
+    dims divisible by ``p`` is sufficient for every (src, dst) pair
+    (the strategies' own constructors already enforce their per-layout
+    constraints). Raises :class:`ConfigError` naming the offending
+    operand so the engine can fall back to a host requantization for a
+    scale leaf instead of tripping a cryptic XLA shape error."""
+    p = int(mesh.devices.size)
+    m, k = int(shape[0]), int(shape[1])
+    if m % p or k % p:
+        raise ConfigError(
+            f"reshard needs both dims of {what} divisible by the device "
+            f"count: shape=({m}, {k}), p={p}"
+        )
+
+
+def build_reshard(mesh, src: str, dst: str):
+    """Build the jitted migration ``src`` -> ``dst`` on ``mesh``.
+
+    Returns a compiled callable mapping a pytree of ``src``-sharded
+    arrays (a bare ``A`` or a quantized resident's leaves — every leaf
+    sharded by :func:`payload_spec`) to the same values ``dst``-sharded,
+    as pure device collectives. ``src == dst`` builds an identity (the
+    engine short-circuits earlier; this keeps the primitive total)."""
+    r, c = mesh_grid_shape(mesh)
+    steps = reshard_program(src, dst, r, c)
+    axes = {
+        "flat": _FLAT,
+        "rows": MESH_AXIS_ROWS,
+        "cols": MESH_AXIS_COLS,
+    }
+    mutation = _MUTATION
+
+    def migrate_leaf(x):
+        if mutation == "host":
+            return _gather_and_slice(x, src, dst, r, c)
+        for step in steps:
+            if step[0] == "a2a":
+                x = lax.all_to_all(
+                    x,
+                    axes[step[1]],
+                    split_axis=step[2],
+                    concat_axis=step[3],
+                    tiled=True,
+                )
+            else:
+                perm = (
+                    _transpose_perm(r, c)
+                    if step[1] == "t"
+                    else _transpose_inv_perm(r, c)
+                )
+                x = lax.ppermute(x, _FLAT, perm)
+        if mutation == "redundant":
+            p = r * c
+            x = lax.ppermute(x, _FLAT, [(d, (d + 1) % p) for d in range(p)])
+            x = lax.ppermute(x, _FLAT, [(d, (d - 1) % p) for d in range(p)])
+        return x
+
+    def body(tree):
+        return jax.tree_util.tree_map(migrate_leaf, tree)
+
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=payload_spec(src),
+            out_specs=payload_spec(dst),
+        )
+    )
+
+
+def _gather_and_slice(x, src: str, dst: str, r: int, c: int):
+    # The seeded "host" mutation: materialize the full operand on every
+    # device, then slice out this device's destination shard. Bitwise
+    # the same result, but the census shows a full-``A`` all-gather —
+    # exactly the payload signature a host round-trip would imply.
+    if src == "rowwise":
+        full = lax.all_gather(x, _FLAT, axis=0, tiled=True)
+    elif src == "colwise":
+        full = lax.all_gather(x, _FLAT, axis=1, tiled=True)
+    else:
+        full = lax.all_gather(x, MESH_AXIS_ROWS, axis=0, tiled=True)
+        full = lax.all_gather(full, MESH_AXIS_COLS, axis=1, tiled=True)
+    p = r * c
+    i = lax.axis_index(MESH_AXIS_ROWS)
+    j = lax.axis_index(MESH_AXIS_COLS)
+    flat = i * c + j
+    m, k = full.shape
+    if dst == "rowwise":
+        return lax.dynamic_slice_in_dim(full, flat * (m // p), m // p, axis=0)
+    if dst == "colwise":
+        return lax.dynamic_slice_in_dim(full, flat * (k // p), k // p, axis=1)
+    return lax.dynamic_slice(
+        full, (i * (m // r), j * (k // c)), (m // r, k // c)
+    )

@@ -117,6 +117,12 @@ AUDIT_M = 64
 AUDIT_K = 2048
 AUDIT_DTYPE = "float32"
 GOLDEN_REL = "data/staticcheck/golden_schedule.json"
+# Schema 7 over 6: the table gains a top-level "reshards" section pinning
+# each online-migration program's collective census and per-device payload
+# bytes per (src, dst) strategy pair (parallel/reshard.py; gate id
+# hlo-reshard-schedule): a layout migration must be the minimal
+# all_to_all/ppermute sequence — a host-transfer-shaped lowering (any
+# gather/reduce kind) or a redundant collective turns the audit red.
 # Schema 6 over 5: the table gains a top-level "fused_solvers" section
 # pinning the fused Pallas iteration tier's jaxpr-level census
 # (ops/pallas_solver.py): exactly ONE pallas_call plus the strategy's S
@@ -133,7 +139,7 @@ GOLDEN_REL = "data/staticcheck/golden_schedule.json"
 # Schema 3 over 2: every entry additionally pins the compiled-artifact
 # memory audit — RHS donation state ("aliased"/"donated") and the static
 # peak-liveness estimate (peak_bytes / peak_bytes_ratio).
-GOLDEN_SCHEMA = 6
+GOLDEN_SCHEMA = 7
 
 # The solver audit's square operand (the solver ops need m == k). Shares
 # the audit mesh's divisibility needs (8 devices, the 2x4 grid); small on
@@ -967,6 +973,152 @@ def expected_schedule(
     )
 
 
+# ---------------------------------------------------------- reshard audit
+#
+# The online-resharding layer (parallel/reshard.py; docs/RESHARDING.md):
+# migrating a resident A between two strategies must lower to the MINIMAL
+# collective program — all_to_all over the right axis (plus the grid
+# transpose ppermute for the colwise↔blockwise pair), every device moving
+# exactly its 1/p local shard per step. The structural formula below is
+# the single symbolic source of truth the cost model's predict_reshard
+# shares (the same late-import seam as schedule_formula), so a formula
+# perturbation reddens the audit and the migration trigger together. A
+# gather/reduce kind in the lowering is the on-device signature of a
+# host-round-trip migration (the full operand materialized somewhere);
+# any count or payload drift from the formula is a redundant — or
+# missing — collective. Both turn hlo-reshard-schedule red
+# (mutation-tested via parallel.reshard._MUTATION).
+
+
+class ReshardAuditConfig(NamedTuple):
+    """One audited migration: a (src, dst) strategy pair."""
+
+    src: str
+    dst: str
+
+    @property
+    def key(self) -> str:
+        return f"reshard|{self.src}|{self.dst}"
+
+
+RESHARD_AUDIT_CONFIGS = tuple(
+    ReshardAuditConfig(src, dst)
+    for src in ("rowwise", "colwise", "blockwise")
+    for dst in ("rowwise", "colwise", "blockwise")
+    if src != dst
+)
+
+
+def reshard_formula(
+    src: str, dst: str, *, m: int, k: int, p: int, r: int, c: int,
+    itemsize: int,
+) -> tuple[dict[str, int], dict[str, int]]:
+    """The (src, dst) migration's collective census and per-device
+    payload bytes as a SYMBOLIC function of the operand and mesh —
+    ``(census, payload_bytes)`` keyed by collective kind. Every step of
+    every program presents exactly the device's 1/p local shard (the
+    constant-footprint invariant), so payload = count × (m·k·itemsize)/p
+    per kind. Evaluated by :func:`expected_reshard` at the audit operand
+    and by ``tuning.cost_model.CostModel.predict_reshard`` over arbitrary
+    shapes (the wire factor — (g−1)/g per all_to_all group — is the cost
+    model's to apply, not the schedule's)."""
+    from ..parallel.reshard import reshard_program
+
+    shard_bytes = (m * k * itemsize) // p
+    census: dict[str, int] = {}
+    for step in reshard_program(src, dst, r, c):
+        kind = "all-to-all" if step[0] == "a2a" else "collective-permute"
+        census[kind] = census.get(kind, 0) + 1
+    payload = {kind: n * shard_bytes for kind, n in census.items()}
+    return census, payload
+
+
+def expected_reshard(
+    rcfg: ReshardAuditConfig, mesh
+) -> tuple[dict[str, int], dict[str, int]]:
+    """The structural formula evaluated at the audit operand — the
+    golden-independent pin on each migration's census."""
+    from ..parallel.mesh import mesh_grid_shape
+
+    p = int(mesh.devices.size)
+    r, c = mesh_grid_shape(mesh)
+    return reshard_formula(
+        rcfg.src, rcfg.dst, m=AUDIT_M, k=AUDIT_K, p=p, r=r, c=c,
+        itemsize=_ITEMSIZE[AUDIT_DTYPE],
+    )
+
+
+def lower_reshard_config(rcfg: ReshardAuditConfig, mesh):
+    """Lower one (src, dst) migration against the src-sharded audit
+    operand (trace-only — exactly the program ``MatvecEngine.reshard``
+    dispatches for the payload leaves)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from ..parallel.reshard import build_reshard, payload_spec
+
+    struct = jax.ShapeDtypeStruct(
+        (AUDIT_M, AUDIT_K), np.dtype(AUDIT_DTYPE),
+        sharding=NamedSharding(mesh, payload_spec(rcfg.src)),
+    )
+    return build_reshard(mesh, rcfg.src, rcfg.dst).lower(struct)
+
+
+def reshard_audit_entry(
+    rcfg: ReshardAuditConfig, mesh, lowered=None
+) -> dict:
+    """Package one migration's observed schedule."""
+    if lowered is None:
+        lowered = lower_reshard_config(rcfg, mesh)
+    census, payload = collective_census(lowered)
+    return {
+        "census": dict(sorted(census.items())),
+        "payload_bytes": dict(sorted(payload.items())),
+        "payload_total_bytes": sum(payload.values()),
+    }
+
+
+def reshard_findings(
+    rcfg: ReshardAuditConfig, entry: dict, mesh
+) -> list[Finding]:
+    """The structural gates for one migration entry: no gather/reduce
+    kind anywhere (a host-transfer-shaped lowering), and census + payload
+    exactly the formula's minimal program (an extra OR missing collective
+    is drift either way)."""
+    findings: list[Finding] = []
+    exp_census, exp_payload = expected_reshard(rcfg, mesh)
+    census = entry["census"]
+    gatherish = sorted(
+        set(census) - {"all-to-all", "collective-permute"}
+    )
+    if gatherish:
+        findings.append(Finding(
+            f"<hlo:{rcfg.key}>", 0, "hlo-reshard-schedule",
+            f"migration lowers {gatherish} — a gather/reduce kind "
+            "materializes more than the 1/p local shard somewhere, the "
+            "on-device signature of a host-round-trip migration; the "
+            f"{rcfg.src}->{rcfg.dst} move must be the minimal "
+            "all_to_all/ppermute program",
+        ))
+    elif census != dict(sorted(exp_census.items())):
+        findings.append(Finding(
+            f"<hlo:{rcfg.key}>", 0, "hlo-reshard-schedule",
+            f"collective census {census} != structural expectation "
+            f"{dict(sorted(exp_census.items()))} — a redundant (or "
+            "missing) collective in the migration program",
+        ))
+    elif entry["payload_bytes"] != dict(sorted(exp_payload.items())):
+        findings.append(Finding(
+            f"<hlo:{rcfg.key}>", 0, "hlo-reshard-schedule",
+            f"collective payload {entry['payload_bytes']} != structural "
+            f"expectation {dict(sorted(exp_payload.items()))} — each "
+            "migration step must move exactly the device's 1/p local "
+            "shard",
+        ))
+    return findings
+
+
 def lowering_fingerprint(lowered) -> str:
     return hashlib.sha256(lowered.as_text().encode()).hexdigest()
 
@@ -1352,7 +1504,7 @@ def lower_spec_config(scfg: SpecAuditConfig, mesh):
     operand (trace-only), with the engine's operand signature
     ``fn(aq, p, u, x, rtol)`` — the quantized pytree, the precomputed
     projection/probe matrices, the request, and the DYNAMIC tolerance
-    scalar (exactly what ``MatvecEngine._spec_builder_for`` compiles)."""
+    scalar (exactly what ``MatvecEngine._spec_builder_for_locked`` compiles)."""
     import jax
     import numpy as np
 
@@ -1474,6 +1626,7 @@ def build_schedule_table(
     solver_configs: Iterable[SolverAuditConfig] | None = None,
     spec_configs: Iterable[SpecAuditConfig] | None = None,
     fused_solver_configs: Iterable[FusedSolverAuditConfig] | None = None,
+    reshard_configs: Iterable[ReshardAuditConfig] | None = None,
 ) -> dict:
     """The full golden-table payload for the current tree: the schedule
     census (plain-struct lowering) merged with the compiled-artifact
@@ -1481,7 +1634,8 @@ def build_schedule_table(
     solver loops' census/while pins per strategy × op, plus the fused
     speculative programs' census/predicate pins per strategy family,
     plus the fused solver tier's jaxpr census pins per op × strategy ×
-    storage (schema 6)."""
+    storage, plus the online-reshard migration programs' census/payload
+    pins per (src, dst) strategy pair (schema 7)."""
     import jax
 
     mesh = _audit_mesh()
@@ -1510,6 +1664,13 @@ def build_schedule_table(
             else tuple(fused_solver_configs)
         )
     }
+    reshard_entries = {
+        rcfg.key: reshard_audit_entry(rcfg, mesh)
+        for rcfg in (
+            RESHARD_AUDIT_CONFIGS if reshard_configs is None
+            else tuple(reshard_configs)
+        )
+    }
     return {
         "schema": GOLDEN_SCHEMA,
         "mesh": {
@@ -1526,6 +1687,7 @@ def build_schedule_table(
         "solvers": solver_entries,
         "speculative": spec_entries,
         "fused_solvers": fused_entries,
+        "reshards": reshard_entries,
     }
 
 
@@ -1552,6 +1714,8 @@ def run_hlo_audit(
     spec_configs: Iterable[SpecAuditConfig] | None = None,
     fused_solvers: bool | None = None,
     fused_solver_configs: Iterable[FusedSolverAuditConfig] | None = None,
+    reshards: bool | None = None,
+    reshard_configs: Iterable[ReshardAuditConfig] | None = None,
 ) -> list[Finding]:
     """The full lowered-artifact audit: the collective-schedule layer
     (census + bytes vs formula and golden, the overlap chunking gate,
@@ -1584,6 +1748,9 @@ def run_hlo_audit(
     if fused_solvers is None:
         # Same narrowing rule again.
         fused_solvers = configs is None or fused_solver_configs is not None
+    if reshards is None:
+        # Same narrowing rule again (gate hlo-reshard-schedule).
+        reshards = configs is None or reshard_configs is not None
     configs = _supported_configs(configs or AUDIT_CONFIGS)
     findings: list[Finding] = []
 
@@ -1824,6 +1991,39 @@ def run_hlo_audit(
                     GOLDEN_REL, 0, "hlo-golden",
                     f"golden table pins unknown fused solver config "
                     f"{stale}; regenerate with --write-golden",
+                ))
+
+    if reshards:
+        golden_reshards = golden.get("reshards", {}) if have_golden else {}
+        for rcfg in (
+            RESHARD_AUDIT_CONFIGS if reshard_configs is None
+            else tuple(reshard_configs)
+        ):
+            entry = reshard_audit_entry(rcfg, mesh)
+            findings.extend(reshard_findings(rcfg, entry, mesh))
+            if have_golden:
+                pinned = golden_reshards.get(rcfg.key)
+                if pinned is None:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-golden",
+                        f"reshard config {rcfg.key} missing from the "
+                        "golden table; bless it with --write-golden",
+                    ))
+                elif pinned != entry:
+                    findings.append(Finding(
+                        GOLDEN_REL, 0, "hlo-census",
+                        f"{rcfg.key}: lowered migration program {entry} "
+                        f"!= golden {pinned}; a collective or payload "
+                        "change in an online-reshard lowering — if "
+                        "deliberate, bless it with --write-golden",
+                    ))
+        if have_golden and reshard_configs is None:
+            audited_reshards = {r.key for r in RESHARD_AUDIT_CONFIGS}
+            for stale in sorted(set(golden_reshards) - audited_reshards):
+                findings.append(Finding(
+                    GOLDEN_REL, 0, "hlo-golden",
+                    f"golden table pins unknown reshard config {stale}; "
+                    "regenerate with --write-golden",
                 ))
 
     if have_golden:

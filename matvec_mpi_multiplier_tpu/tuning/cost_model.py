@@ -559,6 +559,63 @@ class CostModel:
         ``swap_s`` term of :meth:`predict_admission`."""
         return float(nbytes) / self.calibration.mem_bps
 
+    def predict_reshard(
+        self, src: str, dst: str, *, m: int, k: int, p: int, dtype: str,
+        r: int | None = None,
+    ) -> Prediction:
+        """Predicted one-time cost of migrating a resident ``A`` from
+        ``src`` to ``dst`` layout on a ``p``-device mesh
+        (``parallel.reshard``; docs/RESHARDING.md): the migration
+        program's steps priced by the calibrated α–β constants. Every
+        step moves exactly the device's 1/p shard (the
+        constant-footprint invariant ``staticcheck.hlo.reshard_formula``
+        pins), and the wire factor applies per step against its OWN
+        collective-group size — ``(g-1)/g`` for an ``all_to_all`` over a
+        ``g``-device axis, one full-shard hop for a
+        ``collective_permute`` — rather than the dispatch path's factor
+        at ``p``. No compute term: a migration is wire and latency only
+        (a forced requantization is host-side, and the engine keeps it
+        off the hot path). This is the amortized-crossover numerator the
+        global scheduler's ``reshard="auto"`` trigger divides by the
+        EWMA demand horizon."""
+        # Imported at call time ON PURPOSE, same doctrine as predict():
+        # the mutation test reddens the model and the audit through the
+        # one shared formula symbol.
+        from ..staticcheck import hlo
+        from ..parallel.mesh import most_square_factors
+        from ..parallel.reshard import reshard_program
+
+        if r is None:
+            r, _c = most_square_factors(p)
+        c = max(1, p // r)
+        cal = self.calibration
+        itemsize = hlo.dtype_itemsize(dtype)
+        census, _payload = hlo.reshard_formula(
+            src, dst, m=m, k=k, p=p, r=r, c=c, itemsize=itemsize
+        )
+        latency_s = sum(
+            n * cal.alpha_s[family(kind)] for kind, n in census.items()
+        )
+        shard_bytes = float((m * k * itemsize) // p) if p else 0.0
+        group = {"flat": p, "rows": r, "cols": c}
+        wire_bytes = 0.0
+        wire_s = 0.0
+        for step in reshard_program(src, dst, r, c):
+            if step[0] == "a2a":
+                g = group[step[1]]
+                wb = shard_bytes * (g - 1) / g
+                fam = "collective"
+            else:
+                wb = shard_bytes
+                fam = "permute"
+            wire_bytes += wb
+            wire_s += wb / cal.beta_bps[fam]
+        return Prediction(
+            total_s=wire_s + latency_s, compute_s=0.0, wire_s=wire_s,
+            latency_s=latency_s, flops=0.0, a_bytes=m * k * itemsize,
+            wire_bytes=wire_bytes,
+        )
+
     def predict_admission(
         self,
         strategy: str | None,

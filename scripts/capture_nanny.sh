@@ -114,6 +114,16 @@ family_pids() {  # group members + ALL their descendants: catches children
   esac
 }
 
+capture_cmdline() {  # 0 when $1's cmdline carries the capture fingerprint
+  [ -r "/proc/$1/cmdline" ] &&
+  tr '\0' ' ' < "/proc/$1/cmdline" 2>/dev/null |
+    grep -Eq 'watch_and_capture|tpu_measure_all|bench\.sweep|_study\.py|autotune_pallas|derive_vmem_roof|stats_visualization|nbconvert|jupyter'
+}
+
+pid_in_group() {  # 0 when $1 still sits in the watcher's pgid right now
+  [ "$(ps -o pgid= -p "$1" 2>/dev/null | tr -d ' ')" = "$wpid" ]
+}
+
 kill_family() {
   local fam pid matched=""
   fam=$(family_pids)
@@ -125,9 +135,7 @@ kill_family() {
   # be reassigned to an unrelated job within one poll interval. Require
   # the capture's own fingerprint among the members before killing.
   for pid in $fam; do
-    if [ -r "/proc/$pid/cmdline" ] &&
-       tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null |
-         grep -Eq 'watch_and_capture|tpu_measure_all|bench\.sweep|_study\.py|autotune_pallas|derive_vmem_roof|stats_visualization|nbconvert|jupyter'; then
+    if capture_cmdline "$pid"; then
       matched=1; break
     fi
   done
@@ -136,8 +144,21 @@ kill_family() {
     return
   fi
   kill -9 -- "-$wpid" 2>/dev/null
-  # shellcheck disable=SC2086
-  kill -9 $fam 2>/dev/null
+  # The group kill only reaches members still in the pgid; the per-pid
+  # sweep exists for ESCAPEES (setsid'd jupyter kernels, GNU timeout's
+  # own group). But $fam is a snapshot: between collecting it and
+  # striking, an escapee may have exited and its pid been RECYCLED to an
+  # unrelated process — the one-member fingerprint above says nothing
+  # about the others. Re-verify EACH pid at strike time (still in the
+  # verified group, or carrying the capture cmdline itself) and skip the
+  # rest rather than kill on stale identity.
+  for pid in $fam; do
+    if pid_in_group "$pid" || capture_cmdline "$pid"; then
+      kill -9 "$pid" 2>/dev/null
+    else
+      say "pid $pid no longer matches the capture family (exited or recycled) — skipping"
+    fi
+  done
 }
 
 start_watcher "$@"

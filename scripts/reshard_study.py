@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Online-resharding evidence: the drifting-shape A/B capture (ISSUE 18
+acceptance; docs/RESHARDING.md).
+
+One seeded protocol (:func:`~matvec_mpi_multiplier_tpu.bench.serve.
+run_reshard_drift`), run twice: a 3-tenant Zipf fleet registered in the
+calibrated cost model's predicted-WORST layout for the steady traffic
+shape serves a trace that drifts at the rollover index — width-1
+vectors trickling below the amortization threshold before it, closed-
+loop 32-column blocks after it. ``--reshard off`` keeps the fleet
+frozen in the registered layout; ``--reshard auto`` lets the
+``GlobalScheduler`` crossover trigger migrate each tenant's resident
+``A`` on-device (``MatrixRegistry.reshard`` — pure collectives, the
+``hlo-reshard-schedule``-audited programs) once its EWMA demand
+amortizes the migration. Each arm runs in its OWN subprocess so
+allocator state from one arm cannot bias the other's percentiles.
+
+Committed artifacts under ``--out`` (``data/reshard_demo/``), gated by
+``tests/test_data_quality.py``:
+
+* ``tuning_cache.json`` — the full (6-probe) calibration both the
+  registration-layout pick and the trigger's predictions came from.
+* ``out/reshard_ab.csv`` — both arms' rows: pre/steady p50/p99,
+  migration counts and bytes, per-phase compile counts, the request
+  index of the last migration, final per-tenant strategies.
+* ``decisions.jsonl`` — the auto arm's full decision trace; the
+  ``reshard`` decisions carry ``predicted_s`` (the migration cost) and
+  the crossover-plus-amortization reason.
+* ``metrics.json`` — the auto arm's registry snapshot
+  (``registry_reshards_total`` / ``reshard_bytes_total`` /
+  ``gsched_reshards_total`` — the counters the obs panel renders).
+* ``summary.json`` — the A/B headline, asserted before anything is
+  written: auto must beat off on steady-state p99 AND p50, every
+  migration must land before the steady window opens, steady-phase
+  compiles must be ZERO in both arms (the one-time new-layout compile
+  rides the migration's ``warm_widths``, never a steady request), and
+  every reshard decision must carry ``predicted_s`` + reason.
+
+Usage::
+
+    python scripts/reshard_study.py --platform cpu --host-devices 8 \
+        --out data/reshard_demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+# The committed protocol. Shape chosen where the measured layout gap is
+# wide on the CPU mesh AND points the same way as the calibrated
+# model's ranking (tall-narrow A, wide steady blocks: the predicted-
+# worst blockwise pays two collective launches per request where the
+# predicted-best rowwise pays one cheap output gather). The pre-phase
+# trickle (6 req/s fleet-wide over 3 tenants, EWMA horizon 0.5 s)
+# keeps every tenant's amortization horizon under one request, so the
+# trigger provably waits for the demand+shape drift.
+M, K = 8192, 256
+WIDTH_STEADY = 32
+N_TENANTS = 3
+ZIPF_A = 1.1
+N_REQUESTS = 280
+ROLLOVER = 24
+STEADY_SKIP = 56
+PRE_RATE = 6.0
+SEED = 0
+CALIB_REPS = 10
+
+
+def run_arm(args) -> int:
+    """Child mode: one A/B arm in a fresh process. Reads the shared
+    tuning cache (env), writes the result dict as JSON to --result."""
+    from matvec_mpi_multiplier_tpu.bench.serve import run_reshard_drift
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+
+    configure_platform(args.platform, args.host_devices)
+    mesh = make_mesh(args.host_devices)
+    result = run_reshard_drift(
+        args.src, mesh, M, K,
+        n_tenants=N_TENANTS, zipf_a=ZIPF_A, n_requests=N_REQUESTS,
+        rollover=ROLLOVER, width_steady=WIDTH_STEADY, pre_rate=PRE_RATE,
+        steady_skip=STEADY_SKIP, seed=SEED, reshard=args.arm,
+        metrics_out=args.metrics_out or None,
+        decision_jsonl=args.decision_jsonl or None,
+    )
+    Path(args.result).write_text(json.dumps(result, indent=2) + "\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default="data/reshard_demo")
+    parser.add_argument("--platform", default="cpu")
+    parser.add_argument("--host-devices", type=int, default=8)
+    # Child-mode plumbing (internal; the parent spawns itself):
+    parser.add_argument("--arm", choices=["off", "auto"], default=None)
+    parser.add_argument("--src", default=None)
+    parser.add_argument("--result", default=None)
+    parser.add_argument("--metrics-out", default=None)
+    parser.add_argument("--decision-jsonl", default=None)
+    args = parser.parse_args(argv)
+
+    if args.arm is not None:
+        return run_arm(args)
+
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    # The demo's tuning cache IS an artifact: the calibration that
+    # picked the registration layout and armed the trigger travels
+    # with the numbers it explains. The env var is inherited by the
+    # arm subprocesses, so all three consult the SAME record.
+    os.environ["MATVEC_TUNING_CACHE"] = str(out / "tuning_cache.json")
+
+    from matvec_mpi_multiplier_tpu.bench.sweep import configure_platform
+    from matvec_mpi_multiplier_tpu.models import get_strategy
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.parallel.reshard import (
+        RESHARD_STRATEGIES,
+    )
+    from matvec_mpi_multiplier_tpu.tuning.cache import (
+        TuningCache,
+        calibration_key,
+    )
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import (
+        CostModel,
+        calibrate,
+    )
+
+    configure_platform(args.platform, args.host_devices)
+    mesh = make_mesh(args.host_devices)
+    p = int(mesh.devices.size)
+
+    print("== full calibration (6 probes) ==")
+    cal = calibrate(mesh, level="full", n_reps=CALIB_REPS)
+    cache = TuningCache.load()
+    cache.record(calibration_key(p), cal.to_record())
+    cache.save()
+
+    # The fleet registers in the model's predicted-WORST layout for the
+    # steady shape — the drifted trace strands it on the wrong side of
+    # the crossover surface, which is exactly the situation online
+    # resharding exists for.
+    model = CostModel(cal)
+    predicted = {}
+    for s in RESHARD_STRATEGIES:
+        combine = get_strategy(s).default_combine(mesh)
+        predicted[s] = model.predict(
+            s, combine, m=M, k=K, p=p, dtype="float32", b=WIDTH_STEADY
+        ).total_s
+    src = max(predicted, key=predicted.get)
+    print(
+        "predicted steady ms/req: "
+        + "  ".join(f"{s}={t * 1e3:.3f}" for s, t in predicted.items())
+        + f"  -> registering in {src}"
+    )
+
+    def spawn(arm: str, extra: list[str]) -> dict:
+        result_path = out / f".{arm}_result.json"
+        cmd = [
+            sys.executable, __file__, "--arm", arm, "--src", src,
+            "--platform", args.platform,
+            "--host-devices", str(args.host_devices),
+            "--result", str(result_path),
+        ] + extra
+        print(f"== --reshard {arm} (subprocess) ==")
+        subprocess.run(cmd, check=True, cwd=REPO)
+        result = json.loads(result_path.read_text())
+        result_path.unlink()
+        return result
+
+    off = spawn("off", [])
+    auto = spawn("auto", [
+        "--metrics-out", str(out / "metrics.json"),
+        "--decision-jsonl", str(out / "decisions.jsonl"),
+    ])
+
+    summary = {
+        "protocol": {
+            "m": M, "k": K, "p": p, "src": src,
+            "predicted_steady_s": predicted,
+            "n_tenants": N_TENANTS, "zipf_a": ZIPF_A,
+            "n_requests": N_REQUESTS, "rollover": ROLLOVER,
+            "steady_skip": STEADY_SKIP, "width_steady": WIDTH_STEADY,
+            "pre_rate_req_s": PRE_RATE, "seed": SEED,
+            "calibration_level": cal.level,
+        },
+        "off": off,
+        "auto": auto,
+    }
+    print(json.dumps(summary, indent=2))
+
+    # ---- the acceptance gates, asserted BEFORE committing anything ----
+    window = ROLLOVER + STEADY_SKIP
+    failures = []
+    if not auto["p99_steady_ms"] < off["p99_steady_ms"]:
+        failures.append(
+            "steady p99 not better: "
+            f"{auto['p99_steady_ms']:.2f} vs {off['p99_steady_ms']:.2f}"
+        )
+    if not auto["p50_steady_ms"] < off["p50_steady_ms"]:
+        failures.append(
+            "steady p50 not better: "
+            f"{auto['p50_steady_ms']:.2f} vs {off['p50_steady_ms']:.2f}"
+        )
+    if auto["reshards"] < 1:
+        failures.append("auto arm never migrated")
+    if off["reshards"] != 0:
+        failures.append(f"off arm migrated {off['reshards']} times")
+    if not (0 <= auto["last_reshard_at"] < window):
+        failures.append(
+            f"migration at request {auto['last_reshard_at']} did not "
+            f"land before the steady window (opens at {window})"
+        )
+    for arm, r in (("off", off), ("auto", auto)):
+        if r["compiles_steady"] != 0:
+            failures.append(
+                f"{arm} arm compiled {r['compiles_steady']} times in "
+                "the steady window"
+            )
+    expected_bytes = auto["reshards"] * M * K * 4
+    if auto["reshard_bytes"] != expected_bytes:
+        failures.append(
+            f"reshard_bytes {auto['reshard_bytes']} != "
+            f"{auto['reshards']} migrations x {M * K * 4} payload bytes"
+        )
+    if set(off["final_strategies"].values()) != {src}:
+        failures.append("off arm did not stay frozen in the src layout")
+    if not any(s != src for s in auto["final_strategies"].values()):
+        failures.append("auto arm's fleet still entirely in src layout")
+    decisions = [
+        json.loads(ln)
+        for ln in (out / "decisions.jsonl").read_text().splitlines()
+    ]
+    reshard_decisions = [
+        d for d in decisions if d.get("decision") == "reshard"
+    ]
+    if len(reshard_decisions) != auto["reshards"]:
+        failures.append(
+            f"{len(reshard_decisions)} reshard decisions traced but "
+            f"{auto['reshards']} migrations counted"
+        )
+    for d in reshard_decisions:
+        if not (d.get("predicted_s") and "amortizes" in d.get("reason", "")
+                and d.get("src") == src and d.get("dst")):
+            failures.append(f"undertraced reshard decision: {d}")
+    metrics = json.loads((out / "metrics.json").read_text())
+    counters = metrics["counters"]
+    if counters.get("registry_reshards_total") != auto["reshards"]:
+        failures.append("metrics.json reshard counter disagrees")
+    if failures:
+        print("GATE FAILURES:\n  " + "\n  ".join(failures),
+              file=sys.stderr)
+        return 1
+
+    from matvec_mpi_multiplier_tpu.bench.serve import (
+        append_reshard_result,
+    )
+
+    for result in (off, auto):
+        append_reshard_result(result, root=out)
+    (out / "summary.json").write_text(json.dumps(summary, indent=2) + "\n")
+    print(f"\ncommitted A/B capture -> {out}")
+    print(
+        f"  steady p99 {off['p99_steady_ms']:.2f} -> "
+        f"{auto['p99_steady_ms']:.2f} ms, p50 "
+        f"{off['p50_steady_ms']:.2f} -> {auto['p50_steady_ms']:.2f} ms "
+        f"({auto['reshards']} migrations, "
+        f"{auto['reshard_bytes'] / 1e6:.1f} MB moved, last at request "
+        f"{auto['last_reshard_at']}, steady compiles 0/0)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -355,6 +355,55 @@ print(f"speculative smoke ok: accept relerr {rel:.2e}, escalation "
       "escalated answer bitwise-native")
 PY
 
+# Reshard smoke: one on-device rowwise→blockwise migration of a resident
+# A (parallel/reshard.py + engine swap fence; docs/RESHARDING.md). The
+# migrated engine must answer BITWISE-identically to a fresh registration
+# in the destination layout, the residency ledger must stay balanced
+# through the migration (footprint-neutral: the collectives replace the
+# payload in place), and after the one-time new-layout compile (ridden
+# in by warm_widths) steady requests must never recompile. Seconds, not
+# minutes: a regression here means online resharding cannot even start,
+# which should fail fast before the full gate in tests/test_reshard.py.
+echo "reshard smoke: rowwise->blockwise bitwise, ledger balanced, compile-flat"
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+python - <<'PY'
+import numpy as np
+from matvec_mpi_multiplier_tpu import MatvecEngine, make_mesh
+
+mesh = make_mesh(8)
+rng = np.random.default_rng(0)
+a = rng.standard_normal((64, 512)).astype(np.float32)
+x = rng.standard_normal(512).astype(np.float32)
+ledger = [0]
+eng = MatvecEngine(
+    a, mesh, strategy="rowwise", promote=None,
+    residency_listener=lambda delta, reason: ledger.__setitem__(
+        0, ledger[0] + delta
+    ),
+)
+eng.submit(x).result()  # place + serve in the source layout
+assert ledger[0] == eng.device_resident_bytes, "ledger off pre-migration"
+res = eng.reshard("blockwise", warm_widths=(1,))
+assert res["migrated"] and not res["aborted"], res
+assert res["bytes_moved"] == a.nbytes, res
+assert ledger[0] == eng.device_resident_bytes, (
+    "migration leaked in the residency ledger"
+)
+fresh = MatvecEngine(a, mesh, strategy="blockwise", promote=None)
+y_fresh = fresh.submit(x).result()
+assert np.array_equal(eng.submit(x).result(), y_fresh), (
+    "migrated answer != fresh destination registration"
+)
+warm = eng.stats.compiles  # new-layout compile rode in via warm_widths
+for _ in range(4):
+    assert np.array_equal(eng.submit(x).result(), y_fresh)
+assert eng.stats.compiles == warm, "steady requests recompiled"
+eng.close(); fresh.close()
+print(f"reshard smoke ok: {res['src']}->{res['dst']} bitwise vs fresh, "
+      f"{res['bytes_moved']} bytes moved ledger-neutral, "
+      "0 steady recompiles")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

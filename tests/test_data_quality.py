@@ -1609,3 +1609,141 @@ def test_fused_solver_demo_metrics_pin_iteration_time():
     # Histogram samples are per-iteration milliseconds: the p50 sits in
     # the same decade as the CSV's steady-phase per-iteration time.
     assert it["p50"] < 10 * fused["time_per_iter_ms"]
+
+
+# ---- reshard_demo: the committed drifting-shape resharding A/B capture
+# (ISSUE 18; docs/RESHARDING.md). Same doctrine as the gsched demo: the
+# story the README tells — a fleet registered in the predicted-worst
+# layout, stranded by the shape drift, migrated on-device by the
+# crossover trigger into a measurably better steady state with zero
+# steady recompiles — is pinned on the committed artifacts, and every
+# migration must be a fully traced decision, never a silent swap.
+
+RESHARD_DEMO = REPO / "data" / "reshard_demo"
+
+
+def _reshard_artifact(name: str):
+    path = RESHARD_DEMO / name
+    if not path.exists():
+        pytest.skip(f"{path} not committed")
+    if name.endswith(".jsonl"):
+        import json
+
+        return [
+            json.loads(ln) for ln in path.read_text().splitlines() if ln
+        ]
+    if name.endswith(".json"):
+        import json
+
+        return json.loads(path.read_text())
+    return read_csv(path)
+
+
+def _reshard_ab_rows() -> tuple[dict, dict]:
+    """The committed A/B CSV's two rows: (off, auto)."""
+    rows = _reshard_artifact("out/reshard_ab.csv")
+    off = [r for r in rows if r["reshard"] == "off"]
+    auto = [r for r in rows if r["reshard"] == "auto"]
+    assert len(off) == 1 and len(auto) == 1, (
+        "reshard demo must hold exactly one off and one auto row"
+    )
+    return off[0], auto[0]
+
+
+def _finals(row: dict) -> dict:
+    return dict(
+        pair.split(":") for pair in row["final_strategies"].split("|")
+    )
+
+
+def test_reshard_demo_ab_acceptance():
+    """The ISSUE 18 acceptance row: on the same seeded drifting-shape
+    Zipf trace, --reshard auto beats --reshard off on steady-state p99
+    (and p50), every migration lands before the steady window opens,
+    and the steady phase compiles NOTHING in either arm — the one-time
+    new-layout compile rides the migration's warm_widths."""
+    off, auto = _reshard_ab_rows()
+    # Same trace, same fleet, same registered (predicted-worst) layout.
+    for key in ("m", "k", "p", "strategy", "n_tenants", "zipf_a",
+                "n_requests", "rollover", "steady_skip", "width_steady"):
+        assert off[key] == auto[key], key
+    src = off["strategy"]
+    # The frozen arm really is frozen: no migrations, every tenant
+    # finishes in the registered layout.
+    assert off["reshards"] == 0 and off["reshard_bytes"] == 0
+    assert off["last_reshard_at"] == -1
+    assert set(_finals(off).values()) == {src}
+    # The auto arm migrated the whole fleet away from it...
+    assert auto["reshards"] >= 1
+    finals = _finals(auto)
+    assert len(finals) == auto["n_tenants"]
+    assert any(s != src for s in finals.values())
+    # ...with exact bytes-moved accounting (native fp32 payloads)...
+    assert auto["reshard_bytes"] == (
+        auto["reshards"] * auto["m"] * auto["k"] * 4
+    )
+    # ...every migration inside the post-rollover skip window...
+    window = auto["rollover"] + auto["steady_skip"]
+    assert auto["rollover"] <= auto["last_reshard_at"] < window
+    # ...and a measurably better steady state.
+    assert auto["p99_steady_ms"] < off["p99_steady_ms"]
+    assert auto["p50_steady_ms"] < off["p50_steady_ms"]
+    # Zero steady-state recompiles in BOTH arms: warmup covered the
+    # registered layout's widths, warm_widths the destination's.
+    assert off["compiles_steady"] == 0
+    assert auto["compiles_steady"] == 0
+
+
+def test_reshard_demo_decisions_explain_the_migrations():
+    """Every migration in the capture is a traced decision carrying the
+    predicted migration cost and the crossover-plus-amortization
+    reason — a reshard the trace cannot explain is the bug."""
+    off, auto = _reshard_ab_rows()
+    decisions = _reshard_artifact("decisions.jsonl")
+    reshards = [d for d in decisions if d.get("decision") == "reshard"]
+    assert len(reshards) == auto["reshards"]
+    tenants = set()
+    for d in reshards:
+        assert d["predicted_s"] > 0  # the predicted migration cost
+        assert "crossover" in d["reason"]
+        assert "amortizes" in d["reason"]
+        assert d["src"] == auto["strategy"]
+        assert d["dst"] != d["src"]
+        # The trigger's own arithmetic: migrating must have predicted a
+        # strictly better steady per-request time.
+        assert d["new_s"] < d["old_s"]
+        assert d["horizon_requests"] >= 1.0
+        tenants.add(d["tenant"])
+    # One decision per migrated tenant (cooldown: no thrash).
+    assert len(tenants) == len(reshards)
+    finals = _finals(auto)
+    for d in reshards:
+        assert finals[d["tenant"]] == d["dst"]
+    # summary.json agrees with the CSV on the registered layout.
+    summary = _reshard_artifact("summary.json")
+    assert summary["protocol"]["src"] == auto["strategy"]
+    assert summary["auto"]["reshards"] == auto["reshards"]
+    assert summary["off"]["reshards"] == 0
+
+
+def test_reshard_demo_metrics_pin_the_migration():
+    """The auto arm's metrics snapshot shows the migration without
+    reading the trace: the registry/scheduler counters agree with the
+    CSV, and each migrated tenant's strategy gauge points at the
+    destination layout (what the obs tenants panel renders)."""
+    _off, auto = _reshard_ab_rows()
+    snap = _reshard_artifact("metrics.json")
+    c = snap["counters"]
+    assert c["registry_reshards_total"] == auto["reshards"]
+    assert c["gsched_reshards_total"] == auto["reshards"]
+    assert c["reshard_bytes_total"] == auto["reshard_bytes"]
+    gauges = snap["gauges"]
+    for tenant, dst in _finals(auto).items():
+        assert gauges[
+            f'tenant_strategy{{tenant="{tenant}",strategy="{dst}"}}'
+        ] == 1
+        src = auto["strategy"]
+        if dst != src:
+            assert gauges[
+                f'tenant_strategy{{tenant="{tenant}",strategy="{src}"}}'
+            ] == 0

@@ -462,8 +462,8 @@ def test_engine_overlap_combine(devices, rng, cache_path):
         max_bucket=8,
     )
     assert eng.stages == 4
-    assert eng._matvec_key().combine == "overlap@4"
-    assert eng._gemm_key(8).combine == "overlap@4"
+    assert eng._matvec_key_locked().combine == "overlap@4"
+    assert eng._gemm_key_locked(8).combine == "overlap@4"
     x = rng.uniform(0, 10, (64,)).astype(np.float32)
     np.testing.assert_allclose(eng(x), a @ x, rtol=1e-4)
     blk = rng.uniform(0, 10, (64, 5)).astype(np.float32)
@@ -501,7 +501,7 @@ def test_engine_strategy_bound_overlap_resolves_stages(devices, rng):
         max_bucket=8,
     )
     assert eng.stages == 4
-    assert eng._matvec_key().combine == "overlap@4"
+    assert eng._matvec_key_locked().combine == "overlap@4"
     x = rng.uniform(0, 10, (64,)).astype(np.float32)
     np.testing.assert_allclose(eng(x), a @ x, rtol=1e-4)
     blk = rng.uniform(0, 10, (64, 5)).astype(np.float32)

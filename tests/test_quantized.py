@@ -448,11 +448,11 @@ def test_engine_quantized_storage_end_to_end():
         assert engine.resident_bytes < a.nbytes * 0.55
         # ExecKey carries the storage axis; the label exposes it to fault
         # patterns and health() only for non-native storage.
-        key = engine._matvec_key()
+        key = engine._matvec_key_locked()
         assert key.storage == "int8c"
         assert key.label().endswith(":int8c")
         # The degradation ladder's safe tier is NATIVE storage.
-        levels = engine._matvec_levels()
+        levels = engine._matvec_levels_locked()
         assert levels[-1][0].storage == "native"
         assert levels[-1][0].label().count(":int8c") == 0
         # The resident-bytes gauge is exported.

@@ -1,0 +1,429 @@
+"""Online resharding (parallel/reshard.py + MatvecEngine.reshard +
+MatrixRegistry.reshard + the global scheduler's ``reshard="auto"``
+trigger; docs/RESHARDING.md).
+
+Bitwise doctrine: a migration moves the SAME device bytes between
+layouts — ``all_to_all``/``ppermute`` permute data, they never compute —
+so a migrated resident must equal a fresh registration in the
+destination layout shard-for-shard, and every matvec served after the
+swap must be bitwise identical to the fresh engine's. That holds for the
+quantized payload+scale leaves too: a same-blocking migration moves the
+existing leaves verbatim, and a blocking-changing one requantizes from
+the retained host ``A`` exactly as a fresh construction would.
+"""
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import make_mesh
+from matvec_mpi_multiplier_tpu.engine import MatvecEngine
+from matvec_mpi_multiplier_tpu.engine.registry import MatrixRegistry
+from matvec_mpi_multiplier_tpu.engine.global_scheduler import GlobalScheduler
+from matvec_mpi_multiplier_tpu.parallel import reshard as reshard_mod
+from matvec_mpi_multiplier_tpu.parallel.mesh import mesh_grid_shape
+from matvec_mpi_multiplier_tpu.tuning.cost_model import Calibration, CostModel
+from matvec_mpi_multiplier_tpu.utils.errors import ConfigError
+
+M, K = 64, 2048
+PAIRS = [
+    (s, d)
+    for s in reshard_mod.RESHARD_STRATEGIES
+    for d in reshard_mod.RESHARD_STRATEGIES
+    if s != d
+]
+
+
+@pytest.fixture()
+def operands(rng):
+    a = rng.standard_normal((M, K)).astype(np.float32)
+    x = rng.standard_normal(K).astype(np.float32)
+    xb = rng.standard_normal((K, 8)).astype(np.float32)
+    return a, x, xb
+
+
+# ---- the collective programs (parallel/reshard.py) ----
+
+
+@pytest.mark.parametrize("src,dst", PAIRS)
+def test_payload_migrates_shard_exact(devices, rng, src, dst):
+    """build_reshard moves every device shard to exactly where a fresh
+    device_put in the destination layout would place it."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    mesh = make_mesh(len(devices))
+    a = rng.standard_normal((M, K)).astype(np.float32)
+
+    def place(arr, name):
+        return jax.device_put(
+            arr, NamedSharding(mesh, reshard_mod.payload_spec(name))
+        )
+
+    out = reshard_mod.build_reshard(mesh, src, dst)(place(a, src))
+    ref = place(a, dst)
+    for s_out, s_ref in zip(
+        sorted(out.addressable_shards, key=lambda s: s.device.id),
+        sorted(ref.addressable_shards, key=lambda s: s.device.id),
+    ):
+        assert np.array_equal(
+            np.asarray(s_out.data), np.asarray(s_ref.data)
+        ), (src, dst, s_out.device.id)
+
+
+def test_program_elides_degenerate_steps(devices):
+    """Size-1 collective groups and fixed-point permutes never appear in
+    the effective program (the census formula and the built program must
+    agree on every mesh shape)."""
+    mesh = make_mesh(len(devices))
+    r, c = mesh_grid_shape(mesh)
+    for src, dst in PAIRS:
+        for step in reshard_mod.reshard_program(src, dst, r, c):
+            if step[0] == "a2a":
+                assert {"flat": r * c, "rows": r, "cols": c}[step[1]] > 1
+    # Degenerate grid: a 1-column grid's rowwise<->blockwise move is free.
+    assert reshard_mod.reshard_program("rowwise", "blockwise", 4, 1) == ()
+
+
+def test_validate_rejects_indivisible_shapes(devices):
+    mesh = make_mesh(len(devices))
+    with pytest.raises(ConfigError):
+        reshard_mod.validate_reshard((63, K), mesh)
+
+
+# ---- the engine migration ----
+
+
+@pytest.mark.parametrize("src,dst", PAIRS)
+def test_engine_reshard_bitwise_vs_fresh(devices, operands, src, dst):
+    """The acceptance pin: matvec AND promoted-GEMM results after a
+    migration are bitwise identical to a fresh engine built in the
+    destination layout."""
+    a, x, xb = operands
+    mesh = make_mesh(len(devices))
+    eng = MatvecEngine(a, mesh, strategy=src, retain_host=True)
+    eng.submit(x).result()  # serve once in the source layout
+    res = eng.reshard(dst, warm_widths=(1,))
+    assert res["migrated"] and not res["aborted"]
+    assert res["bytes_moved"] == a.nbytes
+    fresh = MatvecEngine(a, mesh, strategy=dst)
+    assert np.array_equal(eng.submit(x).result(), fresh.submit(x).result())
+    assert np.array_equal(
+        eng.submit(xb).result(), fresh.submit(xb).result()
+    )
+    eng.close()
+    fresh.close()
+
+
+@pytest.mark.parametrize("dst", ["colwise", "blockwise"])
+def test_engine_reshard_quantized_bitwise(devices, operands, dst):
+    """int8c residency migrates bitwise: payload and per-block scale
+    leaves move together (or requantize from host when the destination's
+    contraction split changes the blocking) and serve exactly what a
+    fresh int8c engine in the destination layout serves."""
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    eng = MatvecEngine(
+        a, mesh, strategy="rowwise", dtype_storage="int8c", retain_host=True
+    )
+    eng.reshard(dst)
+    fresh = MatvecEngine(a, mesh, strategy=dst, dtype_storage="int8c")
+    assert np.array_equal(eng.submit(x).result(), fresh.submit(x).result())
+    eng.close()
+    fresh.close()
+
+
+def test_engine_reshard_speculative_leaves(devices, operands):
+    """A speculative-armed engine's quantized candidate set rides the
+    migration; the served (verified) answers stay bitwise equal to a
+    fresh speculative engine's."""
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    eng = MatvecEngine(
+        a, mesh, strategy="rowwise", dtype_storage="speculate",
+        retain_host=True,
+    )
+    eng.reshard("blockwise")
+    fresh = MatvecEngine(a, mesh, strategy="blockwise",
+                         dtype_storage="speculate")
+    assert np.array_equal(
+        eng.submit(x, rtol=1e-2).result(),
+        fresh.submit(x, rtol=1e-2).result(),
+    )
+    eng.close()
+    fresh.close()
+
+
+def test_in_flight_dispatch_unaffected(devices, operands):
+    """Futures dispatched before the migration materialize the OLD
+    layout's (bitwise-correct) answer; submits after serve the new."""
+    a, x, xb = operands
+    mesh = make_mesh(len(devices))
+    eng = MatvecEngine(a, mesh, strategy="rowwise", retain_host=True)
+    ref = MatvecEngine(a, mesh, strategy="rowwise")
+    in_flight = [eng.submit(x), eng.submit(xb)]
+    eng.reshard("colwise")
+    assert np.array_equal(in_flight[0].result(), ref.submit(x).result())
+    assert np.array_equal(in_flight[1].result(), ref.submit(xb).result())
+    fresh = MatvecEngine(a, mesh, strategy="colwise")
+    assert np.array_equal(eng.submit(x).result(), fresh.submit(x).result())
+    eng.close()
+    ref.close()
+    fresh.close()
+
+
+def test_eviction_racing_reshard_aborts_cleanly(devices, operands):
+    """Satellite #3: an eviction landing between the staging and the
+    commit aborts the ARRAY swap (config-only), never doubles the HBM
+    footprint, and the next dispatch self-heals in the destination
+    layout."""
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    eng = MatvecEngine(a, mesh, strategy="rowwise", retain_host=True)
+    eng._reshard_pre_commit = eng.release_residency
+    res = eng.reshard("colwise")
+    assert res["aborted"] and not res["migrated"]
+    assert res["bytes_moved"] == 0
+    assert not eng.resident
+    assert eng.device_resident_bytes == 0, "double footprint after abort"
+    eng._reshard_pre_commit = None
+    fresh = MatvecEngine(a, mesh, strategy="colwise")
+    assert np.array_equal(eng.submit(x).result(), fresh.submit(x).result())
+    assert eng.strategy.name == "colwise"
+    eng.close()
+    fresh.close()
+
+
+def test_reshard_ledger_balanced(devices, operands):
+    """Every residency delta reconciles: the ledger (sum of listener
+    deltas) equals the engine's device footprint at every stage of a
+    migrate → evict-mid-migrate → self-heal cycle, and the
+    constant-footprint migration itself is delta-free (all_to_all moves
+    bytes, it never grows them)."""
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    ledger = []
+    eng = MatvecEngine(
+        a, mesh, strategy="rowwise", retain_host=True,
+        residency_listener=lambda delta, reason: ledger.append(
+            (delta, reason)
+        ),
+    )
+
+    def balance():
+        return sum(d for d, _ in ledger)
+
+    base = eng.device_resident_bytes
+    assert balance() == base
+    eng.reshard("blockwise")
+    assert eng.device_resident_bytes == base, "migration grew the footprint"
+    assert balance() == base  # constant footprint: no delta fired
+    # Eviction racing the next migration: the abort must keep the ledger
+    # exact (the release's negative delta, nothing else).
+    eng._reshard_pre_commit = eng.release_residency
+    eng.reshard("colwise")
+    eng._reshard_pre_commit = None
+    assert balance() == eng.device_resident_bytes == 0
+    eng.submit(x).result()  # self-heals in the destination layout
+    assert balance() == eng.device_resident_bytes == base
+    eng.close()
+
+
+def test_zero_steady_recompiles_after_warm_reshard(devices, operands):
+    """After reshard(warm_widths=...), steady-state submits compile
+    nothing (the acceptance criterion the bench's compiles_steady column
+    pins)."""
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    eng = MatvecEngine(a, mesh, strategy="rowwise", retain_host=True)
+    eng.warmup(widths=(1,))
+    eng.reshard("blockwise", warm_widths=(1,))
+    before = eng.stats.compiles
+    for _ in range(5):
+        eng.submit(x).result()
+    assert eng.stats.compiles == before
+    eng.close()
+
+
+def test_reshard_requires_retained_host_only_for_requant(devices, operands):
+    """A native migration needs no host copy; identity reshard returns a
+    no-move summary."""
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    eng = MatvecEngine(a, mesh, strategy="rowwise", retain_host=True)
+    res = eng.reshard("rowwise")
+    assert not res["migrated"] and res["bytes_moved"] == 0
+    eng.close()
+
+
+# ---- the registry integration ----
+
+
+def test_registry_reshard_rehomes_exec_cache(devices, operands):
+    """The migrated tenant adopts (or donates) the destination-layout
+    exec cache: a same-shaped sibling already serving in dst makes the
+    migration compile-free."""
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    reg = MatrixRegistry(mesh=mesh)
+    reg.register("sib", a, strategy="colwise")
+    reg.warmup(widths=(1,))
+    h = reg.register("mover", a, strategy="rowwise")
+    reg.submit("mover", x).result()
+    sib_cache = reg._entry("sib").engine._cache
+    before = sib_cache.stats.compiles
+    reg.reshard("mover", "colwise", warm_widths=(1,))
+    eng = h.engine
+    assert eng._cache is sib_cache, "exec cache not re-homed"
+    assert sib_cache.stats.compiles == before, (
+        "migration recompiled a program the sibling already owns"
+    )
+    fresh = MatvecEngine(a, mesh, strategy="colwise")
+    assert np.array_equal(h(x), fresh.submit(x).result())
+    st = h.stats()
+    assert st["strategy"] == "colwise" and st["reshards"] == 1
+    assert reg._c_reshards.value == 1
+    assert reg._c_reshard_bytes.value == a.nbytes
+    # The ledger never double-counts across the migration.
+    assert reg.accountant.total == sum(
+        reg._entry(t).engine.device_resident_bytes for t in ("sib", "mover")
+    )
+    reg.close()
+    fresh.close()
+
+
+def test_registry_reshard_idempotent_and_serialized(devices, operands):
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    reg = MatrixRegistry(mesh=mesh)
+    reg.register("t", a, strategy="rowwise")
+    reg.submit("t", x).result()  # place the deferred residency
+    assert reg.reshard("t", "rowwise") is None
+    assert reg.reshard("t", "colwise")["migrated"]
+    assert reg.tenant_stats("t")["strategy"] == "colwise"
+    reg.close()
+
+
+def test_tenants_panel_strategy_column_tracks_migration(devices, operands):
+    """``obs metrics`` renders each tenant's CURRENT layout (the one-hot
+    ``tenant_strategy`` gauge) plus the fleet reshard counters, so a
+    migration is visible from the panel alone."""
+    from matvec_mpi_multiplier_tpu.obs.__main__ import render_tenants
+
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    reg = MatrixRegistry(mesh=mesh)
+    reg.register("mover", a, strategy="rowwise")
+    reg.register("stayer", a, strategy="rowwise")
+    for t in ("mover", "stayer"):
+        reg.submit(t, x).result()
+    reg.reshard("mover", "blockwise")
+    panel = render_tenants(reg.metrics.snapshot())
+    rows = {
+        ln.split()[0]: ln.split()[1]
+        for ln in panel.splitlines()
+        if ln.split() and ln.split()[0] in ("mover", "stayer")
+    }
+    assert rows == {"mover": "blockwise", "stayer": "rowwise"}
+    assert "strategy" in panel  # the column header
+    reshard_line = next(
+        ln for ln in panel.splitlines() if "reshards" in ln
+    )
+    assert reshard_line.split()[1] == "1"
+    assert f"{float(a.nbytes):.3e}" in reshard_line
+    reg.close()
+
+
+# ---- the cost model and the scheduler trigger ----
+
+
+def test_predict_reshard_sanity():
+    """Migration predictions are finite, positive, and scale with the
+    payload; the two-step colwise->blockwise program costs more than the
+    one-step rowwise->colwise at the same operand."""
+    model = CostModel(Calibration.synthetic(p=8))
+    one = model.predict_reshard(
+        "rowwise", "colwise", m=M, k=K, p=8, dtype="float32"
+    )
+    two = model.predict_reshard(
+        "colwise", "blockwise", m=M, k=K, p=8, dtype="float32"
+    )
+    assert 0 < one.total_s < two.total_s
+    assert one.compute_s == 0.0  # a migration is wire + latency only
+    big = model.predict_reshard(
+        "rowwise", "colwise", m=M, k=4 * K, p=8, dtype="float32"
+    )
+    assert big.wire_bytes == 4 * one.wire_bytes
+
+
+def test_scheduler_auto_reshard_crossover(devices, operands):
+    """The reshard="auto" trigger migrates a hot tenant out of a
+    predicted-slow layout exactly once (cooldown + already-best damping),
+    records the traced decision with its crossover arithmetic, and the
+    migrated engine serves bitwise."""
+    from matvec_mpi_multiplier_tpu.models import get_strategy
+
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    model = CostModel(Calibration.synthetic(p=8))
+    times = {
+        s: model.predict(
+            s, get_strategy(s).default_combine(mesh),
+            m=M, k=K, p=8, dtype="float32", b=1,
+        ).total_s
+        for s in reshard_mod.RESHARD_STRATEGIES
+    }
+    worst = max(times, key=times.get)
+    reg = MatrixRegistry(mesh=mesh)
+    reg.register("hot", a, strategy=worst)
+    clock = [0.0]
+    sched = GlobalScheduler(
+        reg, cost_model=model, reshard="auto",
+        reshard_cooldown_s=300.0, reshard_horizon_s=30.0,
+        clock=lambda: clock[0],
+    )
+    for _ in range(25):
+        clock[0] += 0.01
+        sched.submit("hot", x).result()
+    decisions = [
+        d for d in sched.decisions() if d["decision"] == "reshard"
+    ]
+    assert len(decisions) == 1, decisions
+    d = decisions[0]
+    assert d["src"] == worst and d["dst"] != worst
+    assert d["predicted_s"] > 0 and d["new_s"] < d["old_s"]
+    assert "crossover" in d["reason"]
+    eng = reg._entry("hot").engine
+    assert eng.strategy.name == d["dst"]
+    fresh = MatvecEngine(a, mesh, strategy=d["dst"])
+    assert np.array_equal(
+        sched.submit("hot", x).result(), fresh.submit(x).result()
+    )
+    sched.close()
+    reg.close()
+    fresh.close()
+
+
+def test_scheduler_reshard_off_never_migrates(devices, operands):
+    a, x, _ = operands
+    mesh = make_mesh(len(devices))
+    model = CostModel(Calibration.synthetic(p=8))
+    reg = MatrixRegistry(mesh=mesh)
+    reg.register("t", a, strategy="blockwise")
+    sched = GlobalScheduler(reg, cost_model=model)  # reshard="off"
+    for _ in range(10):
+        sched.submit("t", x).result()
+    assert not [
+        d for d in sched.decisions() if d["decision"] == "reshard"
+    ]
+    assert reg._entry("t").engine.strategy.name == "blockwise"
+    sched.close()
+    reg.close()
+
+
+def test_scheduler_reshard_rejects_bad_mode(devices, operands):
+    a, _, _ = operands
+    mesh = make_mesh(len(devices))
+    reg = MatrixRegistry(mesh=mesh)
+    with pytest.raises(ConfigError):
+        GlobalScheduler(reg, cost_model=None, reshard="sometimes")
+    reg.close()

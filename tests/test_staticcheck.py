@@ -1557,3 +1557,114 @@ def test_mutation_full_shard_dequant_fails_fused_quant_gate(devices):
     }
     findings = fused_solver_findings(fcfg, bad)
     assert any(f.rule == "hlo-early-dequant" for f in findings), findings
+
+
+# ---- the reshard migration audit (hlo-reshard-schedule) ----
+
+
+def test_reshard_audit_table_covers_every_ordered_pair():
+    """The audit must pin every (src, dst) migration the engine can run:
+    all 6 ordered pairs over {rowwise, colwise, blockwise}."""
+    from matvec_mpi_multiplier_tpu.parallel.reshard import (
+        RESHARD_STRATEGIES,
+    )
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        RESHARD_AUDIT_CONFIGS,
+    )
+
+    pairs = {(c.src, c.dst) for c in RESHARD_AUDIT_CONFIGS}
+    expected = {
+        (s, d)
+        for s in RESHARD_STRATEGIES
+        for d in RESHARD_STRATEGIES
+        if s != d
+    }
+    assert pairs == expected
+    assert all(c.key == f"reshard|{c.src}|{c.dst}"
+               for c in RESHARD_AUDIT_CONFIGS)
+
+
+def test_reshard_lowerings_pass_structural_gates(devices):
+    """Every migration's live lowering satisfies the structural gates
+    (minimal census, 1/p payload per step, no gather kinds) without
+    consulting the golden table."""
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        RESHARD_AUDIT_CONFIGS,
+        reshard_audit_entry,
+        reshard_findings,
+    )
+
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        AUDIT_DTYPE,
+        AUDIT_K,
+        AUDIT_M,
+        dtype_itemsize,
+    )
+
+    mesh = make_mesh(len(devices))
+    shard_bytes = (
+        AUDIT_M * AUDIT_K * dtype_itemsize(AUDIT_DTYPE) // len(devices)
+    )
+    for rcfg in RESHARD_AUDIT_CONFIGS:
+        entry = reshard_audit_entry(rcfg, mesh)
+        findings = reshard_findings(rcfg, entry, mesh)
+        assert findings == [], (rcfg.key, [f.message for f in findings])
+        # The constant-footprint invariant, spelled out: every step's
+        # payload is a whole multiple of the device's 1/p local shard.
+        assert entry["payload_bytes"], rcfg.key
+        assert all(
+            b % shard_bytes == 0
+            for b in entry["payload_bytes"].values()
+        )
+
+
+def test_mutation_host_gather_fails_reshard_audit(devices, monkeypatch):
+    """The acceptance mutation: reroute the migration through a
+    gather-and-slice (the on-device signature of a host round trip) —
+    the audit must go red on every pair while the untouched build
+    passes."""
+    from matvec_mpi_multiplier_tpu.parallel import reshard as reshard_mod
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        RESHARD_AUDIT_CONFIGS,
+        run_hlo_audit,
+    )
+
+    monkeypatch.setattr(reshard_mod, "_MUTATION", "host")
+    findings = run_hlo_audit(
+        configs=[], reshard_configs=list(RESHARD_AUDIT_CONFIGS),
+        check_fingerprints=False,
+    )
+    red = {f.location for f in findings if f.rule == "hlo-reshard-schedule"}
+    assert len(red) == len(RESHARD_AUDIT_CONFIGS), findings
+    monkeypatch.undo()
+    assert run_hlo_audit(
+        configs=[], reshard_configs=list(RESHARD_AUDIT_CONFIGS),
+        check_fingerprints=False,
+    ) == []
+
+
+def test_mutation_redundant_collective_fails_reshard_audit(
+    devices, monkeypatch
+):
+    """The second acceptance mutation: a rotate/unrotate ppermute pair —
+    value-preserving, so only the census can catch it — must redden the
+    audit (the census gate pins the MINIMAL program, not just a correct
+    one)."""
+    from matvec_mpi_multiplier_tpu.parallel import reshard as reshard_mod
+    from matvec_mpi_multiplier_tpu.staticcheck.hlo import (
+        RESHARD_AUDIT_CONFIGS,
+        run_hlo_audit,
+    )
+
+    monkeypatch.setattr(reshard_mod, "_MUTATION", "redundant")
+    findings = run_hlo_audit(
+        configs=[], reshard_configs=list(RESHARD_AUDIT_CONFIGS),
+        check_fingerprints=False,
+    )
+    red = {f.location for f in findings if f.rule == "hlo-reshard-schedule"}
+    assert len(red) == len(RESHARD_AUDIT_CONFIGS), findings
+    assert any(
+        "redundant" in f.message or "census" in f.message
+        for f in findings if f.rule == "hlo-reshard-schedule"
+    )

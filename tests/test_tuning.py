@@ -649,9 +649,9 @@ def test_tune_solver_kernel_smoke(devices, cache_path, monkeypatch):
     a = a @ a.T + 64 * np.eye(64, dtype="float32")
     engine = MatvecEngine(a, mesh, strategy="rowwise", promote=None,
                           solver_kernel="auto")
-    assert engine._resolve_solver_kernel("cg") == decision["solver_kernel"]
+    assert engine._resolve_solver_kernel_locked("cg") == decision["solver_kernel"]
     # auto never routes a basis-building op at the fused tier.
-    assert engine._resolve_solver_kernel("gmres") == "xla"
+    assert engine._resolve_solver_kernel_locked("gmres") == "xla"
 
 
 def test_tune_solver_kernel_skips_untunable_cells(devices, cache_path):
