@@ -135,6 +135,13 @@ from ..engine import (
     split_widths,
 )
 from ..models import available_strategies
+from ..obs import (
+    DEFAULT_TARGETS,
+    FlightRecorder,
+    JsonlSink,
+    SloMonitor,
+    reset_hub,
+)
 from ..obs.registry import MetricsRegistry
 from ..resilience import (
     FaultPlan,
@@ -530,6 +537,9 @@ def run_serve_load(
     seed: int = 0,
     metrics_out: str | None = None,
     trace_jsonl: str | None = None,
+    events_jsonl: str | None = None,
+    slo_out: str | None = None,
+    flight_dir: str | None = None,
     fault_spec: str | None = None,
     fault_seed: int = 0,
     poison_rate: float = 0.0,
@@ -549,13 +559,43 @@ def run_serve_load(
     :data:`POISON_SIGNATURE` and appends a persistent poison fault spec;
     ``resilience`` (default: on whenever faults are armed) enables the
     engine's retry/breaker/ladder policy with ``breaker_reset_s``
-    cooldowns; ``integrity_gate`` arms the NaN/Inf materialize gate."""
+    cooldowns; ``integrity_gate`` arms the NaN/Inf materialize gate.
+
+    Observability control plane (docs/OBSERVABILITY.md):
+    ``events_jsonl`` streams the correlated event timeline to a JSONL
+    file (render one request with ``obs timeline``); ``slo_out`` arms a
+    burn-rate monitor over the run's registry (sampled around each
+    phase) and writes its evaluation JSON (render with ``obs slo``);
+    ``flight_dir`` arms a flight recorder that auto-dumps post-mortem
+    bundles there on typed failures (render with ``obs dump``)."""
     from ..utils.io import generate_matrix
 
     if widths is None:
         widths = [w for w in LOAD_WIDTH_MIX if w <= max_bucket]
     a = generate_matrix(m, k, seed=seed).astype(dtype)
     registry = MetricsRegistry()
+
+    # Arm the observability control plane BEFORE engine construction so
+    # warmup traffic and scheduler decisions land on the same hub. The
+    # hub is process-global (that is what lets the engine, schedulers
+    # and registry correlate without plumbing), so a sink requested here
+    # replaces any previous one.
+    hub = (
+        reset_hub(sink=JsonlSink(events_jsonl))
+        if events_jsonl is not None
+        else None
+    )
+    slo_monitor = (
+        SloMonitor(registry, DEFAULT_TARGETS) if slo_out is not None else None
+    )
+    recorder = None
+    if flight_dir is not None:
+        from ..obs import get_hub
+
+        recorder = FlightRecorder(
+            hub if hub is not None else get_hub(),
+            registry, slo=slo_monitor, dump_dir=flight_dir,
+        )
 
     if not (0.0 <= poison_rate <= 1.0):
         raise ConfigError(
@@ -666,6 +706,13 @@ def run_serve_load(
         compiles_warmup = warm_stats.compiles
         if plan is not None:
             plan.arm()
+        if slo_monitor is not None:
+            # Phase boundary: the window baseline. Sampled BEFORE the
+            # offered-request counter bumps so the steady window sees
+            # the full offered/failed deltas.
+            slo_monitor.sample()
+        if recorder is not None:
+            recorder.snapshot_metrics()
         if req_counter is not None:
             req_counter.inc(n_requests)
 
@@ -717,6 +764,24 @@ def run_serve_load(
                 "the file is missing or incomplete", file=sys.stderr,
             )
         engine.close()
+    if slo_monitor is not None:
+        slo_monitor.sample()  # the post-steady observation
+    if recorder is not None:
+        recorder.snapshot_metrics()
+        recorder.close()
+    if slo_out is not None:
+        path = Path(slo_out)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(
+            json.dumps(slo_monitor.evaluate(), indent=2) + "\n"
+        )
+    if hub is not None:
+        if not hub.flush():
+            print(
+                f"WARNING: event sink could not confirm {events_jsonl} — "
+                "the file is missing or incomplete", file=sys.stderr,
+            )
+        hub.close()
     snap_counters = registry.snapshot()["counters"]
     if metrics_out is not None:
         _ = engine.stats  # refresh the in_flight gauge before exporting
@@ -2351,6 +2416,13 @@ def _run_serve_sweep(args: argparse.Namespace) -> int:
                                 seed=args.seed,
                                 metrics_out=metrics_out,
                                 trace_jsonl=trace_jsonl,
+                                events_jsonl=getattr(
+                                    args, "events_jsonl", None
+                                ),
+                                slo_out=getattr(args, "slo_out", None),
+                                flight_dir=getattr(
+                                    args, "flight_dir", None
+                                ),
                                 fault_spec=fault_spec,
                                 fault_seed=getattr(args, "fault_seed", 0),
                                 poison_rate=poison_rate,
@@ -2701,6 +2773,27 @@ def build_parser() -> argparse.ArgumentParser:
         "(submit->gate->pad->exec_lookup->dispatch->materialize) to FILE "
         "via the obs sink thread; summarize with "
         "`python -m matvec_mpi_multiplier_tpu.obs trace FILE`",
+    )
+    p.add_argument(
+        "--events-jsonl", default=None, metavar="FILE",
+        help="(load mode) stream the correlated event timeline — "
+        "scheduler decisions, swaps, retries, failures, all carrying "
+        "request_id/cause_id — to FILE; reconstruct one request with "
+        "`python -m matvec_mpi_multiplier_tpu.obs timeline FILE RID`",
+    )
+    p.add_argument(
+        "--slo-out", default=None, metavar="FILE",
+        help="(load mode) evaluate the declared SLOs (obs/slo.py "
+        "DEFAULT_TARGETS) over the run and write the burn-rate "
+        "evaluation JSON; render with "
+        "`python -m matvec_mpi_multiplier_tpu.obs slo FILE`",
+    )
+    p.add_argument(
+        "--flight-dir", default=None, metavar="DIR",
+        help="(load mode) arm the flight recorder: auto-dump a post-"
+        "mortem bundle (last events + metric snapshots + SLO state) "
+        "into DIR on any typed failure; render with "
+        "`python -m matvec_mpi_multiplier_tpu.obs dump BUNDLE`",
     )
     p.add_argument(
         "--annotate", action="store_true",

@@ -133,6 +133,13 @@ from ..ops.speculative import (
     project_probes,
 )
 from ..obs.sink import JsonlSink
+from ..obs.timeline import (
+    TimelineHub,
+    bind_request,
+    bound_request_id,
+    get_hub,
+    next_request_id,
+)
 from ..obs.tracing import ActiveTrace, RequestTracer
 from ..resilience.faults import (
     FaultPlan,
@@ -213,6 +220,7 @@ class MatvecFuture:
         trace: ActiveTrace | None = None,
         materialize_hist=None,
         integrity_counter=None,
+        timeline: "TimelineHub | None" = None,
     ):
         # parts: (device_array, width[, corrupt[, accept, resolve]]) —
         # width=None marks a rank-1 single column; an int marks a rank-2
@@ -250,6 +258,10 @@ class MatvecFuture:
         # Non-None enables the NaN/Inf integrity gate: result() refuses to
         # return a non-finite block (ResultIntegrityError), counting here.
         self._integrity_counter = integrity_counter
+        # Correlated event hub: the integrity refusal below is a typed
+        # failure the flight recorder triggers on, so it must appear on
+        # the timeline with this request's id.
+        self._timeline = timeline
 
     @classmethod
     def failed(
@@ -306,6 +318,14 @@ class MatvecFuture:
             )
             if err is not None:
                 self._error = err
+                if self._timeline is not None:
+                    self._timeline.emit(
+                        "integrity_refused",
+                        request_id=(
+                            self._trace.request_id
+                            if self._trace is not None else None
+                        ),
+                    )
                 raise err
         return out
 
@@ -412,6 +432,7 @@ class SolverFuture:
         residual_gauge=None,
         iter_time_hist=None,
         dispatch_t0: float | None = None,
+        timeline: "TimelineHub | None" = None,
     ):
         self._res = res
         self.op = op
@@ -428,6 +449,20 @@ class SolverFuture:
         self._residual_gauge = residual_gauge
         self._iter_time_hist = iter_time_hist
         self._dispatch_t0 = dispatch_t0
+        self._timeline = timeline
+
+    def _emit_failure(self, kind: str, **fields) -> None:
+        """Put one typed-failure event on the timeline (the flight
+        recorder's trigger vocabulary), correlated to this solve."""
+        if self._timeline is not None:
+            self._timeline.emit(
+                kind,
+                request_id=(
+                    self._trace.request_id
+                    if self._trace is not None else None
+                ),
+                op=self.op, **fields,
+            )
 
     @classmethod
     def failed(
@@ -494,8 +529,10 @@ class SolverFuture:
                     if err is not None:
                         status = "integrity_failed"
                         self._error = err
+                        self._emit_failure("integrity_refused")
                         raise err
                 status = "integrity_failed"
+                self._emit_failure("integrity_refused")
                 self._error = SolverDivergedError(
                     f"{self.op} solve produced a non-finite result "
                     f"(residual_norm={rnorm}); the answer is withheld — "
@@ -507,6 +544,10 @@ class SolverFuture:
                 status = "diverged"
                 if self._divergence_counter is not None:
                     self._divergence_counter.inc()
+                self._emit_failure(
+                    "solver_diverged", n_iters=n_iters,
+                    residual_norm=rnorm,
+                )
                 self._error = SolverDivergedError(
                     f"{self.op} solve exhausted its iteration cap "
                     f"({self._cap}) at residual_norm={rnorm:.6e} without "
@@ -665,6 +706,11 @@ class MatvecEngine:
         accountant charges through this; exactly-once per transition
         (concurrent placements account once). Never invoked while the
         engine's residency bookkeeping lock is held.
+    timeline : the correlated event hub (``obs/timeline.py``) lifecycle
+        events emit into — submit/retry/degrade/breaker/escalation, each
+        carrying the request's correlation id. Default: the process hub
+        (``obs.get_hub()``). Emission is a dict build + ``deque.append``
+        (GIL-atomic, no locks, no I/O) — always on, hot-path-safe.
     """
 
     def __init__(
@@ -695,6 +741,7 @@ class MatvecEngine:
         label_prefix: str = "",
         exec_cache: ExecutableCache | None = None,
         residency_listener: Callable[[int, str], None] | None = None,
+        timeline: TimelineHub | None = None,
     ):
         if mesh is None:
             from ..parallel.mesh import make_mesh
@@ -955,12 +1002,20 @@ class MatvecEngine:
                 "speculative candidates the on-device check rejected "
                 "(a traced native re-dispatch served the request)",
             )
-            self._g_escalation_rate = self.metrics.gauge(
+            # Windowed EWMA (τ = 60 s), not a lifetime ratio: the cost
+            # model's ε feed must track RECENT traffic — an engine that
+            # escalated heavily an hour ago but serves cleanly now should
+            # read near zero, and a fresh escalation burst should move
+            # the needle immediately instead of being averaged away by a
+            # long clean history. Exported in snapshots under the same
+            # gauge name, so CostModel.refresh_escalation_rate reads it
+            # unchanged.
+            self._g_escalation_rate = self.metrics.ewma_gauge(
                 "engine_escalation_rate",
-                "escalations / speculative dispatches, refreshed at each "
-                "speculative settlement (the cost model's ε feed)",
+                "escalation EWMA over speculative dispatches (τ=60s), "
+                "refreshed at each speculative settlement (the cost "
+                "model's ε feed)",
             )
-            self._g_escalation_rate.set(0.0)
         else:
             self._c_speculative = None
             self._c_escalations = None
@@ -990,6 +1045,15 @@ class MatvecEngine:
             capacity=trace_capacity,
             sink=JsonlSink(trace_jsonl) if trace_jsonl is not None else None,
         )
+        # Correlated event timeline (obs/timeline.py): lifecycle events
+        # emit here with the request's correlation id. Always on —
+        # emission is a dict + deque.append, hot-path-safe by the obs
+        # doctrine.
+        self._timeline = timeline if timeline is not None else get_hub()
+        # engine.health()["slo"]'s burn-rate monitor, built lazily on the
+        # first health() call so a plain engine's snapshot carries no
+        # slo_* vocabulary (the solver-metric-handles doctrine).
+        self._slo_monitor = None
         self._closed = False
 
         # ---- resilience state (docs/RESILIENCE.md). Counters exist only
@@ -2191,9 +2255,30 @@ class MatvecEngine:
             with self._breakers_lock:
                 br = self._breakers.get(key)
                 if br is None:
+                    # The transition callbacks stay lock-free (the
+                    # callback-ok contract at every ladder call site):
+                    # one counter inc plus one timeline append. The
+                    # event carries cause_id — a state transition is a
+                    # background consequence of the request whose
+                    # dispatch tripped it, not the request itself.
+                    label = key.label()
+
+                    def _opened(label=label):
+                        self._c_breaker_opens.inc()
+                        self._timeline.emit(
+                            "breaker_open",
+                            cause_id=bound_request_id(), key=label,
+                        )
+
+                    def _recovered(label=label):
+                        self._c_recoveries.inc()
+                        self._timeline.emit(
+                            "breaker_close",
+                            cause_id=bound_request_id(), key=label,
+                        )
+
                     br = self._resilience.make_breaker(
-                        on_open=self._c_breaker_opens.inc,
-                        on_close=self._c_recoveries.inc,
+                        on_open=_opened, on_close=_recovered,
                     )
                     self._breakers[key] = br
         return br
@@ -2214,6 +2299,12 @@ class MatvecEngine:
                 if not retryable or attempt >= retry.max_attempts:
                     raise
                 self._c_retries.inc()
+                # Correlates via the submit()-bound request id (the
+                # retry runs synchronously inside the dispatch).
+                self._timeline.emit(
+                    "retry", key=key.label(), attempt=attempt,
+                    fault=type(exc).__name__,
+                )
                 self._resilience.sleep(retry.delay_s(serial, attempt))
                 attempt += 1
 
@@ -2254,6 +2345,10 @@ class MatvecEngine:
                     self._degraded[preferred_label] = key.label()
             if i > 0:
                 self._c_downgrades.inc()
+                self._timeline.emit(
+                    "degrade", preferred=preferred_label,
+                    served=key.label(), level=i,
+                )
             return out
         raise last_exc  # every level failed: the request's real fate
 
@@ -2369,9 +2464,11 @@ class MatvecEngine:
         feeds the breaker like any degraded dispatch."""
         if not accepted:
             self._c_escalations.inc()
-        spec = self._c_speculative.value
-        if spec:
-            self._g_escalation_rate.set(self._c_escalations.value / spec)
+        # One EWMA observation per settlement (1.0 = miss): the ε feed
+        # tracks RECENT traffic, not the lifetime ratio — a clean hour
+        # decays an old escalation storm out of the estimate instead of
+        # averaging it in forever (obs/registry.py EwmaGauge).
+        self._g_escalation_rate.observe(0.0 if accepted else 1.0)
         if self._resilience is not None:
             br = self._breaker_for(self._spec_matvec_key())
             (br.record_success if accepted else br.record_failure)()
@@ -2432,15 +2529,23 @@ class MatvecEngine:
             return self._dispatch_matvec_locked(col, trace)
 
         def resolve(accepted: bool) -> list:
-            self._spec_record(accepted)
-            if accepted:
-                return []
-            # Settlement-time escalation is a dispatch like any other: it
-            # must see ONE layout under the swap fence (a reshard may have
-            # committed between the speculative enqueue and this verdict).
-            with self._swap_lock:
-                with trace.span("escalate", op="matvec", kind="escalate"):
-                    return [self._dispatch_matvec_locked(col, trace)]
+            # Settlement runs on the materializing thread: re-bind the
+            # request id so the breaker feed and the re-dispatch's
+            # events correlate like the original dispatch did.
+            with bind_request(trace.request_id):
+                self._spec_record(accepted)
+                if accepted:
+                    return []
+                self._timeline.emit("escalate", op="matvec")
+                # Settlement-time escalation is a dispatch like any
+                # other: it must see ONE layout under the swap fence (a
+                # reshard may have committed between the speculative
+                # enqueue and this verdict).
+                with self._swap_lock:
+                    with trace.span(
+                        "escalate", op="matvec", kind="escalate"
+                    ):
+                        return [self._dispatch_matvec_locked(col, trace)]
 
         return (y, None, corrupt, accept, resolve)
 
@@ -2464,13 +2569,18 @@ class MatvecEngine:
             return self._dispatch_block_locked(chunk, trace)
 
         def resolve(accepted: bool) -> list:
-            self._spec_record(accepted)
-            if accepted:
-                return []
-            # Same swap-fence rule as the matvec escalation above.
-            with self._swap_lock:
-                with trace.span("escalate", op="gemm", kind="escalate"):
-                    return self._dispatch_block_locked(chunk, trace)
+            # Same re-binding + swap-fence rules as the matvec
+            # escalation above.
+            with bind_request(trace.request_id):
+                self._spec_record(accepted)
+                if accepted:
+                    return []
+                self._timeline.emit("escalate", op="gemm", width=width)
+                with self._swap_lock:
+                    with trace.span(
+                        "escalate", op="gemm", kind="escalate"
+                    ):
+                        return self._dispatch_block_locked(chunk, trace)
 
         return [(y, width, corrupt, accept, resolve)]
 
@@ -2573,9 +2683,25 @@ class MatvecEngine:
             )
         elif x.shape[1] == 0:
             raise ConfigError("empty request (b=0)")
-        trace = self.tracer.start(
+        # Direct submits (no scheduler above — warmup, tests, embedders)
+        # allocate their correlation id from the SAME process counter the
+        # schedulers use, so timeline ids never collide across layers;
+        # the tracer adopts it via the momentary binding.
+        if bound_request_id() is None:
+            with bind_request(next_request_id()):
+                trace = self.tracer.start(
+                    cols=1 if x.ndim == 1 else int(x.shape[1]),
+                    kind="vector" if x.ndim == 1 else "block",
+                )
+        else:
+            trace = self.tracer.start(
+                cols=1 if x.ndim == 1 else int(x.shape[1]),
+                kind="vector" if x.ndim == 1 else "block",
+            )
+        self._timeline.emit(
+            "submit", request_id=trace.request_id,
             cols=1 if x.ndim == 1 else int(x.shape[1]),
-            kind="vector" if x.ndim == 1 else "block",
+            shape="vector" if x.ndim == 1 else "block",
         )
 
         def _expired() -> bool:
@@ -2587,6 +2713,10 @@ class MatvecEngine:
         def _fail() -> MatvecFuture:
             self._c_deadline_failures.inc()
             trace.finish(status="deadline_failed")
+            self._timeline.emit(
+                "deadline_failed", request_id=trace.request_id,
+                deadline_ms=deadline_ms,
+            )
             self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
             return MatvecFuture.failed(DeadlineExceededError(
                 f"request deadline of {deadline_ms} ms elapsed in the "
@@ -2602,7 +2732,10 @@ class MatvecEngine:
             # tolerance, so a poisoned candidate must fail typed, never
             # serve within it — even when the optional gate is off.
             integrity_counter = self._integrity_counter()
-        with trace.span("submit"):
+        # The binding is what correlates everything fired from INSIDE the
+        # dispatch — retries, ladder downgrades, breaker transitions —
+        # with this request, with no per-call-site plumbing.
+        with bind_request(trace.request_id), trace.span("submit"):
             if deadline_ms is not None and deadline_ms <= 0:
                 # Stale on arrival (upstream queueing): skip even the drain.
                 return _fail()
@@ -2629,6 +2762,7 @@ class MatvecEngine:
                             trace=trace,
                             materialize_hist=self._h_materialize,
                             integrity_counter=integrity_counter,
+                            timeline=self._timeline,
                         )
                         self._h_submit.observe(
                             (time.perf_counter() - t0_perf) * 1e3
@@ -2662,17 +2796,22 @@ class MatvecEngine:
                         parts, vector=False,
                         trace=trace, materialize_hist=self._h_materialize,
                         integrity_counter=integrity_counter,
+                        timeline=self._timeline,
                     )
                     self._h_submit.observe(
                         (time.perf_counter() - t0_perf) * 1e3
                     )
                     return fut
-            except BaseException:
+            except BaseException as exc:
                 # The dispatch failed past every configured recovery: the
                 # request's trace must close (status says why) and the
                 # failure must count before it surfaces to the caller.
                 self._c_dispatch_failures.inc()
                 trace.finish(status="dispatch_failed")
+                self._timeline.emit(
+                    "dispatch_failed", request_id=trace.request_id,
+                    error=type(exc).__name__,
+                )
                 self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
                 raise
 
@@ -2773,7 +2912,16 @@ class MatvecEngine:
             c_requests, iter_hist, c_div, g_resid, iter_time_hist,
         ) = self._solver_metric_handles()
         c_requests.inc()
-        trace = self.tracer.start(cols=1, kind=op)
+        # Same global-id allocation as the matvec path for unscheduled
+        # submits (the correlation-id contract: one process counter).
+        if bound_request_id() is None:
+            with bind_request(next_request_id()):
+                trace = self.tracer.start(cols=1, kind=op)
+        else:
+            trace = self.tracer.start(cols=1, kind=op)
+        self._timeline.emit(
+            "submit", request_id=trace.request_id, cols=1, op=op,
+        )
 
         def _expired() -> bool:
             return (
@@ -2784,13 +2932,19 @@ class MatvecEngine:
         def _fail() -> SolverFuture:
             self._c_deadline_failures.inc()
             trace.finish(status="deadline_failed")
+            self._timeline.emit(
+                "deadline_failed", request_id=trace.request_id,
+                deadline_ms=deadline_ms,
+            )
             self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
             return SolverFuture.failed(DeadlineExceededError(
                 f"request deadline of {deadline_ms} ms elapsed in the "
                 "backpressure gate before dispatch"
             ), trace=trace)
 
-        with trace.span("submit"):
+        # Same correlation binding as the matvec path: ladder/breaker
+        # events fired inside the solver dispatch carry this request id.
+        with bind_request(trace.request_id), trace.span("submit"):
             if deadline_ms is not None and deadline_ms <= 0:
                 return _fail()
             with trace.span("gate", max_in_flight=self.max_in_flight):
@@ -2830,12 +2984,17 @@ class MatvecEngine:
                     residual_gauge=g_resid,
                     iter_time_hist=iter_time_hist,
                     dispatch_t0=time.perf_counter(),
+                    timeline=self._timeline,
                 )
                 self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
                 return fut
-            except BaseException:
+            except BaseException as exc:
                 self._c_dispatch_failures.inc()
                 trace.finish(status="dispatch_failed")
+                self._timeline.emit(
+                    "dispatch_failed", request_id=trace.request_id,
+                    error=type(exc).__name__,
+                )
                 self._h_submit.observe((time.perf_counter() - t0_perf) * 1e3)
                 raise
 
@@ -2902,9 +3061,12 @@ class MatvecEngine:
         """Point-in-time resilience snapshot: breaker states per ExecKey,
         the configs currently serving degraded (preferred label → the
         fallback label actually dispatching), fault-injection tallies,
-        and the recovery counters. Refreshes the ``resil_breakers_open``
-        gauge, so an obs snapshot taken after ``health()`` agrees with
-        it. Cheap and lock-light — a health endpoint may poll it."""
+        the recovery counters, and the engine-local SLO burn-rate
+        evaluation (``"slo"``; obs/slo.py — each call is one sample, so
+        a polled endpoint accumulates burn history). Refreshes the
+        ``resil_breakers_open`` gauge, so an obs snapshot taken after
+        ``health()`` agrees with it. Cheap and lock-light — a health
+        endpoint may poll it."""
         with self._breakers_lock:
             items = list(self._breakers.items())
             # _walk_ladder mutates _degraded under the same lock — an
@@ -2930,9 +3092,24 @@ class MatvecEngine:
         # emitter), not this engine's: tuning races run process-wide.
         from ..tuning.cost_model import divergence_health
 
+        # Engine-local SLO burn rates (obs/slo.py, ENGINE_TARGETS): each
+        # health() call is one sample, so a polled health endpoint
+        # accumulates the burn history for free. Built lazily so a plain
+        # engine's metrics snapshot carries no slo_* vocabulary until
+        # someone actually polls health (the solver-metrics doctrine).
+        if self._slo_monitor is None:
+            from ..obs.slo import ENGINE_TARGETS, SloMonitor
+
+            self._slo_monitor = SloMonitor(
+                self.metrics, ENGINE_TARGETS
+            )
+        self._slo_monitor.sample()
+        slo = self._slo_monitor.evaluate()
+
         return {
             "resilience": self._resilience is not None,
             "cost_model": divergence_health(),
+            "slo": slo,
             "integrity_gate": self.integrity_gate,
             "storage": {
                 "format": self.storage,

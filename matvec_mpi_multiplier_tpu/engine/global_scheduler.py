@@ -86,6 +86,12 @@ from typing import Callable
 import numpy as np
 
 from ..obs.sink import JsonlSink
+from ..obs.timeline import (
+    bind_request,
+    bound_request_id,
+    get_hub,
+    next_request_id,
+)
 from ..utils.errors import AdmissionRejectedError, ConfigError
 from .core import DEFAULT_PROMOTE_B, MatvecFuture
 from .registry import MatrixRegistry
@@ -150,13 +156,14 @@ class _GsSlice:
 class _PendingMember:
     """One request waiting in the open cross-tenant batch."""
 
-    __slots__ = ("tenant_id", "block", "width", "future")
+    __slots__ = ("tenant_id", "block", "width", "future", "rid")
 
-    def __init__(self, tenant_id, block, width, future):
+    def __init__(self, tenant_id, block, width, future, rid):
         self.tenant_id = tenant_id
         self.block = block
         self.width = width
         self.future = future
+        self.rid = rid
 
 
 class GlobalScheduler:
@@ -280,6 +287,7 @@ class GlobalScheduler:
         self._sink = (
             JsonlSink(decision_jsonl) if decision_jsonl is not None else None
         )
+        self._timeline = get_hub()
 
         metrics = registry.metrics
         self._c_decisions = metrics.counter(
@@ -341,7 +349,8 @@ class GlobalScheduler:
     # ---- the decision trace ----
 
     def _record(self, decision: str, tenant_id: str, *,
-                predicted_s, reason: str, **fields) -> None:
+                predicted_s, reason: str, request_id=None, cause_id=None,
+                **fields) -> None:
         record = {
             "decision": decision,
             "tenant": tenant_id,
@@ -350,11 +359,22 @@ class GlobalScheduler:
             "t_s": self._clock(),
             **fields,
         }
+        if request_id is not None:
+            record["request_id"] = request_id
+        if cause_id is not None:
+            record["cause_id"] = cause_id
         with self._lock:
             self._decisions.append(record)
             if len(self._decisions) > self._decision_capacity:
                 del self._decisions[: -self._decision_capacity]
         self._c_decisions.inc()
+        # Mirror into the correlated event timeline (hot-path-safe:
+        # deque append + subscriber appends) so `obs timeline <rid>`
+        # shows admission decisions inline with the engine's events.
+        self._timeline.emit(
+            decision, request_id=request_id, cause_id=cause_id,
+            tenant=tenant_id, **fields,
+        )
         if self._sink is not None:
             self._sink.put(record)
 
@@ -379,6 +399,7 @@ class GlobalScheduler:
                 f"lowest demand-aware victim score ({score:.3f}) making "
                 f"headroom for {caused_by}"
             ),
+            cause_id=bound_request_id(),
             caused_by=caused_by,
             restore_bytes=restore_bytes,
         )
@@ -526,6 +547,7 @@ class GlobalScheduler:
                 f"swap-in ({best_rate:.2f} req/s demand) overlapped "
                 f"under {tenant_id}'s {dispatch_s * 1e3:.3f} ms dispatch"
             ),
+            cause_id=bound_request_id(),
             under=tenant_id,
             restore_bytes=restore,
         )
@@ -612,6 +634,7 @@ class GlobalScheduler:
         self._record(
             "reshard", tenant_id,
             predicted_s=migrate_s,
+            cause_id=bound_request_id(),
             reason=(
                 f"crossover: {dst} predicts {new_s * 1e3:.3f} ms/req vs "
                 f"{src} {dispatch_s * 1e3:.3f} ms, and the "
@@ -707,6 +730,10 @@ class GlobalScheduler:
         if vector:
             block = block[:, None]
         width = block.shape[1]
+        # One correlation id per admitted request: every decision line,
+        # timeline event, and (via bind_request around the dispatch
+        # chain) the engine's own trace share it.
+        rid = next_request_id()
 
         dispatch_s = self._predict_dispatch_s(engine, width, rtol)
         if self.model is not None:
@@ -747,8 +774,8 @@ class GlobalScheduler:
                 )
                 self._record(
                     "reject", tenant_id, predicted_s=dispatch_s,
-                    reason=reason, eta_s=eta_s, queue_s=queue_s,
-                    deadline_ms=deadline_ms,
+                    reason=reason, request_id=rid, eta_s=eta_s,
+                    queue_s=queue_s, deadline_ms=deadline_ms,
                 )
                 return MatvecFuture.failed(AdmissionRejectedError(
                     f"request for tenant {tenant_id!r} rejected at "
@@ -767,13 +794,20 @@ class GlobalScheduler:
                     + (f"deadline {deadline_ms:.3f} ms"
                        if deadline_ms is not None else "no deadline")
                 ),
-                eta_s=eta_s, queue_s=queue_s, deadline_ms=deadline_ms,
+                request_id=rid, eta_s=eta_s, queue_s=queue_s,
+                deadline_ms=deadline_ms,
             )
-            self._maybe_interleave(tenant_id, dispatch_s)
-            if self._maybe_reshard(tenant_id, width, dispatch_s):
-                # The migrated layout serves THIS request too: re-predict
-                # so the backlog window charges the new config's time.
-                dispatch_s = self._predict_dispatch_s(engine, width, rtol)
+            with bind_request(rid):
+                # Bound so consequences (evictions under prefetch, the
+                # reshard migration) record cause_id=rid.
+                self._maybe_interleave(tenant_id, dispatch_s)
+                if self._maybe_reshard(tenant_id, width, dispatch_s):
+                    # The migrated layout serves THIS request too:
+                    # re-predict so the backlog window charges the new
+                    # config's time.
+                    dispatch_s = self._predict_dispatch_s(
+                        engine, width, rtol
+                    )
             # Admission owns the deadline from here (module docstring).
             engine_deadline = None
         else:
@@ -784,11 +818,12 @@ class GlobalScheduler:
             self._record(
                 "admit", tenant_id, predicted_s=None,
                 reason="greedy admission (cost model uncalibrated)",
-                deadline_ms=deadline_ms,
+                request_id=rid, deadline_ms=deadline_ms,
             )
-            fut = self.registry.submit(
-                tenant_id, x, deadline_ms=deadline_ms, rtol=rtol
-            )
+            with bind_request(rid):
+                fut = self.registry.submit(
+                    tenant_id, x, deadline_ms=deadline_ms, rtol=rtol
+                )
             self._track(fut, None)
             return fut
 
@@ -796,13 +831,14 @@ class GlobalScheduler:
         if not self._coalesce or rtol is not None:
             # rtol requests dispatch solo (docstring: one tolerance per
             # fused check) — speculation and coalescing don't stack.
-            fut = self.registry.submit(
-                tenant_id, x, deadline_ms=engine_deadline, rtol=rtol
-            )
+            with bind_request(rid):
+                fut = self.registry.submit(
+                    tenant_id, x, deadline_ms=engine_deadline, rtol=rtol
+                )
             self._track(fut, dispatch_s)
             return fut
         return self._enqueue_coalesced(
-            tenant_id, block, vector, width, dispatch_s,
+            tenant_id, block, vector, width, dispatch_s, rid,
             flush_now=deadline_ms is not None or qos == "interactive",
         )
 
@@ -823,16 +859,18 @@ class GlobalScheduler:
             op=op, rhs=rhs, rtol=rtol, maxiter=maxiter,
             restart=restart, steps=steps, interval=interval,
         )
+        rid = next_request_id()
         if self.model is None:
             self._c_admits.inc()
             self._record(
                 "admit", tenant_id, predicted_s=None,
                 reason="greedy admission (cost model uncalibrated)",
-                deadline_ms=deadline_ms, op=op,
+                request_id=rid, deadline_ms=deadline_ms, op=op,
             )
-            fut = self.registry.submit(
-                tenant_id, x, deadline_ms=deadline_ms, **kwargs
-            )
+            with bind_request(rid):
+                fut = self.registry.submit(
+                    tenant_id, x, deadline_ms=deadline_ms, **kwargs
+                )
             self._track(fut, None)
             return fut
 
@@ -871,8 +909,8 @@ class GlobalScheduler:
             )
             self._record(
                 "reject", tenant_id, predicted_s=dispatch_s,
-                reason=reason, eta_s=eta_s, queue_s=queue_s,
-                deadline_ms=deadline_ms, op=op,
+                reason=reason, request_id=rid, eta_s=eta_s,
+                queue_s=queue_s, deadline_ms=deadline_ms, op=op,
             )
             return MatvecFuture.failed(AdmissionRejectedError(
                 f"request for tenant {tenant_id!r} rejected at "
@@ -891,12 +929,16 @@ class GlobalScheduler:
                 + (f"deadline {deadline_ms:.3f} ms"
                    if deadline_ms is not None else "no deadline")
             ),
-            eta_s=eta_s, queue_s=queue_s, deadline_ms=deadline_ms, op=op,
+            request_id=rid, eta_s=eta_s, queue_s=queue_s,
+            deadline_ms=deadline_ms, op=op,
         )
-        self._maybe_interleave(tenant_id, dispatch_s)
         self._c_admits.inc()
-        # Admission owns the deadline from here (module docstring).
-        fut = self.registry.submit(tenant_id, x, deadline_ms=None, **kwargs)
+        with bind_request(rid):
+            self._maybe_interleave(tenant_id, dispatch_s)
+            # Admission owns the deadline from here (module docstring).
+            fut = self.registry.submit(
+                tenant_id, x, deadline_ms=None, **kwargs
+            )
         self._track(fut, dispatch_s)
         return fut
 
@@ -913,7 +955,7 @@ class GlobalScheduler:
         return b_star if b_star is not None else DEFAULT_PROMOTE_B
 
     def _enqueue_coalesced(self, tenant_id, block, vector, width,
-                           dispatch_s, flush_now: bool):
+                           dispatch_s, rid, flush_now: bool):
         # Members reach registry.submit only through the flush OWNER, so
         # their demand estimators would under-tick (the eviction score's
         # input); tick each member here instead. The owner gets one
@@ -922,7 +964,7 @@ class GlobalScheduler:
         self.registry.observe_demand(tenant_id)
         group = self.registry.coalesce_group(tenant_id)
         fut = _GsSlice(self, vector, width)
-        member = _PendingMember(tenant_id, block, width, fut)
+        member = _PendingMember(tenant_id, block, width, fut, rid)
         engine = self.registry._entry(tenant_id).engine
         batch = None
         with self._lock:
@@ -973,16 +1015,21 @@ class GlobalScheduler:
         if cross:
             self._c_cross_tenant.inc(cross + 1)  # every sharing member
         self._c_flushes.inc()
+        # One fresh id per flushed batch; `members` lets the timeline
+        # walk from any member's rid to the batch and back.
+        batch_rid = next_request_id()
         self._record(
             "flush", owner, predicted_s=predicted,
             reason=(
                 f"{len(batch)} request(s), {width} column(s)"
                 + (f", {cross} from other tenants" if cross else "")
             ),
+            request_id=batch_rid, members=[m.rid for m in batch],
             n_requests=len(batch), width=width,
         )
         try:
-            inner = self.registry.submit(owner, stacked)
+            with bind_request(batch_rid):
+                inner = self.registry.submit(owner, stacked)
         except Exception as e:  # swallow-ok: the failure is parked in every member's future via MatvecFuture.failed — callers re-raise it at result()
             shared = _SharedResult(MatvecFuture.failed(e))
         else:

@@ -81,7 +81,8 @@ import threading
 import time
 from typing import Callable, Sequence
 
-from ..obs.registry import MetricsRegistry
+from ..obs.registry import MetricsRegistry, label
+from ..obs.timeline import bound_request_id, get_hub
 from ..utils.errors import ConfigError, TenantQuotaError
 from .core import MatvecEngine, MatvecFuture
 from .executables import ExecutableCache
@@ -389,6 +390,7 @@ class MatrixRegistry:
         self._exec_caches: dict[tuple, ExecutableCache] = {}
         self._serial = itertools.count(1)
         self._closed = False
+        self._timeline = get_hub()
 
         self._g_budget = self.metrics.gauge(
             "registry_hbm_budget_bytes",
@@ -452,20 +454,23 @@ class MatrixRegistry:
 
     # ---- registration ----
 
+    # cardinality-ok: per-tenant series are bounded by the registered
+    # fleet (register() validates ids, unregister removes demand), and
+    # label() escapes the values — the one sanctioned dynamic-name site.
+
     def _tenant_gauge(self, tenant_id: str, what: str, help_: str):
         return self.metrics.gauge(
-            f'tenant_{what}{{tenant="{tenant_id}"}}', help_
+            label(f"tenant_{what}", tenant=tenant_id), help_
         )
 
     def _tenant_counter(self, tenant_id: str, what: str, help_: str):
         return self.metrics.counter(
-            f'tenant_{what}{{tenant="{tenant_id}"}}', help_
+            label(f"tenant_{what}", tenant=tenant_id), help_
         )
 
     def _strategy_gauge(self, tenant_id: str, strategy: str):
         return self.metrics.gauge(
-            f'tenant_strategy{{tenant="{tenant_id}",'
-            f'strategy="{strategy}"}}',
+            label("tenant_strategy", tenant=tenant_id, strategy=strategy),
             "tenant's current partitioning strategy (info metric; the "
             "active strategy label reads 1)",
         )
@@ -533,7 +538,7 @@ class MatrixRegistry:
         # Per-tenant arrival-rate EWMA: the predicted-demand signal
         # (demand-aware eviction) and a snapshot gauge.
         entry.rate = self.metrics.rate_estimator(
-            f'tenant_rate_req_per_s{{tenant="{tenant_id}"}}',
+            label("tenant_rate_req_per_s", tenant=tenant_id),
             "EWMA arrival rate of this tenant's offered requests "
             "(admission-rejected demand included)",
             tau_s=self.rate_tau_s, clock=self._rate_clock,
@@ -709,6 +714,15 @@ class MatrixRegistry:
             self._c_evictions.inc()
             entry.evictions_caused += 1
             entry.c_evictions_caused.inc()
+            # Timeline: a swap-out is a background consequence of the
+            # admission that needed headroom — cause_id, never
+            # request_id. Bookkeeping-only (deque appends), legal under
+            # the lock like the listener below.
+            self._timeline.emit(
+                "swap_out", cause_id=bound_request_id(),
+                tenant=victim.tenant_id, caused_by=entry.tenant_id,
+                score=score,
+            )
             if self.eviction_listener is not None:
                 self.eviction_listener(  # callback-ok: bookkeeping-only contract, documented at the parameter — the global scheduler's _on_eviction appends to its ring and queues a sink record, never takes the registry lock
                     victim.tenant_id, entry.tenant_id, score,
@@ -778,6 +792,12 @@ class MatrixRegistry:
             if not hit:
                 # The async swap-in: device_put is enqueue-only, so this
                 # overlaps under whatever other tenants have in flight.
+                # (emit auto-adopts the bound request id, so the miss
+                # shows up inside the requesting timeline.)
+                self._timeline.emit(
+                    "swap_in", tenant=tenant_id,
+                    restore_bytes=entry.engine.resident_bytes,
+                )
                 entry.engine.ensure_resident()
             fut = entry.engine.submit(x, **kwargs)
         finally:
@@ -899,6 +919,10 @@ class MatrixRegistry:
                 entry.active -= 1
         if placed:
             self._c_prefetches.inc()
+            self._timeline.emit(
+                "prefetch", cause_id=bound_request_id(),
+                tenant=tenant_id, protect=protect,
+            )
         return placed
 
     def reshard(
@@ -968,6 +992,11 @@ class MatrixRegistry:
                 )
             self._c_reshards.inc()
             self._c_reshard_bytes.inc(int(result.get("bytes_moved", 0)))
+        self._timeline.emit(
+            "reshard_apply", cause_id=bound_request_id(),
+            tenant=tenant_id, dst=engine.strategy.name,
+            bytes_moved=int(result.get("bytes_moved", 0)),
+        )
         if warm_widths is not None:
             engine.warmup(widths=warm_widths)
         return result

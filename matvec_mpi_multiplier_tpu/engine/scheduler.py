@@ -111,6 +111,7 @@ from typing import Callable
 
 import numpy as np
 
+from ..obs.timeline import bind_request, next_request_id
 from ..resilience.faults import is_payload_fault, refuse_nonfinite
 from ..utils.errors import ConfigError, DeadlineExceededError
 from .buckets import bucket_for, split_widths
@@ -294,17 +295,19 @@ class _BisectState:
 
 class _Pending:
     """One request waiting in the window: its normalized host block, its
-    absolute deadline (scheduler-clock seconds, None = none), and the
-    future its batch placement will resolve."""
+    absolute deadline (scheduler-clock seconds, None = none), its
+    process-unique correlation id (``obs/timeline.py``), and the future
+    its batch placement will resolve."""
 
-    __slots__ = ("block", "width", "deadline", "qos", "future")
+    __slots__ = ("block", "width", "deadline", "qos", "future", "rid")
 
-    def __init__(self, block, width, deadline, qos, future):
+    def __init__(self, block, width, deadline, qos, future, rid):
         self.block = block
         self.width = width
         self.deadline = deadline
         self.qos = qos
         self.future = future
+        self.rid = rid
 
 
 class SchedulerStats:
@@ -473,6 +476,11 @@ class ArrivalWindowScheduler:
         )
         # Bytes of A one dispatch re-reads — the amortization unit.
         self._a_bytes = engine.m * engine.k * engine.dtype.itemsize
+        # The engine's correlated event hub: scheduler decisions (bypass,
+        # coalesce, bisection, deadline expiry) emit alongside the
+        # engine's dispatch events, correlated by the per-request ids
+        # allocated at admission (obs/timeline.py).
+        self._timeline = engine._timeline
 
         self._flusher: threading.Thread | None = None
         if auto_flush:
@@ -583,10 +591,18 @@ class ArrivalWindowScheduler:
         fut = CoalescedFuture(
             vector, width, integrity_counter=self._integrity_counter
         )
+        # Process-unique correlation id, allocated at ADMISSION: every
+        # event this request causes anywhere below (engine dispatch,
+        # retries, the batch it coalesces into) shares it.
+        rid = next_request_id()
         if deadline_ms is not None and deadline_ms <= 0:
             # Stale on arrival (upstream queueing): fail without touching
             # the window or the engine.
             self._c_deadline_failures.inc()
+            self._timeline.emit(
+                "deadline_failed", request_id=rid,
+                deadline_ms=deadline_ms, at="admission",
+            )
             fut._fail(DeadlineExceededError(
                 f"request deadline of {deadline_ms} ms elapsed before "
                 "admission"
@@ -599,15 +615,22 @@ class ArrivalWindowScheduler:
             window_ms + self.bypass_margin_ms
         ):
             # The deadline cannot survive the window: dispatch alone, now,
-            # with the deadline intact for the engine's own gate.
+            # with the deadline intact for the engine's own gate. The
+            # binding hands the admission id to the engine's tracer and
+            # every event its dispatch emits.
             self._c_bypass.inc()
-            fut._adopt(engine.submit(x, deadline_ms=deadline_ms))
+            self._timeline.emit(
+                "bypass", request_id=rid, deadline_ms=deadline_ms,
+                window_ms=window_ms,
+            )
+            with bind_request(rid):
+                fut._adopt(engine.submit(x, deadline_ms=deadline_ms))
             return fut
 
         deadline = (
             now + deadline_ms / 1e3 if deadline_ms is not None else None
         )
-        pend = _Pending(block, width, deadline, qos, fut)
+        pend = _Pending(block, width, deadline, qos, fut, rid)
         batch = None
         with self._cond:
             if self._closed:
@@ -679,6 +702,9 @@ class ArrivalWindowScheduler:
         for p in batch:
             if p.deadline is not None and now > p.deadline:
                 self._c_deadline_failures.inc()
+                self._timeline.emit(
+                    "deadline_failed", request_id=p.rid, at="window",
+                )
                 p.future._fail(DeadlineExceededError(
                     "request deadline elapsed inside the coalescing "
                     "window before dispatch"
@@ -687,7 +713,17 @@ class ArrivalWindowScheduler:
                 live.append(p)
         if not live:
             return
-        dispatched = self._submit_batch(live, pad_to=None)
+        # The batch gets its OWN correlation id: the flush's engine
+        # dispatch (and everything under it) correlates to the batch,
+        # and members find it through the coalesce event's members list
+        # (obs timeline's one-hop batch expansion).
+        batch_rid = next_request_id()
+        self._timeline.emit(
+            "coalesce", request_id=batch_rid,
+            members=[p.rid for p in live],
+            width=sum(p.width for p in live),
+        )
+        dispatched = self._submit_batch(live, pad_to=None, batch_rid=batch_rid)
         if not dispatched:
             # Every dispatch of the flush failed: no device work ran, so
             # counting it as a coalesced batch (width histogram,
@@ -729,6 +765,7 @@ class ArrivalWindowScheduler:
     def _submit_batch(
         self, live: list[_Pending], pad_to: int | None,
         state: _BisectState | None = None,
+        batch_rid: int | None = None,
     ) -> bool:
         """Dispatch a batch of live requests as one engine submit; on
         failure, bisect and re-dispatch (log-depth) until each failing
@@ -751,6 +788,11 @@ class ArrivalWindowScheduler:
         engine = self.engine
         if state is not None and state.systemic is not None:
             self._c_batch_failed.inc(len(live))
+            self._timeline.emit(
+                "batch_failure", cause_id=batch_rid,
+                members=[p.rid for p in live],
+                error=type(state.systemic).__name__,
+            )
             for p in live:
                 p.future._fail(state.systemic)
             return False
@@ -765,12 +807,18 @@ class ArrivalWindowScheduler:
                 axis=1,
             )
         try:
-            if self._integrity_counter is None:
-                inner = engine.submit(stacked)
-            else:
-                # With the gate on, each CoalescedFuture checks its own
-                # slice — the whole-block check would fail batchmates.
-                inner = engine.submit(stacked, integrity=False)
+            # The batch id binds around the dispatch: the engine's trace
+            # and every nested event (retries, breaker transitions)
+            # correlate to the batch, whose members are on the coalesce
+            # event.
+            with bind_request(batch_rid):
+                if self._integrity_counter is None:
+                    inner = engine.submit(stacked)
+                else:
+                    # With the gate on, each CoalescedFuture checks its
+                    # own slice — the whole-block check would fail
+                    # batchmates.
+                    inner = engine.submit(stacked, integrity=False)
         except Exception as e:
             if state is None:
                 state = _BisectState()
@@ -786,22 +834,35 @@ class ArrivalWindowScheduler:
                 # systemic outage was not isolated BY bisection.
                 state.systemic = e
                 self._c_batch_failed.inc(len(live))
+                self._timeline.emit(
+                    "batch_failure", cause_id=batch_rid,
+                    members=[p.rid for p in live],
+                    error=type(e).__name__,
+                )
                 for p in live:
                     p.future._fail(e)
                 return False
             if len(live) == 1:
                 # Failed alone: genuinely poisoned — this caller's fate.
                 self._c_isolated.inc()
+                self._timeline.emit(
+                    "isolated_failure", request_id=live[0].rid,
+                    cause_id=batch_rid, error=type(e).__name__,
+                )
                 live[0].future._fail(e)
                 return False
             self._c_bisects.inc()
             mid = len(live) // 2
+            self._timeline.emit(
+                "bisect", cause_id=batch_rid,
+                members=[p.rid for p in live], split_at=mid,
+            )
             target = (
                 pad_to if pad_to is not None
                 else self._bisect_pad_target(width)
             )
-            left = self._submit_batch(live[:mid], target, state)
-            right = self._submit_batch(live[mid:], target, state)
+            left = self._submit_batch(live[:mid], target, state, batch_rid)
+            right = self._submit_batch(live[mid:], target, state, batch_rid)
             return left or right
         if state is not None:
             state.successes += 1

@@ -26,9 +26,26 @@ three observability layers a serving system is debugged with:
   the staged pipeline structure by name (the GSPMD/``arXiv:2112.09017``
   debugging discipline, PAPERS.md).
 
+Three control-plane layers ride on those (docs/OBSERVABILITY.md):
+
+* **correlated event timeline** (``timeline.py``) — one causally-ordered
+  event stream across engine, schedulers, registry, and resilience, with
+  ``request_id``/``cause_id`` correlation threaded via a thread-local
+  binding (``bind_request``) so every JSONL line answers "which request
+  caused this";
+* **SLO burn-rate engine** (``slo.py``) — declarative targets evaluated
+  from the registry with multi-window (5m/1h + 1h/6h) burn-rate
+  alerting, exported as ``slo_*`` gauges and ``engine.health()["slo"]``;
+* **flight recorder** (``flight.py``) — always-on bounded black box
+  (last N events + metric snapshots) auto-dumping a post-mortem bundle
+  on typed failures.
+
 ``python -m matvec_mpi_multiplier_tpu.obs`` pretty-prints a metrics
-snapshot or summarizes a JSONL trace (per-phase breakdown, top-k slowest
-requests). Capture recipe: ``docs/OBSERVABILITY.md``.
+snapshot (``--watch`` refreshes), summarizes a JSONL trace (per-phase
+breakdown, top-k slowest requests), reconstructs one request's causal
+story (``timeline``), renders an SLO evaluation (``slo``), and renders a
+flight-recorder bundle (``dump``). Capture recipe:
+``docs/OBSERVABILITY.md``.
 
 Dependency-free by design (stdlib + numpy + jax only): the telemetry layer
 must be importable everywhere the engine is.
@@ -40,31 +57,60 @@ from .annotations import (
     named_span,
     set_annotations,
 )
+from .flight import FlightRecorder
 from .registry import (
     Counter,
+    EwmaGauge,
     Gauge,
     Histogram,
     MetricsRegistry,
     RateEstimator,
     get_registry,
+    label,
     prometheus_text,
     reset_registry,
 )
 from .sink import JsonlSink
+from .slo import DEFAULT_TARGETS, ENGINE_TARGETS, SloMonitor, SloTarget
+from .timeline import (
+    FAILURE_KINDS,
+    TimelineHub,
+    bind_request,
+    bound_request_id,
+    get_hub,
+    next_request_id,
+    related_events,
+    reset_hub,
+)
 from .tracing import RequestTracer, Span
 
 __all__ = [
     "Counter",
+    "EwmaGauge",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "RateEstimator",
     "get_registry",
+    "label",
     "prometheus_text",
     "reset_registry",
     "RequestTracer",
     "Span",
     "JsonlSink",
+    "FAILURE_KINDS",
+    "TimelineHub",
+    "bind_request",
+    "bound_request_id",
+    "get_hub",
+    "next_request_id",
+    "related_events",
+    "reset_hub",
+    "DEFAULT_TARGETS",
+    "ENGINE_TARGETS",
+    "SloMonitor",
+    "SloTarget",
+    "FlightRecorder",
     "named_span",
     "annotations",
     "annotations_enabled",

@@ -1,16 +1,32 @@
-"""Obs CLI: render a metrics snapshot or summarize a JSONL request trace.
+"""Obs CLI: render a metrics snapshot, summarize a JSONL request trace,
+replay one request's causal timeline, render an SLO evaluation, or open
+a flight-recorder bundle.
 
 Usage::
 
     python -m matvec_mpi_multiplier_tpu.obs metrics data/obs_demo/metrics.json
     python -m matvec_mpi_multiplier_tpu.obs metrics snapshot.json --prometheus
+    python -m matvec_mpi_multiplier_tpu.obs metrics live.json --watch 2
     python -m matvec_mpi_multiplier_tpu.obs trace data/obs_demo/trace.jsonl --top 5
+    python -m matvec_mpi_multiplier_tpu.obs timeline data/slo_demo/events.jsonl 17
+    python -m matvec_mpi_multiplier_tpu.obs slo data/slo_demo/slo.json
+    python -m matvec_mpi_multiplier_tpu.obs dump data/slo_demo/flight_000_batch_failure.json
 
 ``metrics`` pretty-prints a ``MetricsRegistry.snapshot()`` JSON (the
-``--metrics-out`` payload of ``bench/serve.py``). ``trace`` aggregates a
+``--metrics-out`` payload of ``bench/serve.py``); ``--watch N``
+re-reads and re-renders the file every N seconds (live dashboards over
+a snapshot the serve loop rewrites). ``trace`` aggregates a
 request-trace JSONL (the ``--trace-jsonl`` payload): per-phase time
 breakdown across every span tree, and the top-k slowest requests with
-their per-phase split.
+their per-phase split; ``--since T`` drops records stamped before the
+epoch-seconds cutoff. ``timeline`` reconstructs one request's causal
+story from an event JSONL (a :class:`~.timeline.TimelineHub` sink
+capture, or a flight bundle's ``events``): every event carrying the
+request id, plus the background actions its admission caused
+(``cause_id``), plus the batch events it rode (one-hop ``members``
+expansion — ``obs/timeline.py``). ``slo`` renders an
+``SloMonitor.evaluate()`` JSON as the burn-rate panel; ``dump`` opens a
+flight-recorder bundle (``obs/flight.py``).
 
 This is driver code — it reads files freely; the I/O lint exempts this
 module by name (the hot-path rule lives in ``registry``/``tracing``).
@@ -22,6 +38,7 @@ import argparse
 import json
 import math
 import sys
+import time
 from pathlib import Path
 
 
@@ -541,11 +558,140 @@ def load_trace(path: str | Path) -> list[dict]:
     return records
 
 
+# ------------------------------------------------- timeline / slo / dump
+
+
+def load_events(path: str | Path) -> list[dict]:
+    """Timeline events from a hub-sink JSONL, or from a flight bundle /
+    ``{"events": [...]}`` JSON (one loader for both capture shapes)."""
+    text = Path(path).read_text()
+    try:
+        payload = json.loads(text)
+    except json.JSONDecodeError:
+        # More than one top-level document: JSONL, one event per line.
+        return [
+            json.loads(line) for line in text.splitlines() if line.strip()
+        ]
+    if isinstance(payload, dict) and "events" in payload:
+        return list(payload["events"])  # flight bundle
+    return [payload] if isinstance(payload, dict) else list(payload)
+
+
+def _fmt_event(event: dict, t0: float) -> str:
+    ids = []
+    if "request_id" in event:
+        ids.append(f"req={event['request_id']}")
+    if "cause_id" in event:
+        ids.append(f"cause={event['cause_id']}")
+    fields = " ".join(
+        f"{k}={v}" for k, v in event.items()
+        if k not in ("seq", "t_s", "kind", "request_id", "cause_id")
+    )
+    return (
+        f"  +{event.get('t_s', t0) - t0:9.3f}s  "
+        f"{event.get('kind', '?'):<18} {' '.join(ids):<18} {fields}"
+    ).rstrip()
+
+
+def render_timeline(
+    events: list[dict], request_id: int, since: float | None = None
+) -> str:
+    """One request's causal story: the events carrying its id, the
+    background actions it caused, and the batch it rode."""
+    from .timeline import FAILURE_KINDS, related_events
+
+    story = related_events(events, request_id)
+    if since is not None:
+        story = [e for e in story if e.get("t_s", 0.0) >= since]
+    if not story:
+        return f"(no events for request {request_id})"
+    t0 = story[0].get("t_s", 0.0)
+    failures = [e for e in story if e.get("kind") in FAILURE_KINDS]
+    out = [
+        f"request {request_id}: {len(story)} event(s)"
+        + (f", {len(failures)} failure(s)" if failures else ""),
+    ]
+    out += [_fmt_event(e, t0) for e in story]
+    return "\n".join(out)
+
+
+def render_slo(evaluation: dict) -> str:
+    """The burn-rate panel for one ``SloMonitor.evaluate()`` payload."""
+    targets = evaluation.get("targets", {})
+    if not targets:
+        return "(no SLO targets)"
+    out = ["slo:"]
+    width = max(len(n) for n in targets)
+    for name, t in targets.items():
+        burn = t.get("burn", {})
+        burns = " ".join(
+            f"{w}={b:.2f}" if b is not None else f"{w}=-"
+            for w, b in burn.items()
+        )
+        goal = (
+            f"{t.get('objective'):.4g}"
+            if t.get("kind") == "availability"
+            else f"<= {t.get('objective'):.4g}"
+        )
+        value = t.get("value")
+        out.append(
+            f"  {name:<{width}}  [{t.get('status', '?'):>7}]  "
+            f"objective {goal}"
+            + (f"  value {value:.4g}" if value is not None else "")
+            + f"  burn {burns}"
+        )
+    for alert in evaluation.get("alerts", []):
+        out.append(
+            f"  ALERT [{alert['severity']}] {alert['slo']}: burn "
+            f"{alert['burn_short']:.1f}x over {alert['short']} and "
+            f"{alert['burn_long']:.1f}x over {alert['long']} "
+            f"(threshold {alert['threshold']}x) — error budget burning "
+            f"{alert['burn_short']:.0f}x faster than sustainable"
+        )
+    return "\n".join(out)
+
+
+def render_dump(bundle: dict) -> str:
+    """A flight-recorder bundle: the trigger, the failure mix of the
+    retained ring, the SLO verdict, and the trailing events."""
+    events = bundle.get("events", [])
+    trigger = bundle.get("trigger")
+    out = ["flight bundle:"]
+    if trigger is not None:
+        out.append(
+            f"  trigger   {trigger.get('kind', '?')} "
+            + " ".join(
+                f"{k}={v}" for k, v in trigger.items()
+                if k not in ("seq", "t_s", "kind")
+            )
+        )
+    else:
+        out.append("  trigger   (manual dump)")
+    kinds: dict[str, int] = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    mix = ", ".join(f"{k}={n}" for k, n in sorted(kinds.items()))
+    out.append(f"  events    {len(events)} retained ({mix})")
+    out.append(
+        f"  snapshots {len(bundle.get('metric_snapshots', []))} metric "
+        "snapshot(s) retained"
+    )
+    if "slo" in bundle:
+        out.append(render_slo(bundle["slo"]))
+    if events:
+        t0 = events[0].get("t_s", 0.0)
+        tail = events[-10:]
+        out.append(f"  last {len(tail)} events:")
+        out += [_fmt_event(e, t0) for e in tail]
+    return "\n".join(out)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m matvec_mpi_multiplier_tpu.obs",
-        description="Render a metrics snapshot or summarize a request-trace "
-        "JSONL (see docs/OBSERVABILITY.md).",
+        description="Render a metrics snapshot, a request-trace JSONL, a "
+        "request timeline, an SLO evaluation, or a flight bundle (see "
+        "docs/OBSERVABILITY.md).",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
     pm = sub.add_parser("metrics", help="pretty-print a metrics snapshot")
@@ -554,13 +700,64 @@ def build_parser() -> argparse.ArgumentParser:
         "--prometheus", action="store_true",
         help="emit Prometheus text format instead of the table",
     )
+    pm.add_argument(
+        "--watch", type=float, default=None, metavar="SECONDS",
+        help="re-read and re-render the snapshot every SECONDS",
+    )
+    pm.add_argument(
+        # Test/driver face for --watch: bounded iterations instead of
+        # forever (hidden from --help to keep the operator surface small).
+        "--watch-iterations", type=int, default=None,
+        help=argparse.SUPPRESS,
+    )
     pt = sub.add_parser("trace", help="summarize a request-trace JSONL")
     pt.add_argument("file", help="trace JSONL (serve --trace-jsonl)")
     pt.add_argument(
         "--top", type=int, default=5,
         help="slowest requests to list (default 5)",
     )
+    pt.add_argument(
+        "--since", type=float, default=None, metavar="EPOCH_S",
+        help="only requests whose trace timestamp is >= this epoch time",
+    )
+    pl = sub.add_parser(
+        "timeline", help="replay one request's causal event story"
+    )
+    pl.add_argument(
+        "file", help="event JSONL (TimelineHub sink) or flight bundle JSON"
+    )
+    pl.add_argument("request_id", type=int, help="the correlation id")
+    pl.add_argument(
+        "--since", type=float, default=None, metavar="EPOCH_S",
+        help="only events stamped >= this epoch time",
+    )
+    ps = sub.add_parser("slo", help="render an SLO burn-rate evaluation")
+    ps.add_argument(
+        "file", help="SloMonitor.evaluate() JSON (serve --slo-out)"
+    )
+    pd = sub.add_parser("dump", help="render a flight-recorder bundle")
+    pd.add_argument("file", help="bundle JSON (FlightRecorder.dump)")
     return p
+
+
+def _watch_metrics(args, path: Path) -> None:
+    remaining = args.watch_iterations
+    while True:
+        try:
+            snapshot = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            body = f"({path}: {e})"  # racing the writer is routine
+        else:
+            body = render_metrics(snapshot, prometheus=args.prometheus)
+        # ANSI clear + home, like watch(1); falls through harmlessly to
+        # plain separators on dumb terminals.
+        print(f"\x1b[2J\x1b[H{path} @ {time.strftime('%H:%M:%S')}")
+        print(body, flush=True)
+        if remaining is not None:
+            remaining -= 1
+            if remaining <= 0:
+                return
+        time.sleep(args.watch)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -571,11 +768,32 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     try:
         if args.cmd == "metrics":
+            if args.watch is not None:
+                _watch_metrics(args, path)
+                return 0
             print(render_metrics(
                 json.loads(path.read_text()), prometheus=args.prometheus
             ))
+        elif args.cmd == "trace":
+            records = load_trace(path)
+            if args.since is not None:
+                records = [
+                    r for r in records if r.get("ts", 0.0) >= args.since
+                ]
+            print(summarize_trace(records, top=args.top))
+        elif args.cmd == "timeline":
+            out = render_timeline(
+                load_events(path), args.request_id, since=args.since
+            )
+            print(out)
+            if out.startswith("(no events"):
+                return 1  # script-friendly miss: the id is not in the file
+        elif args.cmd == "slo":
+            print(render_slo(json.loads(path.read_text())))
         else:
-            print(summarize_trace(load_trace(path), top=args.top))
+            print(render_dump(json.loads(path.read_text())))
+    except KeyboardInterrupt:
+        return 130  # interrupted --watch is the normal way out
     except BrokenPipeError:
         # `obs ... | head` closing the pipe early is normal CLI usage.
         # Point stdout at devnull so the interpreter-shutdown flush of the

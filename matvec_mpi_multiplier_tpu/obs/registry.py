@@ -18,11 +18,16 @@ Design constraints, in order:
   full sample would report — the property the serve bench's p50/p99
   unification test pins.
 
-Plus one derived metric: :class:`RateEstimator`, the windowed EWMA
+Plus two derived metrics: :class:`RateEstimator`, the windowed EWMA
 arrival-rate (req/s) the batching scheduler (``engine/scheduler.py``)
-sizes its coalescing window from. It exports as a gauge in snapshots —
-no new wire type — and takes an injectable clock so its dynamics are
-unit-testable without sleeping.
+sizes its coalescing window from, and :class:`EwmaGauge`, the
+time-decayed windowed average of an observation stream (the engine's
+escalation rate ε, the cost model's divergence) — a lifetime ratio
+never forgets, so a config that misbehaved an hour ago would poison
+re-tuning forever; the EWMA tracks *recent* traffic with time constant
+``tau_s``. Both export as gauges in snapshots — no new wire type — and
+take an injectable clock so their dynamics are unit-testable without
+sleeping.
 """
 
 from __future__ import annotations
@@ -251,6 +256,106 @@ class RateEstimator:
             return self._rate * math.exp(-idle / self.tau_s)
 
 
+class EwmaGauge:
+    """Time-decayed windowed average of an observation stream.
+
+    ``observe(x)`` folds one observation into a pair of decayed
+    accumulators (weighted sum and weight), each discounted by
+    ``exp(-dt/tau_s)`` since the previous observation; ``value`` is
+    their ratio — an exponentially-weighted average in which an
+    observation ``age`` seconds old carries weight ``exp(-age/tau_s)``.
+    Three properties the consumers (the ``engine_escalation_rate`` ε the
+    cost model re-adopts at tuning time, the cost-model divergence
+    gauge) depend on:
+
+    * **recent, not lifetime** — after ~5·tau of contrary evidence the
+      old regime is <1% of the estimate, where a lifetime ratio would
+      still be dragging half its history;
+    * **burst-safe** — observations sharing one clock reading all enter
+      with full weight (the accumulators add; no division by dt);
+    * **idle-stable** — silence decays numerator and denominator
+      equally, so the value *holds* over a quiet period instead of
+      drifting toward zero (no traffic is "no new evidence", not
+      "the rate fell").
+
+    Exported by the registry snapshot as a plain gauge value.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        tau_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if tau_s <= 0:
+            raise ValueError(f"ewma gauge {name!r} needs tau_s > 0")
+        self.name = name
+        self.help = help
+        self.tau_s = float(tau_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._num = 0.0
+        self._den = 0.0
+        self._last: float | None = None
+        self._count = 0
+
+    def observe(self, x: float, now: float | None = None) -> None:
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            if self._last is not None:
+                w = math.exp(-max(0.0, now - self._last) / self.tau_s)
+                self._num *= w
+                self._den *= w
+            self._num += float(x)
+            self._den += 1.0
+            self._last = now
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def value(self) -> float:
+        """The decayed average (0.0 before any observation)."""
+        with self._lock:
+            if self._den <= 0.0:
+                return 0.0
+            return self._num / self._den
+
+
+def label(name: str, **labels: object) -> str:
+    """Build a labeled metric name — ``name{k="v", ...}`` — with the
+    label values escaped per the Prometheus text exposition rules
+    (backslash, double-quote, and newline). The registry stores labeled
+    metrics under their full labeled name (one string, no label
+    indexing), so escaping must happen at construction; every f-string
+    that used to build these names by hand goes through here.
+
+    Label *sources* must still be bounded (tenant ids capped by the
+    registry's capacity, declared SLO names): the staticcheck
+    ``metric-label-cardinality`` rule flags per-request/loop
+    construction from unbounded sources."""
+    if not labels:
+        return name
+    # Keyword order is kept and the separator is a bare comma — the
+    # exact grammar the hand-built f-strings used, so names (and the
+    # committed metrics.json captures keyed on them) are unchanged.
+    parts = ",".join(
+        f'{k}="{escape_label_value(str(v))}"' for k, v in labels.items()
+    )
+    return f"{name}{{{parts}}}"
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: ``\\`` → ``\\\\``, ``"`` →
+    ``\\"``, newline → ``\\n``."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 class MetricsRegistry:
     """Named metrics, get-or-create. One registry per engine (isolated
     counters per serving instance) plus a process default
@@ -263,6 +368,7 @@ class MetricsRegistry:
         self._gauges: dict[str, Gauge] = {}
         self._histograms: dict[str, Histogram] = {}
         self._rates: dict[str, RateEstimator] = {}
+        self._ewmas: dict[str, EwmaGauge] = {}
 
     def counter(self, name: str, help: str = "") -> Counter:
         with self._lock:
@@ -308,19 +414,36 @@ class MetricsRegistry:
                 )
             return r
 
+    def ewma_gauge(
+        self,
+        name: str,
+        help: str = "",
+        tau_s: float = 60.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> EwmaGauge:
+        with self._lock:
+            e = self._ewmas.get(name)
+            if e is None:
+                e = self._ewmas[name] = EwmaGauge(
+                    name, help, tau_s=tau_s, clock=clock
+                )
+            return e
+
     def snapshot(self) -> dict:
         """JSON-able view of every metric — the ``--metrics-out`` payload
         and the obs CLI's input. Values are read metric-by-metric under
         each metric's own lock (atomic per metric; the registry makes no
-        cross-metric consistency claim). Rate estimators export as
-        gauges, sampled at snapshot time."""
+        cross-metric consistency claim). Rate estimators and EWMA gauges
+        export as gauges, sampled at snapshot time."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = dict(self._histograms)
             rates = dict(self._rates)
+            ewmas = dict(self._ewmas)
         gauge_values = {n: g.value for n, g in gauges.items()}
         gauge_values.update({n: r.rate_per_s() for n, r in rates.items()})
+        gauge_values.update({n: e.value for n, e in ewmas.items()})
         return {
             "counters": {n: c.value for n, c in sorted(counters.items())},
             "gauges": dict(sorted(gauge_values.items())),

@@ -71,3 +71,16 @@ class JsonlSink:
     def close(self, timeout: float = 5.0) -> None:
         self._q.put(_CLOSE)
         self._thread.join(timeout)
+
+
+def dump_json(path: str | os.PathLike, payload: dict) -> Path:
+    """Synchronous JSON dump for the flight recorder's writer thread and
+    the CLIs — kept here so the I/O lint's 'all blocking file I/O lives
+    in obs/sink.py' contract stays literally true (flight.py itself
+    never opens a file)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+    return path

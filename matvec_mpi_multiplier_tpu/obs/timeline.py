@@ -1,0 +1,250 @@
+"""Correlated event timeline: one causally-ordered stream for the stack.
+
+Every serving subsystem already tells its own story — request span trees
+(obs/tracing.py), scheduler decision rings (engine/global_scheduler.py),
+resilience counters — but in disjoint streams with no shared key, so
+"which decision caused this slow request" cannot be answered from the
+artifacts. This module is the shared key plus the shared stream:
+
+* **Correlation IDs.** :func:`next_request_id` hands out process-unique
+  request ids; :func:`bind_request` binds one to the current thread so
+  every event emitted anywhere below the binding (engine dispatch,
+  retries, ladder downgrades, breaker transitions fired from inside the
+  dispatch) carries it without any call-site plumbing. The engine's
+  tracer adopts a bound id for its trace records too, so the span tree
+  and the event stream share the key.
+
+* **The hub.** :class:`TimelineHub` is a bounded in-memory ring plus an
+  optional JSONL sink plus zero-or-more in-process subscribers (the
+  flight recorder). Emission is hot-path-safe by construction: one dict
+  build, one GIL-atomic ``deque.append``, one ``SimpleQueue.put`` when a
+  sink is attached — no locks, no file handles, no blocking calls
+  (obs/sink.py owns all file I/O, same doctrine as request traces).
+
+* **The contract.** Every event carries ``request_id`` (the request it
+  belongs to) or ``cause_id`` (the request that *triggered* a background
+  action — an eviction forced by another tenant's admission, a breaker
+  opened by a failing dispatch). Batch events additionally carry
+  ``members`` (the coalesced request ids), which is how a member's
+  timeline finds the batch it rode in. ``python -m ...obs timeline``
+  reconstructs one request's causal story from these three fields.
+
+Event vocabulary (open — subsystems may add kinds, the renderer is
+vocabulary-agnostic): ``submit``, ``bypass``, ``coalesce``, ``retry``,
+``degrade``, ``breaker_open``, ``breaker_close``, ``escalate``,
+``deadline_failed``, ``dispatch_failed``, ``integrity_refused``,
+``solver_diverged``, ``batch_failure``, ``isolated_failure``,
+``bisect``, ``admit``, ``reject``, ``interleave``, ``evict``,
+``prefetch``, ``reshard``, ``flush``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Iterable
+
+__all__ = [
+    "FAILURE_KINDS",
+    "TimelineHub",
+    "bind_request",
+    "bound_request_id",
+    "get_hub",
+    "next_request_id",
+    "related_events",
+    "reset_hub",
+]
+
+# The typed-failure kinds: the flight recorder auto-dumps on these, and
+# the SLO demo's "one failed request" is found by them.
+FAILURE_KINDS = frozenset({
+    "breaker_open",
+    "solver_diverged",
+    "batch_failure",
+    "isolated_failure",
+    "integrity_refused",
+    "deadline_failed",
+    "dispatch_failed",
+})
+
+# Process-unique request ids: ONE counter for every layer. Schedulers
+# allocate at admission; the engine allocates for direct (unscheduled)
+# submits; a bare RequestTracer outside an engine falls back to its own
+# local numbering, but nothing it emits reaches the hub.
+_request_ids = itertools.count(1)
+
+_tls = threading.local()
+
+
+def next_request_id() -> int:
+    """A process-unique correlation id (``itertools.count`` — GIL-atomic,
+    safe from any thread)."""
+    return next(_request_ids)
+
+
+def bound_request_id() -> int | None:
+    """The request id bound to the current thread, or None."""
+    return getattr(_tls, "rid", None)
+
+
+@contextlib.contextmanager
+def bind_request(request_id: int | None):
+    """Bind ``request_id`` to the current thread for the duration of the
+    block. Everything emitted below the binding — nested dispatches,
+    retries, breaker callbacks fired synchronously from inside the
+    dispatch — picks the id up via :func:`bound_request_id` without any
+    argument threading. Bindings nest (the previous binding is restored
+    on exit); binding ``None`` is a no-op passthrough."""
+    prev = getattr(_tls, "rid", None)
+    _tls.rid = request_id if request_id is not None else prev
+    try:
+        yield request_id
+    finally:
+        _tls.rid = prev
+
+
+class TimelineHub:
+    """The unified event stream: bounded ring + optional JSONL sink +
+    in-process subscribers.
+
+    ``emit`` is called from dispatch hot paths and from under subsystem
+    bookkeeping locks (the global scheduler's eviction listener), so it
+    must stay bookkeeping-only: no locks of its own, no I/O, no
+    callbacks that could re-enter subsystem locks. Subscribers share
+    that contract (the flight recorder's subscriber is one
+    ``deque.append`` plus one ``SimpleQueue.put``)."""
+
+    def __init__(
+        self,
+        capacity: int = 4096,
+        *,
+        sink=None,
+        clock: Callable[[], float] = time.time,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._events: deque[dict] = deque(maxlen=capacity)
+        self._sink = sink
+        self._clock = clock
+        # Copy-on-write subscriber tuple: emit iterates a snapshot, so
+        # subscribing never races an in-flight emission.
+        self._subscribers: tuple[Callable[[dict], None], ...] = ()
+        self._count = itertools.count()
+        self._emitted = 0
+
+    def subscribe(self, fn: Callable[[dict], None]) -> None:
+        self._subscribers = self._subscribers + (fn,)
+
+    def emit(
+        self,
+        kind: str,
+        *,
+        request_id: int | None = None,
+        cause_id: int | None = None,
+        **fields: Any,
+    ) -> dict:
+        """Append one event. ``request_id`` defaults to the thread's
+        bound id (:func:`bind_request`); background actions pass
+        ``cause_id`` instead. Returns the event dict (callers may not
+        mutate it after emission — the ring and sink share it)."""
+        if request_id is None and cause_id is None:
+            request_id = bound_request_id()
+        event: dict[str, Any] = {
+            "seq": next(self._count),
+            "t_s": self._clock(),
+            "kind": kind,
+        }
+        if request_id is not None:
+            event["request_id"] = request_id
+        if cause_id is not None:
+            event["cause_id"] = cause_id
+        event.update(fields)
+        self._events.append(event)
+        self._emitted += 1
+        sink = self._sink
+        if sink is not None:
+            sink.put(event)
+        for fn in self._subscribers:
+            fn(event)
+        return event
+
+    def events(self) -> list[dict]:
+        """A snapshot of the ring, oldest first."""
+        return list(self._events)
+
+    @property
+    def emitted(self) -> int:
+        """Total events emitted (the ring bounds memory, not this)."""
+        return self._emitted
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Confirm the sink drained (True when there is no sink)."""
+        return self._sink.flush(timeout=timeout) if self._sink else True
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+
+
+def related_events(
+    events: Iterable[dict], request_id: int
+) -> list[dict]:
+    """The causal slice for one request: events carrying the id as
+    ``request_id`` or ``cause_id``, batch events listing it in
+    ``members``, and — one hop out — events whose ``request_id`` is a
+    batch the request was coalesced into (so a member's timeline shows
+    the batch's retries/downgrades/failures too)."""
+    events = list(events)
+    keys = {request_id}
+    for ev in events:
+        if request_id in ev.get("members", ()):
+            if ev.get("request_id") is not None:
+                keys.add(ev["request_id"])
+            if ev.get("cause_id") is not None:
+                keys.add(ev["cause_id"])
+    out = []
+    for ev in events:
+        if (
+            ev.get("request_id") in keys
+            or ev.get("cause_id") in keys
+            or request_id in ev.get("members", ())
+        ):
+            out.append(ev)
+    out.sort(key=lambda ev: (ev.get("t_s", 0.0), ev.get("seq", 0)))
+    return out
+
+
+# ------------------------------------------------------- process default
+#
+# Same shape as obs.registry.get_registry(): one always-on hub per
+# process so subsystems correlate without plumbing, resettable for tests
+# and for arming a sink at capture time.
+
+_default_hub: TimelineHub | None = None
+_default_lock = threading.Lock()
+
+
+def get_hub() -> TimelineHub:
+    global _default_hub
+    with _default_lock:
+        if _default_hub is None:
+            _default_hub = TimelineHub()
+        return _default_hub
+
+
+def reset_hub(
+    capacity: int = 4096, *, sink=None, clock: Callable[[], float] = time.time
+) -> TimelineHub:
+    """Replace the process hub (tests; capture CLIs arming a sink).
+    Closes the previous hub's sink."""
+    global _default_hub
+    with _default_lock:
+        old = _default_hub
+        _default_hub = TimelineHub(capacity, sink=sink, clock=clock)
+        hub = _default_hub
+    if old is not None:
+        old.close()  # after release: close joins the sink writer thread
+    return hub

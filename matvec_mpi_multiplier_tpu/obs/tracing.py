@@ -28,6 +28,8 @@ import time
 from collections import deque
 from typing import TYPE_CHECKING
 
+from .timeline import bound_request_id
+
 if TYPE_CHECKING:  # import cycle guard only; sink.py imports nothing back
     from .sink import JsonlSink
 
@@ -149,7 +151,15 @@ class RequestTracer:
         self._ids = itertools.count()
 
     def start(self, **attrs) -> ActiveTrace:
-        return ActiveTrace(self, next(self._ids), attrs)
+        """Open a trace. When the thread carries a bound correlation id
+        (``obs.timeline.bind_request`` — the scheduler/registry layers
+        bind one around the synchronous submit chain), the trace adopts
+        it, so the span tree and the event timeline share the key;
+        otherwise the tracer's own counter numbers the request."""
+        rid = bound_request_id()
+        return ActiveTrace(
+            self, next(self._ids) if rid is None else rid, attrs
+        )
 
     def _emit(self, record: dict) -> None:
         self._ring.append(record)  # GIL-atomic; no lock on the hot path

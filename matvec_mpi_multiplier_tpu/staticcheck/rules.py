@@ -43,6 +43,10 @@ lock-order-inversion      lock-order-  the cross-class lock-acquisition
 callback-under-lock       callback-ok  no callback/listener invocation
                                        while holding a lock (the PR 9
                                        ledger-bug shape)
+metric-label-cardinality  cardinality- no labeled/dynamic metric names
+                          ok           built per loop iteration (every
+                                       distinct name is a live series
+                                       forever)
 ========================  ===========  ====================================
 
 The first four are the old grep rules from ``scripts/tier1.sh`` /
@@ -812,6 +816,78 @@ def _check_mutable_default(sf: SourceFile):
                     "across every call (and every trace); default to None "
                     "and construct inside the body"
                 )
+
+
+# Rule #16: metric-series cardinality. The registry stores labeled
+# metrics under their full labeled name (obs/registry.py: one string per
+# series), so every dynamically-built name is a new series for the
+# process's lifetime. Building one per loop iteration — a comprehension
+# over requests, a retry loop keying on attempt — leaks series without
+# bound and OOMs the snapshot long before anything else complains.
+# Dynamic names are legal where the label SOURCE is bounded (tenant ids
+# capped by the registered fleet, declared SLO target names); those
+# sites say so with '# cardinality-ok: <reason>'.
+
+
+_METRIC_CTORS = ("counter", "gauge", "histogram", "rate_estimator",
+                 "ewma_gauge")
+
+_LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+               ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _is_constructed_name(node: ast.AST) -> bool:
+    """A metric-name expression assembled at the call site: f-string,
+    string concat/%-format, ``.format()``, or an ``obs.label(...)``
+    call. A bare constant or a module-level NAME constant is not."""
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.Add, ast.Mod)
+    ):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "format":
+            return True
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if attr == "label":
+            return True
+    return False
+
+
+@_register(
+    "metric-label-cardinality", "cardinality-ok",
+    "labeled/dynamic metric name constructed inside a loop or "
+    "comprehension: each distinct name is a live series forever, so a "
+    "per-iteration name with an unbounded label source leaks series "
+    "without bound",
+    _package,
+)
+def _check_metric_cardinality(sf: SourceFile):
+    loops = [n for n in ast.walk(sf.tree) if isinstance(n, _LOOP_NODES)]
+    seen: set[int] = set()
+    for loop in loops:
+        for call in _calls(loop):
+            if id(call) in seen:
+                continue
+            fn = call.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else None
+            if attr not in _METRIC_CTORS or not call.args:
+                continue
+            if not _is_constructed_name(call.args[0]):
+                continue
+            seen.add(id(call))
+            yield call, (
+                f"{attr}() with a name built per loop iteration: every "
+                "distinct name is a new live series (the registry never "
+                "drops one), so an unbounded label source here leaks "
+                "memory and floods the snapshot — hoist the series, "
+                "bound the source, or mark the bounded case with "
+                "'# cardinality-ok: <reason>'"
+            )
 
 
 # Rules #13-#15: the whole-program lock-graph auditor (lockgraph.py)

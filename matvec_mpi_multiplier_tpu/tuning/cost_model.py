@@ -872,7 +872,9 @@ def record_prediction(
     """One (predicted, measured) candidate pair into the obs registry:
     the ratio histogram the `cost model` panel renders, the
     |log10 ratio| histogram behind the divergence stat, and the
-    divergence gauge (windowed median |log10 ratio|). Called by the
+    divergence gauge — a time-decayed EWMA of |log10 ratio|, so the
+    panel tracks the model's RECENT agreement instead of a lifetime
+    median a fixed regime-change would drag for hours. Called by the
     tuner for every measured candidate once a calibration exists."""
     if predicted_s <= 0 or measured_s <= 0:
         return
@@ -889,11 +891,13 @@ def record_prediction(
         "|log10(predicted/measured)| per tuning candidate",
     )
     div.observe(abs(math.log10(ratio)))
-    reg.gauge(
+    reg.ewma_gauge(
         DIVERGENCE_GAUGE,
-        "windowed median |log10(predicted/measured)| — sustained "
-        f"divergence beyond {DIVERGENCE_LOG10} is a regression signal",
-    ).set(div.percentile(50))
+        "time-decayed |log10(predicted/measured)| over recent "
+        f"candidates (τ=300s) — sustained divergence beyond "
+        f"{DIVERGENCE_LOG10} is a regression signal",
+        tau_s=300.0,
+    ).observe(abs(math.log10(ratio)))
 
 
 def divergence_health(registry=None) -> dict[str, Any]:

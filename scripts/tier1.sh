@@ -404,6 +404,52 @@ print(f"reshard smoke ok: {res['src']}->{res['dst']} bitwise vs fresh, "
       "0 steady recompiles")
 PY
 
+# SLO/flight smoke: the committed observability capture (data/slo_demo;
+# scripts/slo_study.py; docs/OBSERVABILITY.md) still tells its story —
+# the burn-rate page alert is in slo.json, every flight dump was
+# triggered by a typed failure and carries a correlated event ring, and
+# `obs timeline` reconstructs the committed failed request end-to-end
+# (admission, retries, the typed failure) with every event carrying its
+# correlation id. One interpreter, no engine: seconds, not minutes.
+echo "slo/flight smoke: page alert, typed-failure dump, correlated timeline"
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+from pathlib import Path
+
+from matvec_mpi_multiplier_tpu.obs import FAILURE_KINDS, related_events
+from matvec_mpi_multiplier_tpu.obs.__main__ import render_timeline
+
+demo = Path("data/slo_demo")
+slo = json.loads((demo / "slo.json").read_text())
+pages = [a for a in slo["alerts"] if a["severity"] == "page"]
+assert pages, f"no page alert in committed slo.json: {slo['alerts']}"
+assert slo["targets"][pages[0]["slo"]]["status"] == "page"
+dumps = sorted(demo.glob("flight/flight_*.json"))
+assert dumps, "no committed flight dump"
+for p in dumps:
+    bundle = json.loads(p.read_text())
+    assert bundle["trigger"]["kind"] in FAILURE_KINDS, p.name
+    assert all(
+        "request_id" in ev or "cause_id" in ev for ev in bundle["events"]
+    ), p.name
+events = [
+    json.loads(line)
+    for line in (demo / "events.jsonl").read_text().splitlines()
+]
+assert all("request_id" in ev or "cause_id" in ev for ev in events), (
+    "an event line is missing its correlation id"
+)
+rid = json.loads((demo / "summary.json").read_text())["failed_request_id"]
+kinds = {ev["kind"] for ev in related_events(events, rid)}
+assert kinds & FAILURE_KINDS and {"submit", "retry"} <= kinds, kinds
+head = render_timeline(events, rid).splitlines()[0]
+assert head.startswith(f"request {rid}:") and "failure" in head, head
+print(f"slo/flight smoke ok: {pages[0]['slo']} page at "
+      f"{pages[0]['burn_short']:.0f}x/{pages[0]['burn_long']:.0f}x burn, "
+      f"{len(dumps)} typed-failure dump(s), timeline for request {rid} "
+      f"({head.split(': ')[1]})")
+PY
+
 # ROADMAP.md tier-1 verify command (kept in sync with the ROADMAP header).
 # Portability note: under /bin/sh without pipefail (dash), `rc=$?` after
 # `pytest | tee` reads TEE's status, so a failing suite could exit 0. The

@@ -468,11 +468,12 @@ def test_divergence_health_flags_sustained_divergence(registry):
     health = cm.divergence_health()
     assert health["divergent"]
     assert health["median_abs_log10_ratio"] > cm.DIVERGENCE_LOG10
-    # ... and the gauge the obs panel renders tracks the same median.
+    # ... and the gauge the obs panel renders (a time-decayed EWMA of
+    # the same |log10 ratio| stream) reads divergent too: this burst
+    # shares one clock instant, so it holds the plain mean of the 40
+    # observations — dominated by the 30 order-of-magnitude misses.
     snap = get_registry().snapshot()
-    assert snap["gauges"][cm.DIVERGENCE_GAUGE] == pytest.approx(
-        health["median_abs_log10_ratio"]
-    )
+    assert snap["gauges"][cm.DIVERGENCE_GAUGE] > cm.DIVERGENCE_LOG10
 
 
 def test_engine_health_surfaces_cost_model_divergence(devices, registry, rng):

@@ -111,6 +111,91 @@ def test_prometheus_text_format():
     assert "lat_count 1" in text
 
 
+def test_prometheus_histogram_buckets_are_cumulative():
+    """Prometheus ``le`` semantics: each bucket line counts observations
+    <= le, +Inf equals the total count, and the lines appear in
+    ascending bucket order."""
+    from matvec_mpi_multiplier_tpu.obs import prometheus_text
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(1.0, 5.0, 25.0))
+    for v in (0.5, 0.5, 3.0, 30.0, 100.0):
+        h.observe(v)
+    text = reg.to_prometheus()
+    lines = [ln for ln in text.splitlines() if ln.startswith("lat_bucket")]
+    assert lines == [
+        'lat_bucket{le="1.0"} 2',
+        'lat_bucket{le="5.0"} 3',
+        'lat_bucket{le="25.0"} 3',
+        'lat_bucket{le="+Inf"} 5',
+    ]
+    assert "lat_count 5" in text
+    assert f"lat_sum {0.5 + 0.5 + 3.0 + 30.0 + 100.0!r}" in text
+    # The serializer is shared: rendering the snapshot dict (the obs CLI
+    # path over a --metrics-out file) produces the same text.
+    assert prometheus_text(reg.snapshot()) == text
+
+
+def test_prometheus_label_escaping():
+    """label() escapes backslash, double-quote and newline per the text
+    exposition rules, and the labeled name survives into the exposition
+    verbatim (the registry stores labeled metrics by full name)."""
+    from matvec_mpi_multiplier_tpu.obs import label
+    from matvec_mpi_multiplier_tpu.obs.registry import escape_label_value
+
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    name = label("tenant_requests_total", tenant='evil"\\tenant\nx')
+    assert name == (
+        'tenant_requests_total{tenant="evil\\"\\\\tenant\\nx"}'
+    )
+    # Insertion order is kept, separator is a bare comma — the grammar
+    # the committed captures are keyed on.
+    assert label("m", b="1", a="2") == 'm{b="1",a="2"}'
+    assert label("m") == "m"
+    reg = MetricsRegistry()
+    reg.counter(name).inc(2)
+    text = reg.to_prometheus()
+    assert f"{name} 2" in text
+
+
+def test_prometheus_values_agree_with_snapshot():
+    """Snapshot <-> exposition value agreement across every metric type
+    (counters, plain/rate/EWMA gauges, histogram sum/count/buckets)."""
+    reg = MetricsRegistry()
+    reg.counter("c").inc(7)
+    reg.gauge("g").set(2.5)
+    clock = TickClock()
+    r = reg.rate_estimator("r", tau_s=1.0, clock=clock)
+    for _ in range(10):
+        clock.t += 0.1
+        r.observe()
+    e = reg.ewma_gauge("e", tau_s=60.0, clock=clock)
+    e.observe(1.0)
+    e.observe(0.0)
+    h = reg.histogram("h", buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    text = reg.to_prometheus()
+    values = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        metric, value = line.rsplit(" ", 1)
+        values[metric] = float(value)
+    assert values["c"] == snap["counters"]["c"]
+    for gauge in ("g", "r", "e"):
+        assert values[gauge] == pytest.approx(snap["gauges"][gauge])
+    summ = snap["histograms"]["h"]
+    assert values["h_count"] == summ["count"] == 3
+    assert values["h_sum"] == pytest.approx(summ["sum"])
+    for le, cum in summ["buckets"]:
+        le_s = "+Inf" if le == "+Inf" else repr(float(le))
+        assert values[f'h_bucket{{le="{le_s}"}}'] == cum
+
+
 def test_default_registry_reset():
     reset_registry()
     get_registry().counter("x").inc()
@@ -411,7 +496,10 @@ def test_engine_request_trace_is_complete(devices, rng, tmp_path):
         for ln in (tmp_path / "trace.jsonl").read_text().splitlines()
     ]
     assert len(records) == 3 == len(engine.tracer.traces())
-    assert [r["request_id"] for r in records] == [0, 1, 2]
+    # Ids come from the process-wide correlation counter (obs/timeline):
+    # unique and monotone, not pinned — other engines share the counter.
+    ids = [r["request_id"] for r in records]
+    assert len(set(ids)) == 3 and ids == sorted(ids)
     for rec in records:
         assert rec["status"] == "ok"
         roots = [s["name"] for s in rec["spans"]]

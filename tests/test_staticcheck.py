@@ -294,6 +294,25 @@ RULE_FIXTURES = {
         "            batch = list(self._pending)\n"
         "        return self.engine.submit(batch)\n",
     ),
+    "metric-label-cardinality": (
+        f"{PKG}/engine/seeded.py",
+        # a per-request metric name: one live series per request id,
+        # unbounded — the snapshot grows with traffic forever
+        "class Serve:\n"
+        "    def drain(self, batch):\n"
+        "        for req in batch:\n"
+        "            self.metrics.counter(\n"
+        "                f'req_total{{id=\"{req.rid}\"}}', 'per-request'\n"
+        "            ).inc()\n",
+        # the known-clean shape: a bounded source, marked with the reason
+        "class Serve:\n"
+        "    def register_all(self, tenant_ids):\n"
+        "        for tid in tenant_ids:\n"
+        "            self.metrics.counter(  # cardinality-ok: seeded bounded tenant fleet\n"
+        "                f'tenant_requests_total{{tenant=\"{tid}\"}}',\n"
+        "                'per-tenant',\n"
+        "            ).inc()\n",
+    ),
 }
 
 # The PR-6 scope-extension pins: the engine host-sync and hot-path I/O

@@ -1,0 +1,696 @@
+"""Observability control-plane tests: the correlated event timeline
+(obs/timeline.py), the SLO burn-rate engine (obs/slo.py), the flight
+recorder (obs/flight.py), the EWMA gauge decay the escalation/divergence
+feeds ride on, and the engine/scheduler correlation contract — every
+event line carries ``request_id`` or ``cause_id``.
+"""
+
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from matvec_mpi_multiplier_tpu import MatvecEngine, make_mesh
+from matvec_mpi_multiplier_tpu.engine import ArrivalWindowScheduler
+from matvec_mpi_multiplier_tpu.obs import (
+    DEFAULT_TARGETS,
+    FAILURE_KINDS,
+    EwmaGauge,
+    FlightRecorder,
+    MetricsRegistry,
+    SloMonitor,
+    SloTarget,
+    TimelineHub,
+    bind_request,
+    bound_request_id,
+    get_hub,
+    next_request_id,
+    related_events,
+    reset_hub,
+)
+from matvec_mpi_multiplier_tpu.obs.__main__ import (
+    load_events,
+    main as obs_main,
+    render_dump,
+    render_slo,
+    render_timeline,
+)
+from matvec_mpi_multiplier_tpu.obs.slo import WINDOWS_S
+
+
+@pytest.fixture(autouse=True)
+def fresh_hub():
+    """Each test gets a clean process hub (the engine and schedulers
+    emit into the process default)."""
+    hub = reset_hub()
+    yield hub
+    reset_hub()
+
+
+# ---------------------------------------------------------------- timeline
+
+
+def test_emit_adopts_bound_request_id():
+    hub = TimelineHub()
+    with bind_request(41):
+        ev = hub.emit("retry", attempt=1)
+    assert ev["request_id"] == 41
+    assert ev["attempt"] == 1
+    # Outside the binding nothing is adopted.
+    assert "request_id" not in hub.emit("retry", attempt=2)
+
+
+def test_explicit_cause_id_suppresses_auto_bind():
+    """A background consequence (eviction under a bound admission) must
+    record cause_id only — it is not the foreground request."""
+    hub = TimelineHub()
+    with bind_request(7):
+        ev = hub.emit("swap_out", cause_id=bound_request_id(), tenant="b")
+    assert ev["cause_id"] == 7
+    assert "request_id" not in ev
+
+
+def test_bind_request_nests_and_none_passes_through():
+    assert bound_request_id() is None
+    with bind_request(1):
+        assert bound_request_id() == 1
+        with bind_request(2):
+            assert bound_request_id() == 2
+        assert bound_request_id() == 1
+        with bind_request(None):  # passthrough, not an unbind
+            assert bound_request_id() == 1
+    assert bound_request_id() is None
+
+
+def test_bindings_are_thread_local():
+    seen = {}
+
+    def work():
+        seen["other"] = bound_request_id()
+
+    with bind_request(9):
+        t = threading.Thread(target=work)
+        t.start()
+        t.join()
+    assert seen["other"] is None
+
+
+def test_ring_capacity_bounds_memory_but_counts_everything():
+    hub = TimelineHub(capacity=4)
+    for i in range(10):
+        hub.emit("submit", request_id=i)
+    events = hub.events()
+    assert len(events) == 4
+    assert [e["request_id"] for e in events] == [6, 7, 8, 9]
+    assert hub.emitted == 10
+    with pytest.raises(ValueError):
+        TimelineHub(capacity=0)
+
+
+def test_next_request_id_unique_across_threads():
+    out = []
+
+    def grab():
+        out.extend(next_request_id() for _ in range(200))
+
+    threads = [threading.Thread(target=grab) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(set(out)) == len(out)
+
+
+def test_related_events_one_hop_batch_expansion():
+    """A member's timeline pulls in the batch it rode in AND everything
+    that happened to that batch (retries under the batch id)."""
+    hub = TimelineHub()
+    hub.emit("submit", request_id=1)
+    hub.emit("coalesce", request_id=50, members=[1, 2, 3], width=3)
+    hub.emit("retry", request_id=50, attempt=1)
+    hub.emit("submit", request_id=4)          # unrelated
+    hub.emit("swap_out", cause_id=1, tenant="b")  # consequence of 1
+    got = related_events(hub.events(), 1)
+    kinds = [e["kind"] for e in got]
+    assert kinds == ["submit", "coalesce", "retry", "swap_out"]
+    # The unrelated request sees only itself.
+    assert [e["kind"] for e in related_events(hub.events(), 4)] == ["submit"]
+
+
+def test_hub_subscriber_sees_every_event():
+    hub = TimelineHub()
+    seen = []
+    hub.subscribe(seen.append)
+    hub.emit("submit", request_id=1)
+    hub.emit("retry", request_id=1)
+    assert [e["kind"] for e in seen] == ["submit", "retry"]
+
+
+def test_hub_sink_receives_events(tmp_path):
+    from matvec_mpi_multiplier_tpu.obs import JsonlSink
+
+    path = tmp_path / "events.jsonl"
+    hub = TimelineHub(sink=JsonlSink(path))
+    hub.emit("submit", request_id=3, cols=2)
+    assert hub.flush()
+    hub.close()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines[0]["kind"] == "submit" and lines[0]["request_id"] == 3
+
+
+def test_failure_kinds_vocabulary_is_the_flight_trigger_set():
+    # The contract other layers emit against: a typo here silently
+    # disables auto-dumps, so pin the exact set.
+    assert FAILURE_KINDS == {
+        "breaker_open", "solver_diverged", "batch_failure",
+        "isolated_failure", "integrity_refused", "deadline_failed",
+        "dispatch_failed",
+    }
+
+
+# -------------------------------------------------------------- EWMA gauge
+
+
+def test_ewma_gauge_burst_is_plain_mean():
+    g = EwmaGauge("e", tau_s=60.0, clock=lambda: 0.0)
+    for x in (1.0, 0.0, 0.0, 1.0):
+        g.observe(x, now=100.0)
+    assert g.value == pytest.approx(0.5)
+    assert g.count == 4
+
+
+def test_ewma_gauge_decay_pinned_on_fake_clock():
+    """The satellite contract: ε tracks RECENT traffic. One observation
+    of 1.0, then one of 0.0 exactly tau later, must read
+    e^-1/(e^-1 + 1) — the closed form of the two-point decayed mean —
+    and after 5 tau of clean traffic the old regime is <1%."""
+    g = EwmaGauge("e", tau_s=10.0)
+    g.observe(1.0, now=0.0)
+    g.observe(0.0, now=10.0)
+    w = math.exp(-1.0)
+    assert g.value == pytest.approx(w / (w + 1.0))
+    # 5 tau of contrary evidence: lifetime ratio would still read ~0.5
+    # over 2 observations; the EWMA must be under 1%.
+    g2 = EwmaGauge("e2", tau_s=10.0)
+    g2.observe(1.0, now=0.0)
+    g2.observe(0.0, now=50.0)
+    assert g2.value < 0.01
+
+
+def test_ewma_gauge_idle_stable():
+    """Silence is 'no new evidence', not 'the rate fell': the value
+    holds over a quiet period because num and den decay together."""
+    g = EwmaGauge("e", tau_s=10.0)
+    g.observe(1.0, now=0.0)
+    g.observe(1.0, now=1.0)
+    before = g.value
+    g.observe(1.0, now=500.0)  # one observation after a long idle
+    assert g.value == pytest.approx(before) == pytest.approx(1.0)
+
+
+def test_ewma_gauge_exports_as_gauge_in_snapshot():
+    reg = MetricsRegistry()
+    clock = {"t": 0.0}
+    g = reg.ewma_gauge("engine_escalation_rate", tau_s=60.0,
+                       clock=lambda: clock["t"])
+    assert reg.ewma_gauge("engine_escalation_rate") is g  # get-or-create
+    g.observe(1.0)
+    g.observe(0.0)
+    snap = reg.snapshot()
+    assert snap["gauges"]["engine_escalation_rate"] == pytest.approx(0.5)
+
+
+def test_cost_model_adopts_recent_escalation_rate():
+    """refresh_escalation_rate reads the EWMA gauge: after a heavy
+    escalation burst followed by 5 tau of clean speculative traffic, the
+    adopted ε reflects the clean regime, not the lifetime ratio."""
+    from matvec_mpi_multiplier_tpu.tuning.cost_model import (
+        Calibration,
+        CostModel,
+    )
+
+    reg = MetricsRegistry()
+    clock = {"t": 0.0}
+    reg.counter("engine_speculative_dispatches_total").inc(40)
+    g = reg.ewma_gauge(
+        "engine_escalation_rate", tau_s=60.0, clock=lambda: clock["t"]
+    )
+    for _ in range(20):
+        g.observe(1.0)          # t=0: escalation storm (lifetime 50%)
+    clock["t"] = 300.0          # 5 tau later
+    for _ in range(20):
+        g.observe(0.0)          # clean regime
+    cm = CostModel(Calibration(
+        flops=8e10, mem_bps=2e10,
+        alpha_s={"collective": 5e-4}, beta_bps={"collective": 7e8},
+        p=8, level="full", probes={},
+    ))
+    rate = cm.refresh_escalation_rate(reg)
+    assert rate == cm.escalation_rate < 0.01
+
+
+# --------------------------------------------------------------------- SLO
+
+
+def make_monitor(clock, targets=None):
+    reg = MetricsRegistry()
+    total = reg.counter("serve_requests_total")
+    bad = reg.counter("serve_failed_requests_total")
+    mon = SloMonitor(
+        reg,
+        targets or (SloTarget(
+            name="availability", kind="availability", objective=0.999,
+            total=("serve_requests_total",),
+            bad=("serve_failed_requests_total",),
+        ),),
+        clock=lambda: clock["t"],
+    )
+    return reg, total, bad, mon
+
+
+def run_history(clock, total, bad, mon, *, until, step, rps, fail_frac):
+    while clock["t"] < until:
+        clock["t"] += step
+        n = int(rps * step)
+        total.inc(n)
+        bad.inc(int(n * fail_frac))
+        mon.sample()
+
+
+def test_burn_rate_page_fires_on_both_fast_windows():
+    """6 h of clean traffic then a hard failure burst: burn >> 14.4 on
+    both 5 m and 1 h -> page (and the slow pair also breaches here)."""
+    clock = {"t": 0.0}
+    _, total, bad, mon = make_monitor(clock)
+    run_history(clock, total, bad, mon,
+                until=6 * 3600, step=60, rps=10, fail_frac=0.0)
+    ev = mon.evaluate()
+    assert ev["targets"]["availability"]["status"] == "ok"
+    assert ev["alerts"] == []
+    # 10 minutes at 50% failure: error fraction ~0.5 over 5m, budget
+    # 0.001 -> burn ~500 on the fast pair.
+    run_history(clock, total, bad, mon,
+                until=6 * 3600 + 600, step=60, rps=10, fail_frac=0.5)
+    ev = mon.evaluate()
+    t = ev["targets"]["availability"]
+    assert t["status"] == "page"
+    severities = {a["severity"] for a in ev["alerts"]}
+    assert "page" in severities
+    page = next(a for a in ev["alerts"] if a["severity"] == "page")
+    assert page["burn_short"] > 14.4 and page["burn_long"] > 14.4
+
+
+def test_burn_rate_blip_does_not_page():
+    """One bad minute in an hour of clean traffic: the 5 m window
+    breaches but the 1 h window filters it — no page."""
+    clock = {"t": 0.0}
+    _, total, bad, mon = make_monitor(clock)
+    run_history(clock, total, bad, mon,
+                until=3600, step=60, rps=10, fail_frac=0.0)
+    run_history(clock, total, bad, mon,
+                until=3660, step=60, rps=10, fail_frac=0.5)
+    ev = mon.evaluate()
+    t = ev["targets"]["availability"]
+    assert t["burn"]["5m"] > 14.4          # the blip is visible...
+    assert t["burn"]["1h"] < 14.4          # ...but the long window vetoes
+    assert not any(a["severity"] == "page" for a in ev["alerts"])
+
+
+def test_burn_rate_ticket_without_page():
+    """A slow sustained leak: ~1% failures burns ~10x budget on 1 h and
+    6 h (ticket pair) but the incident ended >5 m ago, so the fast pair
+    stays quiet — exactly the 'ticket, not page' regime."""
+    clock = {"t": 0.0}
+    _, total, bad, mon = make_monitor(clock)
+    run_history(clock, total, bad, mon,
+                until=5 * 3600, step=60, rps=10, fail_frac=0.01)
+    # Ten clean minutes: the 5 m window recovers, the long windows still
+    # carry the leak.
+    run_history(clock, total, bad, mon,
+                until=5 * 3600 + 600, step=60, rps=10, fail_frac=0.0)
+    ev = mon.evaluate()
+    t = ev["targets"]["availability"]
+    assert t["status"] == "ticket"
+    assert {a["severity"] for a in ev["alerts"]} == {"ticket"}
+
+
+def test_slo_no_data_and_gauge_export():
+    clock = {"t": 0.0}
+    reg, total, bad, mon = make_monitor(clock)
+    ev = mon.evaluate()
+    assert ev["targets"]["availability"]["status"] == "no_data"
+    snap = reg.snapshot()
+    assert snap["gauges"]["slo_availability_alert"] == -1.0
+    # After traffic the alert gauge goes to 0 and burn gauges exist for
+    # every declared window.
+    run_history(clock, total, bad, mon,
+                until=600, step=60, rps=10, fail_frac=0.0)
+    mon.evaluate()
+    snap = reg.snapshot()
+    assert snap["gauges"]["slo_availability_alert"] == 0.0
+    for w in WINDOWS_S:
+        assert f"slo_availability_burn_{w}" in snap["gauges"]
+
+
+def test_threshold_slo_breach_fraction():
+    """Threshold kind: error fraction = fraction of samples in breach,
+    against the declared time-in-breach budget."""
+    clock = {"t": 0.0}
+    reg = MetricsRegistry()
+    g = reg.gauge("engine_escalation_rate")
+    mon = SloMonitor(
+        reg,
+        (SloTarget(
+            name="escalation", kind="threshold", objective=0.05,
+            source="engine_escalation_rate", budget=0.1,
+        ),),
+        clock=lambda: clock["t"],
+    )
+    for i in range(10):
+        clock["t"] += 30.0
+        g.set(0.5 if i >= 5 else 0.0)   # half the samples in breach
+        mon.sample()
+    ev = mon.evaluate()
+    t = ev["targets"]["escalation"]
+    assert t["value"] == 0.5
+    assert t["errors"]["5m"] == pytest.approx(0.5)
+    assert t["burn"]["5m"] == pytest.approx(5.0)
+
+
+def test_threshold_slo_histogram_percentile_source():
+    clock = {"t": 600.0}
+    reg = MetricsRegistry()
+    h = reg.histogram("serve_e2e_latency_ms")
+    for v in (1.0, 2.0, 100.0):
+        h.observe(v)
+    mon = SloMonitor(
+        reg,
+        (SloTarget(
+            name="p99", kind="threshold", objective=50.0,
+            source="serve_e2e_latency_ms", percentile=99, budget=0.05,
+        ),),
+        clock=lambda: clock["t"],
+    )
+    mon.sample()
+    ev = mon.evaluate()
+    assert ev["targets"]["p99"]["value"] > 50.0
+    assert ev["targets"]["p99"]["errors"]["5m"] == 1.0
+
+
+def test_slo_target_validation():
+    with pytest.raises(ValueError):
+        SloTarget(name="x", kind="availability", objective=1.5,
+                  total=("t",), bad=("b",))
+    with pytest.raises(ValueError):
+        SloTarget(name="x", kind="availability", objective=0.99)
+    with pytest.raises(ValueError):
+        SloTarget(name="x", kind="threshold", objective=1.0)
+    with pytest.raises(ValueError):
+        SloTarget(name="x", kind="nonsense", objective=0.5)
+    with pytest.raises(ValueError):
+        SloMonitor(MetricsRegistry(), (DEFAULT_TARGETS[0],) * 2)
+
+
+def test_engine_health_reports_slo():
+    rng = np.random.default_rng(0)
+    mesh = make_mesh(4)
+    a = rng.uniform(0, 10, (32, 32)).astype(np.float32)
+    engine = MatvecEngine(a, mesh, strategy="rowwise", max_bucket=4)
+    engine.submit(rng.uniform(0, 10, 32).astype(np.float32)).result()
+    health = engine.health()
+    slo = health["slo"]
+    assert slo["targets"]["engine_availability"]["status"] in (
+        "ok", "no_data"
+    )
+    # The slo_* gauges land in the engine's own registry, under the
+    # engine_-prefixed names so a serve monitor sharing the registry
+    # never collides with them.
+    assert (
+        "slo_engine_availability_alert"
+        in engine.metrics.snapshot()["gauges"]
+    )
+
+
+# --------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_auto_dumps_on_failure_kind(tmp_path):
+    hub = TimelineHub()
+    reg = MetricsRegistry()
+    reg.counter("engine_requests_total").inc(3)
+    rec = FlightRecorder(hub, reg, dump_dir=tmp_path)
+    hub.emit("submit", request_id=1)
+    hub.emit("retry", request_id=1, attempt=1)   # not a failure kind
+    hub.emit("breaker_open", request_id=1, key="k")
+    rec.close()  # drains the pending auto-dump
+    dumps = rec.dumped
+    assert len(dumps) == 1
+    assert dumps[0].name.endswith("breaker_open.json")
+    bundle = json.loads(dumps[0].read_text())
+    assert bundle["trigger"]["kind"] == "breaker_open"
+    assert [e["kind"] for e in bundle["events"]] == [
+        "submit", "retry", "breaker_open",
+    ]
+    assert bundle["metrics"]["counters"]["engine_requests_total"] == 3
+
+
+def test_flight_recorder_rate_limits_and_caps(tmp_path):
+    clock = {"t": 0.0}
+    hub = TimelineHub()
+    rec = FlightRecorder(
+        hub, dump_dir=tmp_path, max_dumps=2, min_interval_s=10.0,
+        clock=lambda: clock["t"],
+    )
+    hub.emit("dispatch_failed", request_id=1)
+    hub.emit("dispatch_failed", request_id=2)  # inside min_interval
+    rec.close()
+    assert len(rec.dumped) == 1  # the storm collapsed to one bundle
+    clock["t"] = 100.0
+    rec2 = FlightRecorder(
+        hub, dump_dir=tmp_path, max_dumps=2, min_interval_s=0.0,
+        clock=lambda: clock["t"],
+    )
+    for i in range(5):
+        clock["t"] += 1.0
+        hub.emit("dispatch_failed", request_id=10 + i)
+    rec2.close()
+    assert len(rec2.dumped) == 2  # max_dumps cap
+
+
+def test_flight_recorder_manual_dump_and_bundle(tmp_path):
+    clock = {"t": 0.0}
+    hub = TimelineHub()
+    reg = MetricsRegistry()
+    mon = SloMonitor(reg, DEFAULT_TARGETS, clock=lambda: clock["t"])
+    rec = FlightRecorder(hub, reg, slo=mon, auto_dump=False,
+                         capacity=3, snapshots=2)
+    for i in range(5):
+        hub.emit("submit", request_id=i)
+    rec.snapshot_metrics(now=1.0)
+    rec.snapshot_metrics(now=2.0)
+    rec.snapshot_metrics(now=3.0)
+    with pytest.raises(ValueError):
+        rec.dump()  # no path, no dump_dir
+    out = rec.dump(tmp_path / "manual.json")
+    bundle = json.loads(out.read_text())
+    assert len(bundle["events"]) == 3          # ring capacity
+    assert len(bundle["metric_snapshots"]) == 2  # snapshot cap
+    assert bundle["trigger"] is None
+    assert "slo" in bundle and "targets" in bundle["slo"]
+
+
+def test_flight_recorder_survives_unwritable_dump_dir(tmp_path):
+    target = tmp_path / "blocked"
+    target.write_text("a file, not a directory")
+    hub = TimelineHub()
+    rec = FlightRecorder(hub, dump_dir=target / "sub")
+    hub.emit("dispatch_failed", request_id=1)
+    rec.close()  # writer must not die on the OSError
+    assert rec.dumped == []
+    assert hub.events()  # the ring kept recording
+
+
+# ------------------------------------------------- correlation integration
+
+
+def make_engine(rng, **kwargs):
+    mesh = make_mesh(8)
+    a = rng.uniform(0, 10, (64, 64)).astype(np.float32)
+    kwargs.setdefault("strategy", "rowwise")
+    kwargs.setdefault("max_bucket", 8)
+    return MatvecEngine(a, mesh, **kwargs)
+
+
+def test_every_engine_event_carries_a_correlation_id(devices, rng, fresh_hub):
+    engine = make_engine(rng)
+    X = rng.uniform(0, 10, (64, 4)).astype(np.float32)
+    engine.submit(X[:, 0]).result()
+    engine.submit(X).result()
+    events = fresh_hub.events()
+    assert events, "engine emitted nothing"
+    for ev in events:
+        assert "request_id" in ev or "cause_id" in ev, ev
+    submits = [e for e in events if e["kind"] == "submit"]
+    ids = [e["request_id"] for e in submits]
+    assert len(set(ids)) == len(ids) == 2
+
+
+def test_engine_trace_and_timeline_share_ids(devices, rng, tmp_path, fresh_hub):
+    engine = make_engine(rng, trace_jsonl=str(tmp_path / "trace.jsonl"))
+    x = rng.uniform(0, 10, 64).astype(np.float32)
+    engine.submit(x).result()
+    engine.flush_traces()
+    trace_ids = {
+        json.loads(ln)["request_id"]
+        for ln in (tmp_path / "trace.jsonl").read_text().splitlines()
+    }
+    timeline_ids = {
+        e["request_id"] for e in fresh_hub.events() if "request_id" in e
+    }
+    assert trace_ids <= timeline_ids
+
+
+def test_coalesced_batch_links_members(devices, rng, fresh_hub):
+    """The scheduler's flush event carries members=[...], and a member's
+    related_events pulls in the batch submit."""
+    engine = make_engine(rng, promote=4)
+    sched = ArrivalWindowScheduler(engine, window_ms=50.0)
+    try:
+        xs = [rng.uniform(0, 10, 64).astype(np.float32) for _ in range(3)]
+        futs = [sched.submit(x) for x in xs]
+        sched.flush()
+        for f in futs:
+            f.result()
+    finally:
+        sched.close()
+    events = fresh_hub.events()
+    for ev in events:
+        assert "request_id" in ev or "cause_id" in ev, ev
+    batches = [e for e in events if e.get("members")]
+    assert batches, "no batch event carried members"
+    member = batches[0]["members"][0]
+    kinds = {e["kind"] for e in related_events(events, member)}
+    assert "submit" in kinds
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def make_event_file(tmp_path):
+    events = [
+        {"seq": 0, "t_s": 100.0, "kind": "submit", "request_id": 1,
+         "cols": 1},
+        {"seq": 1, "t_s": 100.1, "kind": "coalesce", "request_id": 9,
+         "members": [1, 2], "width": 2},
+        {"seq": 2, "t_s": 100.2, "kind": "dispatch_failed",
+         "request_id": 9, "fault": "DeviceFaultError"},
+        {"seq": 3, "t_s": 100.3, "kind": "swap_out", "cause_id": 1,
+         "tenant": "b"},
+        {"seq": 4, "t_s": 100.4, "kind": "submit", "request_id": 3},
+    ]
+    path = tmp_path / "events.jsonl"
+    path.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return path, events
+
+
+def test_load_events_jsonl_and_bundle(tmp_path):
+    path, events = make_event_file(tmp_path)
+    assert load_events(path) == events
+    bundle = tmp_path / "bundle.json"
+    bundle.write_text(json.dumps({"trigger": None, "events": events}))
+    assert load_events(bundle) == events
+
+
+def test_render_timeline_reconstructs_one_request(tmp_path):
+    _, events = make_event_file(tmp_path)
+    out = render_timeline(events, 1)
+    assert "request 1" in out
+    assert "1 failure" in out
+    for kind in ("submit", "coalesce", "dispatch_failed", "swap_out"):
+        assert kind in out
+    assert "request_id=3" not in out  # unrelated request excluded
+    # --since drops the early events but keeps the id header.
+    out_since = render_timeline(events, 1, since=100.15)
+    assert "submit" not in out_since.split("\n", 1)[1]
+    assert "dispatch_failed" in out_since
+
+
+def test_obs_timeline_cli(tmp_path, capsys):
+    path, _ = make_event_file(tmp_path)
+    assert obs_main(["timeline", str(path), "1"]) == 0
+    out = capsys.readouterr().out
+    assert "dispatch_failed" in out
+    assert obs_main(["timeline", str(path), "777"]) == 1  # unknown id
+
+
+def test_render_slo_panel_shows_alerts():
+    clock = {"t": 0.0}
+    _, total, bad, mon = make_monitor(clock)
+    run_history(clock, total, bad, mon,
+                until=6 * 3600, step=60, rps=10, fail_frac=0.0)
+    run_history(clock, total, bad, mon,
+                until=6 * 3600 + 600, step=60, rps=10, fail_frac=0.5)
+    out = render_slo(mon.evaluate())
+    assert "[page]" in out
+    assert "ALERT" in out
+    assert "error budget" in out
+
+
+def test_obs_slo_and_dump_cli(tmp_path, capsys):
+    clock = {"t": 0.0}
+    _, total, bad, mon = make_monitor(clock)
+    run_history(clock, total, bad, mon,
+                until=600, step=60, rps=10, fail_frac=0.0)
+    slo_path = tmp_path / "slo.json"
+    slo_path.write_text(json.dumps(mon.evaluate()))
+    assert obs_main(["slo", str(slo_path)]) == 0
+    assert "availability" in capsys.readouterr().out
+
+    hub = TimelineHub()
+    rec = FlightRecorder(hub, auto_dump=False)
+    hub.emit("submit", request_id=1)
+    hub.emit("breaker_open", request_id=1, key="k")
+    out = rec.dump(tmp_path / "bundle.json",
+                   trigger=hub.events()[-1])
+    assert obs_main(["dump", str(out)]) == 0
+    text = capsys.readouterr().out
+    assert "breaker_open" in text
+
+
+def test_render_dump_summarizes_bundle(tmp_path):
+    hub = TimelineHub()
+    reg = MetricsRegistry()
+    rec = FlightRecorder(hub, reg, auto_dump=False)
+    hub.emit("submit", request_id=1)
+    hub.emit("dispatch_failed", request_id=1, fault="DeviceFaultError")
+    out = render_dump(rec.bundle(trigger=hub.events()[-1]))
+    assert "dispatch_failed" in out
+    assert "submit" in out
+
+
+def test_obs_metrics_watch_iterations(tmp_path, capsys):
+    snap = MetricsRegistry().snapshot()
+    path = tmp_path / "metrics.json"
+    path.write_text(json.dumps(snap))
+    assert obs_main([
+        "metrics", str(path), "--watch", "0.01", "--watch-iterations", "2",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert out.count("\x1b[2J") == 2
+
+
+def test_obs_trace_since_filter(tmp_path, capsys):
+    span = {"name": "submit", "dur_ms": 1.0, "children": []}
+    records = [
+        {"request_id": 0, "ts": 10.0, "status": "ok", "dur_ms": 1.0,
+         "spans": [span]},
+        {"request_id": 1, "ts": 20.0, "status": "ok", "dur_ms": 1.0,
+         "spans": [span]},
+    ]
+    path = tmp_path / "trace.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    assert obs_main(["trace", str(path), "--since", "15"]) == 0
+    out = capsys.readouterr().out
+    assert "1 requests" in out or "1 request" in out
