@@ -8,54 +8,63 @@ blockwise) over a TPU device mesh, a most-square mesh-factorization layer, the
 timing protocol with CSV metrics, and SpeedUp/Efficiency analysis.
 
 See SURVEY.md (repo root) for the reference blueprint and file:line citations.
+
+The re-exports resolve lazily (PEP 562): importing the package does NOT
+import jax. ``python -m matvec_mpi_multiplier_tpu.staticcheck --rules``
+must stay a pure-AST pass at tier-1 speed, and running a submodule with
+``-m`` always executes the parent package first — an eager ``from
+.engine import ...`` here would tax every jax-free entry point with the
+full framework import.
 """
 
 from __future__ import annotations
 
-from .models import (
-    BlockwiseStrategy,
-    ColwiseStrategy,
-    MatvecStrategy,
-    RowwiseStrategy,
-    STRATEGIES,
-    available_strategies,
-    get_strategy,
-)
-from .engine import (
-    ArrivalWindowScheduler,
-    MatrixRegistry,
-    MatvecEngine,
-    TenantQuota,
-)
-from .models.gemm import available_gemm_strategies, build_gemm
-from .parallel.mesh import make_1d_mesh, make_mesh, mesh_grid_shape, most_square_factors
-from .utils import io
-from .utils.errors import ConfigError, DataFileError, MatvecError, ShardingError
+import importlib
 
 __version__ = "0.1.0"
 
-__all__ = [
-    "MatvecStrategy",
-    "RowwiseStrategy",
-    "ColwiseStrategy",
-    "BlockwiseStrategy",
-    "STRATEGIES",
-    "get_strategy",
-    "available_strategies",
-    "build_gemm",
-    "available_gemm_strategies",
-    "MatvecEngine",
-    "ArrivalWindowScheduler",
-    "MatrixRegistry",
-    "TenantQuota",
-    "make_mesh",
-    "make_1d_mesh",
-    "mesh_grid_shape",
-    "most_square_factors",
-    "io",
-    "MatvecError",
-    "ShardingError",
-    "DataFileError",
-    "ConfigError",
-    "__version__",
-]
+# Exported name -> (submodule, attr — None re-exports the module itself).
+_EXPORTS = {
+    "MatvecStrategy": (".models", "MatvecStrategy"),
+    "RowwiseStrategy": (".models", "RowwiseStrategy"),
+    "ColwiseStrategy": (".models", "ColwiseStrategy"),
+    "BlockwiseStrategy": (".models", "BlockwiseStrategy"),
+    "STRATEGIES": (".models", "STRATEGIES"),
+    "get_strategy": (".models", "get_strategy"),
+    "available_strategies": (".models", "available_strategies"),
+    "build_gemm": (".models.gemm", "build_gemm"),
+    "available_gemm_strategies": (".models.gemm", "available_gemm_strategies"),
+    "MatvecEngine": (".engine", "MatvecEngine"),
+    "ArrivalWindowScheduler": (".engine", "ArrivalWindowScheduler"),
+    "MatrixRegistry": (".engine", "MatrixRegistry"),
+    "TenantQuota": (".engine", "TenantQuota"),
+    "make_mesh": (".parallel.mesh", "make_mesh"),
+    "make_1d_mesh": (".parallel.mesh", "make_1d_mesh"),
+    "mesh_grid_shape": (".parallel.mesh", "mesh_grid_shape"),
+    "most_square_factors": (".parallel.mesh", "most_square_factors"),
+    "io": (".utils.io", None),
+    "MatvecError": (".utils.errors", "MatvecError"),
+    "ShardingError": (".utils.errors", "ShardingError"),
+    "DataFileError": (".utils.errors", "DataFileError"),
+    "ConfigError": (".utils.errors", "ConfigError"),
+}
+
+__all__ = [*_EXPORTS, "__version__"]
+
+
+def __getattr__(name: str):
+    try:
+        module, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    value = importlib.import_module(module, __name__)
+    if attr is not None:
+        value = getattr(value, attr)
+    globals()[name] = value  # cache: resolve each export once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

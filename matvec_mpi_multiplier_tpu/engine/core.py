@@ -504,10 +504,10 @@ class SolverFuture:
                 # the poison lands here so the refusal below catches it.
                 x = np.array(x)  # sync-ok: host-side writable copy
                 x[0] = np.nan
-            n_iters = int(self._res.n_iters)  # sync-ok: materialization
-            rnorm = float(self._res.residual_norm)  # sync-ok: materialization
-            value = float(self._res.value)  # sync-ok: materialization
-            converged = bool(self._res.converged)  # sync-ok: materialization
+            n_iters = int(self._res.n_iters)  # deliberate host materialization
+            rnorm = float(self._res.residual_norm)  # deliberate host materialization
+            value = float(self._res.value)  # deliberate host materialization
+            converged = bool(self._res.converged)  # deliberate host materialization
             if self._iter_hist is not None:
                 self._iter_hist.observe(n_iters)
             if self._residual_gauge is not None:
@@ -1462,7 +1462,7 @@ class MatvecEngine:
                 requantized=requant is not None,
                 bytes_moved=int(bytes_moved),
             )
-        self._notify_residency(delta, "reshard")  # callback-ok: fired after every engine lock is released (the PR 9 rule); the ledger reconciles, so ordering vs a racing placement is benign
+        self._notify_residency(delta, "reshard")  # fired after every engine lock is released (the PR 9 rule); the ledger reconciles, so ordering vs a racing placement is benign
         if warm_widths is not None:
             # The one-time destination-layout compile, off the hot path.
             self.warmup(widths=warm_widths)
@@ -1497,6 +1497,89 @@ class MatvecEngine:
             # byte-identical to pre-speculation, so existing shared
             # caches keep sharing.
         ) + ((SPECULATE, self._spec_probes) if self.speculative else ())  # unguarded-ok: stable config snapshot — the registry re-homes exec caches under its own lock only after reshard() returns, and taking _swap_lock here would invert the registry->engine lock order
+
+    def exec_keyspace(
+        self,
+        solver_ops: Sequence[str] = (),
+        *,
+        restart: int | None = None,
+        steps: int | None = None,
+    ) -> dict[str, list[str]]:
+        """The finite ExecKey space this engine can compile, classified by
+        WHEN each key may compile — built from the engine's own key
+        constructors (never a parallel re-derivation), so it is the
+        ground truth the static keyspace auditor
+        (``staticcheck/keyspace.py``) cross-checks its symbolic
+        enumeration against.
+
+        Classes (sorted ``ExecKey.label()`` lists):
+
+        - ``"warmup"`` — the exact set :meth:`warmup` (``widths=None``)
+          compiles, plus the preferred key of every DECLARED solver op
+          (a serve config that declares solver traffic warms those at
+          first submit — part of the warm phase by doctrine).
+        - ``"steady"`` — every key :meth:`submit`/:meth:`submit_solver`
+          routing can reach on the healthy path, computed by literally
+          evaluating the routing over every chunk width (a genuinely
+          different path from the warmup enumeration — that is what
+          makes ``steady ⊆ warmup`` a checkable invariant rather than a
+          tautology). ``compiles_steady == 0`` holds iff this is a
+          subset of ``"warmup"``.
+        - ``"fault_only"`` — degradation-ladder safe tiers reachable
+          only after a breaker trips (RESOURCE_EXHAUSTED bucket-halving
+          re-enters the ladder at ladder buckets, so it adds no keys
+          beyond these). Compiles here are fault-path, never steady.
+        """
+        restart = DEFAULT_RESTART if restart is None else int(restart)
+        steps = DEFAULT_STEPS if steps is None else int(steps)
+        for op in solver_ops:
+            if op not in SOLVER_OPS:
+                raise ConfigError(
+                    f"unknown solver op {op!r}; expected one of "
+                    f"{sorted(SOLVER_OPS)}"
+                )
+        with self._swap_lock:
+            warm: set[ExecKey] = {self._matvec_key_locked()}
+            if self.speculative:
+                warm.add(self._spec_matvec_key())
+            if self.b_star is not None:
+                for bucket in bucket_ladder(self.max_bucket):
+                    warm.add(self._gemm_key_locked(bucket))
+                    if self.speculative:
+                        warm.add(self._spec_gemm_key(bucket))
+            steady: set[ExecKey] = {self._matvec_key_locked()}
+            if self.speculative:
+                steady.add(self._spec_matvec_key())
+            if self.b_star is not None:
+                # submit() promotes any request with b >= b* to the block
+                # path and splits it into max_bucket chunks plus one
+                # remainder — so every width in 1..max_bucket is a
+                # reachable chunk, riding the bucket bucket_for() routes
+                # it to. Evaluate that routing exhaustively.
+                for width in range(1, self.max_bucket + 1):
+                    bucket = bucket_for(width, self.max_bucket)
+                    steady.add(self._gemm_key_locked(bucket))
+                    if self.speculative:
+                        steady.add(self._spec_gemm_key(bucket))
+            fault: set[ExecKey] = set()
+            for key, _ in self._matvec_levels_locked()[1:]:
+                fault.add(key)
+            if self.b_star is not None:
+                for bucket in bucket_ladder(self.max_bucket):
+                    for key, _ in self._gemm_levels_locked(bucket)[1:]:
+                        fault.add(key)
+            for op in solver_ops:
+                bucket = solver_bucket(op, restart=restart, steps=steps)
+                levels = self._solver_levels_locked(op, bucket, restart, steps)
+                warm.add(levels[0][0])
+                steady.add(levels[0][0])
+                for key, _ in levels[1:]:
+                    fault.add(key)
+        return {
+            "warmup": sorted(k.label() for k in warm),
+            "steady": sorted(k.label() for k in steady),
+            "fault_only": sorted(k.label() for k in fault - warm - steady),
+        }
 
     def prediction_config(self, b: int = 1, rtol: float | None = None) -> dict:
         """The cost model's view of one dispatch through this engine's
@@ -2093,7 +2176,7 @@ class MatvecEngine:
         self._reclaim()
         while len(self._outstanding) >= self.max_in_flight:
             oldest = self._outstanding.popleft()
-            if hasattr(oldest, "block_until_ready"):  # sync-ok: capability probe only, the wait is the next line
+            if hasattr(oldest, "block_until_ready"):  # capability probe only; the wait is the next line
                 oldest.block_until_ready()  # sync-ok: backpressure drain-oldest at the caller-set high-water mark
             self._c_drains.inc()
             self._reclaim()
@@ -2115,12 +2198,12 @@ class MatvecEngine:
         transparently here (a scheduler flush racing an eviction lands on
         a healed residency, not a crash)."""
         if key.storage == self.storage:
-            if self._a is None:  # unguarded-ok: self-heal probe; ensure_resident re-checks under _residency_lock and a lost race is a dropped buffer, not corruption
+            if self._a is None:  # self-heal probe; ensure_resident re-checks under _residency_lock, and a lost race drops a buffer, not correctness
                 # Transparent re-admission: enqueue-only, accounted, and
                 # bitwise-identical to the pre-eviction residency.
                 self.ensure_resident()  # lock-order-ok: phantom edge — the _locked convention assumes every own lock held, but every real caller of this dispatch tree holds only the _swap fence; callback-ok: the residency listener reconciles the registry ledger, which never re-enters engine locks, so firing here cannot deadlock
-            return self._a  # unguarded-ok: the dispatch captures its own reference; refcounted residency keeps a concurrently evicted buffer alive for this dispatch
-        if self._a_native is None:  # unguarded-ok: double-checked lazy placement — the decisive re-check runs under _residency_lock below
+            return self._a  # the dispatch captures its own reference; refcounted residency keeps a concurrently evicted buffer alive for this dispatch
+        if self._a_native is None:  # double-checked lazy placement — the decisive re-check runs under _residency_lock below
             while True:
                 # Same layout-epoch guard as ensure_resident: never
                 # install a pre-reshard-sharded safe tier over the
@@ -2138,7 +2221,7 @@ class MatvecEngine:
             self._notify_residency(  # callback-ok: the residency listener reconciles the registry ledger, which never re-enters engine locks, so firing here cannot deadlock
                 int(self._a_host.nbytes), "native_fallback"
             )
-        return self._a_native  # unguarded-ok: same refcounted-capture tolerance as the payload return above
+        return self._a_native  # same refcounted-capture tolerance as the payload return above
 
     def _get_traced(self, trace: ActiveTrace, key, builder):
         """Executable-cache lookup under its span, the hit|compile outcome

@@ -172,6 +172,14 @@ class ExecutableCache:
         its first compile)."""
         return self._fingerprints.get(key)
 
+    def keys(self) -> list[ExecKey]:
+        """The ExecKeys compiled so far (insertion order) — the live half
+        of the compile-surface story: the static keyspace audit
+        (``staticcheck/keyspace.py``) enumerates what MAY compile, this
+        lists what DID, and the cross-check test pins the first as a
+        superset of the second."""
+        return list(self._executables)
+
     def __len__(self) -> int:
         return len(self._executables)
 

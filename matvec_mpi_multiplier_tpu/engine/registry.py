@@ -454,9 +454,9 @@ class MatrixRegistry:
 
     # ---- registration ----
 
-    # cardinality-ok: per-tenant series are bounded by the registered
-    # fleet (register() validates ids, unregister removes demand), and
-    # label() escapes the values — the one sanctioned dynamic-name site.
+    # cardinality-ok: bounded per-tenant series — stale-ok: anticipatory; the exemption must survive a refactor that moves registration into a per-tenant loop
+    # (register() validates ids, unregister removes demand, and label()
+    # escapes the values — the one sanctioned dynamic-name site.)
 
     def _tenant_gauge(self, tenant_id: str, what: str, help_: str):
         return self.metrics.gauge(
@@ -956,8 +956,8 @@ class MatrixRegistry:
                 return None
             entry.resharding = True
         try:
-            # registry-ok: the engine migration (collective build +
-            # enqueue + commit) never runs under the registry lock.
+            # The engine migration (collective build + enqueue +
+            # commit) never runs under the registry lock.
             result = engine.reshard(strategy)
         finally:
             with self._lock:

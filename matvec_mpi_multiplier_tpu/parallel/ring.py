@@ -205,8 +205,7 @@ def ring_all_gather(x: Array, axis_name: str) -> Array:
 # Lint contract (scripts/tier1.sh, tests/test_lint.py): overlap schedule
 # bodies in this module and ops/pallas_collective.py must never issue an
 # un-chunked full-width collective — every collective here handles one
-# stage's sub-chunk. Deliberate exceptions carry an `# overlap-ok:` marker
-# with a reason.
+# stage's sub-chunk. Deliberate exceptions carry an `# overlap-ok: <reason>` marker. — stale-ok: syntax documentation, not an exemption
 
 
 def stage_ladder(m: int, p: int, ladder=(8, 4, 2, 1)) -> list[int]:

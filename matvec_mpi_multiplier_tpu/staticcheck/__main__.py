@@ -2,9 +2,10 @@
 
 Usage::
 
-    python -m matvec_mpi_multiplier_tpu.staticcheck            # rules + HLO audit
+    python -m matvec_mpi_multiplier_tpu.staticcheck            # rules + keyspace + HLO
     python -m matvec_mpi_multiplier_tpu.staticcheck --rules    # AST rules only, ~1 s
     python -m matvec_mpi_multiplier_tpu.staticcheck --lockgraph  # rules #13-#15 only
+    python -m matvec_mpi_multiplier_tpu.staticcheck --keyspace  # ExecKey-space audit
     python -m matvec_mpi_multiplier_tpu.staticcheck --hlo-audit  # schedule + memory
     python -m matvec_mpi_multiplier_tpu.staticcheck --memory-audit
     python -m matvec_mpi_multiplier_tpu.staticcheck --json
@@ -22,15 +23,24 @@ forces the virtual-device flags itself, so it works from any shell.
 ``--root`` points the rule layer at another corpus (the
 seeded-violation agreement test).
 
+``--keyspace`` runs the static ExecKey-space compile-surface audit
+(staticcheck/keyspace.py): a pure symbolic enumeration — no mesh, no
+lowering — checked against ``data/staticcheck/golden_keyspace.json``
+and the ``steady ⊆ warmup`` compile budget. ``--keyspace
+--write-golden`` blesses the keyspace golden alone; a bare
+``--write-golden`` blesses both it and the HLO schedule table.
+
 Exit status (distinct per failure class, worst-first):
 
 * ``0`` — clean
-* ``1`` — AST rule findings (incl. the lock-graph rules)
+* ``1`` — AST rule findings (incl. the lock-graph and value-flow rules)
 * ``2`` — usage/environment error
-* ``3`` — HLO-audit failures (schedule/bytes/dequant/donation/peak/
-  fingerprint — the tree violates an artifact invariant)
-* ``4`` — golden drift only (``hlo-golden``/``hlo-census`` — the tree
-  and the committed table disagree; re-bless or revert)
+* ``3`` — artifact-audit failures (schedule/bytes/dequant/donation/
+  peak/fingerprint, or ``keyspace-steady-unwarmed`` — the tree violates
+  an artifact invariant)
+* ``4`` — golden drift only (``hlo-golden``/``hlo-census``/
+  ``keyspace-golden`` — the tree and a committed table disagree;
+  re-bless or revert)
 """
 
 from __future__ import annotations
@@ -49,11 +59,14 @@ EXIT_DRIFT = 4
 
 def exit_status(findings) -> int:
     """The CLI's verdict for a findings list: rule findings dominate,
-    then hard HLO-audit failures, then golden drift (severity
-    ``"drift"``)."""
+    then hard artifact-audit failures (HLO + keyspace), then golden
+    drift (severity ``"drift"``)."""
     if not findings:
         return EXIT_CLEAN
-    if any(not f.rule.startswith("hlo-") for f in findings):
+    if any(
+        not (f.rule.startswith("hlo-") or f.rule.startswith("keyspace-"))
+        for f in findings
+    ):
         return EXIT_RULES
     if any(f.severity != "drift" for f in findings):
         return EXIT_HLO
@@ -106,6 +119,12 @@ def main(argv=None) -> int:
         "--lockgraph", action="store_true",
         help="run ONLY the lock-graph concurrency rules (#13-#15: "
         "lock-mixed-guard, lock-order-inversion, callback-under-lock)",
+    )
+    parser.add_argument(
+        "--keyspace", action="store_true",
+        help="run the static ExecKey-space compile-surface audit "
+        "(symbolic enumeration vs golden_keyspace.json + the "
+        "steady-subset-of-warmup compile budget; no device backend)",
     )
     parser.add_argument(
         "--hlo-audit", action="store_true",
@@ -163,14 +182,22 @@ def main(argv=None) -> int:
             return EXIT_USAGE
 
     explicit = (
-        args.rules or args.lockgraph or args.hlo_audit or args.memory_audit
+        args.rules or args.lockgraph or args.hlo_audit
+        or args.memory_audit or args.keyspace
     )
     run_rules_layer = args.rules or not explicit
     run_hlo_layer = args.hlo_audit or not explicit
     run_memory_only = args.memory_audit and not args.hlo_audit
+    run_keyspace_layer = args.keyspace or not explicit
     if args.write_golden:
-        run_hlo_layer = True
-        run_memory_only = False
+        # A bare --write-golden blesses every golden (schedule +
+        # keyspace); with an explicit layer flag it blesses only the
+        # layers that run — `--keyspace --write-golden` stays symbolic
+        # (no mesh, no lowering).
+        run_keyspace_layer = True
+        if args.hlo_audit or args.memory_audit or not explicit:
+            run_hlo_layer = True
+            run_memory_only = False
 
     findings = []
     if run_rules_layer or args.lockgraph:
@@ -180,6 +207,24 @@ def main(argv=None) -> int:
         if args.lockgraph and not run_rules_layer:
             selected = list(LOCKGRAPH_RULES) + (args.rule or [])
         findings.extend(run_rules(root=args.root, rules=selected))
+
+    if run_keyspace_layer:
+        from .keyspace import run_keyspace_audit, write_golden_keyspace
+
+        if args.write_golden:
+            try:
+                path = write_golden_keyspace()
+            except ValueError as e:
+                print(f"staticcheck: {e}", file=sys.stderr)
+                return EXIT_USAGE
+            print(
+                f"staticcheck: golden keyspace table written to {path}",
+                file=sys.stderr,
+            )
+        # Like the HLO audit, --root does not reach this layer: the
+        # enumerated keyspace and its golden are properties of THIS
+        # checkout's engine, not of an alternate lint corpus.
+        findings.extend(run_keyspace_audit())
 
     if run_hlo_layer or run_memory_only:
         _force_cpu_mesh()

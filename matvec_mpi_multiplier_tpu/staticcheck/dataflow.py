@@ -1,0 +1,933 @@
+"""Interprocedural value-flow engine for the retrace-hazard rules.
+
+The keyspace auditor (``keyspace.py``) proves the ENUMERATED compile
+surface is warm-covered; this engine hunts the code shapes that mint
+executables OUTSIDE the enumerated space — the jit-cache fragmenters the
+grep-shaped rules cannot see because they are properties of how values
+FLOW, not of single call sites:
+
+- #17 ``traced-python-branch`` — ``if``/``while``/``assert`` on a value
+  that reaches a traced body: every distinct value retraces (or raises
+  ``TracerBoolConversionError`` outright).
+- #18 ``weak-type-cache-split`` — a dtype-less Python literal flowing
+  into a jitted call: weak-type promotion keys a second executable for
+  the same shapes.
+- #19 ``unhashable-static-arg`` — a dict/list/lambda reaching a
+  ``jit``/``lower`` static position: ``TypeError: unhashable`` at the
+  first dispatch.
+- #20 ``host-sync-on-tracer`` — ``int()``/``float()``/``np.asarray``
+  applied to a traced value in engine/solver paths: a silent device
+  round-trip the ``# sync-ok`` grep lint can't see (it only knows
+  blocking METHOD names, not which VALUES are tracers).
+
+Like the lock-graph layer this is whole-program (the per-file rule
+checks share one cached analysis keyed on a content hash), jax-free
+(pure ``ast`` — it must run at tier-1 ``--rules`` speed), and
+deliberately shallow where precision would cost speed: taint is
+flow-insensitive within a function, propagated to a fixpoint across
+direct calls resolved by name (same module, ``self.`` methods, then a
+unique bare name anywhere in the corpus — the lockgraph resolution
+doctrine). Attribute reads that are static under trace
+(``.shape``/``.ndim``/``.dtype``/...) strip tracer taint, as do
+``len``/``isinstance``/``is`` — the idioms traced code legitimately
+branches on.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+from pathlib import Path
+from typing import Iterator
+
+from .corpus import SourceFile, iter_corpus, source_file
+
+_PKG = "matvec_mpi_multiplier_tpu"
+
+DATAFLOW_RULES = (
+    "traced-python-branch",
+    "weak-type-cache-split",
+    "unhashable-static-arg",
+    "host-sync-on-tracer",
+)
+
+# Taint facets.
+TRACED = "traced"      # value may be a jax tracer
+WEAK = "weak"          # dtype-less python scalar (weak-type promotion)
+UNHASH = "unhashable"  # dict/list/set/lambda/comprehension
+
+
+def dataflow_scope(rel: str) -> bool:
+    """The engine analyzes (and rules #17–#19 report over) the package —
+    tests/scripts drive engines from host code where these hazards are
+    the *caller's* business, not serving-path regressions."""
+    return rel.startswith(f"{_PKG}/")
+
+
+def sync_scope(rel: str) -> bool:
+    """Rule #20 reports over the engine/solver serving paths — the AOT
+    dispatch discipline those modules own."""
+    return rel.startswith(f"{_PKG}/engine/") or rel.startswith(
+        f"{_PKG}/solvers/"
+    )
+
+
+# jit entry points: the wrapped function's params become tracers and the
+# call result is a jitted binding (rules #18/#19 check its call sites).
+_JIT_NAMES = frozenset({"jax.jit", "jit", "jax.pjit", "pjit"})
+
+# Higher-order tracing entry points -> positions whose function argument
+# is traced. Matched on the alias-resolved dotted name; *suffix* matches
+# below catch the package's compat re-exports.
+_TRACED_HOF: dict[str, tuple[int, ...]] = {
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.scan": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.map": (0,),
+    "jax.vmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.jacfwd": (0,),
+    "jax.jacrev": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+}
+_TRACED_HOF_SUFFIXES: tuple[tuple[str, tuple[int, ...]], ...] = (
+    ("shard_map", (0,)),
+    ("pallas_call", (0,)),
+)
+
+# Attribute reads that are STATIC under trace — branching on them is the
+# legitimate idiom, so they strip tracer taint. ``block`` is the
+# quantized container's pytree AUX field (ops/quantize.py
+# tree_flatten): under shard_map/jit the leaves (q, scales) are
+# tracers but aux data stays a python int.
+_STATIC_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "itemsize", "weak_type", "sharding",
+    "aval", "nbytes", "block",
+})
+
+# Calls whose result is static regardless of argument taint.
+_STRIP_CALLS = frozenset({
+    "len", "isinstance", "issubclass", "hasattr", "type", "id", "callable",
+    "repr", "str", "format",
+})
+
+# Host-materialization calls: applied to a tracer they either sync or
+# fail; their results are host values (python scalars stay WEAK).
+_HOST_SYNC_CALLS = frozenset({
+    "int", "float", "bool", "complex",
+    "numpy.asarray", "numpy.array", "numpy.asanyarray",
+})
+_WEAK_RESULT_CALLS = frozenset({"int", "float", "round", "abs"})
+
+
+@dataclasses.dataclass
+class _Binding:
+    """A name bound to a jitted callable (``g = jax.jit(f, ...)`` or a
+    ``@jit``-decorated function) — the call-site contract rules #18/#19
+    check against."""
+
+    name: str
+    static_nums: tuple[int, ...] = ()
+    static_names: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class _Func:
+    """One analyzed function (or a file's module-level pseudo-function)."""
+
+    rel: str
+    qual: str
+    name: str
+    node: ast.AST           # FunctionDef / AsyncFunctionDef / Module
+    params: tuple[str, ...]
+    cls: str | None
+    static_params: set = dataclasses.field(default_factory=set)
+    traced_root: bool = False   # params are tracers (jit/HOF boundary)
+    ctx_traced: bool = False    # body may execute under trace
+    env: dict = dataclasses.field(default_factory=dict)
+    ret: frozenset = frozenset()
+    # Own-body node index, computed once at collect time: the fixpoint
+    # re-runs `_local_pass` several times per function, and re-walking
+    # the AST each pass dominated the build profile.
+    binds: list = dataclasses.field(default_factory=list)
+    sites: list = dataclasses.field(default_factory=list)
+
+    @property
+    def body(self) -> list:
+        return self.node.body
+
+
+_BIND_NODES = (
+    ast.Assign, ast.AnnAssign, ast.AugAssign, ast.For, ast.AsyncFor,
+    ast.With, ast.AsyncWith, ast.Return, ast.NamedExpr,
+)
+_SITE_NODES = (ast.If, ast.While, ast.Assert, ast.Call)
+_STMT_BEARING = (ast.stmt, ast.ExceptHandler, ast.match_case)
+
+
+def _walk_own(body: list) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function /
+    lambda bodies (those are separate ``_Func``s with their own taint
+    context). The guard is on the POPPED node, not the pushed child —
+    a def sitting directly in the statement list (or a module's
+    top-level defs) must not leak its locals into the enclosing env."""
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _const_static_nums(node: ast.expr | None) -> tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _const_static_names(node: ast.expr | None) -> tuple[str, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            elt.value for elt in node.elts
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+        )
+    return ()
+
+
+_UNRESOLVED = object()  # memo sentinel: "not computed yet" != "None"
+
+
+class Program:
+    """The whole-program taint analysis: built once per corpus content
+    hash, consumed by the per-file rule checks."""
+
+    def __init__(self, root: Path) -> None:
+        self.root = Path(root)
+        self.funcs: dict[tuple[str, str], _Func] = {}
+        self.by_file: dict[str, dict[str, _Func]] = {}
+        self.by_bare: dict[str, list[_Func]] = {}
+        self.by_method: dict[tuple[str, str], list[_Func]] = {}
+        self.by_method_name: dict[str, list[_Func]] = {}
+        self.by_node: dict[int, _Func] = {}
+        self.modules: dict[str, _Func] = {}
+        self.bindings: dict[tuple[str, str], _Binding] = {}
+        self.aliases: dict[str, dict[str, str]] = {}
+        self.findings: dict[str, dict[str, list]] = {
+            rule: {} for rule in DATAFLOW_RULES
+        }
+        self.callers: dict[tuple[str, str], set] = {}
+        self._dirty: set[tuple[str, str]] = set()
+        self._resolve_cache: dict[tuple[str, str | None, int], object] = {}
+        self._dotted_cache: dict[tuple[str, int], str | None] = {}
+        self._changed = False
+        self._build()
+
+    # ---- construction ----
+
+    def _build(self) -> None:
+        sources: list[SourceFile] = []
+        for path in iter_corpus(self.root):
+            rel = path.relative_to(self.root).as_posix()
+            if not dataflow_scope(rel):
+                continue
+            try:
+                sources.append(source_file(path, self.root))
+            except (SyntaxError, UnicodeDecodeError):
+                continue  # rules.py reports parse errors separately
+        for sf in sources:
+            self._collect(sf)
+        for sf in sources:
+            self._mark_traced(sf)
+        # Interprocedural fixpoint over a worklist: taint facets only
+        # ever GROW (a finite monotone lattice), so re-processing only
+        # functions whose inputs changed terminates — and keeps the
+        # whole-program pass at tier-1 --rules speed.
+        pending = list(self.funcs)
+        in_queue = set(pending)
+        rounds = 0
+        limit = 50 * max(1, len(self.funcs))
+        while pending and rounds < limit:
+            rounds += 1
+            key = pending.pop()
+            in_queue.discard(key)
+            fn = self.funcs[key]
+            self._seed(fn)
+            ret_before = fn.ret
+            ctx_before = fn.ctx_traced
+            for _ in range(4):
+                self._dirty.clear()
+                changed = self._local_pass(fn)
+                for dirty_key in self._dirty:
+                    if dirty_key != key and dirty_key not in in_queue:
+                        pending.append(dirty_key)
+                        in_queue.add(dirty_key)
+                if not changed:
+                    break
+            if fn.ret != ret_before or fn.ctx_traced != ctx_before:
+                for caller in self.callers.get(key, ()):
+                    if caller not in in_queue:
+                        pending.append(caller)
+                        in_queue.add(caller)
+        for fn in self.funcs.values():
+            self._check(fn)
+
+    def _collect(self, sf: SourceFile) -> None:
+        self.aliases[sf.rel] = dict(sf.aliases)
+        file_funcs: dict[str, _Func] = {}
+        module = _Func(
+            rel=sf.rel, qual="<module>", name="<module>", node=sf.tree,
+            params=(), cls=None,
+        )
+        self._index(module)
+        self.modules[sf.rel] = module
+        self.funcs[(sf.rel, "<module>")] = module
+        self.by_node[id(sf.tree)] = module
+
+        def visit(node: ast.AST, cls: str | None, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name, f"{prefix}{child.name}.")
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    qual = f"{prefix}{child.name}"
+                    params = tuple(
+                        a.arg for a in (
+                            child.args.posonlyargs + child.args.args
+                            + child.args.kwonlyargs
+                        )
+                    )
+                    fn = _Func(
+                        rel=sf.rel, qual=qual, name=child.name, node=child,
+                        params=params, cls=cls,
+                    )
+                    self._index(fn)
+                    self.funcs[(sf.rel, qual)] = fn
+                    self.by_node[id(child)] = fn
+                    file_funcs.setdefault(child.name, fn)
+                    self.by_bare.setdefault(child.name, []).append(fn)
+                    if cls is not None:
+                        self.by_method.setdefault(
+                            (cls, child.name), []
+                        ).append(fn)
+                        self.by_method_name.setdefault(
+                            child.name, []
+                        ).append(fn)
+                    visit(child, cls, f"{qual}.<locals>.")
+                elif isinstance(child, _STMT_BEARING):
+                    # Defs are statements; only statement-bearing nodes
+                    # (stmt bodies, except handlers, match cases) can
+                    # contain one. Expression subtrees hold at most
+                    # lambdas, which this collector never models — so
+                    # pruning them is exact, not an approximation.
+                    visit(child, cls, prefix)
+
+        visit(sf.tree, None, "")
+        self.by_file[sf.rel] = file_funcs
+
+    def _index(self, fn: _Func) -> None:
+        """One own-body walk, bucketing the nodes the taint pass
+        (``binds``) and the rule checks (``sites``) iterate."""
+        for node in _walk_own(fn.body):
+            if isinstance(node, _BIND_NODES):
+                fn.binds.append(node)
+            if isinstance(node, _SITE_NODES):
+                fn.sites.append(node)
+
+    def _dotted(self, rel: str, expr: ast.expr) -> str | None:
+        key = (rel, id(expr))
+        hit = self._dotted_cache.get(key, _UNRESOLVED)
+        if hit is not _UNRESOLVED:
+            return hit
+        parts: list[str] = []
+        node = expr
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            out = None
+        else:
+            aliases = self.aliases.get(rel, {})
+            parts.append(aliases.get(node.id, node.id))
+            out = ".".join(reversed(parts))
+        self._dotted_cache[key] = out
+        return out
+
+    def _hof_positions(self, dotted: str | None) -> tuple[int, ...] | None:
+        if dotted is None:
+            return None
+        if dotted in _JIT_NAMES:
+            return (0,)
+        hit = _TRACED_HOF.get(dotted)
+        if hit is not None:
+            return hit
+        for suffix, positions in _TRACED_HOF_SUFFIXES:
+            if dotted == suffix or dotted.endswith("." + suffix):
+                return positions
+        return None
+
+    def _resolve(
+        self, rel: str, cls: str | None, expr: ast.expr
+    ) -> _Func | None:
+        """Resolve a call target to an analyzed function: same-module
+        name, ``self.method`` (same class first), then a UNIQUE bare
+        name anywhere in the program. Memoized per call site — the
+        fixpoint re-evaluates expressions many times."""
+        key = (rel, cls, id(expr))
+        hit = self._resolve_cache.get(key, _UNRESOLVED)
+        if hit is not _UNRESOLVED:
+            return hit
+        out = self._resolve_uncached(rel, cls, expr)
+        self._resolve_cache[key] = out
+        return out
+
+    def _resolve_uncached(
+        self, rel: str, cls: str | None, expr: ast.expr
+    ) -> _Func | None:
+        if isinstance(expr, ast.Name):
+            fn = self.by_file.get(rel, {}).get(expr.id)
+            if fn is not None:
+                return fn
+            candidates = self.by_bare.get(expr.id, [])
+            if len(candidates) == 1:
+                return candidates[0]
+            return None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            if cls is not None:
+                same = [
+                    f for f in self.by_method.get((cls, expr.attr), [])
+                    if f.rel == rel
+                ]
+                if same:
+                    return same[0]
+            candidates = self.by_method_name.get(expr.attr, [])
+            if len(candidates) == 1:
+                return candidates[0]
+        return None
+
+    def _apply_static(self, fn: _Func, binding: _Binding) -> None:
+        params = [p for p in fn.params if p != "self"]
+        for i in binding.static_nums:
+            if 0 <= i < len(params):
+                fn.static_params.add(params[i])
+        fn.static_params.update(
+            n for n in binding.static_names if n in fn.params
+        )
+
+    def _mark_traced(self, sf: SourceFile) -> None:
+        rel = sf.rel
+        for node in sf.nodes(
+            ast.Assign, ast.Call, ast.FunctionDef, ast.AsyncFunctionDef
+        ):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                call = node.value
+                dotted = self._dotted(rel, call.func)
+                if dotted in _JIT_NAMES:
+                    binding = _Binding(
+                        name="?",
+                        static_nums=self._kw_nums(call),
+                        static_names=self._kw_names(call),
+                    )
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            binding.name = tgt.id
+                            self.bindings[(rel, tgt.id)] = binding
+                    if call.args:
+                        target = call.args[0]
+                        fn = self._resolve(rel, None, target)
+                        if fn is not None:
+                            fn.traced_root = fn.ctx_traced = True
+                            self._apply_static(fn, binding)
+            if isinstance(node, ast.Call):
+                positions = self._hof_positions(
+                    self._dotted(rel, node.func)
+                )
+                if positions is not None:
+                    for i in positions:
+                        if i < len(node.args):
+                            fn = self._resolve(rel, None, node.args[i])
+                            if fn is not None:
+                                fn.traced_root = fn.ctx_traced = True
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                for dec in node.decorator_list:
+                    binding = self._jit_decorator(rel, dec)
+                    if binding is None:
+                        continue
+                    fn = self.by_node.get(id(node))
+                    if fn is not None:
+                        fn.traced_root = fn.ctx_traced = True
+                        self._apply_static(fn, binding)
+                    binding.name = node.name
+                    self.bindings[(rel, node.name)] = binding
+
+    def _kw_nums(self, call: ast.Call) -> tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                return _const_static_nums(kw.value)
+        return ()
+
+    def _kw_names(self, call: ast.Call) -> tuple[str, ...]:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                return _const_static_names(kw.value)
+        return ()
+
+    def _jit_decorator(
+        self, rel: str, dec: ast.expr
+    ) -> _Binding | None:
+        dotted = self._dotted(rel, dec)
+        if dotted in _JIT_NAMES:
+            return _Binding(name="?")
+        if isinstance(dec, ast.Call):
+            inner = self._dotted(rel, dec.func)
+            if inner in _JIT_NAMES:
+                return _Binding(
+                    name="?", static_nums=self._kw_nums(dec),
+                    static_names=self._kw_names(dec),
+                )
+            if inner in ("functools.partial", "partial") and dec.args:
+                if self._dotted(rel, dec.args[0]) in _JIT_NAMES:
+                    return _Binding(
+                        name="?", static_nums=self._kw_nums(dec),
+                        static_names=self._kw_names(dec),
+                    )
+        return None
+
+    # ---- taint ----
+
+    def _seed(self, fn: _Func) -> None:
+        if fn.traced_root:
+            for p in fn.params:
+                if p == "self" or p in fn.static_params:
+                    continue
+                if TRACED not in fn.env.get(p, frozenset()):
+                    fn.env[p] = fn.env.get(p, frozenset()) | {TRACED}
+                    self._changed = True
+
+    def _merge(self, fn: _Func, name: str, taint: frozenset) -> bool:
+        old = fn.env.get(name, frozenset())
+        new = old | taint
+        if new != old:
+            fn.env[name] = new
+            return True
+        return False
+
+    def _bind(
+        self,
+        fn: _Func,
+        target: ast.expr,
+        taint: frozenset,
+        value: ast.expr | None = None,
+    ) -> bool:
+        changed = False
+        if isinstance(target, ast.Name):
+            changed |= self._merge(fn, target.id, taint)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if (
+                isinstance(value, (ast.Tuple, ast.List))
+                and len(value.elts) == len(target.elts)
+                and not any(
+                    isinstance(e, ast.Starred) for e in target.elts
+                )
+            ):
+                # `a, b = x, [y]` — element-wise, so the display's
+                # UNHASH lands only on the name actually bound to it.
+                for elt, velt in zip(target.elts, value.elts):
+                    changed |= self._bind(
+                        fn, elt, self._taint(fn, velt), velt
+                    )
+            else:
+                # Unpacking a container yields ELEMENTS — the
+                # container's own unhashability does not transfer.
+                for elt in target.elts:
+                    changed |= self._bind(fn, elt, taint - {UNHASH})
+        elif isinstance(target, ast.Starred):
+            changed |= self._bind(fn, target.value, taint)
+        return changed
+
+    def _taint(self, fn: _Func, node: ast.expr) -> frozenset:
+        if isinstance(node, ast.Name):
+            local = fn.env.get(node.id)
+            if local is not None:
+                return local
+            module = self.modules.get(fn.rel)
+            if module is not None and module is not fn:
+                return module.env.get(node.id, frozenset())
+            return frozenset()
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return frozenset()
+            if isinstance(node.value, (int, float, complex)):
+                return frozenset({WEAK})
+            return frozenset()
+        if isinstance(node, ast.Attribute):
+            base = self._taint(fn, node.value)
+            if node.attr in _STATIC_ATTRS:
+                return base - {TRACED, WEAK}
+            return base - {WEAK}
+        if isinstance(node, ast.Subscript):
+            # Indexing yields an ELEMENT: a tracer stays a tracer, but
+            # the container's unhashability does not ride along.
+            return self._taint(fn, node.value) - {UNHASH}
+        if isinstance(node, ast.BinOp):
+            # JAX weak-type promotion: weak ⊗ weak stays weak, but a
+            # weak scalar against a strong array yields a STRONG array
+            # — so WEAK survives only when BOTH sides carry it.
+            left = self._taint(fn, node.left)
+            right = self._taint(fn, node.right)
+            out = (left | right) - {WEAK}
+            if WEAK in left and WEAK in right:
+                out |= {WEAK}
+            return out
+        if isinstance(node, ast.UnaryOp):
+            return self._taint(fn, node.operand)
+        if isinstance(node, ast.BoolOp):
+            out: frozenset = frozenset()
+            for v in node.values:
+                out |= self._taint(fn, v)
+            return out
+        if isinstance(node, ast.Compare):
+            # A comparison's result is a bool (or a traced bool array)
+            # — never a weak literal or an unhashable container.
+            out = self._taint(fn, node.left)
+            for c in node.comparators:
+                out |= self._taint(fn, c)
+            out -= {WEAK, UNHASH}
+            if all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                out -= {TRACED}
+            return out
+        if isinstance(node, ast.Call):
+            return self._call_taint(fn, node)
+        if isinstance(node, ast.Tuple):
+            out = frozenset()
+            for elt in node.elts:
+                out |= self._taint(fn, elt)
+            return out
+        if isinstance(node, (ast.List, ast.Set)):
+            out = frozenset({UNHASH})
+            for elt in node.elts:
+                out |= self._taint(fn, elt)
+            return out
+        if isinstance(node, ast.Dict):
+            out = frozenset({UNHASH})
+            for v in node.values:
+                if v is not None:
+                    out |= self._taint(fn, v)
+            return out
+        if isinstance(node, ast.Lambda):
+            return frozenset({UNHASH})
+        if isinstance(
+            node,
+            (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+        ):
+            return frozenset({UNHASH})
+        if isinstance(node, ast.IfExp):
+            return self._taint(fn, node.body) | self._taint(fn, node.orelse)
+        if isinstance(node, ast.Starred):
+            return self._taint(fn, node.value)
+        if isinstance(node, ast.NamedExpr):
+            return self._taint(fn, node.value)
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            return frozenset()
+        return frozenset()
+
+    def _call_taint(self, fn: _Func, call: ast.Call) -> frozenset:
+        dotted = self._dotted(fn.rel, call.func)
+        arg_taints = [self._taint(fn, a) for a in call.args]
+        kw_taints = {
+            kw.arg: self._taint(fn, kw.value)
+            for kw in call.keywords if kw.arg is not None
+        }
+        merged: frozenset = frozenset()
+        for t in arg_taints:
+            merged |= t
+        for t in kw_taints.values():
+            merged |= t
+        if isinstance(call.func, ast.Attribute):
+            # Method calls: the receiver's taint rides the result
+            # (x.sum() of a tracer is a tracer).
+            merged |= self._taint(fn, call.func.value)
+        callee = self._resolve(fn.rel, fn.cls, call.func)
+        if callee is not None and callee is not fn:
+            ckey = (callee.rel, callee.qual)
+            self.callers.setdefault(ckey, set()).add((fn.rel, fn.qual))
+            changed = False
+            params = [p for p in callee.params if p != "self"]
+            for i, t in enumerate(arg_taints):
+                if i < len(params) and t:
+                    changed |= self._merge(callee, params[i], t)
+            for name, t in kw_taints.items():
+                if name in callee.params and t:
+                    changed |= self._merge(callee, name, t)
+            if fn.ctx_traced and not callee.ctx_traced:
+                callee.ctx_traced = True
+                changed = True
+            if changed:
+                self._dirty.add(ckey)
+            return callee.ret
+        if dotted in _STRIP_CALLS:
+            return frozenset()
+        if dotted in _HOST_SYNC_CALLS:
+            if dotted in _WEAK_RESULT_CALLS:
+                return frozenset({WEAK})
+            return frozenset()
+        # Unresolved call: tracer taint flows through (jnp/lax results
+        # of traced operands are traced); weak/unhashable do not (call
+        # results are not python literals or displays).
+        return frozenset({TRACED} if TRACED in merged else ())
+
+    def _local_pass(self, fn: _Func) -> bool:
+        changed = False
+        for node in fn.binds:
+            if isinstance(node, ast.Assign):
+                t = self._taint(fn, node.value)
+                for tgt in node.targets:
+                    changed |= self._bind(fn, tgt, t, node.value)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                changed |= self._bind(
+                    fn, node.target, self._taint(fn, node.value),
+                    node.value,
+                )
+            elif isinstance(node, ast.AugAssign):
+                t = self._taint(fn, node.value) | self._taint(
+                    fn, node.target
+                )
+                changed |= self._bind(fn, node.target, t)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                # Iteration yields ELEMENTS of the iterable — a traced
+                # element stays traced, list-ness does not transfer.
+                changed |= self._bind(
+                    fn, node.target,
+                    self._taint(fn, node.iter) - {UNHASH},
+                )
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        changed |= self._bind(
+                            fn, item.optional_vars,
+                            self._taint(fn, item.context_expr),
+                        )
+            elif isinstance(node, ast.Return) and node.value is not None:
+                new = fn.ret | self._taint(fn, node.value)
+                if new != fn.ret:
+                    fn.ret = new
+                    changed = True
+            elif isinstance(node, ast.NamedExpr):
+                changed |= self._bind(
+                    fn, node.target, self._taint(fn, node.value)
+                )
+        if changed:
+            self._changed = True
+        return changed
+
+    # ---- rule checks ----
+
+    def _emit(self, rule: str, fn: _Func, node: ast.AST, msg: str) -> None:
+        self.findings[rule].setdefault(fn.rel, []).append((node, msg))
+
+    def _static_positions(
+        self, binding: _Binding, call: ast.Call
+    ) -> Iterator[tuple[ast.expr, str]]:
+        for i, arg in enumerate(call.args):
+            if i in binding.static_nums:
+                yield arg, f"position {i}"
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in binding.static_names:
+                yield kw.value, f"argname {kw.arg!r}"
+
+    def _check(self, fn: _Func) -> None:
+        for node in fn.sites:
+            if fn.ctx_traced and isinstance(
+                node, (ast.If, ast.While, ast.Assert)
+            ):
+                test = node.test
+                if TRACED in self._taint(fn, test):
+                    kind = type(node).__name__.lower()
+                    self._emit(
+                        "traced-python-branch", fn, node,
+                        f"Python `{kind}` on a traced value inside a "
+                        f"trace context ({fn.qual}) — retraces per value "
+                        f"or raises TracerBoolConversionError; use "
+                        f"lax.cond/jnp.where or branch on static "
+                        f".shape/.ndim/.dtype",
+                    )
+            if not isinstance(node, ast.Call):
+                continue
+            call = node
+            dotted = self._dotted(fn.rel, call.func)
+            if (
+                fn.ctx_traced
+                and dotted in _HOST_SYNC_CALLS
+                and any(
+                    TRACED in self._taint(fn, a)
+                    for a in list(call.args)
+                    + [kw.value for kw in call.keywords if kw.arg]
+                )
+            ):
+                self._emit(
+                    "host-sync-on-tracer", fn, call,
+                    f"{dotted}() on a traced value inside a trace "
+                    f"context ({fn.qual}) — a silent device round-trip "
+                    f"that blocks dispatch; keep the value on device "
+                    f"(jnp.*) or hoist the conversion out of the traced "
+                    f"body",
+                )
+            binding = self._call_binding(fn, call)
+            if binding is None:
+                continue
+            static_args = dict(
+                (id(expr), where)
+                for expr, where in self._static_positions(binding, call)
+            )
+            for expr, where in self._static_positions(binding, call):
+                taint = self._taint(fn, expr)
+                if UNHASH in taint:
+                    self._emit(
+                        "unhashable-static-arg", fn, expr,
+                        f"unhashable value reaches static {where} of "
+                        f"jitted `{binding.name}` — jit static args are "
+                        f"cache keys and must be hashable; pass a tuple "
+                        f"or a frozen config object",
+                    )
+            for i, expr in enumerate(call.args):
+                if id(expr) in static_args:
+                    continue
+                self._check_weak(fn, binding, expr)
+            for kw in call.keywords:
+                if kw.arg is None or id(kw.value) in static_args:
+                    continue
+                self._check_weak(fn, binding, kw.value)
+
+    def _check_weak(
+        self, fn: _Func, binding: _Binding, expr: ast.expr
+    ) -> None:
+        taint = self._taint(fn, expr)
+        if WEAK in taint and TRACED not in taint:
+            self._emit(
+                "weak-type-cache-split", fn, expr,
+                f"dtype-less Python scalar flows into jitted "
+                f"`{binding.name}` — weak-type promotion mints a second "
+                f"executable for the same shapes; wrap it "
+                f"(jnp.float32(...)) or pass an array",
+            )
+
+    def _call_binding(self, fn: _Func, call: ast.Call) -> _Binding | None:
+        if isinstance(call.func, ast.Name):
+            binding = self.bindings.get((fn.rel, call.func.id))
+            if binding is not None:
+                return binding
+        if isinstance(call.func, ast.Call):
+            # Immediate dispatch: jax.jit(f, static_argnums=...)(args).
+            dotted = self._dotted(fn.rel, call.func.func)
+            if dotted in _JIT_NAMES:
+                return _Binding(
+                    name=self._dotted(fn.rel, call.func.args[0])
+                    if call.func.args else "<jitted>",
+                    static_nums=self._kw_nums(call.func),
+                    static_names=self._kw_names(call.func),
+                )
+        return None
+
+
+# ---- cache + rule registration (the lockgraph pattern) ----
+
+# root -> (generation, content signature, program).
+_CACHE: dict[str, tuple[int, tuple, Program]] = {}
+_GENERATION = [0]
+
+
+def new_generation() -> None:
+    """Invalidate the once-per-run corpus validation (rules.run_rules
+    calls this at entry; a direct ``analyze`` caller that mutates files
+    between calls must call it too)."""
+    _GENERATION[0] += 1
+
+
+def analyze(root: Path) -> Program:
+    """The corpus's value-flow program, rebuilt only when an in-scope
+    file's content changes, validated at most once per rule-engine run."""
+    root = Path(root)
+    key = str(root.resolve())
+    gen = _GENERATION[0]
+    cached = _CACHE.get(key)
+    if cached is not None and cached[0] == gen:
+        return cached[2]
+    sig = []
+    for path in iter_corpus(root):
+        rel = path.relative_to(root).as_posix()
+        if dataflow_scope(rel):
+            sig.append(
+                (rel, hashlib.sha1(path.read_bytes()).hexdigest())
+            )
+    sig_t = tuple(sig)
+    if cached is not None and cached[1] == sig_t:
+        program = cached[2]
+    else:
+        program = Program(root)
+    _CACHE[key] = (gen, sig_t, program)
+    return program
+
+
+def _check_for(rule: str):
+    def check(sf: SourceFile) -> Iterator[tuple[ast.AST, str]]:
+        yield from analyze(sf.root).findings[rule].get(sf.rel, [])
+
+    return check
+
+
+def register_dataflow_rules(register) -> None:
+    """Hook the four value-flow rules into the ordinary rule registry
+    (rules.py calls this before computing MARKERS)."""
+    register(
+        "traced-python-branch", "traced-branch-ok",
+        "if/while/assert on a value that reaches a jit-traced body "
+        "(retraces per value or raises TracerBoolConversionError)",
+        dataflow_scope,
+    )(_check_for("traced-python-branch"))
+    register(
+        "weak-type-cache-split", "weak-type-ok",
+        "dtype-less Python literal flowing into a jitted call (weak-type "
+        "promotion splits the executable cache on the same shapes)",
+        dataflow_scope,
+    )(_check_for("weak-type-cache-split"))
+    register(
+        "unhashable-static-arg", "static-arg-ok",
+        "dict/list/lambda reaching a jit/lower static position "
+        "(unhashable cache key fails at first dispatch)",
+        dataflow_scope,
+    )(_check_for("unhashable-static-arg"))
+    register(
+        "host-sync-on-tracer", "tracer-sync-ok",
+        "int()/float()/np.asarray on a traced value in engine/solver "
+        "paths (a silent device round-trip the sync-ok grep cannot see)",
+        sync_scope,
+    )(_check_for("host-sync-on-tracer"))

@@ -16,8 +16,8 @@ import json
 # Rules whose findings mean "the committed golden table disagrees with
 # the tree" rather than "the tree violates an invariant" — a distinct
 # severity (and CLI exit status) because the remedy is different:
-# re-bless the table, or revert the schedule change.
-DRIFT_RULES = frozenset({"hlo-golden", "hlo-census"})
+# re-bless the table, or revert the schedule/keyspace change.
+DRIFT_RULES = frozenset({"hlo-golden", "hlo-census", "keyspace-golden"})
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -52,8 +52,14 @@ class Finding:
 
 
 def dedup(findings) -> list[Finding]:
-    """Sorted, duplicate-free view (alias chains can hit one line twice)."""
-    return sorted(set(findings))
+    """Sorted view, duplicate-free by (path, line, rule): alias chains
+    can hit one line twice, and one site reached through two scope
+    predicates (or two message spellings of the same violation) is still
+    ONE finding to fix — the first (lowest-sorting) message wins."""
+    out: dict[tuple[str, int, str], Finding] = {}
+    for f in sorted(findings):
+        out.setdefault((f.path, f.line, f.rule), f)
+    return list(out.values())
 
 
 def render_text(findings) -> str:

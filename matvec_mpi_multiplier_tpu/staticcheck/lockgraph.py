@@ -55,7 +55,7 @@ import hashlib
 from pathlib import Path
 from typing import Iterator
 
-from .corpus import SourceFile, iter_corpus
+from .corpus import SourceFile, iter_corpus, source_file
 
 _PKG = "matvec_mpi_multiplier_tpu"
 
@@ -372,6 +372,13 @@ class LockGraph:
         self.findings: dict[str, dict[str, list[tuple[ast.AST, str]]]] = {
             rule: {} for rule in LOCKGRAPH_RULES
         }
+        # rel -> line spans where a '# lock-order-ok:' marker actually
+        # DROPPED an edge. This rule consumes its marker before cycle
+        # detection (an exempted edge suppresses the whole cycle, so no
+        # raw finding ever surfaces at the marked site — or at its
+        # sibling edges); the stale-marker audit must take these spans
+        # as live coverage or every working exemption looks rotted.
+        self.marker_hits: dict[str, set[int]] = {}
         self._build()
         self._normalize_locks()
         self._refine_locked_helpers()
@@ -387,12 +394,11 @@ class LockGraph:
             if not lockgraph_scope(rel):
                 continue
             try:
-                sf = SourceFile(path, self.root)
+                sf = source_file(path, self.root)
             except (SyntaxError, UnicodeDecodeError):
                 continue  # run_rules owns the parse-error finding
-            for node in ast.walk(sf.tree):
-                if isinstance(node, ast.ClassDef):
-                    self._ingest_class(sf, node)
+            for node in sf.nodes(ast.ClassDef):
+                self._ingest_class(sf, node)
             for node in sf.tree.body:
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     meth = _Method(None, node.name, sf, node)
@@ -675,7 +681,14 @@ class LockGraph:
             if h == lk or h == _ANY or lk == _ANY:
                 return
             if "lock-order-ok:" in sf.span_comments(node):
-                return  # marker drops the edge before cycle detection
+                # Marker drops the edge before cycle detection; record
+                # the consumed span so the stale audit sees it as live.
+                first = getattr(node, "lineno", 0)
+                last = getattr(node, "end_lineno", first) or first
+                self.marker_hits.setdefault(sf.rel, set()).update(
+                    range(first, last + 1)
+                )
+                return
             edges.setdefault((h, lk), []).append((sf, node, via))
 
         for m in self.all_methods:
@@ -873,6 +886,10 @@ def register_lockgraph_rules(register) -> None:
         "cycle in the cross-class lock-acquisition order graph (two "
         "threads taking the same locks in opposite orders can deadlock)",
         lockgraph_scope,
+        # This rule consumes its marker inside the graph build (an
+        # exempted edge never reaches cycle detection), so it reports
+        # the consumed spans for the stale-marker audit itself.
+        covered=lambda sf: analyze(sf.root).marker_hits.get(sf.rel, ()),
     )(_check_for("lock-order-inversion"))
     register(
         "callback-under-lock", "callback-ok",

@@ -67,7 +67,9 @@ import dataclasses
 from pathlib import Path
 from typing import Callable, Iterable, Iterator
 
-from .corpus import SourceFile, iter_corpus, repo_root
+from .corpus import SourceFile, iter_corpus, repo_root, source_file
+from .dataflow import new_generation as dataflow_new_generation
+from .dataflow import register_dataflow_rules
 from .findings import Finding, dedup
 from .lockgraph import new_generation as lockgraph_new_generation
 from .lockgraph import register_lockgraph_rules
@@ -87,14 +89,20 @@ class Rule:
     description: str                # one line, shown by --list
     scope: Callable[[str], bool]    # repo-relative posix path predicate
     check: Callable[[SourceFile], Iterator[tuple[ast.AST, str]]]
+    # Line spans where the rule consumed its marker INTERNALLY (before
+    # any finding could surface — lock-order-inversion drops exempted
+    # edges ahead of cycle detection, which also suppresses the sibling
+    # edges of the cycle). The stale-marker audit unions these into its
+    # live coverage; None for rules whose raw findings reach run_rules.
+    covered: Callable[[SourceFile], Iterable[int]] | None = None
 
 
 RULES: dict[str, Rule] = {}
 
 
-def _register(name, marker, description, scope):
+def _register(name, marker, description, scope, covered=None):
     def deco(fn):
-        RULES[name] = Rule(name, marker, description, scope, fn)
+        RULES[name] = Rule(name, marker, description, scope, fn, covered)
         return fn
 
     return deco
@@ -127,6 +135,8 @@ def _marker_reason_findings(
         if not rule.marker:
             continue
         token = f"{rule.marker}:"
+        if token not in sf.text:
+            continue  # skip the tokenize pass for marker-free files
         for lineno, comment in sf.comments.items():
             if token in comment and not comment.split(token, 1)[1].strip():
                 yield Finding(
@@ -135,6 +145,48 @@ def _marker_reason_findings(
                     f"exemption marker documents WHY, or it is an escape "
                     f"hatch)",
                 )
+
+
+STALE_MARKER = "stale-ok"
+
+
+def _stale_marker_findings(
+    sf: SourceFile, rules: Iterable[Rule], covered: dict[str, set[int]]
+) -> Iterator[Finding]:
+    """Exemption markers must sit where their rule actually FIRES —
+    exemptions rot as code changes, and a rotted one silently blesses
+    the next real finding at that site. ``covered`` maps each in-scope
+    rule's marker to the line spans its raw (pre-exemption) findings
+    touched this run; a marker comment outside every span is stale.
+    ``# stale-ok: reason`` keeps a deliberately anticipatory marker."""
+    stale_token = f"{STALE_MARKER}:"
+    for rule in rules:
+        if not rule.marker:
+            continue
+        token = f"{rule.marker}:"
+        if token not in sf.text:
+            continue  # skip the tokenize pass for marker-free files
+        live = covered.get(rule.marker, set())
+        for lineno, comment in sf.comments.items():
+            if token not in comment or lineno in live:
+                continue
+            if stale_token in comment:
+                if not comment.split(stale_token, 1)[1].strip():
+                    yield Finding(
+                        sf.rel, lineno, "marker-missing-reason",
+                        f"'# {stale_token}' without a reason (the "
+                        f"stale-marker escape hatch documents WHY the "
+                        f"marker is kept ahead of its rule)",
+                    )
+                continue
+            yield Finding(
+                sf.rel, lineno, "stale-marker",
+                f"'# {token}' comment but {rule.name} no longer fires "
+                f"at this site — the exemption has rotted; drop the "
+                f"marker, or keep it deliberately with "
+                f"'# {stale_token} reason'",
+                marker=STALE_MARKER,
+            )
 
 
 def run_rules(
@@ -149,13 +201,14 @@ def run_rules(
         list(RULES.values()) if rules is None
         else [get_rule(n) for n in rules]
     )
-    # One corpus validation per run for the whole-program lock-graph
-    # rules (their per-file checks share the run's analysis).
+    # One corpus validation per run for the whole-program lock-graph and
+    # value-flow rules (their per-file checks share the run's analysis).
     lockgraph_new_generation()
+    dataflow_new_generation()
     findings: list[Finding] = []
     for path in iter_corpus(root):
         try:
-            sf = SourceFile(path, root)
+            sf = source_file(path, root)
         except (SyntaxError, UnicodeDecodeError) as e:
             rel = path.relative_to(root).as_posix()
             findings.append(
@@ -164,15 +217,29 @@ def run_rules(
             )
             continue
         in_scope = [r for r in selected if r.scope(sf.rel)]
+        # marker -> line numbers its rule's RAW findings span, feeding
+        # the stale-marker audit below.
+        covered: dict[str, set[int]] = {}
         for rule in in_scope:
+            if rule.marker and rule.covered is not None:
+                covered.setdefault(rule.marker, set()).update(
+                    rule.covered(sf)
+                )
             for node, message in rule.check(sf):
-                if rule.marker and _exempt(sf, node, rule.marker):
-                    continue
+                if rule.marker:
+                    lineno = getattr(node, "lineno", 0)
+                    end = getattr(node, "end_lineno", None) or lineno
+                    covered.setdefault(rule.marker, set()).update(
+                        range(lineno, end + 1)
+                    )
+                    if _exempt(sf, node, rule.marker):
+                        continue
                 findings.append(
                     Finding(sf.rel, getattr(node, "lineno", 0), rule.name,
                             message, marker=rule.marker)
                 )
         findings.extend(_marker_reason_findings(sf, in_scope))
+        findings.extend(_stale_marker_findings(sf, in_scope, covered))
     return dedup(findings)
 
 
@@ -189,7 +256,7 @@ def check_marker_reasons(
         if not rule.scope(rel):
             continue
         try:
-            sf = SourceFile(path, root)
+            sf = source_file(path, root)
         except (SyntaxError, UnicodeDecodeError):
             continue  # run_rules owns the parse-error finding
         findings.extend(_marker_reason_findings(sf, [rule]))
@@ -286,7 +353,14 @@ def _check_shard_map(sf: SourceFile):
         "direct shard_map reference; route it through "
         f"{_PKG}.utils.compat so a JAX API bump stays a one-file change"
     )
-    for node in ast.walk(sf.tree):
+    # Any hit — import, attribute chain, or aliased bare name — requires
+    # the literal text somewhere in the file (the alias's own import
+    # line at minimum), so skip the per-name resolution scan without it.
+    if "shard_map" not in sf.text:
+        return
+    for node in sf.nodes(
+        ast.ImportFrom, ast.Import, ast.Attribute, ast.Name
+    ):
         if isinstance(node, ast.ImportFrom):
             mod = node.module or ""
             if mod in ("jax", "jax.experimental") and any(
@@ -321,7 +395,7 @@ _SYNC_CALLS = ("numpy.asarray", "numpy.array", "jax.numpy.asarray")
     _engine,
 )
 def _check_host_sync(sf: SourceFile):
-    for call in _calls(sf.tree):
+    for call in sf.nodes(ast.Call):
         fn = call.func
         attr = fn.attr if isinstance(fn, ast.Attribute) else (
             fn.id if isinstance(fn, ast.Name) else None
@@ -351,7 +425,7 @@ _FULL_WIDTH = ("jax.lax.all_gather", "jax.lax.psum")
     _overlap_bodies,
 )
 def _check_overlap(sf: SourceFile):
-    for call in _calls(sf.tree):
+    for call in sf.nodes(ast.Call):
         q = sf.qualname(call.func)
         if q in _FULL_WIDTH:
             yield call, (
@@ -374,7 +448,7 @@ _IO_CALLS = ("open", "io.open", "json.dump")
     _hot_path,
 )
 def _check_blocking_io(sf: SourceFile):
-    for call in _calls(sf.tree):
+    for call in sf.nodes(ast.Call):
         fn = call.func
         q = sf.qualname(fn) or ""
         if q in _IO_CALLS:
@@ -419,7 +493,7 @@ _F64_CTORS = ("numpy.float64", "jax.numpy.float64")
     _package,
 )
 def _check_fp64(sf: SourceFile):
-    for call in _calls(sf.tree):
+    for call in sf.nodes(ast.Call):
         q = sf.qualname(call.func) or ""
         if q in _F64_CTORS:
             yield call, (
@@ -525,8 +599,8 @@ def _walk_excluding_deferred(nodes: Iterable[ast.AST]) -> Iterator[ast.AST]:
     _scheduler,
 )
 def _check_lock_across_dispatch(sf: SourceFile):
-    for node in ast.walk(sf.tree):
-        if not isinstance(node, ast.With) or not _lockish_with(node):
+    for node in sf.nodes(ast.With):
+        if not _lockish_with(node):
             continue
         for inner in _walk_excluding_deferred(node.body):
             if not isinstance(inner, ast.Call):
@@ -614,9 +688,7 @@ def _handler_records(handler: ast.ExceptHandler) -> bool:
     _package,
 )
 def _check_silent_except(sf: SourceFile):
-    for node in ast.walk(sf.tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
+    for node in sf.nodes(ast.ExceptHandler):
         if not _handler_is_broad(sf, node):
             continue
         if _handler_records(node):
@@ -667,7 +739,7 @@ def _is_f64_dtype_expr(sf: SourceFile, node: ast.AST) -> bool:
     _quant_scope,
 )
 def _check_quant_fp64(sf: SourceFile):
-    for call in _calls(sf.tree):
+    for call in sf.nodes(ast.Call):
         fn = call.func
         if isinstance(fn, ast.Attribute) and fn.attr == "astype" and any(
             _is_f64_dtype_expr(sf, arg) for arg in call.args
@@ -723,8 +795,8 @@ _REGISTRY_LOCK_CALLS = (
     _engine,
 )
 def _check_registry_lock(sf: SourceFile):
-    for node in ast.walk(sf.tree):
-        if not isinstance(node, ast.With) or not _lockish_with(node):
+    for node in sf.nodes(ast.With):
+        if not _lockish_with(node):
             continue
         for inner in _walk_excluding_deferred(node.body):
             if not isinstance(inner, ast.Call):
@@ -774,7 +846,7 @@ _MEASUREMENT_CALLS = (
     _admission_scope,
 )
 def _check_admission_measurement(sf: SourceFile):
-    for call in _calls(sf.tree):
+    for call in sf.nodes(ast.Call):
         fn = call.func
         attr = fn.attr if isinstance(fn, ast.Attribute) else (
             fn.id if isinstance(fn, ast.Name) else None
@@ -801,11 +873,9 @@ _MUTABLE_FACTORIES = (
     _package,
 )
 def _check_mutable_default(sf: SourceFile):
-    for node in ast.walk(sf.tree):
-        if not isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-        ):
-            continue
+    for node in sf.nodes(
+        ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda
+    ):
         for default in _defaults(node.args):
             if isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
                 isinstance(default, ast.Call)
@@ -826,7 +896,7 @@ def _check_mutable_default(sf: SourceFile):
 # bound and OOMs the snapshot long before anything else complains.
 # Dynamic names are legal where the label SOURCE is bounded (tenant ids
 # capped by the registered fleet, declared SLO target names); those
-# sites say so with '# cardinality-ok: <reason>'.
+# sites say so with '# cardinality-ok: <reason>'. — stale-ok: syntax documentation, not an exemption
 
 
 _METRIC_CTORS = ("counter", "gauge", "histogram", "rate_estimator",
@@ -867,7 +937,7 @@ def _is_constructed_name(node: ast.AST) -> bool:
     _package,
 )
 def _check_metric_cardinality(sf: SourceFile):
-    loops = [n for n in ast.walk(sf.tree) if isinstance(n, _LOOP_NODES)]
+    loops = sf.nodes(*_LOOP_NODES)
     seen: set[int] = set()
     for loop in loops:
         for call in _calls(loop):
@@ -894,5 +964,29 @@ def _check_metric_cardinality(sf: SourceFile):
 # registers through the same decorator so markers, fixtures and the CLI
 # inherit; registration precedes the MARKERS snapshot below.
 register_lockgraph_rules(_register)
+register_dataflow_rules(_register)
 
 MARKERS: dict[str, str] = _markers()
+
+# Canonical one-line scope descriptions keyed by scope-predicate name —
+# the single vocabulary docs/STATIC_ANALYSIS.md's rule-index table must
+# use (tests/test_staticcheck.py's doc-drift gate compares the table's
+# scope column against scope_label()).
+_SCOPE_LABELS: dict[str, str] = {
+    "_all_but_compat": "package minus utils/compat.py",
+    "_engine": "engine/",
+    "_overlap_bodies": "parallel/ring.py, ops/pallas_collective.py",
+    "_hot_path": "engine/ + obs/ (minus sink, CLI)",
+    "_package": "package",
+    "_scheduler": "engine/scheduler.py",
+    "_quant_scope": "ops/quantize.py, ops/pallas_quant.py",
+    "_admission_scope": "engine/global_scheduler.py",
+    "lockgraph_scope": "engine/, obs/, resilience/, tuning/",
+    "dataflow_scope": "package",
+    "sync_scope": "engine/ + solvers/",
+}
+
+
+def scope_label(name: str) -> str:
+    """The canonical scope string for one rule (doc-drift gate API)."""
+    return _SCOPE_LABELS[get_rule(name).scope.__name__]

@@ -353,7 +353,7 @@ class TuningCache:
             try:
                 os.replace(self.path, self.corrupt_path)
             except OSError:
-                pass  # swallow-ok: the corrupt file disappeared between load and save — there is no evidence left to preserve
+                pass  # the corrupt file disappeared between load and save — there is no evidence left to preserve
             self.quarantined = False
         payload = {"version": CACHE_VERSION, "entries": self.entries}
         fd, tmp = tempfile.mkstemp(

@@ -26,7 +26,32 @@
 set -eu
 cd "$(dirname "$0")/.."
 
+# The rule layer rides inside a hard latency budget: the whole point of
+# a pure-AST tier (no jax import — the package re-exports are lazy, no
+# device backend, content-hashed whole-program caches) is that it runs
+# on every edit. A wall-clock regression here means someone taxed the
+# hot path; fail loudly instead of letting the lint tier quietly decay
+# into a suite-speed tool. Python's own perf_counter, not `time`(1):
+# POSIX sh offers no portable sub-second arithmetic.
+lint_t0=$(python -c 'import time; print(time.perf_counter())')
 python -m matvec_mpi_multiplier_tpu.staticcheck --rules
+python - "$lint_t0" <<'PY'
+import sys, time
+elapsed = time.perf_counter() - float(sys.argv[1])
+budget = 3.0
+print(f"lint wall-clock: {elapsed:.2f}s (budget {budget:.0f}s)")
+if elapsed >= budget:
+    sys.exit(f"--rules took {elapsed:.2f}s, over the {budget:.0f}s "
+             "tier-1 budget (did a rule start importing jax or "
+             "re-walking the corpus per rule?)")
+PY
+
+# Keyspace smoke: the symbolic ExecKey-space audit (enumeration vs the
+# committed golden + the steady-subset-of-warmup compile budget) is
+# jax-free and sub-second, so it rides the lint tier — a widened compile
+# surface or an unwarmed steady key fails here before the suite spends
+# runtime proving compiles_steady == 0 dynamically.
+python -m matvec_mpi_multiplier_tpu.staticcheck --keyspace
 [ "${1:-}" = "--lint-only" ] && exit 0
 
 # Chaos smoke: one seeded --fault-spec serve trace end-to-end through the

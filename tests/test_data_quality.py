@@ -950,6 +950,113 @@ def test_golden_schedule_pins_fused_solver_census():
         fused["cg|colwise|psum|int8c"]["census"]
 
 
+# ---- the golden keyspace table (layer 3's committed artifact) ----
+# Same doctrine as the schedule golden: these gates hold the FILE to
+# schema and to the compile-budget invariant; whether the pinned key
+# sets still match what the enumerator derives (and what the engine's
+# own key constructors mint) is tests/test_staticcheck.py's job.
+
+GOLDEN_KEYSPACE = REPO / "data" / "staticcheck" / "golden_keyspace.json"
+
+_KEYSPACE_CLASSES = ("warmup", "steady", "fault_only", "rollover")
+
+
+def _golden_keyspace():
+    import json
+
+    assert GOLDEN_KEYSPACE.is_file(), (
+        "golden keyspace table missing; bless with `python -m "
+        "matvec_mpi_multiplier_tpu.staticcheck --keyspace --write-golden`"
+    )
+    return json.loads(GOLDEN_KEYSPACE.read_text())
+
+
+def test_golden_keyspace_schema_and_budget():
+    """The committed compile-surface artifact: schema-versioned, exactly
+    the pinned config set, every entry carrying the four compile classes
+    plus a budget whose steady_beyond_warmup is ZERO — the static
+    compiles_steady == 0 proof, readable off the file alone."""
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import (
+        KEYSPACE_CONFIGS,
+        KEYSPACE_SCHEMA,
+    )
+
+    payload = _golden_keyspace()
+    assert payload["schema"] == KEYSPACE_SCHEMA
+    configs = payload["configs"]
+    assert set(configs) == {cfg.name for cfg in KEYSPACE_CONFIGS}
+    for name, entry in configs.items():
+        assert set(entry) == {"serve", "budget", *_KEYSPACE_CLASSES}, name
+        for cls in _KEYSPACE_CLASSES:
+            labels = entry[cls]
+            assert labels == sorted(labels), (name, cls)
+            assert len(set(labels)) == len(labels), (name, cls)
+            # Every label parses as an ExecKey label: op:strategy:kernel:
+            # combine:bucket:dtype[:storage].
+            for label in labels:
+                parts = label.split(":")
+                assert len(parts) in (6, 7), (name, label)
+                assert parts[4].isdigit(), (name, label)
+        steady, warm = set(entry["steady"]), set(entry["warmup"])
+        assert steady <= warm, (name, sorted(steady - warm))
+        budget = entry["budget"]
+        assert budget["steady_beyond_warmup"] == 0, name
+        assert budget["warmup"] == len(warm), name
+        assert budget["total"] == len(
+            warm | steady | set(entry["fault_only"]) | set(entry["rollover"])
+        ), name
+    # The reshard config is the one that exercises the rollover class —
+    # the golden must keep covering it.
+    assert configs["rowwise_reshard"]["rollover"], (
+        "the reshard config lost its rollover pins"
+    )
+
+
+def test_golden_keyspace_claim_matches_committed_serve_evidence():
+    """The static claim against the dynamic evidence: every committed
+    healthy-serve capture's compiles_steady counter is 0, and the one
+    chaos capture's post-warmup compiles stay inside the enumerated
+    fault surface (degradation tiers ARE the fault_only class — chaos
+    may compile them, steady routing never does)."""
+    import csv
+
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import (
+        ServeConfig,
+        enumerate_keyspace,
+    )
+
+    chaos = REPO / "data" / "resilience_demo" / "out" / "serve_colwise.csv"
+    seen = []
+    for path in sorted((REPO / "data").rglob("*.csv")):
+        with open(path) as fh:
+            rows = list(csv.DictReader(fh, skipinitialspace=True))
+        if not rows or "compiles_steady" not in rows[0]:
+            continue
+        seen.append(path)
+        if path == chaos:
+            continue
+        for row in rows:
+            assert int(row["compiles_steady"]) == 0, (
+                f"{path.relative_to(REPO)}: a committed healthy-serve "
+                f"capture recompiled in steady state: {row}"
+            )
+    assert len(seen) >= 8, seen  # the evidence base itself must not rot
+
+    with open(chaos) as fh:
+        row = next(csv.DictReader(fh, skipinitialspace=True))
+    space = enumerate_keyspace(ServeConfig(
+        name="resilience_demo", strategy=row["strategy"],
+        combine=row["combine"], promote=int(row["b_star"]),
+        max_bucket=int(row["max_bucket"]),
+    ))
+    assert int(row["compiles_warmup"]) == len(space.warmup), row
+    post_warmup = int(row["compiles_steady"])
+    assert 0 < post_warmup <= len(space.fault_only), (
+        "the chaos capture's post-warmup compiles escaped the "
+        f"enumerated fault surface: {post_warmup} vs {space.fault_only}"
+    )
+
+
 # ---- quantized_demo: the committed storage-axis capture (ISSUE 8) ----
 #
 # Artifacts: tuning_cache.json (the v4 sixth-axis race: winners +

@@ -313,6 +313,76 @@ RULE_FIXTURES = {
         "                'per-tenant',\n"
         "            ).inc()\n",
     ),
+    "traced-python-branch": (
+        f"{PKG}/ops/seeded.py",
+        # a Python `if` on a traced value: TracerBoolConversionError at
+        # trace time, or a silently specialized branch if it concretizes
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if x.sum() > 0:\n"
+        "        return x\n"
+        "    return -x\n",
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if x.sum() > 0:  # traced-branch-ok: seeded sign dispatch\n"
+        "        return x\n"
+        "    return -x\n",
+    ),
+    "weak-type-cache-split": (
+        f"{PKG}/ops/seeded.py",
+        # a bare Python float reaching a jitted arg: weak-typed avals
+        # split the compile cache against strongly-typed callers
+        "import jax\n"
+        "@jax.jit\n"
+        "def g(x, scale):\n"
+        "    return x * scale\n"
+        "def serve(x):\n"
+        "    s = 0.5\n"
+        "    return g(x, s)\n",
+        # the discipline: pin the dtype before the call boundary
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def g(x, scale):\n"
+        "    return x * scale\n"
+        "def serve(x):\n"
+        "    s = jnp.float32(0.5)\n"
+        "    return g(x, s)\n",
+    ),
+    "unhashable-static-arg": (
+        f"{PKG}/ops/seeded.py",
+        # a list into a static_argnames position: TypeError (unhashable)
+        # at dispatch — static args key the compile cache by hash
+        "import jax\n"
+        "def f(x, tiles):\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnames=('tiles',))\n"
+        "def serve(x):\n"
+        "    return g(x, tiles=[8, 16])\n",
+        "import jax\n"
+        "def f(x, tiles):\n"
+        "    return x\n"
+        "g = jax.jit(f, static_argnames=('tiles',))\n"
+        "def serve(x):\n"
+        "    return g(x, tiles=(8, 16))\n",
+    ),
+    "host-sync-on-tracer": (
+        f"{PKG}/engine/seeded.py",
+        # float() on a tracer inside a jitted body: a host materialization
+        # the trace cannot express — ConcretizationTypeError at trace time
+        "import jax\n"
+        "@jax.jit\n"
+        "def norm(x):\n"
+        "    s = float(x[0])\n"
+        "    return s\n",
+        "import jax\n"
+        "@jax.jit\n"
+        "def norm(x):\n"
+        "    s = float(x[0])  # tracer-sync-ok: seeded deliberate abstraction break\n"
+        "    return s\n",
+    ),
 }
 
 # The PR-6 scope-extension pins: the engine host-sync and hot-path I/O
@@ -1687,3 +1757,388 @@ def test_mutation_redundant_collective_fails_reshard_audit(
         "redundant" in f.message or "census" in f.message
         for f in findings if f.rule == "hlo-reshard-schedule"
     )
+
+
+# ------------------------------------------- stale-marker audit (satellite)
+
+
+def test_stale_marker_is_flagged_and_stale_ok_suppresses(tmp_path):
+    """A marker comment whose rule no longer fires anywhere on its lines
+    is lint debt — flagged as `stale-marker`; a same-line
+    `stale-ok: <reason>` keeps a deliberately anticipatory marker; a
+    reasonless `stale-ok:` is itself a finding (the escape hatch cannot
+    be silent)."""
+    rel = f"{PKG}/engine/seeded.py"
+    _seed(
+        tmp_path, rel,
+        "def dispatch(y):\n"
+        "    return y  # sync-ok: nothing here syncs anymore\n",
+    )
+    found = run_rules(root=tmp_path)
+    stale = [f for f in found if f.rule == "stale-marker"]
+    assert [(f.path, f.line) for f in stale] == [(rel, 2)], found
+    assert "sync-ok" in stale[0].message
+
+    _seed(
+        tmp_path, rel,
+        "def dispatch(y):\n"
+        "    return y  # sync-ok: anticipatory — stale-ok: pinned for the\n",
+    )
+    found = run_rules(root=tmp_path)
+    assert not [f for f in found if f.rule == "stale-marker"], found
+
+    _seed(
+        tmp_path, rel,
+        "def dispatch(y):\n"
+        "    return y  # sync-ok: anticipatory — stale-ok:\n",
+    )
+    found = run_rules(root=tmp_path)
+    assert any(
+        f.rule == "marker-missing-reason" and "stale-ok" in f.message
+        for f in found
+    ), found
+
+
+def test_live_marker_is_not_stale(tmp_path):
+    """The other direction: a marker actually suppressing a finding is
+    LIVE coverage, not debt — the engine-host-sync clean twin must not
+    trip the stale audit."""
+    rel, _bad, clean = RULE_FIXTURES["engine-host-sync"]
+    _seed(tmp_path, rel, clean)
+    found = run_rules(root=tmp_path)
+    assert not [f for f in found if f.rule == "stale-marker"], found
+
+
+def test_internally_consumed_lock_order_marker_is_live(tmp_path):
+    """The subtle liveness class: lock-order-inversion consumes its
+    marker INSIDE the graph build (the exempted edge is dropped before
+    cycle detection, which also silences the cycle's sibling edges), so
+    no raw finding ever reaches the span ledger. The rule's `covered`
+    hook must report those consumed spans as live — the marked fixture
+    may not be called stale."""
+    rel, bad, clean = RULE_FIXTURES["lock-order-inversion"]
+    # The edge is recorded at the cross-lock CALL site — the marker goes
+    # on that line (the repo's own `with`-line markers cover direct
+    # acquisition edges, whose node IS the with statement).
+    marked = bad.replace(
+        "            self.registry.seeded_charge()\n",
+        "            self.registry.seeded_charge()  # lock-order-ok: seeded proven ordering\n",
+    )
+    assert marked != bad  # the replace matched
+    _seed(tmp_path, rel, marked)
+    found = run_rules(root=tmp_path)
+    assert not [f for f in found if f.rule == "lock-order-inversion"], found
+    assert not [f for f in found if f.rule == "stale-marker"], found
+
+
+def test_repo_tree_has_no_stale_markers():
+    """The triage contract on the real tree: every committed marker
+    either suppresses a live finding, is internally consumed
+    (lock-order edges), or carries a `stale-ok:` reason."""
+    found = run_rules()
+    assert not [f for f in found if f.rule == "stale-marker"], [
+        (f.path, f.line, f.message) for f in found if f.rule == "stale-marker"
+    ]
+
+
+# -------------------------------------------- findings mechanics (satellite)
+
+
+def test_dedup_collapses_by_path_line_rule():
+    from matvec_mpi_multiplier_tpu.staticcheck.findings import (
+        Finding,
+        dedup,
+    )
+
+    a1 = Finding("x.py", 3, "engine-host-sync", "b message")
+    a2 = Finding("x.py", 3, "engine-host-sync", "a message")
+    other_line = Finding("x.py", 4, "engine-host-sync", "c")
+    other_rule = Finding("x.py", 3, "hot-path-blocking-io", "d")
+    out = dedup([a1, a2, other_line, other_rule, a1])
+    assert len(out) == 3
+    kept = {(f.path, f.line, f.rule): f.message for f in out}
+    # first-sorted message wins for the collapsed pair
+    assert kept[("x.py", 3, "engine-host-sync")] == "a message"
+
+
+def test_exit_status_keyspace_precedence():
+    """keyspace-steady-unwarmed is a hard artifact failure (exit 3, like
+    HLO invariants); keyspace-golden alone is drift (exit 4); any AST
+    rule finding still dominates both."""
+    from matvec_mpi_multiplier_tpu.staticcheck.__main__ import (
+        EXIT_DRIFT,
+        EXIT_HLO,
+        EXIT_RULES,
+        exit_status,
+    )
+    from matvec_mpi_multiplier_tpu.staticcheck.findings import Finding
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import GOLDEN_REL
+
+    hard = Finding(GOLDEN_REL, 0, "keyspace-steady-unwarmed", "m")
+    drift = Finding(GOLDEN_REL, 0, "keyspace-golden", "m")
+    rule = Finding("x.py", 3, "engine-host-sync", "m", marker="sync-ok")
+    assert drift.severity == "drift"  # DRIFT_RULES owns the severity
+    assert hard.severity == "error"
+    assert exit_status([drift]) == EXIT_DRIFT
+    assert exit_status([hard, drift]) == EXIT_HLO
+    assert exit_status([rule, hard, drift]) == EXIT_RULES
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_findings_round_trip_rule_severity_marker(rule, tmp_path):
+    """The property the --json consumers (CI artifact, the workflow's
+    jq gates) rely on: every rule's finding serializes with its
+    registry-declared rule id and marker, severity 'error', and
+    survives a JSON round trip field-for-field."""
+    from matvec_mpi_multiplier_tpu.staticcheck.findings import Finding
+
+    rel, bad, _clean = RULE_FIXTURES[rule]
+    _seed(tmp_path, rel, bad)
+    found = [f for f in run_rules(root=tmp_path, rules=[rule])
+             if f.rule == rule]
+    assert found
+    for f in found:
+        payload = json.loads(json.dumps(f.as_dict()))
+        assert payload["rule"] == rule
+        assert payload["severity"] == "error"
+        assert payload["marker"] == RULES[rule].marker
+        assert payload["path"] == rel and payload["line"] >= 1
+        assert Finding(**payload) == f
+
+
+def test_source_file_cache_shares_and_invalidates(tmp_path):
+    """One parse per content: repeated corpus access returns the SAME
+    SourceFile object, and an on-disk edit (fixture/mutation flows)
+    invalidates by content — never served stale."""
+    from matvec_mpi_multiplier_tpu.staticcheck.corpus import source_file
+
+    rel = f"{PKG}/ops/seeded.py"
+    _seed(tmp_path, rel, "A = 1\n")
+    path = tmp_path / rel
+    first = source_file(path, tmp_path)
+    assert source_file(path, tmp_path) is first
+    _seed(tmp_path, rel, "A = 2\n")
+    fresh = source_file(path, tmp_path)
+    assert fresh is not first and fresh.text == "A = 2\n"
+
+
+def test_dataflow_cache_invalidates_on_edit(tmp_path):
+    """The dataflow engine's per-file cache keys on content: editing a
+    clean file into a violating one (same path, same run pattern as the
+    fixture tests) must produce the finding — no stale verdicts."""
+    rel, bad, clean = RULE_FIXTURES["traced-python-branch"]
+    _seed(tmp_path, rel, clean)
+    assert run_rules(root=tmp_path, rules=["traced-python-branch"]) == []
+    _seed(tmp_path, rel, bad)
+    found = run_rules(root=tmp_path, rules=["traced-python-branch"])
+    assert any(f.rule == "traced-python-branch" for f in found), found
+
+
+# ------------------------------------------- keyspace audit (layer 3)
+
+
+def test_keyspace_audit_green_on_untouched_tree():
+    """The committed golden matches the enumerator and every pinned
+    config satisfies the compile budget — the `--keyspace` CLI tier."""
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import (
+        run_keyspace_audit,
+    )
+
+    assert run_keyspace_audit(REPO) == []
+
+
+def test_keyspace_budget_proves_steady_subset_of_warmup():
+    """The static compiles_steady == 0 proof, config by config: steady
+    routing never reaches a key warmup does not compile, and the budget
+    record says so."""
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import (
+        KEYSPACE_CONFIGS,
+        enumerate_keyspace,
+    )
+
+    assert len(KEYSPACE_CONFIGS) >= 8
+    for cfg in KEYSPACE_CONFIGS:
+        space = enumerate_keyspace(cfg)
+        assert set(space.steady) <= set(space.warmup), cfg.name
+        assert space.budget["steady_beyond_warmup"] == 0, cfg.name
+        assert space.budget["warmup"] == len(space.warmup)
+        assert space.budget["total"] == len(
+            set(space.warmup) | set(space.steady)
+            | set(space.fault_only) | set(space.rollover)
+        )
+        # The classes partition: fault/rollover never duplicate a
+        # warm/steady key (a key is classified by its FIRST compile).
+        assert not set(space.fault_only) & set(space.warmup)
+        assert not set(space.rollover) & set(space.warmup)
+
+
+def test_keyspace_golden_drift_detected_on_widened_surface():
+    """A silently widened keyspace (one extra warm key) and a missing
+    golden both surface as keyspace-golden findings — drift severity,
+    never a hard error."""
+    import copy
+
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import (
+        audit_table,
+        keyspace_table,
+        load_golden,
+    )
+
+    table = keyspace_table()
+    golden = load_golden(REPO)
+    assert golden is not None
+    assert audit_table(table, golden) == []
+
+    widened = copy.deepcopy(table)
+    name = sorted(widened["configs"])[0]
+    widened["configs"][name]["warmup"].append(
+        "gemm:rowwise:pallas:none:512:float64"
+    )
+    findings = audit_table(widened, golden)
+    assert any(
+        f.rule == "keyspace-golden" and name in f.message
+        and f.severity == "drift"
+        for f in findings
+    ), findings
+
+    findings = audit_table(table, None)
+    assert [f.rule for f in findings] == ["keyspace-golden"]
+
+
+def test_keyspace_mutation_unwarmed_steady_key_is_hard_red(monkeypatch):
+    """The budget gate bites: narrow the warmup enumeration by one
+    bucket (a warmup() that stops covering the ladder) and the audit
+    must go hard red (keyspace-steady-unwarmed) AND --write-golden must
+    refuse to bless the broken invariant."""
+    from matvec_mpi_multiplier_tpu.staticcheck import keyspace as ks
+
+    real = ks._warm_buckets
+
+    def narrowed(cfg):
+        buckets = real(cfg)
+        return set(sorted(buckets)[:-1]) if buckets else buckets
+
+    monkeypatch.setattr(ks, "_warm_buckets", narrowed)
+    findings = ks.audit_table(ks.keyspace_table(), ks.load_golden(REPO))
+    hard = [f for f in findings if f.rule == "keyspace-steady-unwarmed"]
+    assert hard, findings
+    assert all(f.severity == "error" for f in hard)
+    with pytest.raises(ValueError, match="refusing to bless"):
+        ks.write_golden_keyspace()
+    monkeypatch.undo()
+    assert ks.run_keyspace_audit(REPO) == []
+
+
+def test_keyspace_cross_check_engine_ground_truth(devices):
+    """The symbolic enumeration against the engine's own key
+    constructors (MatvecEngine.exec_keyspace): same warmup, steady and
+    fault-only label sets for a plain GEMM-ladder config and for the
+    solver-serving config — the static proof is about the REAL key
+    mint, not a parallel re-derivation."""
+    from matvec_mpi_multiplier_tpu.engine.core import MatvecEngine
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.solvers import SOLVER_OPS
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import (
+        ServeConfig,
+        enumerate_keyspace,
+    )
+
+    mesh = make_mesh(len(devices))
+    a = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+
+    space = enumerate_keyspace(
+        ServeConfig(name="x", strategy="rowwise", promote=8, max_bucket=32)
+    )
+    engine = MatvecEngine(
+        a, mesh, strategy="rowwise", promote=8, max_bucket=32,
+    )
+    try:
+        live = engine.exec_keyspace()
+        assert live["warmup"] == list(space.warmup)
+        assert live["steady"] == list(space.steady)
+        assert live["fault_only"] == list(space.fault_only)
+    finally:
+        engine.close()
+
+    space = enumerate_keyspace(ServeConfig(
+        name="x", strategy="rowwise", promote=None,
+        solver_ops=tuple(SOLVER_OPS),
+    ))
+    engine = MatvecEngine(a, mesh, strategy="rowwise", promote=None)
+    try:
+        live = engine.exec_keyspace(solver_ops=tuple(SOLVER_OPS))
+        assert live["warmup"] == list(space.warmup)
+        assert live["steady"] == list(space.steady)
+        assert live["fault_only"] == list(space.fault_only)
+    finally:
+        engine.close()
+
+
+def test_keyspace_covers_live_compile_set(devices):
+    """Dynamic containment: after warmup plus steady traffic (a
+    remainder width and a full bucket), every key the executable cache
+    actually compiled is inside the enumerated warmup set — the compiled
+    reality never escapes the static surface."""
+    from matvec_mpi_multiplier_tpu.engine.core import MatvecEngine
+    from matvec_mpi_multiplier_tpu.parallel.mesh import make_mesh
+    from matvec_mpi_multiplier_tpu.staticcheck.keyspace import (
+        ServeConfig,
+        enumerate_keyspace,
+    )
+
+    space = enumerate_keyspace(
+        ServeConfig(name="x", strategy="rowwise", promote=8, max_bucket=32)
+    )
+    mesh = make_mesh(len(devices))
+    a = np.arange(64 * 64, dtype=np.float32).reshape(64, 64)
+    engine = MatvecEngine(
+        a, mesh, strategy="rowwise", promote=8, max_bucket=32,
+    )
+    try:
+        engine.warmup()
+        engine.submit(np.ones((64, 5), np.float32)).result()
+        engine.submit(np.ones((64, 20), np.float32)).result()
+        compiled = {k.label() for k in engine._cache.keys()}
+    finally:
+        engine.close()
+    assert compiled <= set(space.warmup), compiled - set(space.warmup)
+
+
+# ------------------------------------------------ doc-drift gate (satellite)
+
+
+def test_rule_index_doc_matches_registry():
+    """docs/STATIC_ANALYSIS.md's rule-index table is test-checked
+    against the live registry in BOTH directions: every registered rule
+    has a row, no row names a dead rule, and each row's marker and
+    scope cells are the registry's own strings (MARKERS / scope_label)
+    — renaming, re-scoping or re-markering a rule without the doc is a
+    failure."""
+    import re
+
+    from matvec_mpi_multiplier_tpu.staticcheck import MARKERS
+    from matvec_mpi_multiplier_tpu.staticcheck.rules import scope_label
+
+    doc = (REPO / "docs" / "STATIC_ANALYSIS.md").read_text()
+    rows = {}
+    for line in doc.splitlines():
+        m = re.match(r"^\| `([a-z0-9-]+)` \|", line)
+        if not m or m.group(1) not in RULES:
+            continue
+        cells = [c.strip() for c in line.split("|")]
+        assert len(cells) == 6, f"malformed rule-index row: {line!r}"
+        rows[m.group(1)] = (cells[2], cells[3])
+    assert set(rows) == set(RULES), (
+        "rule-index table out of sync with the registry: "
+        f"doc-only={sorted(set(rows) - set(RULES))}, "
+        f"registry-only={sorted(set(RULES) - set(rows))}"
+    )
+    for rule, (marker_cell, scope_cell) in rows.items():
+        marker = RULES[rule].marker
+        want_marker = f"`{marker}`" if marker else "—"
+        assert marker_cell == want_marker, (rule, marker_cell, want_marker)
+        assert scope_cell == f"`{scope_label(rule)}`", (rule, scope_cell)
+    # The marker registry itself backs the doc's contract section.
+    assert MARKERS == {
+        r.marker: r.name for r in RULES.values() if r.marker
+    }
